@@ -1,0 +1,132 @@
+"""Crypto key tests (reference strategy: crypto/ed25519/ed25519_test.go,
+crypto/secp256k1/secp256k1_test.go)."""
+
+import hashlib
+
+import pytest
+
+from tmtpu.crypto import ed25519, ed25519_ref, secp256k1, tmhash
+from tmtpu.crypto.ripemd160 import ripemd160
+
+
+class TestEd25519Ref:
+    # RFC 8032 §7.1 test vectors
+    VECTORS = [
+        (
+            "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+            "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+            "",
+            "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+            "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+        ),
+        (
+            "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+            "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+            "72",
+            "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+            "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+        ),
+        (
+            "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+            "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+            "af82",
+            "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+            "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a",
+        ),
+    ]
+
+    @pytest.mark.parametrize("seed,pub,msg,sig", VECTORS)
+    def test_rfc8032_vectors(self, seed, pub, msg, sig):
+        seed, pub, msg, sig = (
+            bytes.fromhex(seed),
+            bytes.fromhex(pub),
+            bytes.fromhex(msg),
+            bytes.fromhex(sig),
+        )
+        assert ed25519_ref.public_key(seed) == pub
+        assert ed25519_ref.sign(seed, msg) == sig
+        assert ed25519_ref.verify(pub, msg, sig)
+        # corrupted signature / message / key all fail
+        bad = bytearray(sig)
+        bad[0] ^= 1
+        assert not ed25519_ref.verify(pub, msg, bytes(bad))
+        assert not ed25519_ref.verify(pub, msg + b"x", sig)
+
+    def test_noncanonical_s_rejected(self):
+        seed = bytes(32)
+        pub = ed25519_ref.public_key(seed)
+        sig = ed25519_ref.sign(seed, b"hello")
+        s = int.from_bytes(sig[32:], "little")
+        bad_s = s + ed25519_ref.L
+        bad = sig[:32] + bad_s.to_bytes(32, "little")
+        assert not ed25519_ref.verify(pub, b"hello", bad)
+
+    def test_ref_matches_openssl(self):
+        for i in range(8):
+            seed = hashlib.sha256(b"seed%d" % i).digest()
+            msg = b"msg%d" % i
+            pk = ed25519.PrivKeyEd25519(seed)
+            sig = pk.sign(msg)
+            assert sig == ed25519_ref.sign(seed, msg)
+            assert pk.pub_key().verify_signature(msg, sig)
+            assert ed25519_ref.verify(pk.pub_key().bytes(), msg, sig)
+
+
+class TestEd25519Key:
+    def test_sign_verify(self):
+        priv = ed25519.gen_priv_key()
+        pub = priv.pub_key()
+        msg = b"sign me"
+        sig = priv.sign(msg)
+        assert len(sig) == 64
+        assert pub.verify_signature(msg, sig)
+        assert not pub.verify_signature(b"other", sig)
+        assert not pub.verify_signature(msg, b"\x00" * 64)
+        assert not pub.verify_signature(msg, b"short")
+
+    def test_address(self):
+        priv = ed25519.gen_priv_key_from_secret(b"test-secret")
+        pub = priv.pub_key()
+        assert pub.address() == tmhash.sum_truncated(pub.bytes())
+        assert len(pub.address()) == 20
+
+    def test_deterministic_from_secret(self):
+        a = ed25519.gen_priv_key_from_secret(b"x")
+        b = ed25519.gen_priv_key_from_secret(b"x")
+        assert a.bytes() == b.bytes()
+        assert a.pub_key().equals(b.pub_key())
+
+
+class TestSecp256k1:
+    def test_sign_verify(self):
+        priv = secp256k1.gen_priv_key()
+        pub = priv.pub_key()
+        msg = b"sign me"
+        sig = priv.sign(msg)
+        assert len(sig) == 64
+        assert pub.verify_signature(msg, sig)
+        assert not pub.verify_signature(b"other", sig)
+
+    def test_low_s_enforced(self):
+        priv = secp256k1.gen_priv_key()
+        pub = priv.pub_key()
+        msg = b"malleable"
+        sig = priv.sign(msg)
+        r = sig[:32]
+        s = int.from_bytes(sig[32:], "big")
+        assert s <= secp256k1.HALF_N
+        high = (secp256k1.N - s).to_bytes(32, "big")
+        assert not pub.verify_signature(msg, r + high)
+
+    def test_address_len(self):
+        assert len(secp256k1.gen_priv_key().pub_key().address()) == 20
+
+
+def test_ripemd160_vectors():
+    # Standard test vectors from the RIPEMD-160 spec.
+    assert ripemd160(b"").hex() == "9c1185a5c5e9fc54612808977ee8f548b2258d31"
+    assert ripemd160(b"abc").hex() == "8eb208f7e05d987a9b044a8e98c6b087f15a0bfc"
+    assert (
+        ripemd160(b"message digest").hex()
+        == "5d0689ef49d2fae572b881b123a85ffa21595f36"
+    )

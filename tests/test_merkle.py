@@ -1,0 +1,65 @@
+"""Merkle tree tests (reference: crypto/merkle/tree_test.go, proof_test.go)."""
+
+import hashlib
+
+import pytest
+
+from tmtpu.crypto import merkle
+
+
+def test_empty_tree():
+    assert merkle.hash_from_byte_slices([]) == hashlib.sha256(b"").digest()
+
+
+def test_single_leaf():
+    item = b"hello"
+    assert (
+        merkle.hash_from_byte_slices([item])
+        == hashlib.sha256(b"\x00" + item).digest()
+    )
+
+
+def test_two_leaves():
+    a, b = b"a", b"b"
+    la = hashlib.sha256(b"\x00" + a).digest()
+    lb = hashlib.sha256(b"\x00" + b).digest()
+    expected = hashlib.sha256(b"\x01" + la + lb).digest()
+    assert merkle.hash_from_byte_slices([a, b]) == expected
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4, 5, 7, 8, 9, 33, 100])
+def test_proofs(n):
+    items = [b"item%d" % i for i in range(n)]
+    root = merkle.hash_from_byte_slices(items)
+    proof_root, proofs = merkle.proofs_from_byte_slices(items)
+    assert proof_root == root
+    for i, proof in enumerate(proofs):
+        assert proof.total == n
+        assert proof.index == i
+        proof.verify(root, items[i])
+        with pytest.raises(ValueError):
+            proof.verify(root, b"wrong")
+        if n > 1:
+            with pytest.raises(ValueError):
+                proof.verify(b"\x00" * 32, items[i])
+
+
+def test_proof_proto_roundtrip():
+    from tmtpu.types import pb
+
+    items = [b"x", b"y", b"z"]
+    _, proofs = merkle.proofs_from_byte_slices(items)
+    p = proofs[1]
+    restored = merkle.Proof.from_proto(pb.Proof.decode(p.to_proto().encode()))
+    assert restored.total == p.total
+    assert restored.index == p.index
+    assert restored.leaf_hash == p.leaf_hash
+    assert restored.aunts == p.aunts
+
+
+def test_split_point():
+    assert merkle._split_point(2) == 1
+    assert merkle._split_point(3) == 2
+    assert merkle._split_point(4) == 2
+    assert merkle._split_point(5) == 4
+    assert merkle._split_point(8) == 4

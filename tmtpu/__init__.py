@@ -1,0 +1,14 @@
+"""tendermint-tpu: a from-scratch BFT state-machine-replication framework.
+
+Capabilities mirror Tendermint Core v0.34 (reference layout documented in
+SURVEY.md), re-designed around a TPU-native batch-crypto backend: vote
+ingestion, commit verification, fast sync and light-client verification all
+route signature batches through a pluggable ``crypto.BatchVerifier`` whose
+``tpu`` backend runs ed25519 group arithmetic as JAX/XLA programs sharded over
+a TPU mesh, with vote-tally bitarrays and voting-power sums reduced on-device.
+"""
+
+from tmtpu.version import TMCoreSemVer, BlockProtocol, P2PProtocol, ABCISemVer
+
+__all__ = ["TMCoreSemVer", "BlockProtocol", "P2PProtocol", "ABCISemVer"]
+__version__ = TMCoreSemVer

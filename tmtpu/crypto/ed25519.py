@@ -1,0 +1,103 @@
+"""ed25519 keys (reference: crypto/ed25519/ed25519.go).
+
+Single-signature CPU path uses the ``cryptography`` (OpenSSL) backend with a
+pure-Python fallback (``ed25519_ref``); both implement the Go-stdlib
+cofactorless semantics that the TPU batch path reproduces bit-exactly.
+"""
+
+from __future__ import annotations
+
+import os
+
+from tmtpu.crypto import ed25519_ref, tmhash
+from tmtpu.crypto.keys import PrivKey, PubKey, register_key_type
+
+try:  # fast path: OpenSSL via the cryptography package
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+    from cryptography.exceptions import InvalidSignature
+
+    _HAVE_OPENSSL = True
+except Exception:  # pragma: no cover
+    _HAVE_OPENSSL = False
+
+KEY_TYPE = "ed25519"
+PUB_KEY_SIZE = 32
+PRIVATE_KEY_SIZE = 64  # seed || pubkey, matching Go's ed25519.PrivateKey
+SIGNATURE_SIZE = 64
+SEED_SIZE = 32
+
+
+class PubKeyEd25519(PubKey):
+    __slots__ = ("_bytes",)
+
+    def __init__(self, key_bytes: bytes):
+        if len(key_bytes) != PUB_KEY_SIZE:
+            raise ValueError(f"ed25519 pubkey must be {PUB_KEY_SIZE} bytes")
+        self._bytes = bytes(key_bytes)
+
+    def address(self) -> bytes:
+        # Address = first 20 bytes of SHA-256(pubkey)
+        # (crypto/ed25519/ed25519.go:120-124).
+        return tmhash.sum_truncated(self._bytes)
+
+    def bytes(self) -> bytes:
+        return self._bytes
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != SIGNATURE_SIZE:
+            return False
+        if _HAVE_OPENSSL:
+            try:
+                Ed25519PublicKey.from_public_bytes(self._bytes).verify(sig, msg)
+                return True
+            except (InvalidSignature, ValueError):
+                return False
+        return ed25519_ref.verify(self._bytes, msg, sig)
+
+    def type_value(self) -> str:
+        return KEY_TYPE
+
+
+class PrivKeyEd25519(PrivKey):
+    __slots__ = ("_seed", "_pub")
+
+    def __init__(self, key_bytes: bytes):
+        # Accept either a 32-byte seed or the Go-style 64-byte seed||pub.
+        if len(key_bytes) == SEED_SIZE:
+            seed = bytes(key_bytes)
+        elif len(key_bytes) == PRIVATE_KEY_SIZE:
+            seed = bytes(key_bytes[:SEED_SIZE])
+        else:
+            raise ValueError("ed25519 privkey must be 32 or 64 bytes")
+        self._seed = seed
+        self._pub = ed25519_ref.public_key(seed)
+
+    def bytes(self) -> bytes:
+        return self._seed + self._pub
+
+    def sign(self, msg: bytes) -> bytes:
+        if _HAVE_OPENSSL:
+            return Ed25519PrivateKey.from_private_bytes(self._seed).sign(msg)
+        return ed25519_ref.sign(self._seed, msg)
+
+    def pub_key(self) -> PubKey:
+        return PubKeyEd25519(self._pub)
+
+    def type_value(self) -> str:
+        return KEY_TYPE
+
+
+def gen_priv_key() -> PrivKeyEd25519:
+    return PrivKeyEd25519(os.urandom(SEED_SIZE))
+
+
+def gen_priv_key_from_secret(secret: bytes) -> PrivKeyEd25519:
+    """Deterministic key from a secret (crypto/ed25519/ed25519.go:103-112):
+    seed = SHA-256(secret).  Testing/tooling only."""
+    return PrivKeyEd25519(tmhash.sum(secret))
+
+
+register_key_type(KEY_TYPE, PubKeyEd25519, PrivKeyEd25519)

@@ -1,0 +1,212 @@
+"""Pure-Python ed25519 (RFC 8032) reference implementation.
+
+This module is the framework's *spec oracle* for ed25519 semantics
+(reference behavior: crypto/ed25519/ed25519.go:148-155, which defers to Go's
+stdlib / filippo.io edwards25519):
+
+- verification is **cofactorless**: checks ``[s]B == R + [h]A`` by
+  re-encoding ``R' = [s]B - [h]A`` and byte-comparing against the signature's
+  R bytes;
+- ``s`` must be canonical (``s < L``);
+- ``A`` must decode: canonical ``y < p`` and on-curve (mixed-order points are
+  accepted, exactly as Go stdlib does).
+
+It is deliberately slow-but-obvious; the fast paths are
+``cryptography``'s OpenSSL backend (CPU) and ``tmtpu.tpu`` (TPU batches),
+both differentially tested against this module. It is also used to
+precompute the fixed-base tables the TPU kernels load as constants.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Optional, Tuple
+
+# Field and curve parameters.
+P = 2**255 - 19
+L = 2**252 + 27742317777372353535851937790883648493
+D = (-121665 * pow(121666, P - 2, P)) % P  # curve constant d
+SQRT_M1 = pow(2, (P - 1) // 4, P)  # sqrt(-1) mod p
+
+# A point is (X, Y, Z, T) in extended twisted Edwards coordinates,
+# with x = X/Z, y = Y/Z, T = XY/Z.
+Point = Tuple[int, int, int, int]
+
+IDENTITY: Point = (0, 1, 1, 0)
+
+# Base point.
+_BY = 4 * pow(5, P - 2, P) % P
+_BX = None  # computed below
+
+
+def _recover_x(y: int, sign: int) -> Optional[int]:
+    """x from y via x^2 = (y^2-1)/(d*y^2+1); None if not square."""
+    if y >= P:
+        return None
+    x2 = (y * y - 1) * pow(D * y * y + 1, P - 2, P) % P
+    if x2 == 0:
+        if sign:
+            return None
+        return 0
+    # square root candidate: x = x2^((p+3)/8)
+    x = pow(x2, (P + 3) // 8, P)
+    if (x * x - x2) % P != 0:
+        x = x * SQRT_M1 % P
+    if (x * x - x2) % P != 0:
+        return None
+    if (x & 1) != sign:
+        x = P - x
+    return x
+
+
+_BX = _recover_x(_BY, 0)
+BASE: Point = (_BX, _BY, 1, _BX * _BY % P)
+
+
+def point_add(p: Point, q: Point) -> Point:
+    """Unified addition (add-2008-hwcd-3); complete for ed25519."""
+    X1, Y1, Z1, T1 = p
+    X2, Y2, Z2, T2 = q
+    A = (Y1 - X1) * (Y2 - X2) % P
+    B = (Y1 + X1) * (Y2 + X2) % P
+    C = T1 * 2 * D * T2 % P
+    Dv = Z1 * 2 * Z2 % P
+    E = B - A
+    F = Dv - C
+    G = Dv + C
+    H = B + A
+    return (E * F % P, G * H % P, F * G % P, E * H % P)
+
+
+def point_double(p: Point) -> Point:
+    """Dedicated doubling (dbl-2008-hwcd), valid for all points."""
+    X1, Y1, Z1, _ = p
+    A = X1 * X1 % P
+    B = Y1 * Y1 % P
+    C = 2 * Z1 * Z1 % P
+    H = A + B
+    E = (H - (X1 + Y1) * (X1 + Y1)) % P
+    G = A - B
+    F = C + G
+    return (E * F % P, G * H % P, F * G % P, E * H % P)
+
+
+def point_neg(p: Point) -> Point:
+    X, Y, Z, T = p
+    return (P - X if X else 0, Y, Z, P - T if T else 0)
+
+
+def point_equal(p: Point, q: Point) -> bool:
+    # x1/z1 == x2/z2  and  y1/z1 == y2/z2
+    return (p[0] * q[2] - q[0] * p[2]) % P == 0 and (
+        p[1] * q[2] - q[1] * p[2]
+    ) % P == 0
+
+
+def scalar_mult(s: int, p: Point) -> Point:
+    q = IDENTITY
+    while s > 0:
+        if s & 1:
+            q = point_add(q, p)
+        p = point_double(p)
+        s >>= 1
+    return q
+
+
+def point_compress(p: Point) -> bytes:
+    X, Y, Z, _ = p
+    zinv = pow(Z, P - 2, P)
+    x = X * zinv % P
+    y = Y * zinv % P
+    return int.to_bytes(y | ((x & 1) << 255), 32, "little")
+
+
+def point_decompress(s: bytes) -> Optional[Point]:
+    if len(s) != 32:
+        return None
+    val = int.from_bytes(s, "little")
+    sign = val >> 255
+    y = val & ((1 << 255) - 1)
+    if y >= P:
+        return None  # non-canonical encoding rejected (Go stdlib SetBytes)
+    x = _recover_x(y, sign)
+    if x is None:
+        return None
+    return (x, y, 1, x * y % P)
+
+
+def _sha512_mod_l(*chunks: bytes) -> int:
+    h = hashlib.sha512()
+    for c in chunks:
+        h.update(c)
+    return int.from_bytes(h.digest(), "little") % L
+
+
+def secret_expand(seed: bytes) -> Tuple[int, bytes]:
+    h = hashlib.sha512(seed).digest()
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    return a, h[32:]
+
+
+def public_key(seed: bytes) -> bytes:
+    a, _ = secret_expand(seed)
+    return point_compress(scalar_mult(a, BASE))
+
+
+def sign(seed: bytes, msg: bytes) -> bytes:
+    a, prefix = secret_expand(seed)
+    A = point_compress(scalar_mult(a, BASE))
+    r = _sha512_mod_l(prefix, msg)
+    R = point_compress(scalar_mult(r, BASE))
+    h = _sha512_mod_l(R, A, msg)
+    s = (r + h * a) % L
+    return R + int.to_bytes(s, 32, "little")
+
+
+def verify(pubkey: bytes, msg: bytes, sig: bytes) -> bool:
+    """Cofactorless verify, Go-stdlib-equivalent semantics."""
+    if len(sig) != 64 or len(pubkey) != 32:
+        return False
+    A = point_decompress(pubkey)
+    if A is None:
+        return False
+    Rbytes = sig[:32]
+    s = int.from_bytes(sig[32:], "little")
+    if s >= L:
+        return False  # non-canonical s rejected
+    h = _sha512_mod_l(Rbytes, pubkey, msg)
+    # R' = [s]B - [h]A, then byte-compare its encoding with sig's R.
+    Rprime = point_add(scalar_mult(s, BASE), point_neg(scalar_mult(h, A)))
+    return point_compress(Rprime) == Rbytes
+
+
+# ---------------------------------------------------------------------------
+# Table generation for the TPU fixed-base path (tmtpu/tpu/tables.py).
+
+
+def affine(p: Point) -> Tuple[int, int]:
+    zinv = pow(p[2], P - 2, P)
+    return p[0] * zinv % P, p[1] * zinv % P
+
+
+def fixed_base_window_table(window_bits: int = 4) -> List[List[Point]]:
+    """table[w][d] = [d * 2^(window_bits*w)]B in affine-normalized extended
+    coords (Z=1), d in [0, 2^window_bits).  Entry d=0 is the identity; the
+    TPU add formula is complete so no special-casing is needed on-device.
+    """
+    n_windows = (253 + window_bits - 1) // window_bits
+    out: List[List[Point]] = []
+    base = BASE
+    for _ in range(n_windows):
+        row = [IDENTITY]
+        acc = IDENTITY
+        for _d in range(1, 1 << window_bits):
+            acc = point_add(acc, base)
+            x, y = affine(acc)
+            row.append((x, y, 1, x * y % P))
+        out.append(row)
+        for _ in range(window_bits):
+            base = point_double(base)
+    return out

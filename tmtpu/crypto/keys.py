@@ -1,0 +1,106 @@
+"""Key interfaces (reference: crypto/crypto.go:22-42).
+
+``PubKey``: address / bytes / verify_signature / equals / type_value.
+``PrivKey``: bytes / sign / pub_key / equals / type_value.
+
+Concrete curves register themselves in ``KEY_TYPES`` so protobuf and JSON
+codecs (crypto/encoding/codec.go:14-63 analogue: tmtpu.crypto.encoding) can
+round-trip them by name.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Dict, Type
+
+ADDRESS_SIZE = 20
+
+
+class PubKey(ABC):
+    @abstractmethod
+    def address(self) -> bytes:
+        """20-byte address derived from the key bytes."""
+
+    @abstractmethod
+    def bytes(self) -> bytes:
+        ...
+
+    @abstractmethod
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        ...
+
+    @abstractmethod
+    def type_value(self) -> str:
+        ...
+
+    def equals(self, other: "PubKey") -> bool:
+        return (
+            isinstance(other, PubKey)
+            and self.type_value() == other.type_value()
+            and self.bytes() == other.bytes()
+        )
+
+    def __eq__(self, other):
+        return isinstance(other, PubKey) and self.equals(other)
+
+    def __hash__(self):
+        return hash((self.type_value(), self.bytes()))
+
+    def __repr__(self):
+        return f"PubKey{{{self.type_value()}:{self.bytes().hex().upper()}}}"
+
+
+class PrivKey(ABC):
+    @abstractmethod
+    def bytes(self) -> bytes:
+        ...
+
+    @abstractmethod
+    def sign(self, msg: bytes) -> bytes:
+        ...
+
+    @abstractmethod
+    def pub_key(self) -> PubKey:
+        ...
+
+    @abstractmethod
+    def type_value(self) -> str:
+        ...
+
+    def equals(self, other: "PrivKey") -> bool:
+        return (
+            isinstance(other, PrivKey)
+            and self.type_value() == other.type_value()
+            and self.bytes() == other.bytes()
+        )
+
+
+class BatchVerifier(ABC):
+    """Batch signature verification (new in this framework; no counterpart in
+    the reference, which verifies one-at-a-time — SURVEY.md §2.1).
+
+    Usage: ``add()`` any number of (pubkey, msg, sig) triples, then a single
+    ``verify()`` returns (all_ok, per-item validity list).  Implementations:
+    ``tmtpu.crypto.batch.CPUBatchVerifier`` and ``tmtpu.tpu.engine``'s TPU
+    verifier.
+    """
+
+    @abstractmethod
+    def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
+        ...
+
+    @abstractmethod
+    def verify(self) -> "tuple[bool, list[bool]]":
+        ...
+
+    @abstractmethod
+    def count(self) -> int:
+        ...
+
+
+# type-name -> (pubkey class, privkey class); filled by curve modules.
+KEY_TYPES: Dict[str, tuple] = {}
+
+
+def register_key_type(name: str, pub_cls: Type[PubKey], priv_cls: Type[PrivKey]):
+    KEY_TYPES[name] = (pub_cls, priv_cls)

@@ -1,0 +1,253 @@
+"""RFC 6962 Merkle tree and proofs (reference: crypto/merkle/).
+
+- ``hash_from_byte_slices`` (crypto/merkle/tree.go:9-22)
+- ``Proof`` with compute/verify (crypto/merkle/proof.go)
+- ``ProofOperator`` chains for app/IAVL query proofs
+  (crypto/merkle/proof_op.go).
+Empty tree hashes to SHA-256 of the empty string; leaves are prefixed 0x00,
+inner nodes 0x01 (crypto/merkle/hash.go).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+LEAF_PREFIX = b"\x00"
+INNER_PREFIX = b"\x01"
+
+
+def _sha256(b: bytes) -> bytes:
+    return hashlib.sha256(b).digest()
+
+
+def empty_hash() -> bytes:
+    return _sha256(b"")
+
+
+def leaf_hash(leaf: bytes) -> bytes:
+    return _sha256(LEAF_PREFIX + leaf)
+
+
+def inner_hash(left: bytes, right: bytes) -> bytes:
+    return _sha256(INNER_PREFIX + left + right)
+
+
+def _split_point(length: int) -> int:
+    """Largest power of two strictly less than length
+    (crypto/merkle/tree.go:94-106)."""
+    if length < 1:
+        raise ValueError("length must be >= 1")
+    bit = 1
+    while bit * 2 < length:
+        bit *= 2
+    return bit
+
+
+def hash_from_byte_slices(items: Sequence[bytes]) -> bytes:
+    n = len(items)
+    if n == 0:
+        return empty_hash()
+    if n == 1:
+        return leaf_hash(items[0])
+    k = _split_point(n)
+    left = hash_from_byte_slices(items[:k])
+    right = hash_from_byte_slices(items[k:])
+    return inner_hash(left, right)
+
+
+@dataclass
+class Proof:
+    """Merkle proof of item inclusion (crypto/merkle/proof.go:18-31)."""
+
+    total: int
+    index: int
+    leaf_hash: bytes
+    aunts: List[bytes] = field(default_factory=list)
+
+    def compute_root_hash(self) -> Optional[bytes]:
+        return _compute_hash_from_aunts(
+            self.index, self.total, self.leaf_hash, self.aunts
+        )
+
+    def verify(self, root_hash: bytes, leaf: bytes) -> None:
+        if self.total < 0:
+            raise ValueError("proof total must be positive")
+        if self.index < 0:
+            raise ValueError("proof index cannot be negative")
+        if leaf_hash(leaf) != self.leaf_hash:
+            raise ValueError("invalid leaf hash")
+        computed = self.compute_root_hash()
+        if computed != root_hash:
+            raise ValueError(
+                f"invalid root hash: wanted {root_hash.hex()} got "
+                f"{computed.hex() if computed else None}"
+            )
+
+    def to_proto(self):
+        from tmtpu.types import pb
+
+        return pb.Proof(
+            total=self.total,
+            index=self.index,
+            leaf_hash=self.leaf_hash,
+            aunts=list(self.aunts),
+        )
+
+    @classmethod
+    def from_proto(cls, p) -> "Proof":
+        return cls(
+            total=p.total, index=p.index, leaf_hash=p.leaf_hash, aunts=list(p.aunts)
+        )
+
+
+def _compute_hash_from_aunts(
+    index: int, total: int, leaf: bytes, aunts: List[bytes]
+) -> Optional[bytes]:
+    if index >= total or index < 0 or total <= 0:
+        return None
+    if total == 1:
+        if aunts:
+            return None
+        return leaf
+    if not aunts:
+        return None
+    k = _split_point(total)
+    if index < k:
+        left = _compute_hash_from_aunts(index, k, leaf, aunts[:-1])
+        if left is None:
+            return None
+        return inner_hash(left, aunts[-1])
+    right = _compute_hash_from_aunts(index - k, total - k, leaf, aunts[:-1])
+    if right is None:
+        return None
+    return inner_hash(aunts[-1], right)
+
+
+def proofs_from_byte_slices(items: Sequence[bytes]) -> tuple:
+    """Returns (root_hash, [Proof per item]) (crypto/merkle/proof.go:40-51)."""
+    trails, root = _trails_from_byte_slices(list(items))
+    root_hash = root.hash
+    proofs = []
+    for i, trail in enumerate(trails):
+        proofs.append(
+            Proof(
+                total=len(items),
+                index=i,
+                leaf_hash=trail.hash,
+                aunts=trail.flatten_aunts(),
+            )
+        )
+    return root_hash, proofs
+
+
+class _ProofNode:
+    __slots__ = ("hash", "parent", "left", "right")
+
+    def __init__(self, h: bytes):
+        self.hash = h
+        self.parent = None
+        self.left = None  # left sibling needed for proof
+        self.right = None  # right sibling needed for proof
+
+    def flatten_aunts(self) -> List[bytes]:
+        aunts = []
+        node = self
+        while node is not None:
+            if node.left is not None:
+                aunts.append(node.left.hash)
+            elif node.right is not None:
+                aunts.append(node.right.hash)
+            node = node.parent
+        return aunts
+
+
+def _trails_from_byte_slices(items: List[bytes]):
+    n = len(items)
+    if n == 0:
+        return [], _ProofNode(empty_hash())
+    if n == 1:
+        node = _ProofNode(leaf_hash(items[0]))
+        return [node], node
+    k = _split_point(n)
+    lefts, left_root = _trails_from_byte_slices(items[:k])
+    rights, right_root = _trails_from_byte_slices(items[k:])
+    root = _ProofNode(inner_hash(left_root.hash, right_root.hash))
+    left_root.parent = root
+    left_root.right = right_root
+    right_root.parent = root
+    right_root.left = left_root
+    return lefts + rights, root
+
+
+# ---------------------------------------------------------------------------
+# ProofOperator chains (crypto/merkle/proof_op.go) — used by the light client
+# to verify ABCI query proofs against the app hash.
+
+
+class ProofOperator:
+    def run(self, args: List[bytes]) -> List[bytes]:
+        raise NotImplementedError
+
+    def get_key(self) -> bytes:
+        raise NotImplementedError
+
+
+class ValueOp(ProofOperator):
+    """Leaf-value op backed by a Proof (crypto/merkle/proof_value.go)."""
+
+    TYPE = "simple:v"
+
+    def __init__(self, key: bytes, proof: Proof):
+        self.key = key
+        self.proof = proof
+
+    def run(self, args: List[bytes]) -> List[bytes]:
+        if len(args) != 1:
+            raise ValueError("ValueOp expects 1 arg")
+        vhash = _sha256(args[0])
+        if leaf_hash(vhash) != self.proof.leaf_hash:
+            raise ValueError("leaf mismatch")
+        root = self.proof.compute_root_hash()
+        if root is None:
+            raise ValueError("bad proof")
+        return [root]
+
+    def get_key(self) -> bytes:
+        return self.key
+
+
+class ProofRuntime:
+    """Registry + chained verification (crypto/merkle/proof_op.go:79-139)."""
+
+    def __init__(self):
+        self._decoders: Dict[str, Callable] = {}
+
+    def register_op_decoder(self, typ: str, dec: Callable):
+        self._decoders[typ] = dec
+
+    def verify_value(self, ops: List[ProofOperator], root: bytes, keypath: str,
+                     value: bytes) -> None:
+        self.verify(ops, root, keypath, [value])
+
+    def verify_absence(self, ops: List[ProofOperator], root: bytes,
+                       keypath: str) -> None:
+        self.verify(ops, root, keypath, [])
+
+    def verify(self, ops: List[ProofOperator], root: bytes, keypath: str,
+               args: List[bytes]) -> None:
+        keys = [k for k in keypath.split("/") if k]
+        for op in ops:
+            key = op.get_key()
+            if key:
+                if not keys:
+                    raise ValueError(f"key path exhausted at {key!r}")
+                expected = keys.pop()
+                if expected.encode() != key:
+                    raise ValueError(f"key mismatch: {expected!r} vs {key!r}")
+            args = op.run(args)
+        if args != [root]:
+            raise ValueError("proof did not produce root hash")
+        if keys:
+            raise ValueError("keypath not fully consumed")

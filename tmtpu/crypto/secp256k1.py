@@ -1,0 +1,110 @@
+"""secp256k1 keys (reference: crypto/secp256k1/secp256k1.go).
+
+Signatures are 64-byte R||S with low-S normalization over SHA-256(msg);
+addresses are Bitcoin-style RIPEMD160(SHA-256(compressed pubkey))
+(crypto/secp256k1/secp256k1.go:11-12,141-152,195-197).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+from tmtpu.crypto.keys import PrivKey, PubKey, register_key_type
+from tmtpu.crypto.ripemd160 import ripemd160
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    decode_dss_signature,
+    encode_dss_signature,
+)
+
+KEY_TYPE = "secp256k1"
+PUB_KEY_SIZE = 33  # compressed
+PRIV_KEY_SIZE = 32
+SIG_SIZE = 64
+
+_CURVE = ec.SECP256K1()
+# group order
+N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+HALF_N = N // 2
+
+
+class PubKeySecp256k1(PubKey):
+    __slots__ = ("_bytes",)
+
+    def __init__(self, key_bytes: bytes):
+        if len(key_bytes) != PUB_KEY_SIZE:
+            raise ValueError(f"secp256k1 pubkey must be {PUB_KEY_SIZE} bytes")
+        self._bytes = bytes(key_bytes)
+
+    def address(self) -> bytes:
+        return ripemd160(hashlib.sha256(self._bytes).digest())
+
+    def bytes(self) -> bytes:
+        return self._bytes
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != SIG_SIZE:
+            return False
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        if s > HALF_N:  # reject malleable (non-lowS) signatures (:195-197)
+            return False
+        if r == 0 or s == 0 or r >= N or s >= N:
+            return False
+        try:
+            pub = ec.EllipticCurvePublicKey.from_encoded_point(_CURVE, self._bytes)
+            pub.verify(encode_dss_signature(r, s), msg, ec.ECDSA(hashes.SHA256()))
+            return True
+        except (InvalidSignature, ValueError):
+            return False
+
+    def type_value(self) -> str:
+        return KEY_TYPE
+
+
+class PrivKeySecp256k1(PrivKey):
+    __slots__ = ("_bytes", "_key")
+
+    def __init__(self, key_bytes: bytes):
+        if len(key_bytes) != PRIV_KEY_SIZE:
+            raise ValueError(f"secp256k1 privkey must be {PRIV_KEY_SIZE} bytes")
+        self._bytes = bytes(key_bytes)
+        self._key = ec.derive_private_key(
+            int.from_bytes(key_bytes, "big"), _CURVE
+        )
+
+    def bytes(self) -> bytes:
+        return self._bytes
+
+    def sign(self, msg: bytes) -> bytes:
+        der = self._key.sign(msg, ec.ECDSA(hashes.SHA256()))
+        r, s = decode_dss_signature(der)
+        if s > HALF_N:
+            s = N - s
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+    def pub_key(self) -> PubKey:
+        raw = self._key.public_key().public_bytes(
+            encoding=serialization.Encoding.X962,
+            format=serialization.PublicFormat.CompressedPoint,
+        )
+        return PubKeySecp256k1(raw)
+
+    def type_value(self) -> str:
+        return KEY_TYPE
+
+
+def gen_priv_key() -> PrivKeySecp256k1:
+    while True:
+        cand = os.urandom(PRIV_KEY_SIZE)
+        v = int.from_bytes(cand, "big")
+        if 0 < v < N:
+            return PrivKeySecp256k1(cand)
+
+
+register_key_type(KEY_TYPE, PubKeySecp256k1, PrivKeySecp256k1)

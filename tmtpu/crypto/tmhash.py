@@ -1,0 +1,23 @@
+"""SHA-256 helpers (reference: crypto/tmhash/hash.go:1-65).
+
+``sum`` is the full 32-byte SHA-256; ``sum_truncated`` is the 20-byte
+truncated form used for addresses.
+"""
+
+import hashlib
+
+SIZE = 32
+TRUNCATED_SIZE = 20
+BLOCK_SIZE = 64
+
+
+def sum(bz: bytes) -> bytes:  # noqa: A001 - mirrors reference naming
+    return hashlib.sha256(bz).digest()
+
+
+def sum_truncated(bz: bytes) -> bytes:
+    return hashlib.sha256(bz).digest()[:TRUNCATED_SIZE]
+
+
+def new():
+    return hashlib.sha256()

@@ -1,0 +1,333 @@
+"""Deterministic protobuf (proto3) wire encoding primitives.
+
+Reference counterpart: libs/protoio/ (varint-delimited writer/reader used for
+sign bytes — types/vote.go:93 — and all p2p/WAL framing).  The framework
+hand-rolls proto encoding instead of using a codegen library so that
+consensus-critical byte strings (sign bytes, hashes) are deterministic,
+auditable, and exactly reproduce the gogoproto encoding conventions:
+
+- scalar fields with proto3 zero values are omitted;
+- gogoproto ``nullable=false`` embedded messages are ALWAYS emitted (even if
+  their own encoding is empty);
+- fields are emitted in ascending field-number order;
+- negative varints use 10-byte two's-complement encoding.
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+from typing import List, Tuple
+
+WIRE_VARINT = 0
+WIRE_FIXED64 = 1
+WIRE_BYTES = 2
+WIRE_FIXED32 = 5
+
+
+def encode_uvarint(value: int) -> bytes:
+    if value < 0:
+        raise ValueError("uvarint cannot be negative")
+    out = bytearray()
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def encode_varint(value: int) -> bytes:
+    """Signed varint (two's complement, as protobuf int32/int64)."""
+    if value < 0:
+        value += 1 << 64
+    return encode_uvarint(value)
+
+
+def encode_zigzag(value: int) -> bytes:
+    return encode_uvarint((value << 1) ^ (value >> 63))
+
+
+def decode_uvarint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(buf):
+            raise EOFError("truncated varint")
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 70:
+            raise ValueError("varint too long")
+
+
+def decode_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    v, pos = decode_uvarint(buf, pos)
+    if v >= 1 << 63:
+        v -= 1 << 64
+    return v, pos
+
+
+def tag(field_number: int, wire_type: int) -> bytes:
+    return encode_uvarint((field_number << 3) | wire_type)
+
+
+def write_varint_field(w: io.BytesIO, fn: int, value: int) -> None:
+    w.write(tag(fn, WIRE_VARINT))
+    w.write(encode_varint(value))
+
+
+def write_bytes_field(w: io.BytesIO, fn: int, value: bytes) -> None:
+    w.write(tag(fn, WIRE_BYTES))
+    w.write(encode_uvarint(len(value)))
+    w.write(value)
+
+
+def write_sfixed64_field(w: io.BytesIO, fn: int, value: int) -> None:
+    w.write(tag(fn, WIRE_FIXED64))
+    w.write(struct.pack("<q", value))
+
+
+def write_fixed64_field(w: io.BytesIO, fn: int, value: int) -> None:
+    w.write(tag(fn, WIRE_FIXED64))
+    w.write(struct.pack("<Q", value))
+
+
+# --- length/varint-delimited framing (libs/protoio/writer.go, reader.go) ---
+
+
+def marshal_delimited(msg_bytes: bytes) -> bytes:
+    """Prefix an encoded message with its uvarint length
+    (libs/protoio/io.go MarshalDelimited) — the sign-bytes envelope."""
+    return encode_uvarint(len(msg_bytes)) + msg_bytes
+
+
+def unmarshal_delimited(buf: bytes) -> bytes:
+    n, pos = decode_uvarint(buf, 0)
+    if len(buf) - pos < n:
+        raise EOFError("truncated delimited message")
+    return buf[pos : pos + n]
+
+
+class DelimitedReader:
+    """Reads uvarint-length-prefixed messages from a binary stream."""
+
+    def __init__(self, stream, max_size: int = 64 * 1024 * 1024):
+        self._stream = stream
+        self._max = max_size
+
+    def read_msg(self) -> bytes:
+        n = self._read_uvarint()
+        if n > self._max:
+            raise ValueError(f"message too large: {n}")
+        data = self._stream.read(n)
+        if len(data) != n:
+            raise EOFError("truncated message body")
+        return data
+
+    def _read_uvarint(self) -> int:
+        result = 0
+        shift = 0
+        while True:
+            b = self._stream.read(1)
+            if not b:
+                raise EOFError("eof reading varint")
+            result |= (b[0] & 0x7F) << shift
+            if not b[0] & 0x80:
+                return result
+            shift += 7
+            if shift > 70:
+                raise ValueError("varint too long")
+
+
+# ---------------------------------------------------------------------------
+# Declarative message framework.  Each message class declares FIELDS as a
+# list of (field_number, attr_name, type_spec); type specs:
+#   "int32" "int64" "uint32" "uint64" "bool" "enum"  - varint scalars
+#   "sfixed64" "fixed64"                             - 8-byte little endian
+#   "bytes" "string"                                 - length-delimited
+#   "double"                                         - 8-byte float
+#   ("msg", cls)        - nullable embedded message (omit when None)
+#   ("msg!", cls)       - gogo non-nullable embedded message (always emit)
+#   ("rep", spec)       - repeated field of any of the above
+# Decoding tolerates unknown fields (skips them), as protobuf requires.
+
+
+class ProtoMessage:
+    FIELDS: List[tuple] = []
+
+    def __init__(self, **kwargs):
+        names = {f[1] for f in self.FIELDS}
+        for _, name, spec in self.FIELDS:
+            setattr(self, name, _default_for(spec))
+        for k, v in kwargs.items():
+            if k not in names:
+                raise TypeError(f"{type(self).__name__} has no field {k!r}")
+            setattr(self, k, v)
+
+    def encode(self) -> bytes:
+        w = io.BytesIO()
+        for fn, name, spec in self.FIELDS:
+            _encode_field(w, fn, spec, getattr(self, name))
+        return w.getvalue()
+
+    @classmethod
+    def decode(cls, buf: bytes):
+        msg = cls()
+        pos = 0
+        by_fn = {f[0]: f for f in cls.FIELDS}
+        while pos < len(buf):
+            key, pos = decode_uvarint(buf, pos)
+            fn, wt = key >> 3, key & 7
+            fld = by_fn.get(fn)
+            if fld is None:
+                pos = _skip_field(buf, pos, wt)
+                continue
+            _, name, spec = fld
+            value, pos = _decode_field(buf, pos, wt, spec)
+            if isinstance(spec, tuple) and spec[0] == "rep":
+                getattr(msg, name).append(value)
+            else:
+                setattr(msg, name, value)
+        return msg
+
+    def __eq__(self, other):
+        return type(self) is type(other) and all(
+            getattr(self, f[1]) == getattr(other, f[1]) for f in self.FIELDS
+        )
+
+    def __repr__(self):
+        parts = ", ".join(
+            f"{f[1]}={getattr(self, f[1])!r}" for f in self.FIELDS
+        )
+        return f"{type(self).__name__}({parts})"
+
+
+def _default_for(spec):
+    if isinstance(spec, tuple):
+        if spec[0] == "rep":
+            return []
+        if spec[0] == "msg":
+            return None
+        if spec[0] == "msg!":
+            return spec[1]()
+    if spec in ("bytes",):
+        return b""
+    if spec == "string":
+        return ""
+    if spec == "bool":
+        return False
+    if spec == "double":
+        return 0.0
+    return 0
+
+
+def _encode_field(w, fn, spec, value):
+    if isinstance(spec, tuple):
+        kind = spec[0]
+        if kind == "rep":
+            for item in value:
+                _encode_single(w, fn, spec[1], item, always=True)
+            return
+        if kind == "msg":
+            if value is not None:
+                write_bytes_field(w, fn, value.encode())
+            return
+        if kind == "msg!":
+            write_bytes_field(w, fn, value.encode() if value is not None else b"")
+            return
+        raise ValueError(f"bad spec {spec}")
+    _encode_single(w, fn, spec, value, always=False)
+
+
+def _encode_single(w, fn, spec, value, always):
+    if isinstance(spec, tuple):
+        # repeated message element
+        if spec[0] in ("msg", "msg!"):
+            write_bytes_field(w, fn, value.encode())
+            return
+        raise ValueError(f"bad repeated spec {spec}")
+    if spec in ("int32", "int64", "enum"):
+        if value or always:
+            write_varint_field(w, fn, value)
+    elif spec in ("uint32", "uint64"):
+        if value or always:
+            w.write(tag(fn, WIRE_VARINT))
+            w.write(encode_uvarint(value))
+    elif spec == "bool":
+        if value or always:
+            write_varint_field(w, fn, 1 if value else 0)
+    elif spec == "sfixed64":
+        if value or always:
+            write_sfixed64_field(w, fn, value)
+    elif spec == "fixed64":
+        if value or always:
+            write_fixed64_field(w, fn, value)
+    elif spec == "double":
+        if value or always:
+            w.write(tag(fn, WIRE_FIXED64))
+            w.write(struct.pack("<d", value))
+    elif spec == "bytes":
+        if value or always:
+            write_bytes_field(w, fn, bytes(value))
+    elif spec == "string":
+        if value or always:
+            write_bytes_field(w, fn, value.encode("utf-8"))
+    else:
+        raise ValueError(f"unknown field spec {spec!r}")
+
+
+def _skip_field(buf, pos, wt):
+    if wt == WIRE_VARINT:
+        _, pos = decode_uvarint(buf, pos)
+        return pos
+    if wt == WIRE_FIXED64:
+        return pos + 8
+    if wt == WIRE_FIXED32:
+        return pos + 4
+    if wt == WIRE_BYTES:
+        n, pos = decode_uvarint(buf, pos)
+        return pos + n
+    raise ValueError(f"unsupported wire type {wt}")
+
+
+def _decode_field(buf, pos, wt, spec):
+    if isinstance(spec, tuple):
+        if spec[0] == "rep":
+            return _decode_field(buf, pos, wt, spec[1])
+        if spec[0] in ("msg", "msg!"):
+            n, pos = decode_uvarint(buf, pos)
+            sub = buf[pos : pos + n]
+            if len(sub) != n:
+                raise EOFError("truncated embedded message")
+            return spec[1].decode(sub), pos + n
+        raise ValueError(f"bad spec {spec}")
+    if spec in ("int32", "int64", "enum"):
+        return decode_varint(buf, pos)
+    if spec in ("uint32", "uint64"):
+        return decode_uvarint(buf, pos)
+    if spec == "bool":
+        v, pos = decode_uvarint(buf, pos)
+        return bool(v), pos
+    if spec == "sfixed64":
+        return struct.unpack_from("<q", buf, pos)[0], pos + 8
+    if spec == "fixed64":
+        return struct.unpack_from("<Q", buf, pos)[0], pos + 8
+    if spec == "double":
+        return struct.unpack_from("<d", buf, pos)[0], pos + 8
+    if spec == "bytes":
+        n, pos = decode_uvarint(buf, pos)
+        if len(buf) - pos < n:
+            raise EOFError("truncated bytes field")
+        return buf[pos : pos + n], pos + n
+    if spec == "string":
+        n, pos = decode_uvarint(buf, pos)
+        if len(buf) - pos < n:
+            raise EOFError("truncated string field")
+        return buf[pos : pos + n].decode("utf-8"), pos + n
+    raise ValueError(f"unknown field spec {spec!r}")

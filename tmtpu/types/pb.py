@@ -1,0 +1,341 @@
+"""Protobuf wire messages for core types.
+
+Schema mirrors the reference's proto/tendermint/{types,crypto,version}
+definitions (proto/tendermint/types/types.proto, canonical.proto,
+validator.proto, evidence.proto, params.proto; proto/tendermint/crypto/
+keys.proto, proof.proto; proto/tendermint/version/types.proto), encoded with
+the deterministic gogo-compatible writer in tmtpu.libs.protoio.
+"""
+
+from __future__ import annotations
+
+from tmtpu.libs.protoio import ProtoMessage
+
+# --- enums (proto/tendermint/types/types.proto:12-36) ---
+
+BLOCK_ID_FLAG_UNKNOWN = 0
+BLOCK_ID_FLAG_ABSENT = 1
+BLOCK_ID_FLAG_COMMIT = 2
+BLOCK_ID_FLAG_NIL = 3
+
+SIGNED_MSG_TYPE_UNKNOWN = 0
+SIGNED_MSG_TYPE_PREVOTE = 1
+SIGNED_MSG_TYPE_PRECOMMIT = 2
+SIGNED_MSG_TYPE_PROPOSAL = 32
+
+# Go's zero time.Time (0001-01-01T00:00:00Z) in unix seconds.
+GO_ZERO_SECONDS = -62135596800
+GO_ZERO_NANOS = GO_ZERO_SECONDS * 1_000_000_000
+
+
+class Timestamp(ProtoMessage):
+    """google.protobuf.Timestamp."""
+
+    FIELDS = [(1, "seconds", "int64"), (2, "nanos", "int32")]
+
+    @classmethod
+    def from_unix_nanos(cls, ns: int) -> "Timestamp":
+        return cls(seconds=ns // 1_000_000_000, nanos=ns % 1_000_000_000)
+
+    def to_unix_nanos(self) -> int:
+        return self.seconds * 1_000_000_000 + self.nanos
+
+
+class Consensus(ProtoMessage):
+    """tendermint.version.Consensus."""
+
+    FIELDS = [(1, "block", "uint64"), (2, "app", "uint64")]
+
+
+class App(ProtoMessage):
+    """tendermint.version.App."""
+
+    FIELDS = [(1, "protocol", "uint64"), (2, "software", "string")]
+
+
+class PublicKey(ProtoMessage):
+    """tendermint.crypto.PublicKey (oneof sum: ed25519=1 | secp256k1=2).
+
+    The framework additionally understands sr25519 on field 3 for mixed-curve
+    validator sets (an extension; the reference's codec only maps
+    ed25519/secp256k1 — crypto/encoding/codec.go:14-63)."""
+
+    FIELDS = [(1, "ed25519", "bytes"), (2, "secp256k1", "bytes"),
+              (3, "sr25519", "bytes")]
+
+
+class Proof(ProtoMessage):
+    """tendermint.crypto.Proof."""
+
+    FIELDS = [
+        (1, "total", "int64"),
+        (2, "index", "int64"),
+        (3, "leaf_hash", "bytes"),
+        (4, "aunts", ("rep", "bytes")),
+    ]
+
+
+class PartSetHeader(ProtoMessage):
+    FIELDS = [(1, "total", "uint32"), (2, "hash", "bytes")]
+
+
+class BlockID(ProtoMessage):
+    FIELDS = [
+        (1, "hash", "bytes"),
+        (2, "part_set_header", ("msg!", PartSetHeader)),
+    ]
+
+
+class Part(ProtoMessage):
+    FIELDS = [
+        (1, "index", "uint32"),
+        (2, "bytes", "bytes"),
+        (3, "proof", ("msg!", Proof)),
+    ]
+
+
+class CanonicalPartSetHeader(ProtoMessage):
+    FIELDS = [(1, "total", "uint32"), (2, "hash", "bytes")]
+
+
+class CanonicalBlockID(ProtoMessage):
+    FIELDS = [
+        (1, "hash", "bytes"),
+        (2, "part_set_header", ("msg!", CanonicalPartSetHeader)),
+    ]
+
+
+class CanonicalVote(ProtoMessage):
+    """proto/tendermint/types/canonical.proto:30-38.  height/round are
+    sfixed64 for fixed-size canonical encoding; block_id is nullable."""
+
+    FIELDS = [
+        (1, "type", "enum"),
+        (2, "height", "sfixed64"),
+        (3, "round", "sfixed64"),
+        (4, "block_id", ("msg", CanonicalBlockID)),
+        (5, "timestamp", ("msg!", Timestamp)),
+        (6, "chain_id", "string"),
+    ]
+
+
+class CanonicalProposal(ProtoMessage):
+    FIELDS = [
+        (1, "type", "enum"),
+        (2, "height", "sfixed64"),
+        (3, "round", "sfixed64"),
+        (4, "pol_round", "int64"),
+        (5, "block_id", ("msg", CanonicalBlockID)),
+        (6, "timestamp", ("msg!", Timestamp)),
+        (7, "chain_id", "string"),
+    ]
+
+
+class Vote(ProtoMessage):
+    FIELDS = [
+        (1, "type", "enum"),
+        (2, "height", "int64"),
+        (3, "round", "int32"),
+        (4, "block_id", ("msg!", BlockID)),
+        (5, "timestamp", ("msg!", Timestamp)),
+        (6, "validator_address", "bytes"),
+        (7, "validator_index", "int32"),
+        (8, "signature", "bytes"),
+    ]
+
+
+class Proposal(ProtoMessage):
+    FIELDS = [
+        (1, "type", "enum"),
+        (2, "height", "int64"),
+        (3, "round", "int32"),
+        (4, "pol_round", "int32"),
+        (5, "block_id", ("msg!", BlockID)),
+        (6, "timestamp", ("msg!", Timestamp)),
+        (7, "signature", "bytes"),
+    ]
+
+
+class CommitSig(ProtoMessage):
+    FIELDS = [
+        (1, "block_id_flag", "enum"),
+        (2, "validator_address", "bytes"),
+        (3, "timestamp", ("msg!", Timestamp)),
+        (4, "signature", "bytes"),
+    ]
+
+
+class Commit(ProtoMessage):
+    FIELDS = [
+        (1, "height", "int64"),
+        (2, "round", "int32"),
+        (3, "block_id", ("msg!", BlockID)),
+        (4, "signatures", ("rep", ("msg!", CommitSig))),
+    ]
+
+
+class Header(ProtoMessage):
+    FIELDS = [
+        (1, "version", ("msg!", Consensus)),
+        (2, "chain_id", "string"),
+        (3, "height", "int64"),
+        (4, "time", ("msg!", Timestamp)),
+        (5, "last_block_id", ("msg!", BlockID)),
+        (6, "last_commit_hash", "bytes"),
+        (7, "data_hash", "bytes"),
+        (8, "validators_hash", "bytes"),
+        (9, "next_validators_hash", "bytes"),
+        (10, "consensus_hash", "bytes"),
+        (11, "app_hash", "bytes"),
+        (12, "last_results_hash", "bytes"),
+        (13, "evidence_hash", "bytes"),
+        (14, "proposer_address", "bytes"),
+    ]
+
+
+class Data(ProtoMessage):
+    FIELDS = [(1, "txs", ("rep", "bytes"))]
+
+
+class Validator(ProtoMessage):
+    FIELDS = [
+        (1, "address", "bytes"),
+        (2, "pub_key", ("msg!", PublicKey)),
+        (3, "voting_power", "int64"),
+        (4, "proposer_priority", "int64"),
+    ]
+
+
+class ValidatorSet(ProtoMessage):
+    FIELDS = [
+        (1, "validators", ("rep", ("msg!", Validator))),
+        (2, "proposer", ("msg", Validator)),
+        (3, "total_voting_power", "int64"),
+    ]
+
+
+class SimpleValidator(ProtoMessage):
+    """Hash input for ValidatorSet.Hash (types/validator.go:117-133);
+    pub_key is nullable here."""
+
+    FIELDS = [
+        (1, "pub_key", ("msg", PublicKey)),
+        (2, "voting_power", "int64"),
+    ]
+
+
+# --- evidence (proto/tendermint/types/evidence.proto) ---
+
+
+class LightBlockPB(ProtoMessage):
+    FIELDS: list = []  # filled in below (forward refs)
+
+
+class DuplicateVoteEvidence(ProtoMessage):
+    FIELDS = [
+        (1, "vote_a", ("msg", Vote)),
+        (2, "vote_b", ("msg", Vote)),
+        (3, "total_voting_power", "int64"),
+        (4, "validator_power", "int64"),
+        (5, "timestamp", ("msg!", Timestamp)),
+    ]
+
+
+class SignedHeader(ProtoMessage):
+    FIELDS = [
+        (1, "header", ("msg", Header)),
+        (2, "commit", ("msg", Commit)),
+    ]
+
+
+class LightBlock(ProtoMessage):
+    FIELDS = [
+        (1, "signed_header", ("msg", SignedHeader)),
+        (2, "validator_set", ("msg", ValidatorSet)),
+    ]
+
+
+class LightClientAttackEvidence(ProtoMessage):
+    FIELDS = [
+        (1, "conflicting_block", ("msg", LightBlock)),
+        (2, "common_height", "int64"),
+        (3, "byzantine_validators", ("rep", ("msg!", Validator))),
+        (4, "total_voting_power", "int64"),
+        (5, "timestamp", ("msg!", Timestamp)),
+    ]
+
+
+class Evidence(ProtoMessage):
+    """oneof sum: duplicate_vote_evidence=1 | light_client_attack_evidence=2."""
+
+    FIELDS = [
+        (1, "duplicate_vote_evidence", ("msg", DuplicateVoteEvidence)),
+        (2, "light_client_attack_evidence", ("msg", LightClientAttackEvidence)),
+    ]
+
+
+class EvidenceList(ProtoMessage):
+    FIELDS = [(1, "evidence", ("rep", ("msg!", Evidence)))]
+
+
+class Block(ProtoMessage):
+    """proto/tendermint/types/block.proto."""
+
+    FIELDS = [
+        (1, "header", ("msg!", Header)),
+        (2, "data", ("msg!", Data)),
+        (3, "evidence", ("msg!", EvidenceList)),
+        (4, "last_commit", ("msg", Commit)),
+    ]
+
+
+# --- consensus params (proto/tendermint/types/params.proto) ---
+
+
+class BlockParams(ProtoMessage):
+    FIELDS = [(1, "max_bytes", "int64"), (2, "max_gas", "int64")]
+
+
+class Duration(ProtoMessage):
+    """google.protobuf.Duration."""
+
+    FIELDS = [(1, "seconds", "int64"), (2, "nanos", "int32")]
+
+    @classmethod
+    def from_nanos(cls, ns: int) -> "Duration":
+        return cls(seconds=int(ns) // 1_000_000_000, nanos=int(ns) % 1_000_000_000)
+
+    def to_nanos(self) -> int:
+        return self.seconds * 1_000_000_000 + self.nanos
+
+
+class EvidenceParams(ProtoMessage):
+    FIELDS = [
+        (1, "max_age_num_blocks", "int64"),
+        (2, "max_age_duration", ("msg!", Duration)),
+        (3, "max_bytes", "int64"),
+    ]
+
+
+class ValidatorParams(ProtoMessage):
+    FIELDS = [(1, "pub_key_types", ("rep", "string"))]
+
+
+class VersionParams(ProtoMessage):
+    FIELDS = [(1, "app_version", "uint64")]
+
+
+class ConsensusParams(ProtoMessage):
+    FIELDS = [
+        (1, "block", ("msg", BlockParams)),
+        (2, "evidence", ("msg", EvidenceParams)),
+        (3, "validator", ("msg", ValidatorParams)),
+        (4, "version", ("msg", VersionParams)),
+    ]
+
+
+class HashedParams(ProtoMessage):
+    """Subset of params hashed into Header.ConsensusHash
+    (proto/tendermint/types/params.proto HashedParams)."""
+
+    FIELDS = [(1, "block_max_bytes", "int64"), (2, "block_max_gas", "int64")]

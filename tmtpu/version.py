@@ -1,0 +1,17 @@
+"""Version constants (reference: version/version.go:10-23)."""
+
+# Semantic version of this framework. Tracks the reference's 0.34 protocol
+# line: block/p2p/abci protocol versions below are wire-compatible constants.
+TMCoreSemVer = "0.34.24-tpu.1"
+
+# ABCI protocol semantic version (reference: version/version.go:14).
+ABCISemVer = "0.17.0"
+ABCIVersion = ABCISemVer
+
+# Block protocol version: changes when the block format changes
+# (reference: version/version.go:20).
+BlockProtocol = 11
+
+# P2P protocol version: changes when the p2p wire format changes
+# (reference: version/version.go:23).
+P2PProtocol = 8
