@@ -1,15 +1,17 @@
 """Test configuration.
 
-Tests run JAX on a virtual 8-device CPU mesh so multi-chip sharding
-(tmtpu/tpu/mesh.py) is exercised without TPU hardware; the driver's
-dryrun_multichip does the same.  Must be set before jax is imported anywhere.
+Tests run JAX on a virtual 8-device CPU mesh so multi-chip sharding is
+exercised without TPU hardware; the driver's dryrun_multichip does the same.
+``force_cpu_backend`` must run before any test triggers jax backend
+initialization (this image's axon sitecustomize would otherwise pin the
+platform to the TPU tunnel — see tmtpu/tpu/compat.py).
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tmtpu.tpu.compat import force_cpu_backend
+
+force_cpu_backend(8)

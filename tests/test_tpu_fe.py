@@ -1,0 +1,121 @@
+"""Field arithmetic (tmtpu/tpu/fe.py) vs Python big-int oracle.
+
+These are the safety-critical bound checks: every op's carry analysis is
+exercised at the documented worst-case limb magnitudes, not just random
+values, because an int32 overflow on-device would silently corrupt
+signature verification.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tmtpu.tpu import fe
+
+P = fe.P_INT
+rng = np.random.default_rng(7)
+
+
+def rand_loose(n, hi=9500):
+    """[20, n] limbs uniform in [0, hi] — the loose-form worst case."""
+    return rng.integers(0, hi + 1, size=(fe.NLIMBS, n), dtype=np.int32)
+
+
+def rand_canonical(n):
+    vals = [rng.integers(0, 2**63) | (rng.integers(0, 2**63) << 192) for _ in range(n)]
+    vals = [int(v) % P for v in vals]
+    arr = np.stack([fe.limbs_of_int(v) for v in vals], axis=1)
+    return arr, vals
+
+
+def col_vals(a):
+    return [fe.int_of_limbs(np.asarray(a)[:, j]) for j in range(a.shape[1])]
+
+
+def test_k64p_and_plimbs():
+    assert fe.int_of_limbs(fe.K64P) == 64 * P
+    assert fe.int_of_limbs(fe.P_LIMBS) == P
+
+
+def test_pack_bytes_le():
+    raw = rng.integers(0, 256, size=(17, 32), dtype=np.uint8)
+    limbs = fe.pack_bytes_le(raw)
+    for j in range(17):
+        assert fe.int_of_limbs(limbs[:, j]) == int.from_bytes(raw[j].tobytes(), "little")
+
+
+@pytest.mark.parametrize("hi", [9500, 1, 8191])
+def test_mul_bounds_and_value(hi):
+    a = rand_loose(64, hi)
+    b = rand_loose(64, hi)
+    c = np.asarray(fe.mul(jnp.asarray(a), jnp.asarray(b)))
+    assert c.min() >= 0 and c.max() <= 8800
+    for va, vb, vc in zip(col_vals(a), col_vals(b), col_vals(c)):
+        assert vc % P == (va * vb) % P
+
+
+def test_mul_worst_case_constant():
+    # All limbs at the documented bound — the exact int32-overflow edge.
+    a = np.full((fe.NLIMBS, 4), 9500, dtype=np.int32)
+    c = np.asarray(fe.mul(jnp.asarray(a), jnp.asarray(a)))
+    va = fe.int_of_limbs(a[:, 0])
+    assert fe.int_of_limbs(c[:, 0]) % P == (va * va) % P
+    assert c.max() <= 8800
+
+
+def test_sq_matches_mul():
+    a = rand_loose(64)
+    s = np.asarray(fe.sq(jnp.asarray(a)))
+    assert s.min() >= 0 and s.max() <= 8800
+    for va, vs in zip(col_vals(a), col_vals(s)):
+        assert vs % P == (va * va) % P
+
+
+def test_add_sub_neg():
+    a = rand_loose(64)
+    b = rand_loose(64)
+    s = np.asarray(fe.add(jnp.asarray(a), jnp.asarray(b)))
+    d = np.asarray(fe.sub(jnp.asarray(a), jnp.asarray(b)))
+    n = np.asarray(fe.neg(jnp.asarray(b)))
+    assert s.max() <= 9500 and s.min() >= 0
+    assert d.max() <= 9500 and d.min() >= 0
+    for va, vb, vs, vd, vn in zip(col_vals(a), col_vals(b), col_vals(s), col_vals(d), col_vals(n)):
+        assert vs % P == (va + vb) % P
+        assert vd % P == (va - vb) % P
+        assert vn % P == (-vb) % P
+
+
+def test_freeze_exact():
+    # Random loose inputs plus adversarial near-p values.
+    a = rand_loose(48)
+    specials = [0, 1, P - 1, P, P + 1, 2 * P - 1, 2 * P, 2**255 - 1, 19, P + 19]
+    sp = np.stack([fe.limbs_of_int(v % (1 << 260)) for v in specials], axis=1)
+    x = np.concatenate([a, sp.astype(np.int32)], axis=1)
+    f = np.asarray(fe.freeze(jnp.asarray(x)))
+    assert f.min() >= 0 and f.max() <= fe.MASK
+    for vx, vf in zip(col_vals(x), col_vals(f)):
+        assert vf == vx % P
+        assert 0 <= vf < P
+
+
+def test_freeze_ripple_adversarial():
+    # Value engineered so the carry must ripple across every limb:
+    # all limbs 8191 with a pending +1 — catches any probabilistic-settling
+    # shortcut in the canonical chain.
+    x = np.full((fe.NLIMBS, 3), fe.MASK, dtype=np.int32)
+    x[0, 1] += 1  # == 2^260 exactly -> ≡ 608 mod p
+    x[0, 2] += 2
+    f = np.asarray(fe.freeze(jnp.asarray(x)))
+    for j in range(3):
+        assert fe.int_of_limbs(f[:, j]) == fe.int_of_limbs(x[:, j]) % P
+
+
+def test_invert():
+    a, vals = rand_canonical(16)
+    inv = np.asarray(fe.invert(jnp.asarray(a)))
+    for va, vi in zip(vals, col_vals(inv)):
+        if va == 0:
+            assert vi % P == 0  # 0^(p-2) = 0
+        else:
+            assert (va * vi) % P == 1

@@ -1,0 +1,36 @@
+"""Backend selection helpers.
+
+This image boots every Python process with an `axon` PJRT plugin
+(sitecustomize) that force-sets ``jax_platforms=axon`` in jax config — so
+neither ``JAX_PLATFORMS=cpu`` in the environment nor os.environ tweaks are
+enough to get a CPU backend for tests / multi-chip dry-runs, and a wedged
+TPU tunnel hangs backend init for every process. ``force_cpu_backend``
+reliably pins jax to host CPU with ``n`` virtual devices; call it before
+any jax computation (it is a no-op if a backend is already initialized —
+too late by then, so call early).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_backend(n_devices: int = 8) -> None:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        import jax._src.xla_bridge as xb
+
+        reg = getattr(xb, "_backend_factories", None)
+        if reg:
+            for k in [k for k in list(reg) if k != "cpu"]:
+                reg.pop(k)
+    except Exception:
+        pass  # registry layout changed; jax_platforms=cpu should still win
