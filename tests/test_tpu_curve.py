@@ -1,0 +1,103 @@
+"""Batched point ops (tmtpu/tpu/curve.py) vs the ed25519_ref oracle."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from tmtpu.crypto import ed25519_ref as ref
+from tmtpu.tpu import curve, fe
+
+rng = np.random.default_rng(3)
+
+
+def rand_points(n):
+    pts = []
+    for _ in range(n):
+        k = int(rng.integers(1, 2**62)) * int(rng.integers(1, 2**62)) + 1
+        pts.append(ref.scalar_mult(k, ref.BASE))
+    return pts
+
+
+def to_dev(pts):
+    arr = np.stack(
+        [[fe.limbs_of_int(c) for c in p] for p in pts], axis=-1
+    )  # [4, 20, n] after transpose of limb stacking
+    return tuple(jnp.asarray(arr[i]) for i in range(4))
+
+
+def from_dev(dev, j):
+    comps = [fe.int_of_limbs(np.asarray(fe.freeze(c))[:, j]) for c in dev]
+    return tuple(comps)
+
+
+def assert_same(dev, pts):
+    for j, p in enumerate(pts):
+        got = from_dev(dev, j)
+        assert ref.point_equal(got, p), (j, got, p)
+
+
+def test_double_add_vs_ref():
+    pts = rand_points(8) + [ref.IDENTITY, ref.BASE]
+    d = to_dev(pts)
+    assert_same(curve.double(d), [ref.point_double(p) for p in pts])
+    qs = rand_points(9) + [ref.IDENTITY]
+    q = to_dev(qs)
+    assert_same(
+        curve.add_cached(d, curve.to_cached(q)),
+        [ref.point_add(a, b) for a, b in zip(pts, qs)],
+    )
+    assert_same(curve.negate(d), [ref.point_neg(p) for p in pts])
+    assert bool(np.all(np.asarray(curve.on_curve_mask(d))))
+
+
+def test_add_niels_vs_ref():
+    tab = jnp.asarray(curve.fixed_base_niels_table().astype(np.float32))
+    pts = rand_points(6)
+    d = to_dev(pts)
+    digits = np.array([0, 1, 5, 15, 7, 2], dtype=np.int32)
+    out = curve.add_niels(d, curve.lookup_niels_const(tab, jnp.asarray(digits)))
+    expect = [
+        ref.point_add(p, ref.scalar_mult(int(k), ref.BASE))
+        for p, k in zip(pts, digits)
+    ]
+    assert_same(out, expect)
+
+
+def test_shamir_vs_ref():
+    import jax
+
+    n = 4
+    pts = rand_points(n)
+    s_vals = [int(rng.integers(0, 2**63)) << 190 | int(rng.integers(0, 2**63)) for _ in range(n)]
+    h_vals = [int(rng.integers(0, 2**63)) << 189 | int(rng.integers(0, 2**63)) for _ in range(n)]
+    s_vals = [v % ref.L for v in s_vals]
+    h_vals = [v % ref.L for v in h_vals]
+
+    def digits_of(vals):
+        d = np.zeros((curve.NDIGITS, n), dtype=np.int32)
+        for j, v in enumerate(vals):
+            for w in range(curve.NDIGITS):
+                d[curve.NDIGITS - 1 - w, j] = (v >> (4 * w)) & 0xF
+        return d
+
+    tab = jnp.asarray(curve.fixed_base_niels_table().astype(np.float32))
+    fn = jax.jit(lambda sd, hd, a: curve.shamir_double_scalar(sd, hd, a, tab))
+    out = fn(jnp.asarray(digits_of(s_vals)), jnp.asarray(digits_of(h_vals)), to_dev(pts))
+    expect = [
+        ref.point_add(ref.scalar_mult(s, ref.BASE), ref.scalar_mult(h, a))
+        for s, h, a in zip(s_vals, h_vals, pts)
+    ]
+    assert_same(out, expect)
+
+
+def test_compress_check():
+    pts = rand_points(5)
+    enc = [ref.point_compress(p) for p in pts]
+    raw = np.frombuffer(b"".join(enc), dtype=np.uint8).reshape(5, 32).copy()
+    sign = (raw[:, 31] >> 7).astype(np.int32)
+    raw[:, 31] &= 0x7F
+    y_claim = fe.pack_bytes_le(raw)
+    ok = curve.compress_check(to_dev(pts), jnp.asarray(y_claim), jnp.asarray(sign))
+    assert bool(np.all(np.asarray(ok)))
+    # flipped sign must fail
+    bad = curve.compress_check(to_dev(pts), jnp.asarray(y_claim), jnp.asarray(1 - sign))
+    assert not bool(np.any(np.asarray(bad)))

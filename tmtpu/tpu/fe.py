@@ -165,7 +165,7 @@ def _fold_product(c):
 def mul(a, b):
     """Schoolbook product + reduction. Inputs loose (≤ 9500 -> coefficient
     bound 20*9500^2 = 1.805e9 < 2^31-1). Output loose (≤ 8800)."""
-    B = a.shape[1:]
+    B = jnp.broadcast_shapes(a.shape[1:], b.shape[1:])
     c = jnp.zeros((2 * NLIMBS,) + B, dtype=jnp.int32)
     for i in range(NLIMBS):
         c = c.at[i : i + NLIMBS].add(a[i][None] * b)
