@@ -1,0 +1,53 @@
+"""North-star benchmark: ed25519 batch-verify throughput at a 10k-validator
+VoteSet (BASELINE.md: Go stdlib serial verify ≈ 50-60 µs/sig ⇒ ~18.2k sig/s
+per core; target ≥10×).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "sig/s", "vs_baseline": N}
+
+Measures the steady-state device pipeline (verify_core: decompress +
+Straus/Shamir ladder + compressed compare) on whatever jax.devices() offers
+(the real TPU chip under the driver), batch = 10,000 lanes — one full
+VoteSet at MaxVotesCount (types/vote_set.go:18).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+GO_SERIAL_SIG_S = 1e6 / 55.0  # 55 µs/sig Go stdlib midpoint (BASELINE.md)
+
+
+def main():
+    from tmtpu.tpu import sharding as sh
+    from tmtpu.tpu import verify as tv
+
+    lanes = 10_000
+    args = sh.example_batch(lanes)
+    powers = jnp.asarray(sh.powers_to_limbs([1000] * lanes))
+    table = tv.base_table_f32()
+
+    step = jax.jit(sh.verify_tally_step)
+    # warmup / compile
+    out = jax.block_until_ready(step(*args, powers, table))
+    assert bool(jnp.all(out[0])), "bench lanes must verify"
+
+    n_iters = 5
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        out = jax.block_until_ready(step(*args, powers, table))
+    dt = (time.perf_counter() - t0) / n_iters
+    sig_s = lanes / dt
+
+    print(json.dumps({
+        "metric": "ed25519_batch_verify_10k_voteset",
+        "value": round(sig_s, 1),
+        "unit": "sig/s",
+        "vs_baseline": round(sig_s / GO_SERIAL_SIG_S, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

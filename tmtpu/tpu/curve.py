@@ -18,8 +18,6 @@ Two cached operand forms avoid per-add constant multiplies:
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -135,10 +133,12 @@ def lookup_niels_const(table_f32, digits):
     """table_f32 [16, 3, 20] float32, digits [B] int32 -> niels ([20,B] x3).
 
     One-hot matmul instead of gather: limbs < 2^13 are exact in f32, and the
-    [B,16]x[16,60] contraction rides the MXU."""
+    [B,16]x[16,60] contraction rides the MXU. Precision HIGHEST is required:
+    the TPU MXU's default f32 matmul truncates inputs to bf16 (8-bit
+    mantissa), which corrupts 13-bit limbs."""
     oh = jax.nn.one_hot(digits, 1 << WINDOW, dtype=jnp.float32)  # [B, 16]
     flat = table_f32.reshape(1 << WINDOW, -1)  # [16, 60]
-    sel = oh @ flat  # [B, 60]
+    sel = jnp.matmul(oh, flat, precision=jax.lax.Precision.HIGHEST)  # [B, 60]
     sel = sel.astype(jnp.int32).T.reshape(3, fe.NLIMBS, -1)
     return (sel[0], sel[1], sel[2])
 
@@ -160,7 +160,9 @@ def build_cached_table(p):
 def lookup_cached_batched(table_f32, digits):
     """table_f32 [16, 4, 20, B] float32, digits [B] -> cached ([20,B] x4)."""
     oh = jax.nn.one_hot(digits, 1 << WINDOW, dtype=jnp.float32, axis=0)  # [16, B]
-    sel = jnp.einsum("tclb,tb->clb", table_f32, oh).astype(jnp.int32)
+    sel = jnp.einsum(
+        "tclb,tb->clb", table_f32, oh, precision=jax.lax.Precision.HIGHEST
+    ).astype(jnp.int32)
     return (sel[0], sel[1], sel[2], sel[3])
 
 

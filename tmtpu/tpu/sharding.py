@@ -1,0 +1,115 @@
+"""Multi-chip sharding for the batch verifier + on-device vote tally.
+
+The framework's scale axis is validator-set size (SURVEY.md §5: per-round
+work is O(V) signature verifies + O(V) bitarray/power bookkeeping, V ≤ 10000
+— types/vote_set.go:18). The TPU mapping is data parallelism over signature
+*lanes*: every per-lane array (limbs [20, B], digits [64, B], masks [B]) is
+sharded on its trailing batch dimension over a 1-D device mesh (axis
+``"sig"``), the fixed-base table is replicated, and the only cross-device
+traffic is the tally reduction (psum of power-limb sums — a few hundred
+bytes) riding ICI. Scaling to multi-host meshes changes nothing in this
+file: the same NamedSharding specs lay lanes out over DCN-connected hosts
+and XLA inserts the hierarchical reduction.
+
+Voting powers are int64 in the reference (types/validator.go). TPUs have no
+64-bit integer ALU, so powers ride as 5×13-bit limbs ([5, B] int32, same
+radix as the field arithmetic); per-limb lane sums stay < 2^31 for any
+B ≤ 2^17 and are recombined into a Python int on the host.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tmtpu.tpu import verify as tv
+
+POWER_RADIX = 13
+POWER_LIMBS = 5  # 5 * 13 = 65 bits >= int64
+
+
+def powers_to_limbs(powers) -> np.ndarray:
+    """int64-ish array/list [B] -> [5, B] int32 radix-2^13 limbs."""
+    out = np.zeros((POWER_LIMBS, len(powers)), dtype=np.int32)
+    for i, p in enumerate(powers):
+        v = int(p)
+        for j in range(POWER_LIMBS):
+            out[j, i] = v & ((1 << POWER_RADIX) - 1)
+            v >>= POWER_RADIX
+        assert v == 0, "voting power exceeds 65 bits"
+    return out
+
+
+def limb_sums_to_int(sums) -> int:
+    s = np.asarray(sums, dtype=np.int64)
+    return int(sum(int(s[j]) << (POWER_RADIX * j) for j in range(POWER_LIMBS)))
+
+
+def pack_bitarray(mask):
+    """bool [B] -> uint32 words [ceil(B/32)] (zero-padded high bits).
+    The on-device equivalent of libs/bits.BitArray for vote bookkeeping."""
+    b = mask.shape[0]
+    if b % 32:
+        mask = jnp.concatenate(
+            [mask, jnp.zeros(32 - b % 32, dtype=mask.dtype)]
+        )
+        b = mask.shape[0]
+    w = mask.reshape(b // 32, 32).astype(jnp.uint32)
+    return (w << jnp.arange(32, dtype=jnp.uint32)[None, :]).sum(
+        axis=1, dtype=jnp.uint32
+    )
+
+
+def verify_tally_step(pk_y, pk_sign, r_y, r_sign, s_digits, h_digits,
+                      power_limbs, table):
+    """The flagship device step: batch-verify all lanes, then reduce the
+    valid lanes' voting power and pack the validity bitarray — the fused
+    VoteSet.addVote hot path (types/vote_set.go:233-304) for a whole round's
+    votes at once. Returns (mask [B] bool, power_sums [5] int32,
+    bit_words [B/32] uint32)."""
+    mask = tv.verify_core(pk_y, pk_sign, r_y, r_sign, s_digits, h_digits, table)
+    power_sums = jnp.sum(power_limbs * mask[None].astype(jnp.int32), axis=1)
+    return mask, power_sums, pack_bitarray(mask)
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), ("sig",))
+
+
+def sharded_verify_tally(mesh: Mesh):
+    """Build the pjit'd multi-chip step for ``mesh``. Lane arrays are sharded
+    on the batch dim; the power reduction crosses devices as an XLA psum.
+    Returns a callable with the same signature as ``verify_tally_step``."""
+    lane = NamedSharding(mesh, P(None, "sig"))
+    flat = NamedSharding(mesh, P("sig"))
+    repl = NamedSharding(mesh, P())
+    return jax.jit(
+        verify_tally_step,
+        in_shardings=(lane, flat, lane, flat, lane, lane, lane, repl),
+        out_shardings=(flat, repl, flat),
+    )
+
+
+def _tile(a, reps):
+    return jnp.repeat(a, reps, axis=-1)
+
+
+def example_batch(lanes: int):
+    """Deterministic well-formed device args with ``lanes`` lanes (one real
+    signature tiled), for compile checks and benchmarks."""
+    from tmtpu.crypto import ed25519_ref as ref
+
+    seed = bytes(range(32))
+    msg = b"tmtpu-example-vote-sign-bytes" * 4
+    pk = ref.public_key(seed)
+    sig = ref.sign(seed, msg)
+    args, host_ok = tv.prepare_batch([pk], [msg], [sig])
+    assert host_ok.all()
+    return tuple(_tile(a, lanes) for a in args)
