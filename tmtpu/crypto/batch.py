@@ -1,0 +1,130 @@
+"""BatchVerifier — the framework's batch-first signature verification API.
+
+The reference has NO batch verifier (SURVEY.md: every signature goes through
+crypto.PubKey.VerifySignature one at a time — crypto/crypto.go:25). This
+interface is the new hot-path primitive every upper layer is written
+against (VoteSet, VerifyCommit*, light client, evidence):
+
+    bv = new_batch_verifier()          # picks TPU when available
+    for pk, msg, sig in ...: bv.add(pk, msg, sig)
+    all_ok, mask = bv.verify()
+
+Backends:
+- ``cpu``: serial per-signature verify through the PubKey objects (OpenSSL
+  under the hood) — the fallback and the small-batch fast path;
+- ``tpu``: groups ed25519 items into one device batch
+  (tmtpu.tpu.verify.batch_verify) and routes other curves to CPU. Per-lane
+  semantics are identical to serial verification (no probabilistic batch
+  equation), so the returned mask is exact for mixed valid/invalid batches.
+
+Backend selection: ``set_default_backend`` / config ``crypto.backend``;
+``auto`` probes for a usable jax device once and caches the answer.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import List, Optional, Tuple
+
+from tmtpu.crypto import keys
+from tmtpu.crypto.keys import PubKey
+
+ED25519 = "ed25519"
+
+_TPU_MIN_BATCH = 8  # below this, device dispatch overhead beats CPU serial
+
+_default_backend = os.environ.get("TMTPU_CRYPTO_BACKEND", "auto")
+_probe_lock = threading.Lock()
+_tpu_usable: Optional[bool] = None
+
+
+def set_default_backend(backend: str) -> None:
+    global _default_backend, _tpu_usable
+    if backend not in ("auto", "cpu", "tpu"):
+        raise ValueError(f"unknown crypto backend {backend!r}")
+    _default_backend = backend
+    if backend != "auto":
+        _tpu_usable = None
+
+
+def _tpu_available() -> bool:
+    global _tpu_usable
+    if _tpu_usable is None:
+        with _probe_lock:
+            if _tpu_usable is None:
+                try:
+                    import jax
+
+                    _tpu_usable = len(jax.devices()) > 0
+                except Exception:
+                    _tpu_usable = False
+    return _tpu_usable
+
+
+class BatchVerifier(keys.BatchVerifier):
+    """Accumulate (pubkey, msg, sig) items, then verify them all at once."""
+
+    def __init__(self):
+        self._items: List[Tuple[PubKey, bytes, bytes]] = []
+
+    def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
+        self._items.append((pub_key, bytes(msg), bytes(sig)))
+
+    def count(self) -> int:
+        return len(self._items)
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def verify(self) -> Tuple[bool, List[bool]]:
+        raise NotImplementedError
+
+
+class CPUBatchVerifier(BatchVerifier):
+    def verify(self) -> Tuple[bool, List[bool]]:
+        mask = [pk.verify_signature(msg, sig) for pk, msg, sig in self._items]
+        return all(mask), mask
+
+
+class TPUBatchVerifier(BatchVerifier):
+    def verify(self) -> Tuple[bool, List[bool]]:
+        ed_idx, ed_pks, ed_msgs, ed_sigs = [], [], [], []
+        mask: List[bool] = [False] * len(self._items)
+        for i, (pk, msg, sig) in enumerate(self._items):
+            if pk.type_value() == ED25519 and len(sig) == 64:
+                ed_idx.append(i)
+                ed_pks.append(pk.bytes())
+                ed_msgs.append(msg)
+                ed_sigs.append(sig)
+            else:
+                mask[i] = pk.verify_signature(msg, sig)
+        if ed_idx:
+            if len(ed_idx) < _TPU_MIN_BATCH:
+                for j, i in enumerate(ed_idx):
+                    mask[i] = self._items[i][0].verify_signature(
+                        ed_msgs[j], ed_sigs[j]
+                    )
+            else:
+                from tmtpu.tpu import verify as tv
+
+                dev_mask = tv.batch_verify(ed_pks, ed_msgs, ed_sigs)
+                for j, i in enumerate(ed_idx):
+                    mask[i] = bool(dev_mask[j])
+        return all(mask), mask
+
+
+def new_batch_verifier(backend: Optional[str] = None) -> BatchVerifier:
+    b = backend or _default_backend
+    if b == "auto":
+        b = "tpu" if _tpu_available() else "cpu"
+    if b == "tpu":
+        return TPUBatchVerifier()
+    return CPUBatchVerifier()
+
+
+def batch_verify_items(items, backend: Optional[str] = None):
+    bv = new_batch_verifier(backend)
+    for pk, msg, sig in items:
+        bv.add(pk, msg, sig)
+    return bv.verify()

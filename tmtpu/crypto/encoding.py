@@ -1,0 +1,37 @@
+"""PubKey ⇄ protobuf conversion (reference: crypto/encoding/codec.go:14-63).
+
+The reference maps ed25519 and secp256k1; this framework additionally maps
+sr25519 (field 3) for mixed-curve validator sets (a BASELINE.json config).
+"""
+
+from __future__ import annotations
+
+from tmtpu.crypto.keys import KEY_TYPES, PubKey
+from tmtpu.types import pb
+
+# ensure curve modules have registered themselves
+from tmtpu.crypto import ed25519 as _ed  # noqa: F401
+from tmtpu.crypto import secp256k1 as _secp  # noqa: F401
+
+
+def pubkey_to_proto(pk: PubKey) -> pb.PublicKey:
+    t = pk.type_value()
+    if t == "ed25519":
+        return pb.PublicKey(ed25519=pk.bytes())
+    if t == "secp256k1":
+        return pb.PublicKey(secp256k1=pk.bytes())
+    if t == "sr25519":
+        return pb.PublicKey(sr25519=pk.bytes())
+    raise ValueError(f"cannot proto-encode key type {t!r}")
+
+
+def pubkey_from_proto(msg: pb.PublicKey) -> PubKey:
+    for name, field in (("ed25519", msg.ed25519),
+                        ("secp256k1", msg.secp256k1),
+                        ("sr25519", msg.sr25519)):
+        if field:
+            entry = KEY_TYPES.get(name)
+            if entry is None:
+                raise ValueError(f"key type {name!r} not registered")
+            return entry[0](field)
+    raise ValueError("empty PublicKey sum")

@@ -1,0 +1,174 @@
+"""BitArray — vote bookkeeping bitset (reference: libs/bits/bit_array.go:16).
+
+Dense ``numpy.uint64`` word layout so the same buffer can ship to the TPU
+sidecar unchanged (the device tally produces/consumes packed words — see
+tmtpu.tpu.sharding.pack_bitarray). Thread-safe like the reference (a single
+lock around mutations); JSON form is the reference's ``"x_x_"`` string.
+"""
+
+from __future__ import annotations
+
+import secrets
+import threading
+
+import numpy as np
+
+
+class BitArray:
+    __slots__ = ("_bits", "_words", "_lock")
+
+    def __init__(self, bits: int):
+        if bits < 0:
+            raise ValueError("negative bit count")
+        self._bits = bits
+        self._words = np.zeros((bits + 63) // 64, dtype=np.uint64)
+        self._lock = threading.Lock()
+
+    # -- constructors -------------------------------------------------------
+
+    @classmethod
+    def from_words(cls, bits: int, words: np.ndarray) -> "BitArray":
+        """From packed words: uint64, or the uint32 words the TPU tally emits
+        (tmtpu.tpu.sharding.pack_bitarray) — uint32 pairs are fused
+        little-endian into uint64."""
+        ba = cls(bits)
+        w = np.asarray(words)
+        if w.dtype == np.uint32:
+            if len(w) % 2:
+                w = np.concatenate([w, np.zeros(1, dtype=np.uint32)])
+            w = w.view(np.uint64) if w.data.contiguous else \
+                np.ascontiguousarray(w).view(np.uint64)
+        else:
+            w = w.astype(np.uint64)
+        ba._words[: len(w)] = w[: len(ba._words)]
+        ba._mask_tail()
+        return ba
+
+    @classmethod
+    def from_bools(cls, flags) -> "BitArray":
+        ba = cls(len(flags))
+        for i, f in enumerate(flags):
+            if f:
+                ba._words[i >> 6] |= np.uint64(1 << (i & 63))
+        return ba
+
+    def _mask_tail(self) -> None:
+        extra = len(self._words) * 64 - self._bits
+        if extra and len(self._words):
+            self._words[-1] &= np.uint64((1 << (64 - extra)) - 1)
+
+    # -- core ops -----------------------------------------------------------
+
+    def size(self) -> int:
+        return self._bits
+
+    def get_index(self, i: int) -> bool:
+        if i < 0 or i >= self._bits:
+            return False
+        return bool((self._words[i >> 6] >> np.uint64(i & 63)) & np.uint64(1))
+
+    def set_index(self, i: int, v: bool) -> bool:
+        if i < 0 or i >= self._bits:
+            return False
+        with self._lock:
+            if v:
+                self._words[i >> 6] |= np.uint64(1 << (i & 63))
+            else:
+                self._words[i >> 6] &= ~np.uint64(1 << (i & 63))
+        return True
+
+    def copy(self) -> "BitArray":
+        ba = BitArray(self._bits)
+        ba._words = self._words.copy()
+        return ba
+
+    def or_(self, other: "BitArray") -> "BitArray":
+        """Union, sized to the larger operand (bit_array.go Or)."""
+        n = max(self._bits, other._bits)
+        ba = BitArray(n)
+        ba._words[: len(self._words)] = self._words
+        ba._words[: len(other._words)] |= other._words
+        return ba
+
+    def and_(self, other: "BitArray") -> "BitArray":
+        """Intersection, sized to the smaller operand (bit_array.go And)."""
+        n = min(self._bits, other._bits)
+        ba = BitArray(n)
+        k = len(ba._words)
+        ba._words[:] = self._words[:k] & other._words[:k]
+        ba._mask_tail()
+        return ba
+
+    def not_(self) -> "BitArray":
+        ba = BitArray(self._bits)
+        ba._words = ~self._words
+        ba._mask_tail()
+        return ba
+
+    def sub(self, other: "BitArray") -> "BitArray":
+        """Bits set in self but not in other (self &^ other), sized to self."""
+        ba = self.copy()
+        k = min(len(self._words), len(other._words))
+        ba._words[:k] &= ~other._words[:k]
+        ba._mask_tail()
+        return ba
+
+    def is_empty(self) -> bool:
+        return not self._words.any()
+
+    def is_full(self) -> bool:
+        return self.num_true_bits() == self._bits
+
+    def num_true_bits(self) -> int:
+        return int(np.bitwise_count(self._words).sum())
+
+    def pick_random(self):
+        """A uniformly random set bit's index, or None (bit_array.go
+        PickRandom — used by vote gossip to pick what to send)."""
+        idxs = self.true_indices()
+        if not idxs:
+            return None
+        return idxs[secrets.randbelow(len(idxs))]
+
+    def true_indices(self) -> list:
+        out = []
+        for w_i, w in enumerate(self._words):
+            w = int(w)
+            while w:
+                b = w & -w
+                out.append(w_i * 64 + b.bit_length() - 1)
+                w ^= b
+        return out
+
+    def update(self, other: "BitArray") -> None:
+        """Overwrite with other's bits (sizes must match semantics of
+        bit_array.go Update: copies min length)."""
+        with self._lock:
+            k = min(len(self._words), len(other._words))
+            self._words[:k] = other._words[:k]
+            self._mask_tail()
+
+    # -- wire / display -----------------------------------------------------
+
+    def words(self) -> np.ndarray:
+        return self._words.copy()
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, BitArray)
+            and self._bits == other._bits
+            and bool((self._words == other._words).all())
+        )
+
+    def __str__(self):
+        return "".join("x" if self.get_index(i) else "_" for i in range(self._bits))
+
+    def __repr__(self):
+        return f"BA{{{self._bits}:{self}}}"
+
+    def to_json(self) -> str:
+        return str(self)
+
+    @classmethod
+    def from_json(cls, s: str) -> "BitArray":
+        return cls.from_bools([c == "x" for c in s])

@@ -1,0 +1,161 @@
+"""Evidence types (reference: types/evidence.go) — DuplicateVoteEvidence and
+LightClientAttackEvidence, their hashing and ABCI form."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from tmtpu.crypto import tmhash
+from tmtpu.crypto.merkle import hash_from_byte_slices
+from tmtpu.types import pb
+from tmtpu.types.vote import Vote
+
+
+class DuplicateVoteEvidence:
+    """Two conflicting votes from one validator at the same H/R/type
+    (types/evidence.go:53). vote_a is the lexicographically-first block key,
+    matching NewDuplicateVoteEvidence ordering."""
+
+    TYPE = "duplicate/vote"
+
+    def __init__(self, vote_a: Vote, vote_b: Vote,
+                 total_voting_power: int = 0, validator_power: int = 0,
+                 timestamp: int = 0):
+        self.vote_a = vote_a
+        self.vote_b = vote_b
+        self.total_voting_power = int(total_voting_power)
+        self.validator_power = int(validator_power)
+        self.timestamp = int(timestamp)
+
+    @classmethod
+    def new(cls, vote1: Vote, vote2: Vote, block_time: int, val_set
+            ) -> "DuplicateVoteEvidence":
+        if vote1 is None or vote2 is None or val_set is None:
+            raise ValueError("missing vote or validator set")
+        _, val = val_set.get_by_address(vote1.validator_address)
+        if val is None:
+            raise ValueError("validator not in validator set")
+        if vote1.block_id.key() <= vote2.block_id.key():
+            a, b = vote1, vote2
+        else:
+            a, b = vote2, vote1
+        return cls(a, b, val_set.total_voting_power(), val.voting_power,
+                   block_time)
+
+    def height(self) -> int:
+        return self.vote_a.height
+
+    def time(self) -> int:
+        return self.timestamp
+
+    def bytes(self) -> bytes:
+        return self.to_proto().encode()
+
+    def hash(self) -> bytes:
+        return tmhash.sum(self.bytes())
+
+    def validate_basic(self) -> None:
+        if self.vote_a is None or self.vote_b is None:
+            raise ValueError("empty duplicate vote")
+        self.vote_a.validate_basic()
+        self.vote_b.validate_basic()
+        if self.vote_a.block_id.key() >= self.vote_b.block_id.key():
+            raise ValueError("duplicate votes in invalid order")
+
+    def to_proto(self) -> pb.DuplicateVoteEvidence:
+        return pb.DuplicateVoteEvidence(
+            vote_a=self.vote_a.to_proto(), vote_b=self.vote_b.to_proto(),
+            total_voting_power=self.total_voting_power,
+            validator_power=self.validator_power,
+            timestamp=pb.Timestamp.from_unix_nanos(self.timestamp),
+        )
+
+    @classmethod
+    def from_proto(cls, m: pb.DuplicateVoteEvidence) -> "DuplicateVoteEvidence":
+        return cls(Vote.from_proto(m.vote_a), Vote.from_proto(m.vote_b),
+                   m.total_voting_power, m.validator_power,
+                   m.timestamp.to_unix_nanos() if m.timestamp else 0)
+
+    def __eq__(self, other):
+        return (isinstance(other, DuplicateVoteEvidence)
+                and self.bytes() == other.bytes())
+
+
+class LightClientAttackEvidence:
+    """A conflicting light block trace (types/evidence.go:154)."""
+
+    TYPE = "light_client_attack"
+
+    def __init__(self, conflicting_block, common_height: int,
+                 byzantine_validators: Optional[list] = None,
+                 total_voting_power: int = 0, timestamp: int = 0):
+        self.conflicting_block = conflicting_block  # light.LightBlock
+        self.common_height = int(common_height)
+        self.byzantine_validators = byzantine_validators or []
+        self.total_voting_power = int(total_voting_power)
+        self.timestamp = int(timestamp)
+
+    def height(self) -> int:
+        return self.common_height
+
+    def time(self) -> int:
+        return self.timestamp
+
+    def bytes(self) -> bytes:
+        return self.to_proto().encode()
+
+    def hash(self) -> bytes:
+        return tmhash.sum(self.bytes())
+
+    def validate_basic(self) -> None:
+        if self.conflicting_block is None:
+            raise ValueError("conflicting block is nil")
+        if self.common_height <= 0:
+            raise ValueError("non-positive common height")
+
+    def to_proto(self) -> pb.LightClientAttackEvidence:
+        return pb.LightClientAttackEvidence(
+            conflicting_block=self.conflicting_block.to_proto(),
+            common_height=self.common_height,
+            byzantine_validators=[v.to_proto()
+                                  for v in self.byzantine_validators],
+            total_voting_power=self.total_voting_power,
+            timestamp=pb.Timestamp.from_unix_nanos(self.timestamp),
+        )
+
+    @classmethod
+    def from_proto(cls, m: pb.LightClientAttackEvidence):
+        from tmtpu.types.light_block import LightBlock
+        from tmtpu.types.validator import Validator
+
+        return cls(LightBlock.from_proto(m.conflicting_block),
+                   m.common_height,
+                   [Validator.from_proto(v) for v in m.byzantine_validators],
+                   m.total_voting_power,
+                   m.timestamp.to_unix_nanos() if m.timestamp else 0)
+
+    def __eq__(self, other):
+        return (isinstance(other, LightClientAttackEvidence)
+                and self.bytes() == other.bytes())
+
+
+def evidence_to_proto(ev) -> pb.Evidence:
+    if isinstance(ev, DuplicateVoteEvidence):
+        return pb.Evidence(duplicate_vote_evidence=ev.to_proto())
+    if isinstance(ev, LightClientAttackEvidence):
+        return pb.Evidence(light_client_attack_evidence=ev.to_proto())
+    raise ValueError(f"evidence is not recognized: {type(ev)}")
+
+
+def evidence_from_proto(m: pb.Evidence):
+    if m.duplicate_vote_evidence is not None:
+        return DuplicateVoteEvidence.from_proto(m.duplicate_vote_evidence)
+    if m.light_client_attack_evidence is not None:
+        return LightClientAttackEvidence.from_proto(
+            m.light_client_attack_evidence)
+    raise ValueError("empty evidence sum")
+
+
+def evidence_list_hash(evidence: List) -> bytes:
+    """types/evidence.go EvidenceList.Hash — merkle over Evidence.Bytes."""
+    return hash_from_byte_slices([e.bytes() for e in evidence])

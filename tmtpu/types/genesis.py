@@ -1,0 +1,153 @@
+"""Genesis document (reference: types/genesis.go)."""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import List, Optional
+
+from tmtpu.crypto import tmhash
+from tmtpu.crypto.keys import KEY_TYPES, PubKey
+from tmtpu.types.params import ConsensusParams
+from tmtpu.types.validator import Validator, ValidatorSet
+
+MAX_CHAIN_ID_LEN = 50
+
+
+class GenesisValidator:
+    def __init__(self, pub_key: PubKey, power: int, name: str = "",
+                 address: Optional[bytes] = None):
+        self.pub_key = pub_key
+        self.power = int(power)
+        self.name = name
+        self.address = address if address is not None else pub_key.address()
+
+
+class GenesisDoc:
+    def __init__(self, chain_id: str, genesis_time: int = 0,
+                 initial_height: int = 1,
+                 consensus_params: Optional[ConsensusParams] = None,
+                 validators: Optional[List[GenesisValidator]] = None,
+                 app_hash: bytes = b"", app_state: Optional[dict] = None):
+        self.chain_id = chain_id
+        self.genesis_time = genesis_time or time.time_ns()
+        self.initial_height = initial_height
+        self.consensus_params = consensus_params or ConsensusParams()
+        self.validators = validators or []
+        self.app_hash = app_hash
+        self.app_state = app_state or {}
+
+    def validate_and_complete(self) -> None:
+        """genesis.go ValidateAndComplete."""
+        if not self.chain_id:
+            raise ValueError("genesis doc must include non-empty chain_id")
+        if len(self.chain_id) > MAX_CHAIN_ID_LEN:
+            raise ValueError(f"chain_id in genesis doc is too long (max: "
+                             f"{MAX_CHAIN_ID_LEN})")
+        if self.initial_height < 0:
+            raise ValueError("initial_height cannot be negative")
+        if self.initial_height == 0:
+            self.initial_height = 1
+        self.consensus_params.validate_basic()
+        for i, v in enumerate(self.validators):
+            if v.power == 0:
+                raise ValueError(f"genesis file cannot contain validators "
+                                 f"with no voting power: {v.name or i}")
+            if v.address != v.pub_key.address():
+                raise ValueError(f"incorrect address for validator {i}")
+
+    def validator_set(self) -> ValidatorSet:
+        return ValidatorSet(
+            [Validator(v.pub_key, v.power) for v in self.validators]
+        )
+
+    def document_hash(self) -> bytes:
+        return tmhash.sum(self.to_json().encode())
+
+    # -- JSON round-trip (genesis.json on disk) -----------------------------
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "genesis_time": self.genesis_time,
+            "chain_id": self.chain_id,
+            "initial_height": str(self.initial_height),
+            "consensus_params": {
+                "block": {
+                    "max_bytes": str(self.consensus_params.block_max_bytes),
+                    "max_gas": str(self.consensus_params.block_max_gas),
+                },
+                "evidence": {
+                    "max_age_num_blocks": str(
+                        self.consensus_params.evidence_max_age_num_blocks),
+                    "max_age_duration": str(
+                        self.consensus_params.evidence_max_age_duration_ns),
+                    "max_bytes": str(self.consensus_params.evidence_max_bytes),
+                },
+                "validator": {
+                    "pub_key_types": self.consensus_params.pub_key_types,
+                },
+                "version": {
+                    "app_version": str(self.consensus_params.app_version),
+                },
+            },
+            "validators": [
+                {
+                    "address": v.address.hex().upper(),
+                    "pub_key": {"type": v.pub_key.type_value(),
+                                "value": v.pub_key.bytes().hex()},
+                    "power": str(v.power),
+                    "name": v.name,
+                }
+                for v in self.validators
+            ],
+            "app_hash": self.app_hash.hex().upper(),
+            "app_state": self.app_state,
+        }, sort_keys=True, separators=(",", ":"))
+
+    @classmethod
+    def from_json(cls, s: str) -> "GenesisDoc":
+        d = json.loads(s)
+        cp = d.get("consensus_params", {})
+        blk = cp.get("block", {})
+        ev = cp.get("evidence", {})
+        vp = cp.get("validator", {})
+        ver = cp.get("version", {})
+        params = ConsensusParams(
+            block_max_bytes=int(blk.get("max_bytes", 22020096)),
+            block_max_gas=int(blk.get("max_gas", -1)),
+            evidence_max_age_num_blocks=int(ev.get("max_age_num_blocks", 100000)),
+            evidence_max_age_duration_ns=int(ev.get("max_age_duration",
+                                                    48 * 3600 * 10**9)),
+            evidence_max_bytes=int(ev.get("max_bytes", 1048576)),
+            pub_key_types=vp.get("pub_key_types", ["ed25519"]),
+            app_version=int(ver.get("app_version", 0)),
+        )
+        vals = []
+        for v in d.get("validators", []):
+            ktype = v["pub_key"]["type"]
+            entry = KEY_TYPES.get(ktype)
+            if entry is None:
+                raise ValueError(f"unknown pubkey type {ktype!r}")
+            pk = entry[0](bytes.fromhex(v["pub_key"]["value"]))
+            vals.append(GenesisValidator(pk, int(v["power"]),
+                                         v.get("name", "")))
+        doc = cls(
+            chain_id=d["chain_id"],
+            genesis_time=int(d.get("genesis_time", 0)),
+            initial_height=int(d.get("initial_height", 1)),
+            consensus_params=params,
+            validators=vals,
+            app_hash=bytes.fromhex(d.get("app_hash", "")),
+            app_state=d.get("app_state", {}),
+        )
+        doc.validate_and_complete()
+        return doc
+
+    def save_as(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json())
+
+    @classmethod
+    def from_file(cls, path: str) -> "GenesisDoc":
+        with open(path) as f:
+            return cls.from_json(f.read())

@@ -1,0 +1,80 @@
+"""SignedHeader and LightBlock (reference: types/light.go)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from tmtpu.types import pb
+from tmtpu.types.block import Commit, Header
+from tmtpu.types.validator import ValidatorSet
+
+
+class SignedHeader:
+    def __init__(self, header: Header, commit: Commit):
+        self.header = header
+        self.commit = commit
+
+    def validate_basic(self, chain_id: str) -> None:
+        if self.header is None:
+            raise ValueError("missing header")
+        if self.commit is None:
+            raise ValueError("missing commit")
+        self.header.validate_basic()
+        self.commit.validate_basic()
+        if self.header.chain_id != chain_id:
+            raise ValueError(
+                f"header belongs to another chain {self.header.chain_id!r}"
+            )
+        if self.commit.height != self.header.height:
+            raise ValueError("header and commit height mismatch")
+        if self.commit.block_id.hash != self.header.hash():
+            raise ValueError("commit signs a different block")
+
+    def to_proto(self) -> pb.SignedHeader:
+        return pb.SignedHeader(header=self.header.to_proto(),
+                               commit=self.commit.to_proto())
+
+    @classmethod
+    def from_proto(cls, m: pb.SignedHeader) -> "SignedHeader":
+        return cls(Header.from_proto(m.header), Commit.from_proto(m.commit))
+
+
+class LightBlock:
+    """types/light.go LightBlock — SignedHeader + the ValidatorSet that
+    signed it."""
+
+    def __init__(self, signed_header: SignedHeader,
+                 validator_set: ValidatorSet):
+        self.signed_header = signed_header
+        self.validator_set = validator_set
+
+    @property
+    def header(self) -> Header:
+        return self.signed_header.header
+
+    @property
+    def commit(self) -> Commit:
+        return self.signed_header.commit
+
+    def height(self) -> int:
+        return self.signed_header.header.height
+
+    def validate_basic(self, chain_id: str) -> None:
+        if self.signed_header is None:
+            raise ValueError("missing signed header")
+        if self.validator_set is None:
+            raise ValueError("missing validator set")
+        self.signed_header.validate_basic(chain_id)
+        self.validator_set.validate_basic()
+        if self.signed_header.header.validators_hash != \
+                self.validator_set.hash():
+            raise ValueError("validator set does not match header")
+
+    def to_proto(self) -> pb.LightBlock:
+        return pb.LightBlock(signed_header=self.signed_header.to_proto(),
+                             validator_set=self.validator_set.to_proto())
+
+    @classmethod
+    def from_proto(cls, m: pb.LightBlock) -> "LightBlock":
+        return cls(SignedHeader.from_proto(m.signed_header),
+                   ValidatorSet.from_proto(m.validator_set))

@@ -1,0 +1,114 @@
+"""Consensus parameters (reference: types/params.go)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from tmtpu.crypto import tmhash
+from tmtpu.types import pb
+
+MAX_BLOCK_SIZE_BYTES = 104857600  # 100 MiB, types/params.go:14
+BLOCK_PART_SIZE_BYTES = 65536
+MAX_BLOCK_PARTS_COUNT = (MAX_BLOCK_SIZE_BYTES // BLOCK_PART_SIZE_BYTES) + 1
+
+ABCI_PUBKEY_TYPE_ED25519 = "ed25519"
+ABCI_PUBKEY_TYPE_SECP256K1 = "secp256k1"
+ABCI_PUBKEY_TYPE_SR25519 = "sr25519"
+
+
+class ConsensusParams:
+    def __init__(self,
+                 block_max_bytes: int = 22020096,  # 21 MiB default
+                 block_max_gas: int = -1,
+                 evidence_max_age_num_blocks: int = 100000,
+                 evidence_max_age_duration_ns: int = 48 * 3600 * 10**9,
+                 evidence_max_bytes: int = 1048576,
+                 pub_key_types: Optional[List[str]] = None,
+                 app_version: int = 0):
+        self.block_max_bytes = block_max_bytes
+        self.block_max_gas = block_max_gas
+        self.evidence_max_age_num_blocks = evidence_max_age_num_blocks
+        self.evidence_max_age_duration_ns = evidence_max_age_duration_ns
+        self.evidence_max_bytes = evidence_max_bytes
+        self.pub_key_types = pub_key_types or [ABCI_PUBKEY_TYPE_ED25519]
+        self.app_version = app_version
+
+    def validate_basic(self) -> None:
+        if self.block_max_bytes <= 0 or \
+                self.block_max_bytes > MAX_BLOCK_SIZE_BYTES:
+            raise ValueError("block.MaxBytes out of range")
+        if self.block_max_gas < -1:
+            raise ValueError("block.MaxGas must be >= -1")
+        if self.evidence_max_age_num_blocks <= 0:
+            raise ValueError("evidence.MaxAgeNumBlocks must be positive")
+        if not self.pub_key_types:
+            raise ValueError("len(Validator.PubKeyTypes) must be > 0")
+
+    def hash(self) -> bytes:
+        """types/params.go HashConsensusParams — SHA-256 of HashedParams."""
+        return tmhash.sum(pb.HashedParams(
+            block_max_bytes=self.block_max_bytes,
+            block_max_gas=self.block_max_gas,
+        ).encode())
+
+    def update(self, updates) -> "ConsensusParams":
+        """Apply an abci.ConsensusParams update message; None fields keep
+        current values (types/params.go UpdateConsensusParams)."""
+        res = ConsensusParams(
+            self.block_max_bytes, self.block_max_gas,
+            self.evidence_max_age_num_blocks,
+            self.evidence_max_age_duration_ns, self.evidence_max_bytes,
+            list(self.pub_key_types), self.app_version,
+        )
+        if updates is None:
+            return res
+        if updates.block is not None:
+            res.block_max_bytes = updates.block.max_bytes
+            res.block_max_gas = updates.block.max_gas
+        if updates.evidence is not None:
+            res.evidence_max_age_num_blocks = updates.evidence.max_age_num_blocks
+            if updates.evidence.max_age_duration is not None:
+                res.evidence_max_age_duration_ns = \
+                    updates.evidence.max_age_duration.to_nanos()
+            res.evidence_max_bytes = updates.evidence.max_bytes
+        if updates.validator is not None:
+            res.pub_key_types = list(updates.validator.pub_key_types)
+        if updates.version is not None:
+            res.app_version = updates.version.app_version
+        return res
+
+    def to_proto(self) -> pb.ConsensusParams:
+        return pb.ConsensusParams(
+            block=pb.BlockParams(max_bytes=self.block_max_bytes,
+                                 max_gas=self.block_max_gas),
+            evidence=pb.EvidenceParams(
+                max_age_num_blocks=self.evidence_max_age_num_blocks,
+                max_age_duration=pb.Duration.from_nanos(
+                    self.evidence_max_age_duration_ns),
+                max_bytes=self.evidence_max_bytes,
+            ),
+            validator=pb.ValidatorParams(pub_key_types=list(self.pub_key_types)),
+            version=pb.VersionParams(app_version=self.app_version),
+        )
+
+    @classmethod
+    def from_proto(cls, m: pb.ConsensusParams) -> "ConsensusParams":
+        cp = cls()
+        if m.block is not None:
+            cp.block_max_bytes = m.block.max_bytes
+            cp.block_max_gas = m.block.max_gas
+        if m.evidence is not None:
+            cp.evidence_max_age_num_blocks = m.evidence.max_age_num_blocks
+            if m.evidence.max_age_duration is not None:
+                cp.evidence_max_age_duration_ns = \
+                    m.evidence.max_age_duration.to_nanos()
+            cp.evidence_max_bytes = m.evidence.max_bytes
+        if m.validator is not None:
+            cp.pub_key_types = list(m.validator.pub_key_types)
+        if m.version is not None:
+            cp.app_version = m.version.app_version
+        return cp
+
+    def __eq__(self, other):
+        return isinstance(other, ConsensusParams) and \
+            self.to_proto().encode() == other.to_proto().encode()

@@ -1,0 +1,131 @@
+"""PartSet (reference: types/part_set.go) — a serialized block split into
+64 kB parts with merkle proofs, the unit of block gossip."""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from tmtpu.crypto.merkle import Proof, proofs_from_byte_slices
+from tmtpu.libs.bits import BitArray
+from tmtpu.types import pb
+from tmtpu.types.params import BLOCK_PART_SIZE_BYTES
+
+
+class Part:
+    __slots__ = ("index", "bytes", "proof")
+
+    def __init__(self, index: int, data: bytes, proof: Proof):
+        self.index = index
+        self.bytes = bytes(data)
+        self.proof = proof
+
+    def validate_basic(self) -> None:
+        if len(self.bytes) > BLOCK_PART_SIZE_BYTES:
+            raise ValueError("part too big")
+
+    def to_proto(self) -> pb.Part:
+        return pb.Part(index=self.index, bytes=self.bytes,
+                       proof=self.proof.to_proto())
+
+    @classmethod
+    def from_proto(cls, m: pb.Part) -> "Part":
+        return cls(m.index, bytes(m.bytes), Proof.from_proto(m.proof))
+
+
+class PartSetHeader:
+    __slots__ = ("total", "hash")
+
+    def __init__(self, total: int = 0, hash: bytes = b""):
+        self.total = total
+        self.hash = bytes(hash)
+
+    def is_zero(self) -> bool:
+        return self.total == 0 and not self.hash
+
+    def __eq__(self, other):
+        return (isinstance(other, PartSetHeader) and self.total == other.total
+                and self.hash == other.hash)
+
+
+class PartSet:
+    """Either built complete from data (NewPartSetFromData) or assembled
+    incrementally from a header (NewPartSetFromHeader)."""
+
+    def __init__(self, total: int, root_hash: bytes):
+        self.total = total
+        self.hash = root_hash
+        self._parts: List[Optional[Part]] = [None] * total
+        self._bit_array = BitArray(total)
+        self._count = 0
+        self._byte_size = 0
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_data(cls, data: bytes, part_size: int = BLOCK_PART_SIZE_BYTES
+                  ) -> "PartSet":
+        chunks = [data[i:i + part_size] for i in range(0, len(data), part_size)] \
+            or [b""]
+        root, proofs = proofs_from_byte_slices(chunks)
+        ps = cls(len(chunks), root)
+        for i, (chunk, proof) in enumerate(zip(chunks, proofs)):
+            ps._parts[i] = Part(i, chunk, proof)
+            ps._bit_array.set_index(i, True)
+        ps._count = len(chunks)
+        ps._byte_size = len(data)
+        return ps
+
+    @classmethod
+    def from_header(cls, header) -> "PartSet":
+        return cls(header.parts_total if hasattr(header, "parts_total")
+                   else header.total,
+                   header.hash if isinstance(header.hash, bytes)
+                   else bytes(header.hash))
+
+    def add_part(self, part: Part) -> bool:
+        """part_set.go AddPart — verifies the merkle proof against the
+        header hash."""
+        with self._lock:
+            if part.index >= self.total:
+                raise ValueError("unexpected part index")
+            if self._parts[part.index] is not None:
+                return False
+            part.validate_basic()
+            from tmtpu.crypto.merkle import leaf_hash
+
+            if part.proof.index != part.index or \
+                    part.proof.total != self.total:
+                raise ValueError("wrong proof shape")
+            part.proof.verify(self.hash, part.bytes)
+            self._parts[part.index] = part
+            self._bit_array.set_index(part.index, True)
+            self._count += 1
+            self._byte_size += len(part.bytes)
+            return True
+
+    def get_part(self, index: int) -> Optional[Part]:
+        with self._lock:
+            return self._parts[index] if 0 <= index < self.total else None
+
+    def is_complete(self) -> bool:
+        return self._count == self.total
+
+    def count(self) -> int:
+        return self._count
+
+    def byte_size(self) -> int:
+        return self._byte_size
+
+    def bit_array(self) -> BitArray:
+        with self._lock:
+            return self._bit_array.copy()
+
+    def header(self):
+        from tmtpu.types.block import BlockID
+
+        return PartSetHeader(self.total, self.hash)
+
+    def assemble(self) -> bytes:
+        if not self.is_complete():
+            raise ValueError("part set incomplete")
+        return b"".join(p.bytes for p in self._parts)

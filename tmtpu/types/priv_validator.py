@@ -1,0 +1,55 @@
+"""PrivValidator interface + MockPV (reference: types/priv_validator.go).
+
+The interface signs votes/proposals by *mutating* the passed object's
+signature (and timestamp canonicalization happens at the caller), matching
+the reference's contract (priv_validator.go:18-19).
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+from tmtpu.crypto import ed25519
+from tmtpu.crypto.keys import PrivKey, PubKey
+
+
+class PrivValidator(ABC):
+    @abstractmethod
+    def get_pub_key(self) -> PubKey:
+        ...
+
+    @abstractmethod
+    def sign_vote(self, chain_id: str, vote_pb) -> None:
+        """Sign and set vote_pb.signature."""
+
+    @abstractmethod
+    def sign_proposal(self, chain_id: str, proposal_pb) -> None:
+        """Sign and set proposal_pb.signature."""
+
+
+class MockPV(PrivValidator):
+    """In-proc signer for tests (priv_validator.go:73). Can be configured to
+    misbehave for byzantine tests."""
+
+    def __init__(self, priv_key: PrivKey = None,
+                 break_proposal_sigs: bool = False,
+                 break_vote_sigs: bool = False):
+        self.priv_key = priv_key or ed25519.gen_priv_key()
+        self.break_proposal_sigs = break_proposal_sigs
+        self.break_vote_sigs = break_vote_sigs
+
+    def get_pub_key(self) -> PubKey:
+        return self.priv_key.pub_key()
+
+    def sign_vote(self, chain_id: str, vote) -> None:
+        if self.break_vote_sigs:
+            chain_id = "incorrect-chain-id"
+        vote.signature = self.priv_key.sign(vote.sign_bytes(chain_id))
+
+    def sign_proposal(self, chain_id: str, proposal) -> None:
+        if self.break_proposal_sigs:
+            chain_id = "incorrect-chain-id"
+        proposal.signature = self.priv_key.sign(proposal.sign_bytes(chain_id))
+
+    def address(self) -> bytes:
+        return self.get_pub_key().address()

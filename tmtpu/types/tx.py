@@ -1,0 +1,26 @@
+"""Tx helpers (reference: types/tx.go) — tx hashing and merkle inclusion
+proofs for /tx RPC."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from tmtpu.crypto import tmhash
+from tmtpu.crypto.merkle import Proof, hash_from_byte_slices, proofs_from_byte_slices
+
+
+def tx_hash(tx: bytes) -> bytes:
+    """types/tx.go Tx.Hash — SHA-256 of the raw tx bytes."""
+    return tmhash.sum(tx)
+
+
+def txs_hash(txs: Sequence[bytes]) -> bytes:
+    """types/tx.go Txs.Hash — merkle root of the tx hashes."""
+    return hash_from_byte_slices([tx_hash(t) for t in txs])
+
+
+def tx_proof(txs: Sequence[bytes], index: int):
+    """types/tx.go Txs.Proof — (root, Proof) for txs[index]; leaves are tx
+    hashes."""
+    root, proofs = proofs_from_byte_slices([tx_hash(t) for t in txs])
+    return root, proofs[index]
