@@ -1,0 +1,143 @@
+"""In-process consensus tests (model: consensus/state_test.go and
+common_test.go — N ConsensusStates wired directly, no network)."""
+
+import threading
+import time
+
+import pytest
+
+from tmtpu.abci.example.kvstore import KVStoreApplication
+from tmtpu.config.config import ConsensusConfig
+from tmtpu.consensus.state import ConsensusState
+from tmtpu.libs.db import MemDB
+from tmtpu.proxy import AppConns, LocalClientCreator
+from tmtpu.state.execution import BlockExecutor
+from tmtpu.state.state import state_from_genesis
+from tmtpu.state.store import StateStore
+from tmtpu.store.block_store import BlockStore
+from tmtpu.types.event_bus import EVENT_NEW_BLOCK, EventBus
+from tmtpu.types.genesis import GenesisDoc, GenesisValidator
+from tmtpu.types.priv_validator import MockPV
+
+CHAIN_ID = "cs-test-chain"
+
+
+def make_network(n_vals, wal_dir=None):
+    """N consensus states over one genesis, cross-wired in-proc."""
+    pvs = [MockPV() for _ in range(n_vals)]
+    gen = GenesisDoc(
+        chain_id=CHAIN_ID,
+        genesis_time=time.time_ns(),
+        validators=[GenesisValidator(pv.get_pub_key(), 10) for pv in pvs],
+    )
+    nodes = []
+    for i, pv in enumerate(pvs):
+        app = KVStoreApplication()
+        conns = AppConns(LocalClientCreator(app))
+        conns.start()
+        state_store = StateStore(MemDB())
+        block_store = BlockStore(MemDB())
+        genesis_state = state_from_genesis(gen)
+        state_store.save(genesis_state)
+        bus = EventBus()
+        exec_ = BlockExecutor(state_store, conns.consensus, event_bus=bus)
+        cs = ConsensusState(
+            ConsensusConfig.test_config(), genesis_state, exec_, block_store,
+            event_bus=bus, priv_validator=pv,
+            wal_path=f"{wal_dir}/wal{i}" if wal_dir else "",
+        )
+        nodes.append(cs)
+
+    # cross-wire: own votes/proposals go to every other node
+    def wire(src):
+        def on_vote(vote):
+            for dst in nodes:
+                if dst is not src:
+                    dst.add_vote_msg(vote, peer_id=f"node{nodes.index(src)}")
+
+        def on_proposal(proposal, parts):
+            for dst in nodes:
+                if dst is not src:
+                    dst.add_proposal(proposal, f"node{nodes.index(src)}")
+                    for j in range(parts.total):
+                        dst.add_block_part(proposal.height, proposal.round,
+                                           parts.get_part(j),
+                                           f"node{nodes.index(src)}")
+
+        src.on_own_vote = on_vote
+        src.on_own_proposal = on_proposal
+
+    for cs in nodes:
+        wire(cs)
+    return nodes
+
+
+def stop_all(nodes):
+    for cs in nodes:
+        cs.stop()
+
+
+def test_single_validator_commits_blocks(tmp_path):
+    nodes = make_network(1, wal_dir=str(tmp_path))
+    cs = nodes[0]
+    try:
+        cs.start()
+        assert cs.wait_for_height(3, timeout=30), \
+            f"stuck at {cs.rs.height_round_step()}"
+        assert cs.block_store.height() >= 3
+        b2 = cs.block_store.load_block(2)
+        assert b2.header.height == 2
+        assert b2.last_commit.height == 1
+        # the chain links: block 2's last_block_id points at block 1
+        b1 = cs.block_store.load_block(1)
+        assert b2.header.last_block_id.hash == b1.hash()
+    finally:
+        stop_all(nodes)
+
+
+def test_four_validators_reach_consensus():
+    nodes = make_network(4)
+    try:
+        for cs in nodes:
+            cs.start()
+        for cs in nodes:
+            assert cs.wait_for_height(3, timeout=60), \
+                f"stuck at {cs.rs.height_round_step()}"
+        # all nodes committed the same blocks
+        h1 = [cs.block_store.load_block(1).hash() for cs in nodes]
+        h2 = [cs.block_store.load_block(2).hash() for cs in nodes]
+        assert len(set(h1)) == 1
+        assert len(set(h2)) == 1
+        # app state converged
+        app_hashes = [cs.state.app_hash for cs in nodes]
+        assert len(set(app_hashes)) == 1
+    finally:
+        stop_all(nodes)
+
+
+def test_one_faulty_node_does_not_stop_consensus():
+    # 4 validators, one signs with a broken chain id -> its votes are
+    # invalid, the other 3 still have +2/3 and commit
+    nodes = make_network(4)
+    nodes[3].priv_validator.break_vote_sigs = True
+    try:
+        for cs in nodes:
+            cs.start()
+        for cs in nodes[:3]:
+            assert cs.wait_for_height(2, timeout=60), \
+                f"stuck at {cs.rs.height_round_step()}"
+    finally:
+        stop_all(nodes)
+
+
+def test_event_bus_emits_new_block():
+    nodes = make_network(1)
+    cs = nodes[0]
+    sub = cs.event_bus.subscribe_type("test", EVENT_NEW_BLOCK)
+    try:
+        cs.start()
+        item = sub.next(timeout=30)
+        assert item is not None
+        assert item.data["block"].header.height >= 1
+    finally:
+        stop_all(nodes)

@@ -1,0 +1,117 @@
+"""Node + RPC end-to-end test (model: test/app/test.sh — kvstore over RPC):
+start a single-validator node, drive it purely through the JSON-RPC API."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from tmtpu.config.config import Config, ConsensusConfig
+from tmtpu.node.node import Node
+from tmtpu.privval.file_pv import FilePV
+from tmtpu.types.genesis import GenesisDoc, GenesisValidator
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    home = tmp_path_factory.mktemp("tmhome")
+    cfg = Config.test_config()
+    cfg.base.home = str(home)
+    cfg.base.crypto_backend = "cpu"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    (home / "config").mkdir()
+    (home / "data").mkdir()
+    pv = FilePV.load_or_generate(
+        cfg.rooted(cfg.base.priv_validator_key_file),
+        cfg.rooted(cfg.base.priv_validator_state_file))
+    gen = GenesisDoc(chain_id="rpc-chain", genesis_time=time.time_ns(),
+                     validators=[GenesisValidator(pv.get_pub_key(), 10)])
+    gen.save_as(cfg.genesis_path)
+    n = Node(cfg)
+    n.start()
+    yield n
+    n.stop()
+
+
+def rpc_get(node, method, **params):
+    q = "&".join(f"{k}={v}" for k, v in params.items())
+    url = f"http://127.0.0.1:{node.rpc_server.port}/{method}"
+    if q:
+        url += "?" + q
+    with urllib.request.urlopen(url, timeout=30) as r:
+        body = json.loads(r.read())
+    assert "error" not in body, body
+    return body["result"]
+
+
+def rpc_post(node, method, **params):
+    url = f"http://127.0.0.1:{node.rpc_server.port}/"
+    req = urllib.request.Request(
+        url, data=json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                              "params": params}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        body = json.loads(r.read())
+    assert "error" not in body, body
+    return body["result"]
+
+
+def test_status_and_height_advances(node):
+    s1 = rpc_get(node, "status")
+    assert s1["node_info"]["network"] == "rpc-chain"
+    time.sleep(1.0)
+    s2 = rpc_get(node, "status")
+    assert int(s2["sync_info"]["latest_block_height"]) > \
+        int(s1["sync_info"]["latest_block_height"])
+
+
+def test_broadcast_tx_commit_and_query(node):
+    res = rpc_get(node, "broadcast_tx_commit", tx='"rpckey=rpcval"')
+    assert res["deliver_tx"]["code"] == 0
+    assert int(res["height"]) > 0
+    # query the app for the key
+    q = rpc_get(node, "abci_query", data="rpckey")
+    import base64
+
+    assert base64.b64decode(q["response"]["value"]) == b"rpcval"
+
+
+def test_block_and_commit_and_validators(node):
+    rpc_get(node, "broadcast_tx_commit", tx='"k2=v2"')
+    h = int(rpc_get(node, "status")["sync_info"]["latest_block_height"])
+    blk = rpc_get(node, "block", height=h)
+    assert int(blk["block"]["header"]["height"]) == h
+    cm = rpc_get(node, "commit", height=h)
+    assert int(cm["signed_header"]["header"]["height"]) == h
+    vals = rpc_get(node, "validators")
+    assert vals["total"] == "1"
+    bc = rpc_get(node, "blockchain")
+    assert len(bc["block_metas"]) >= 1
+
+
+def test_tx_indexing_and_search(node):
+    res = rpc_get(node, "broadcast_tx_commit", tx='"searchme=found"')
+    txhash = res["hash"]
+    got = rpc_post(node, "tx", hash=txhash, prove=True)
+    assert got["height"] == res["height"]
+    assert got["proof"]["root_hash"]
+    sr = rpc_post(node, "tx_search", query=f"tx.height={res['height']}")
+    assert int(sr["total_count"]) >= 1
+
+
+def test_block_results_and_abci_info(node):
+    res = rpc_get(node, "broadcast_tx_commit", tx='"br=1"')
+    br = rpc_get(node, "block_results", height=int(res["height"]))
+    assert any(r["code"] == 0 for r in br["txs_results"])
+    info = rpc_get(node, "abci_info")
+    assert int(info["response"]["last_block_height"]) > 0
+
+
+def test_unconfirmed_and_consensus_state(node):
+    ut = rpc_get(node, "num_unconfirmed_txs")
+    assert "n_txs" in ut
+    cs = rpc_get(node, "consensus_state")
+    assert "/" in cs["round_state"]["height/round/step"]
+    cp = rpc_get(node, "consensus_params")
+    assert cp["consensus_params"]["validator"]["pub_key_types"] == ["ed25519"]

@@ -1,0 +1,247 @@
+"""ABCI clients (reference: abci/client/): local (in-proc, mutex-serialized,
+local_client.go) and socket (length-prefixed proto over TCP/unix with a
+pipelined async request queue, socket_client.go)."""
+
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+from typing import Callable, List, Optional, Tuple
+
+from tmtpu.abci import types as abci
+from tmtpu.libs import protoio
+
+
+class ClientError(Exception):
+    pass
+
+
+class ReqRes:
+    """A pending request/response pair (abci/client/client.go ReqRes)."""
+
+    __slots__ = ("request", "_response", "_done", "_cb")
+
+    def __init__(self, request: abci.Request):
+        self.request = request
+        self._response: Optional[abci.Response] = None
+        self._done = threading.Event()
+        self._cb: Optional[Callable] = None
+
+    def set_response(self, res: abci.Response) -> None:
+        self._response = res
+        self._done.set()
+        cb = self._cb
+        if cb is not None:
+            cb(res)
+
+    def wait(self, timeout: Optional[float] = None) -> abci.Response:
+        if not self._done.wait(timeout):
+            raise ClientError("abci request timed out")
+        return self._response
+
+    def set_callback(self, cb: Callable) -> None:
+        if self._done.is_set():
+            cb(self._response)
+        else:
+            self._cb = cb
+
+
+class Client:
+    """Sync + async ABCI surface. *_sync methods block for the response;
+    *_async return a ReqRes (pipelined on the socket client)."""
+
+    def echo_sync(self, msg: str) -> abci.ResponseEcho:
+        return self._call(abci.Request(echo=abci.RequestEcho(message=msg))).echo
+
+    def info_sync(self, req: abci.RequestInfo) -> abci.ResponseInfo:
+        return self._call(abci.Request(info=req)).info
+
+    def init_chain_sync(self, req) -> abci.ResponseInitChain:
+        return self._call(abci.Request(init_chain=req)).init_chain
+
+    def query_sync(self, req) -> abci.ResponseQuery:
+        return self._call(abci.Request(query=req)).query
+
+    def begin_block_sync(self, req) -> abci.ResponseBeginBlock:
+        return self._call(abci.Request(begin_block=req)).begin_block
+
+    def check_tx_sync(self, req) -> abci.ResponseCheckTx:
+        return self._call(abci.Request(check_tx=req)).check_tx
+
+    def check_tx_async(self, req) -> ReqRes:
+        return self._call_async(abci.Request(check_tx=req))
+
+    def deliver_tx_sync(self, req) -> abci.ResponseDeliverTx:
+        return self._call(abci.Request(deliver_tx=req)).deliver_tx
+
+    def deliver_tx_async(self, req) -> ReqRes:
+        return self._call_async(abci.Request(deliver_tx=req))
+
+    def end_block_sync(self, req) -> abci.ResponseEndBlock:
+        return self._call(abci.Request(end_block=req)).end_block
+
+    def commit_sync(self) -> abci.ResponseCommit:
+        return self._call(abci.Request(commit=abci.RequestCommit())).commit
+
+    def list_snapshots_sync(self, req) -> abci.ResponseListSnapshots:
+        return self._call(abci.Request(list_snapshots=req)).list_snapshots
+
+    def offer_snapshot_sync(self, req) -> abci.ResponseOfferSnapshot:
+        return self._call(abci.Request(offer_snapshot=req)).offer_snapshot
+
+    def load_snapshot_chunk_sync(self, req) -> abci.ResponseLoadSnapshotChunk:
+        return self._call(abci.Request(load_snapshot_chunk=req)) \
+            .load_snapshot_chunk
+
+    def apply_snapshot_chunk_sync(self, req) -> abci.ResponseApplySnapshotChunk:
+        return self._call(abci.Request(apply_snapshot_chunk=req)) \
+            .apply_snapshot_chunk
+
+    def flush_sync(self) -> None:
+        self._call(abci.Request(flush=abci.RequestFlush()))
+
+    def set_response_callback(self, cb) -> None:
+        """Global callback fired for every async response (used by the
+        mempool for CheckTx bookkeeping)."""
+        self._global_cb = cb
+
+    # -- to implement -------------------------------------------------------
+
+    def _call(self, req: abci.Request) -> abci.Response:
+        raise NotImplementedError
+
+    def _call_async(self, req: abci.Request) -> ReqRes:
+        raise NotImplementedError
+
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
+
+class LocalClient(Client):
+    """In-process client wrapping an Application behind one mutex
+    (abci/client/local_client.go)."""
+
+    def __init__(self, app: abci.Application,
+                 mtx: Optional[threading.RLock] = None):
+        self.app = app
+        self.mtx = mtx or threading.RLock()
+        self._global_cb = None
+
+    def _call(self, req: abci.Request) -> abci.Response:
+        with self.mtx:
+            res = abci.dispatch(self.app, req)
+        if res.exception is not None:
+            raise ClientError(res.exception.error)
+        return res
+
+    def _call_async(self, req: abci.Request) -> ReqRes:
+        rr = ReqRes(req)
+        res = self._call(req)
+        rr.set_response(res)
+        if self._global_cb is not None:
+            self._global_cb(req, res)
+        return rr
+
+
+class SocketClient(Client):
+    """Length-prefixed proto over a stream socket with pipelined requests
+    (abci/client/socket_client.go): a send queue + recv thread matching
+    responses to the FIFO of in-flight requests."""
+
+    def __init__(self, addr: str):
+        self.addr = addr
+        self._sock: Optional[socket.socket] = None
+        self._send_q: "queue.Queue[Optional[ReqRes]]" = queue.Queue(maxsize=256)
+        self._inflight: "queue.Queue[ReqRes]" = queue.Queue()
+        self._global_cb = None
+        self._err: Optional[Exception] = None
+        self._stopped = threading.Event()
+
+    def start(self) -> None:
+        self._sock = _dial(self.addr)
+        self._send_t = threading.Thread(target=self._send_loop, daemon=True)
+        self._recv_t = threading.Thread(target=self._recv_loop, daemon=True)
+        self._send_t.start()
+        self._recv_t.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._send_q.put(None)
+        if self._sock is not None:
+            try:
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            self._sock.close()
+
+    def _send_loop(self) -> None:
+        wfile = self._sock.makefile("wb")
+        try:
+            while not self._stopped.is_set():
+                rr = self._send_q.get()
+                if rr is None:
+                    return
+                data = rr.request.encode()
+                wfile.write(protoio.marshal_delimited(data))
+                # flush eagerly when the queue drains (pipelining preserved)
+                if self._send_q.empty():
+                    wfile.flush()
+        except OSError as e:
+            self._err = e
+
+    def _recv_loop(self) -> None:
+        rfile = self._sock.makefile("rb")
+        reader = protoio.DelimitedReader(rfile)
+        try:
+            while not self._stopped.is_set():
+                res = abci.Response.decode(reader.read_msg())
+                rr = self._inflight.get_nowait()
+                rr.set_response(res)
+                if self._global_cb is not None and \
+                        res.which() not in ("flush", "exception"):
+                    self._global_cb(rr.request, res)
+        except (OSError, EOFError, queue.Empty) as e:
+            self._err = e
+            # fail all in-flight requests
+            while True:
+                try:
+                    rr = self._inflight.get_nowait()
+                except queue.Empty:
+                    break
+                rr.set_response(abci.Response(
+                    exception=abci.ResponseException(error=str(e))))
+
+    def _call_async(self, req: abci.Request) -> ReqRes:
+        if self._err is not None:
+            raise ClientError(f"socket client errored: {self._err}")
+        rr = ReqRes(req)
+        self._inflight.put(rr)
+        self._send_q.put(rr)
+        return rr
+
+    def _call(self, req: abci.Request) -> abci.Response:
+        rr = self._call_async(req)
+        if req.which() != "flush":
+            self._call_async(abci.Request(flush=abci.RequestFlush()))
+        res = rr.wait(timeout=30.0)
+        if res.exception is not None:
+            raise ClientError(res.exception.error)
+        return res
+
+
+def _dial(addr: str) -> socket.socket:
+    """addr: 'tcp://host:port' or 'unix://path'."""
+    if addr.startswith("unix://"):
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(addr[len("unix://"):])
+        return s
+    if addr.startswith("tcp://"):
+        addr = addr[len("tcp://"):]
+    host, _, port = addr.rpartition(":")
+    s = socket.create_connection((host or "127.0.0.1", int(port)))
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return s

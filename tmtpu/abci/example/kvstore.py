@@ -1,0 +1,149 @@
+"""kvstore example app (reference: abci/example/kvstore/kvstore.go and
+persistent_kvstore.go) — the benchmark application.
+
+- ``DeliverTx``: ``k=v`` sets key k; a bare tx sets tx=tx.
+- AppHash = 8-byte big-endian count of txs ever applied (kvstore.go:123's
+  size-based hash, byte-for-byte trivial but deterministic).
+- Validator updates via ``val:<hex pubkey>!<power>`` txs (persistent
+  variant's ValUpdates flow), returned from EndBlock.
+- Query paths: raw key lookup or "/val/<addr-hex>".
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional
+
+from tmtpu.abci import types as abci
+from tmtpu.types import pb
+
+VALIDATOR_TX_PREFIX = b"val:"
+
+
+class KVStoreApplication(abci.Application):
+    def __init__(self, db=None):
+        self.db = db  # optional tmtpu.libs.db KV store for persistence
+        self.state: Dict[bytes, bytes] = {}
+        self.size = 0
+        self.height = 0
+        self.app_hash = b"\x00" * 8
+        self.val_updates: List[abci.ValidatorUpdate] = []
+        self.validators: Dict[bytes, abci.ValidatorUpdate] = {}
+        if db is not None:
+            self._load()
+
+    # -- persistence --------------------------------------------------------
+
+    def _load(self) -> None:
+        raw = self.db.get(b"kvstore:meta")
+        if raw:
+            self.height, self.size = struct.unpack(">qq", raw[:16])
+            self.app_hash = raw[16:24]
+        for k, v in self.db.iter_prefix(b"kvstore:data:"):
+            self.state[k[len(b"kvstore:data:"):]] = v
+        for k, v in self.db.iter_prefix(b"kvstore:val:"):
+            self.validators[k[len(b"kvstore:val:"):]] = \
+                abci.ValidatorUpdate.decode(v)
+
+    def _persist(self) -> None:
+        if self.db is None:
+            return
+        self.db.set(b"kvstore:meta",
+                    struct.pack(">qq", self.height, self.size) + self.app_hash)
+
+    # -- abci ---------------------------------------------------------------
+
+    def info(self, req: abci.RequestInfo) -> abci.ResponseInfo:
+        return abci.ResponseInfo(
+            data=f"{{\"size\":{self.size}}}", version="0.17.0", app_version=1,
+            last_block_height=self.height,
+            last_block_app_hash=self.app_hash if self.height else b"",
+        )
+
+    def init_chain(self, req: abci.RequestInitChain) -> abci.ResponseInitChain:
+        for vu in req.validators:
+            self._set_validator(vu)
+        return abci.ResponseInitChain()
+
+    def begin_block(self, req: abci.RequestBeginBlock) -> abci.ResponseBeginBlock:
+        self.val_updates = []
+        return abci.ResponseBeginBlock()
+
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        if req.tx.startswith(VALIDATOR_TX_PREFIX) and \
+                not self._parse_val_tx(req.tx):
+            return abci.ResponseCheckTx(code=1, log="invalid validator tx")
+        return abci.ResponseCheckTx(code=abci.CODE_TYPE_OK, gas_wanted=1)
+
+    def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
+        tx = bytes(req.tx)
+        if tx.startswith(VALIDATOR_TX_PREFIX):
+            vu = self._parse_val_tx(tx)
+            if vu is None:
+                return abci.ResponseDeliverTx(code=1, log="invalid validator tx")
+            self.val_updates.append(vu)
+            self._set_validator(vu)
+        else:
+            if b"=" in tx:
+                k, _, v = tx.partition(b"=")
+            else:
+                k, v = tx, tx
+            self.state[k] = v
+            if self.db is not None:
+                self.db.set(b"kvstore:data:" + k, v)
+        self.size += 1
+        events = [abci.Event(type="app", attributes=[
+            abci.EventAttribute(key=b"key", value=tx.partition(b"=")[0],
+                                index=True),
+        ])]
+        return abci.ResponseDeliverTx(code=abci.CODE_TYPE_OK, events=events)
+
+    def end_block(self, req: abci.RequestEndBlock) -> abci.ResponseEndBlock:
+        self.height = req.height
+        return abci.ResponseEndBlock(validator_updates=self.val_updates)
+
+    def commit(self) -> abci.ResponseCommit:
+        self.app_hash = struct.pack(">q", self.size)
+        self._persist()
+        return abci.ResponseCommit(data=self.app_hash)
+
+    def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
+        if req.path == "/val":
+            vu = self.validators.get(req.data)
+            return abci.ResponseQuery(
+                code=abci.CODE_TYPE_OK, key=req.data,
+                value=vu.encode() if vu else b"", height=self.height,
+            )
+        value = self.state.get(bytes(req.data), b"")
+        return abci.ResponseQuery(
+            code=abci.CODE_TYPE_OK, key=bytes(req.data), value=value,
+            log="exists" if value else "does not exist", height=self.height,
+        )
+
+    # -- validator tx helpers ----------------------------------------------
+
+    def _parse_val_tx(self, tx: bytes) -> Optional[abci.ValidatorUpdate]:
+        try:
+            body = tx[len(VALIDATOR_TX_PREFIX):].decode()
+            pk_hex, _, power = body.partition("!")
+            return abci.ValidatorUpdate(
+                pub_key=pb.PublicKey(ed25519=bytes.fromhex(pk_hex)),
+                power=int(power),
+            )
+        except (ValueError, UnicodeDecodeError):
+            return None
+
+    def _set_validator(self, vu: abci.ValidatorUpdate) -> None:
+        key = vu.pub_key.encode()
+        if vu.power == 0:
+            self.validators.pop(key, None)
+            if self.db is not None:
+                self.db.delete(b"kvstore:val:" + key)
+        else:
+            self.validators[key] = vu
+            if self.db is not None:
+                self.db.set(b"kvstore:val:" + key, vu.encode())
+
+
+def make_validator_tx(pubkey_bytes: bytes, power: int) -> bytes:
+    return VALIDATOR_TX_PREFIX + f"{pubkey_bytes.hex()}!{power}".encode()

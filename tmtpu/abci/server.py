@@ -1,0 +1,81 @@
+"""ABCI socket server (reference: abci/server/socket_server.go) — serves an
+Application to out-of-process consensus engines over the length-prefixed
+proto protocol."""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+from typing import Optional
+
+from tmtpu.abci import types as abci
+from tmtpu.libs import protoio
+
+
+class SocketServer:
+    def __init__(self, addr: str, app: abci.Application):
+        self.addr = addr
+        self.app = app
+        self._mtx = threading.RLock()  # one app, serialized like the reference
+        self._listener: Optional[socket.socket] = None
+        self._stopped = threading.Event()
+        self._threads = []
+
+    def start(self) -> None:
+        if self.addr.startswith("unix://"):
+            path = self.addr[len("unix://"):]
+            if os.path.exists(path):
+                os.unlink(path)
+            self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._listener.bind(path)
+        else:
+            addr = self.addr[len("tcp://"):] if self.addr.startswith("tcp://") \
+                else self.addr
+            host, _, port = addr.rpartition(":")
+            self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._listener.bind((host or "127.0.0.1", int(port)))
+        self._listener.listen(8)
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    @property
+    def listen_port(self) -> int:
+        return self._listener.getsockname()[1]
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._listener is not None:
+            self._listener.close()
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1) \
+                if conn.family != socket.AF_UNIX else None
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        rfile = conn.makefile("rb")
+        wfile = conn.makefile("wb")
+        reader = protoio.DelimitedReader(rfile)
+        try:
+            while not self._stopped.is_set():
+                req = abci.Request.decode(reader.read_msg())
+                with self._mtx:
+                    res = abci.dispatch(self.app, req)
+                wfile.write(protoio.marshal_delimited(res.encode()))
+                if req.which() == "flush":
+                    wfile.flush()
+        except (OSError, EOFError):
+            pass
+        finally:
+            conn.close()

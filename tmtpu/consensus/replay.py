@@ -1,0 +1,129 @@
+"""Crash recovery (reference: consensus/replay.go).
+
+Two layers, as in the reference:
+1. **Handshaker** (:241) — at boot, ABCI Info tells us where the app is;
+   stored blocks are replayed into the app until app, store and state agree.
+2. **WAL catchup** (:93 catchupReplay) — messages for the in-progress height
+   are re-fed through the consensus handlers (ConsensusState.catchup_replay).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from tmtpu.abci import types as abci
+from tmtpu.crypto.encoding import pubkey_to_proto
+from tmtpu.state.execution import BlockExecutor, update_state
+from tmtpu.state.store import StateStore
+from tmtpu.types import pb
+from tmtpu.types.block import BlockID
+from tmtpu.types.validator import Validator
+
+
+class HandshakeError(Exception):
+    pass
+
+
+class Handshaker:
+    def __init__(self, state_store: StateStore, state, block_store,
+                 genesis_doc, event_bus=None):
+        self.state_store = state_store
+        self.state = state
+        self.block_store = block_store
+        self.genesis_doc = genesis_doc
+        self.event_bus = event_bus
+        self.n_blocks = 0
+
+    def handshake(self, proxy_app) -> bytes:
+        """replay.go:241 — returns the app hash both sides agree on."""
+        res = proxy_app.query.info_sync(abci.RequestInfo(version="tmtpu"))
+        app_height = res.last_block_height
+        app_hash = bytes(res.last_block_app_hash)
+        if app_height < 0:
+            raise HandshakeError(f"got negative last block height {app_height}")
+        if res.app_version and res.app_version != self.state.app_version:
+            # replay.go:263 — the app's version becomes part of state
+            self.state.app_version = res.app_version
+            self.state_store.save(self.state)
+        app_hash = self.replay_blocks(proxy_app, app_hash, app_height)
+        return app_hash
+
+    def replay_blocks(self, proxy_app, app_hash: bytes, app_height: int
+                      ) -> bytes:
+        """replay.go:284 ReplayBlocks."""
+        store_height = self.block_store.height()
+        state_height = self.state.last_block_height
+
+        if app_height == 0:
+            # fresh app: InitChain with genesis validators
+            vals = [abci.ValidatorUpdate(
+                pub_key=pubkey_to_proto(v.pub_key), power=v.power)
+                for v in self.genesis_doc.validators]
+            req = abci.RequestInitChain(
+                time=pb.Timestamp.from_unix_nanos(
+                    self.genesis_doc.genesis_time),
+                chain_id=self.genesis_doc.chain_id,
+                consensus_params=_abci_params(
+                    self.genesis_doc.consensus_params),
+                validators=vals,
+                app_state_bytes=b"",
+                initial_height=self.genesis_doc.initial_height,
+            )
+            r = proxy_app.consensus.init_chain_sync(req)
+            if state_height == 0:
+                # plant the app's genesis response into state
+                if r.app_hash:
+                    self.state.app_hash = bytes(r.app_hash)
+                    app_hash = bytes(r.app_hash)
+                if r.consensus_params is not None:
+                    self.state.consensus_params = \
+                        self.state.consensus_params.update(r.consensus_params)
+                if r.validators:
+                    from tmtpu.crypto.encoding import pubkey_from_proto
+
+                    updates = [Validator(pubkey_from_proto(v.pub_key), v.power)
+                               for v in r.validators]
+                    from tmtpu.types.validator import ValidatorSet
+
+                    vs = ValidatorSet(updates)
+                    self.state.validators = vs
+                    self.state.next_validators = \
+                        vs.copy_increment_proposer_priority(1)
+                self.state_store.save(self.state)
+
+        if store_height == 0:
+            return self.state.app_hash if state_height == 0 else app_hash
+
+        if store_height < app_height:
+            raise HandshakeError(
+                f"app block height {app_height} ahead of store {store_height}")
+        if store_height < state_height:
+            raise HandshakeError(
+                f"state height {state_height} ahead of store {store_height}")
+
+        # replay stored blocks the app hasn't seen
+        exec_ = BlockExecutor(self.state_store, proxy_app.consensus,
+                              event_bus=None)
+        for h in range(app_height + 1, store_height + 1):
+            block = self.block_store.load_block(h)
+            if block is None:
+                raise HandshakeError(f"missing block {h} in store")
+            self.n_blocks += 1
+            if h <= state_height:
+                # state already reflects this block: replay app-side only
+                responses = exec_._exec_block_on_proxy_app(self.state, block)
+                res = proxy_app.consensus.commit_sync()
+                app_hash = bytes(res.data)
+            else:
+                # final block: full ApplyBlock updates state too
+                meta = self.block_store.load_block_meta(h)
+                self.state, _ = exec_.apply_block(
+                    self.state, meta.block_id, block)
+                app_hash = self.state.app_hash
+        return app_hash
+
+
+def _abci_params(params) -> abci.ConsensusParams:
+    p = params.to_proto()
+    return abci.ConsensusParams(block=p.block, evidence=p.evidence,
+                                validator=p.validator, version=p.version)

@@ -1,0 +1,67 @@
+"""Timeout ticker (reference: consensus/ticker.go) — schedules one pending
+timeout at a time; a newer (height, round, step) overrides older ones."""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Callable, NamedTuple, Optional
+
+
+class TimeoutInfo(NamedTuple):
+    duration_ns: int
+    height: int
+    round: int
+    step: int
+
+
+class TimeoutTicker:
+    """One timer thread; schedule_timeout replaces the pending timeout iff
+    the new one is for a later (H, R, S) — ticker.go timeoutRoutine."""
+
+    def __init__(self, on_timeout: Callable[[TimeoutInfo], None]):
+        self._on_timeout = on_timeout
+        self._cv = threading.Condition()
+        self._pending: Optional[tuple] = None  # (deadline_ns, TimeoutInfo)
+        self._stopped = False
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="timeout-ticker")
+        self._thread.start()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify()
+
+    def schedule_timeout(self, ti: TimeoutInfo) -> None:
+        deadline = time.time_ns() + ti.duration_ns
+        with self._cv:
+            if self._pending is not None:
+                _, old = self._pending
+                if (ti.height, ti.round, ti.step) < \
+                        (old.height, old.round, old.step):
+                    return  # stale
+            self._pending = (deadline, ti)
+            self._cv.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._stopped and self._pending is None:
+                    self._cv.wait()
+                if self._stopped:
+                    return
+                deadline, ti = self._pending
+                now = time.time_ns()
+                if now < deadline:
+                    self._cv.wait(timeout=(deadline - now) / 1e9)
+                    continue  # re-check: pending may have been replaced
+                self._pending = None
+            try:
+                self._on_timeout(ti)
+            except Exception:
+                pass

@@ -1,0 +1,186 @@
+"""Consensus round state + HeightVoteSet (reference: consensus/types/)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from tmtpu.types.validator import ValidatorSet
+from tmtpu.types.vote import PRECOMMIT, PREVOTE, Vote, VoteError
+from tmtpu.types.vote_set import VoteSet
+
+# RoundStepType (consensus/types/round_state.go:12-24)
+STEP_NEW_HEIGHT = 1
+STEP_NEW_ROUND = 2
+STEP_PROPOSE = 3
+STEP_PREVOTE = 4
+STEP_PREVOTE_WAIT = 5
+STEP_PRECOMMIT = 6
+STEP_PRECOMMIT_WAIT = 7
+STEP_COMMIT = 8
+
+STEP_NAMES = {
+    STEP_NEW_HEIGHT: "NewHeight", STEP_NEW_ROUND: "NewRound",
+    STEP_PROPOSE: "Propose", STEP_PREVOTE: "Prevote",
+    STEP_PREVOTE_WAIT: "PrevoteWait", STEP_PRECOMMIT: "Precommit",
+    STEP_PRECOMMIT_WAIT: "PrecommitWait", STEP_COMMIT: "Commit",
+}
+
+
+class RoundState:
+    """consensus/types/round_state.go:65 — the full mutable round state the
+    state machine carries (snapshotted for gossip/RPC)."""
+
+    def __init__(self):
+        self.height = 0
+        self.round = 0
+        self.step = STEP_NEW_HEIGHT
+        self.start_time = 0  # unix nanos
+        self.commit_time = 0
+        self.validators: Optional[ValidatorSet] = None
+        self.proposal = None
+        self.proposal_block = None
+        self.proposal_block_parts = None
+        self.locked_round = -1
+        self.locked_block = None
+        self.locked_block_parts = None
+        self.valid_round = -1
+        self.valid_block = None
+        self.valid_block_parts = None
+        self.votes: Optional[HeightVoteSet] = None
+        self.commit_round = -1
+        self.last_commit = None  # VoteSet of last height's precommits
+        self.last_validators: Optional[ValidatorSet] = None
+        self.triggered_timeout_precommit = False
+
+    def step_name(self) -> str:
+        return STEP_NAMES.get(self.step, "?")
+
+    def height_round_step(self) -> str:
+        return f"{self.height}/{self.round}/{self.step_name()}"
+
+
+class HeightVoteSet:
+    """consensus/types/height_vote_set.go — prevotes+precommits per round,
+    with bounded peer-catchup rounds."""
+
+    MAX_CATCHUP_ROUNDS = 2  # height_vote_set.go:14
+
+    def __init__(self, chain_id: str, height: int, val_set: ValidatorSet,
+                 verify_backend=None):
+        self.chain_id = chain_id
+        self.height = height
+        self.val_set = val_set
+        self.verify_backend = verify_backend
+        self._lock = threading.RLock()
+        self._round = 0
+        self._round_vote_sets: Dict[int, dict] = {}
+        self._peer_catchup_rounds: Dict[str, List[int]] = {}
+        self._add_round(0)
+        self._add_round(1)
+
+    def _add_round(self, round: int) -> None:
+        if round in self._round_vote_sets:
+            return
+        self._round_vote_sets[round] = {
+            PREVOTE: VoteSet(self.chain_id, self.height, round, PREVOTE,
+                             self.val_set, self.verify_backend),
+            PRECOMMIT: VoteSet(self.chain_id, self.height, round, PRECOMMIT,
+                               self.val_set, self.verify_backend),
+        }
+
+    def set_round(self, round: int) -> None:
+        """Create vote sets up to round+1; the working round must not
+        regress (height_vote_set.go SetRound)."""
+        with self._lock:
+            if self._round != 0 and round < self._round:
+                raise ValueError("SetRound() must increment round")
+            for r in range(self._round, round + 2):
+                self._add_round(r)
+            self._round = round
+
+    def round(self) -> int:
+        with self._lock:
+            return self._round
+
+    def add_vote(self, vote: Vote, peer_id: str = "") -> bool:
+        ok = self.add_votes([vote], peer_id)
+        return ok[0]
+
+    def add_votes(self, votes: List[Vote], peer_id: str = "") -> List[bool]:
+        """Batch add: groups by (round, type) and feeds each group's batch
+        to the underlying VoteSet (one TPU dispatch per group)."""
+        with self._lock:
+            groups: Dict[tuple, List[int]] = {}
+            results = [False] * len(votes)
+            first_err = None
+            for i, v in enumerate(votes):
+                if v.type not in (PREVOTE, PRECOMMIT):
+                    first_err = first_err or VoteError("invalid vote type")
+                    continue
+                if v.round not in self._round_vote_sets:
+                    rounds = self._peer_catchup_rounds.setdefault(peer_id, [])
+                    if v.round in rounds:
+                        pass  # already tracking this catchup round
+                    elif len(rounds) < self.MAX_CATCHUP_ROUNDS:
+                        self._add_round(v.round)
+                        rounds.append(v.round)
+                    else:
+                        # punish peers sending too many catchup rounds
+                        first_err = first_err or VoteError(
+                            "peer has sent a vote that does not match our round "
+                            "for more than one round"
+                        )
+                        continue
+                groups.setdefault((v.round, v.type), []).append(i)
+            conflict = None
+            for (rnd, typ), idxs in groups.items():
+                vs = self._round_vote_sets[rnd][typ]
+                try:
+                    sub = vs.add_votes([votes[i] for i in idxs])
+                except VoteError as e:
+                    from tmtpu.types.vote import ErrVoteConflictingVotes
+
+                    if isinstance(e, ErrVoteConflictingVotes):
+                        conflict = conflict or e
+                        sub = e.results  # batch was processed before raising
+                    else:
+                        first_err = first_err or e
+                        continue
+                if sub is not None:
+                    for i, ok in zip(idxs, sub):
+                        results[i] = ok
+            if conflict is not None:
+                conflict.results = results
+                raise conflict
+            if first_err is not None and not any(results):
+                raise first_err
+            return results
+
+    def prevotes(self, round: int) -> Optional[VoteSet]:
+        return self._get(round, PREVOTE)
+
+    def precommits(self, round: int) -> Optional[VoteSet]:
+        return self._get(round, PRECOMMIT)
+
+    def _get(self, round: int, typ: int) -> Optional[VoteSet]:
+        with self._lock:
+            rvs = self._round_vote_sets.get(round)
+            return rvs[typ] if rvs else None
+
+    def pol_info(self) -> tuple:
+        """Highest round with a prevote polka (height_vote_set.go POLInfo)."""
+        with self._lock:
+            for r in range(self._round, -1, -1):
+                vs = self._get(r, PREVOTE)
+                if vs is not None:
+                    bid, ok = vs.two_thirds_majority()
+                    if ok:
+                        return r, bid
+            return -1, None
+
+    def set_peer_maj23(self, round: int, typ: int, peer_id: str,
+                       block_id) -> None:
+        with self._lock:
+            self._add_round(round)
+            self._round_vote_sets[round][typ].set_peer_maj23(peer_id, block_id)

@@ -1,0 +1,164 @@
+"""Write-ahead log (reference: consensus/wal.go).
+
+Every consensus message is appended (fsync'd for our own messages) BEFORE
+processing, so a crashed node replays to exactly where it left off. Record
+format: crc32(payload) | uvarint len | payload, where payload is a
+WALMessage proto envelope. #ENDHEIGHT markers (EndHeightMessage) delimit
+heights for SearchForEndHeight (:231), like the reference.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import time
+import zlib
+from typing import Iterator, Optional, Tuple
+
+from tmtpu.libs import protoio
+from tmtpu.types import pb
+
+
+class TimeoutInfoPB(pb.ProtoMessage):
+    FIELDS = [
+        (1, "duration_ns", "int64"),
+        (2, "height", "int64"),
+        (3, "round", "int32"),
+        (4, "step", "int32"),
+    ]
+
+
+class MsgInfoPB(pb.ProtoMessage):
+    """A peer/internal consensus message: exactly one payload set."""
+
+    FIELDS = [
+        (1, "peer_id", "string"),
+        (2, "proposal", ("msg", pb.Proposal)),
+        (3, "block_part_height", "int64"),
+        (4, "block_part_round", "int32"),
+        (5, "block_part", ("msg", pb.Part)),
+        (6, "vote", ("msg", pb.Vote)),
+    ]
+
+
+class EndHeightPB(pb.ProtoMessage):
+    FIELDS = [(1, "height", "int64")]
+
+
+class EventRoundStatePB(pb.ProtoMessage):
+    FIELDS = [(1, "height", "int64"), (2, "round", "int32"),
+              (3, "step", "string")]
+
+
+class WALMessagePB(pb.ProtoMessage):
+    FIELDS = [
+        (1, "time", ("msg!", pb.Timestamp)),
+        (2, "end_height", ("msg", EndHeightPB)),
+        (3, "msg_info", ("msg", MsgInfoPB)),
+        (4, "timeout", ("msg", TimeoutInfoPB)),
+        (5, "event_round_state", ("msg", EventRoundStatePB)),
+    ]
+
+
+class CorruptedWALError(Exception):
+    pass
+
+
+class WAL:
+    """consensus/wal.go:58 WAL interface: Write / WriteSync /
+    FlushAndSync / SearchForEndHeight."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "ab")
+        self._lock = threading.Lock()
+
+    def write(self, msg: WALMessagePB) -> None:
+        payload = msg.encode()
+        rec = struct.pack(">I", zlib.crc32(payload)) + \
+            protoio.encode_uvarint(len(payload)) + payload
+        with self._lock:
+            self._f.write(rec)
+
+    def write_sync(self, msg: WALMessagePB) -> None:
+        self.write(msg)
+        self.flush_and_sync()
+
+    def flush_and_sync(self) -> None:
+        with self._lock:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+            finally:
+                self._f.close()
+
+    # -- helpers to build messages -----------------------------------------
+
+    @staticmethod
+    def make(now_ns: Optional[int] = None, **kw) -> WALMessagePB:
+        return WALMessagePB(
+            time=pb.Timestamp.from_unix_nanos(now_ns or time.time_ns()), **kw
+        )
+
+    def write_end_height(self, height: int) -> None:
+        self.write_sync(self.make(end_height=EndHeightPB(height=height)))
+
+    # -- reading ------------------------------------------------------------
+
+    @staticmethod
+    def iter_messages(path: str, strict: bool = False
+                      ) -> Iterator[WALMessagePB]:
+        """Decode records; a torn tail record terminates iteration (crash
+        tolerance), a mid-file corruption raises in strict mode."""
+        try:
+            f = open(path, "rb")
+        except FileNotFoundError:
+            return
+        with f:
+            data = f.read()
+        pos = 0
+        n = len(data)
+        while pos < n:
+            start = pos
+            if n - pos < 5:
+                return  # torn tail
+            (crc,) = struct.unpack_from(">I", data, pos)
+            pos += 4
+            try:
+                length, pos = protoio.decode_uvarint(data, pos)
+            except (EOFError, ValueError):
+                return
+            if length > 10 * 1024 * 1024 or n - pos < length:
+                if strict and start != n:
+                    raise CorruptedWALError(f"torn record at offset {start}")
+                return
+            payload = data[pos:pos + length]
+            pos += length
+            if zlib.crc32(payload) != crc:
+                if strict:
+                    raise CorruptedWALError(f"crc mismatch at offset {start}")
+                return
+            try:
+                yield WALMessagePB.decode(payload)
+            except Exception as e:
+                if strict:
+                    raise CorruptedWALError(str(e)) from e
+                return
+
+    @classmethod
+    def search_for_end_height(cls, path: str, height: int
+                              ) -> Optional[int]:
+        """wal.go:231 — index (message ordinal) just after #ENDHEIGHT for
+        ``height``, or None."""
+        found = None
+        for i, msg in enumerate(cls.iter_messages(path)):
+            if msg.end_height is not None and msg.end_height.height == height:
+                found = i + 1
+        return found

@@ -48,17 +48,31 @@ def set_default_backend(backend: str) -> None:
         _tpu_usable = None
 
 
+_PROBE_TIMEOUT_S = float(os.environ.get("TMTPU_TPU_PROBE_TIMEOUT", "10"))
+
+
 def _tpu_available() -> bool:
+    """Probe for a usable jax device ONCE, with a hard timeout: a wedged
+    PJRT plugin/tunnel can hang backend init indefinitely, and consensus
+    must degrade to the CPU path rather than stall."""
     global _tpu_usable
     if _tpu_usable is None:
         with _probe_lock:
             if _tpu_usable is None:
-                try:
-                    import jax
+                result = {}
 
-                    _tpu_usable = len(jax.devices()) > 0
-                except Exception:
-                    _tpu_usable = False
+                def probe():
+                    try:
+                        import jax
+
+                        result["ok"] = len(jax.devices()) > 0
+                    except Exception:
+                        result["ok"] = False
+
+                t = threading.Thread(target=probe, daemon=True)
+                t.start()
+                t.join(_PROBE_TIMEOUT_S)
+                _tpu_usable = result.get("ok", False)
     return _tpu_usable
 
 

@@ -1,0 +1,183 @@
+"""Evidence pool (reference: evidence/pool.go, evidence/verify.go).
+
+Receives equivocations from consensus (pool.go:179 ReportConflictingVotes),
+verifies them (verify.go:162 VerifyDuplicateVote — two signature checks,
+batched here), gossips/ships them in blocks and prunes expired ones.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from tmtpu.crypto import batch as crypto_batch
+from tmtpu.libs.db import DB
+from tmtpu.types import pb
+from tmtpu.types.evidence import (
+    DuplicateVoteEvidence, LightClientAttackEvidence, evidence_from_proto,
+    evidence_to_proto,
+)
+
+
+class EvidenceError(Exception):
+    pass
+
+
+def _k_pending(height: int, ev_hash: bytes) -> bytes:
+    return b"evp:%020d:" % height + ev_hash
+
+
+def _k_committed(height: int, ev_hash: bytes) -> bytes:
+    return b"evc:%020d:" % height + ev_hash
+
+
+class EvidencePool:
+    def __init__(self, db: DB, state_store, block_store,
+                 verify_backend=None):
+        self.db = db
+        self.state_store = state_store
+        self.block_store = block_store
+        self.verify_backend = verify_backend
+        self._lock = threading.Lock()
+        self._state = None  # latest sm.State, set on update()
+
+    # -- ingestion ----------------------------------------------------------
+
+    def report_conflicting_votes(self, vote_a, vote_b) -> None:
+        """pool.go:179 — equivocation straight from consensus; the votes'
+        signatures were already verified by the VoteSet."""
+        state = self._state or self.state_store.load()
+        if state is None:
+            return
+        vals = self.state_store.load_validators(vote_a.height) \
+            or state.validators
+        ev = DuplicateVoteEvidence.new(
+            vote_a, vote_b, block_time=state.last_block_time, val_set=vals)
+        with self._lock:
+            if self._is_pending(ev) or self._is_committed(ev):
+                return
+            self.db.set(_k_pending(ev.height(), ev.hash()),
+                        evidence_to_proto(ev).encode())
+
+    def add_evidence(self, ev) -> None:
+        """pool.go AddEvidence — gossiped evidence must be verified."""
+        with self._lock:
+            if self._is_pending(ev) or self._is_committed(ev):
+                return
+        self.verify(ev)
+        with self._lock:
+            self.db.set(_k_pending(ev.height(), ev.hash()),
+                        evidence_to_proto(ev).encode())
+
+    # -- verification (verify.go) ------------------------------------------
+
+    def verify(self, ev) -> None:
+        state = self._state or self.state_store.load()
+        if state is None:
+            raise EvidenceError("no state to verify evidence against")
+        params = state.consensus_params
+        age_blocks = state.last_block_height - ev.height()
+        age_ns = state.last_block_time - ev.time()
+        if age_blocks > params.evidence_max_age_num_blocks and \
+                age_ns > params.evidence_max_age_duration_ns:
+            raise EvidenceError(
+                f"evidence from height {ev.height()} is too old")
+        if isinstance(ev, DuplicateVoteEvidence):
+            self._verify_duplicate_vote(ev, state)
+        elif isinstance(ev, LightClientAttackEvidence):
+            self._verify_light_attack(ev, state)
+        else:
+            raise EvidenceError(f"unknown evidence type {type(ev)}")
+
+    def _verify_duplicate_vote(self, ev: DuplicateVoteEvidence, state
+                               ) -> None:
+        """verify.go:162 VerifyDuplicateVote — both sigs in one batch."""
+        a, b = ev.vote_a, ev.vote_b
+        if a.height != b.height or a.round != b.round or \
+                a.type != b.type:
+            raise EvidenceError("duplicate votes from different H/R/S")
+        if a.validator_address != b.validator_address:
+            raise EvidenceError("duplicate votes from different validators")
+        if a.block_id == b.block_id:
+            raise EvidenceError("duplicate votes for the same block")
+        vals = self.state_store.load_validators(a.height)
+        if vals is None:
+            raise EvidenceError(f"no validators for height {a.height}")
+        _, val = vals.get_by_address(a.validator_address)
+        if val is None:
+            raise EvidenceError("validator not in set at evidence height")
+        if ev.validator_power != val.voting_power:
+            raise EvidenceError("validator power mismatch")
+        if ev.total_voting_power != vals.total_voting_power():
+            raise EvidenceError("total voting power mismatch")
+        bv = crypto_batch.new_batch_verifier(self.verify_backend)
+        bv.add(val.pub_key, a.sign_bytes(state.chain_id), a.signature)
+        bv.add(val.pub_key, b.sign_bytes(state.chain_id), b.signature)
+        ok, _ = bv.verify()
+        if not ok:
+            raise EvidenceError("invalid signature on duplicate vote")
+
+    def _verify_light_attack(self, ev: LightClientAttackEvidence, state
+                             ) -> None:
+        """verify.go:113 VerifyLightClientAttack (common-height check)."""
+        common_vals = self.state_store.load_validators(ev.common_height)
+        if common_vals is None:
+            raise EvidenceError(
+                f"no validators for common height {ev.common_height}")
+        sh = ev.conflicting_block.signed_header
+        common_vals.verify_commit_light_trusting(
+            state.chain_id, sh.commit, 1, 3, backend=self.verify_backend)
+        trusted = self.block_store.load_block_meta(sh.header.height)
+        if trusted is not None and \
+                trusted.header.hash() == sh.header.hash():
+            raise EvidenceError(
+                "conflicting block matches our own block — not an attack")
+
+    # -- block building / lifecycle ----------------------------------------
+
+    def pending_evidence(self, max_bytes: int) -> List:
+        out, total = [], 0
+        with self._lock:
+            for _, raw in self.db.iter_prefix(b"evp:"):
+                if total + len(raw) > max_bytes:
+                    break
+                out.append(evidence_from_proto(pb.Evidence.decode(raw)))
+                total += len(raw)
+        return out
+
+    def update(self, state, block_evidence: List) -> None:
+        """pool.go Update — mark committed, prune expired."""
+        with self._lock:
+            self._state = state
+            for ev in block_evidence:
+                self.db.set(_k_committed(ev.height(), ev.hash()), b"\x01")
+                self.db.delete(_k_pending(ev.height(), ev.hash()))
+            # prune expired pending evidence
+            params = state.consensus_params
+            for k, raw in list(self.db.iter_prefix(b"evp:")):
+                ev = evidence_from_proto(pb.Evidence.decode(raw))
+                age_blocks = state.last_block_height - ev.height()
+                age_ns = state.last_block_time - ev.time()
+                if age_blocks > params.evidence_max_age_num_blocks and \
+                        age_ns > params.evidence_max_age_duration_ns:
+                    self.db.delete(k)
+
+    def check_evidence(self, ev_list: List) -> None:
+        """pool.go CheckEvidence — verify a block's evidence list."""
+        seen = set()
+        for ev in ev_list:
+            if ev.hash() in seen:
+                raise EvidenceError("duplicate evidence in block")
+            seen.add(ev.hash())
+            with self._lock:
+                committed = self._is_committed(ev)
+            if committed:
+                raise EvidenceError("evidence was already committed")
+            self.verify(ev)
+
+    def _is_pending(self, ev) -> bool:
+        return self.db.has(_k_pending(ev.height(), ev.hash()))
+
+    def _is_committed(self, ev) -> bool:
+        return self.db.has(_k_committed(ev.height(), ev.hash()))

@@ -1,0 +1,121 @@
+"""Key-value store abstraction (counterpart of the reference's tm-db
+dependency, go.mod: tendermint/tm-db — LevelDB et al).
+
+Backends: ``MemDB`` (dict, tests) and ``SQLiteDB`` (stdlib sqlite3 in WAL
+mode — durable, transactional, zero extra deps). Both provide get/set/
+delete/iteration-by-prefix and write batches, which is the full surface the
+store/state/indexer layers need.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from typing import Iterator, List, Optional, Tuple
+
+
+class DB:
+    def get(self, key: bytes) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def set(self, key: bytes, value: bytes) -> None:
+        raise NotImplementedError
+
+    def delete(self, key: bytes) -> None:
+        raise NotImplementedError
+
+    def has(self, key: bytes) -> bool:
+        return self.get(key) is not None
+
+    def iter_prefix(self, prefix: bytes) -> Iterator[Tuple[bytes, bytes]]:
+        """Ascending iteration over keys with the given prefix."""
+        raise NotImplementedError
+
+    def write_batch(self, sets: List[Tuple[bytes, bytes]],
+                    deletes: List[bytes] = ()) -> None:
+        for k in deletes:
+            self.delete(k)
+        for k, v in sets:
+            self.set(k, v)
+
+    def close(self) -> None:
+        pass
+
+
+class MemDB(DB):
+    def __init__(self):
+        self._data = {}
+        self._lock = threading.Lock()
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self._data.get(bytes(key))
+
+    def set(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._data[bytes(key)] = bytes(value)
+
+    def delete(self, key: bytes) -> None:
+        with self._lock:
+            self._data.pop(bytes(key), None)
+
+    def iter_prefix(self, prefix: bytes):
+        with self._lock:
+            items = sorted((k, v) for k, v in self._data.items()
+                           if k.startswith(prefix))
+        yield from items
+
+
+class SQLiteDB(DB):
+    def __init__(self, path: str):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA synchronous=NORMAL")
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB)"
+        )
+        self._conn.commit()
+        self._lock = threading.Lock()
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT v FROM kv WHERE k = ?", (bytes(key),)
+            ).fetchone()
+        return row[0] if row else None
+
+    def set(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO kv (k, v) VALUES (?, ?)",
+                (bytes(key), bytes(value)),
+            )
+            self._conn.commit()
+
+    def delete(self, key: bytes) -> None:
+        with self._lock:
+            self._conn.execute("DELETE FROM kv WHERE k = ?", (bytes(key),))
+            self._conn.commit()
+
+    def iter_prefix(self, prefix: bytes):
+        hi = prefix + b"\xff" * 8
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT k, v FROM kv WHERE k >= ? AND k <= ? ORDER BY k",
+                (bytes(prefix), hi),
+            ).fetchall()
+        yield from ((bytes(k), bytes(v)) for k, v in rows)
+
+    def write_batch(self, sets, deletes=()) -> None:
+        with self._lock:
+            self._conn.executemany(
+                "DELETE FROM kv WHERE k = ?", [(bytes(k),) for k in deletes]
+            )
+            self._conn.executemany(
+                "INSERT OR REPLACE INTO kv (k, v) VALUES (?, ?)",
+                [(bytes(k), bytes(v)) for k, v in sets],
+            )
+            self._conn.commit()
+
+    def close(self) -> None:
+        self._conn.close()

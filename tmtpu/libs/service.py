@@ -1,0 +1,65 @@
+"""BaseService lifecycle (reference: libs/service/service.go) — the
+Start/Stop/Reset + is-running contract every long-lived component uses."""
+
+from __future__ import annotations
+
+import threading
+
+
+class ServiceError(Exception):
+    pass
+
+
+class BaseService:
+    def __init__(self, name: str = ""):
+        self._name = name or type(self).__name__
+        self._started = False
+        self._stopped = False
+        self._quit = threading.Event()
+        self._svc_lock = threading.Lock()
+
+    def start(self) -> None:
+        with self._svc_lock:
+            if self._started:
+                raise ServiceError(f"{self._name} already started")
+            if self._stopped:
+                raise ServiceError(f"{self._name} already stopped")
+            self.on_start()
+            self._started = True
+
+    def stop(self) -> None:
+        with self._svc_lock:
+            if self._stopped or not self._started:
+                return
+            self._quit.set()
+            self.on_stop()
+            self._stopped = True
+
+    def reset(self) -> None:
+        with self._svc_lock:
+            if not self._stopped:
+                raise ServiceError(f"{self._name} not stopped, cannot reset")
+            self._started = False
+            self._stopped = False
+            self._quit = threading.Event()
+            self.on_reset()
+
+    def is_running(self) -> bool:
+        return self._started and not self._stopped
+
+    def wait(self, timeout=None) -> None:
+        self._quit.wait(timeout)
+
+    @property
+    def quit_event(self) -> threading.Event:
+        return self._quit
+
+    # hooks
+    def on_start(self) -> None:
+        pass
+
+    def on_stop(self) -> None:
+        pass
+
+    def on_reset(self) -> None:
+        pass

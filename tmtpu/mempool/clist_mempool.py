@@ -1,0 +1,198 @@
+"""Mempool v0 — FIFO with tx cache (reference: mempool/v0/clist_mempool.go).
+
+CheckTx goes through the mempool ABCI connection; committed txs are removed
+and the remainder re-checked on update (:435), exactly the reference's
+lifecycle. The concurrent-linked-list becomes an OrderedDict under one lock
+(Python's list/dict are already thread-safe under the GIL for our access
+pattern; the lock covers compound ops).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable, List, Optional
+
+from tmtpu.abci import types as abci
+from tmtpu.crypto import tmhash
+
+
+class TxInMempoolError(Exception):
+    pass
+
+
+class MempoolFullError(Exception):
+    pass
+
+
+class TxCache:
+    """LRU of tx hashes (mempool/cache.go)."""
+
+    def __init__(self, size: int):
+        self.size = size
+        self._map: "OrderedDict[bytes, None]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def push(self, tx: bytes) -> bool:
+        key = tmhash.sum(tx)
+        with self._lock:
+            if key in self._map:
+                self._map.move_to_end(key)
+                return False
+            self._map[key] = None
+            if len(self._map) > self.size:
+                self._map.popitem(last=False)
+            return True
+
+    def remove(self, tx: bytes) -> None:
+        with self._lock:
+            self._map.pop(tmhash.sum(tx), None)
+
+
+class CListMempool:
+    def __init__(self, proxy_app, max_txs: int = 5000,
+                 max_txs_bytes: int = 1 << 30, cache_size: int = 10000,
+                 keep_invalid_txs_in_cache: bool = False,
+                 pre_check: Optional[Callable] = None):
+        self.proxy_app = proxy_app
+        self.max_txs = max_txs
+        self.max_txs_bytes = max_txs_bytes
+        self.keep_invalid_txs_in_cache = keep_invalid_txs_in_cache
+        self.pre_check = pre_check
+        self.cache = TxCache(cache_size)
+        self._txs: "OrderedDict[bytes, dict]" = OrderedDict()  # hash -> info
+        self._txs_bytes = 0
+        self._height = 0
+        self._lock = threading.RLock()
+        self._update_lock = threading.RLock()  # Lock()/Unlock() surface
+        self._notify: List[Callable] = []
+
+    # -- Mempool interface (mempool/mempool.go:30) --------------------------
+
+    def check_tx(self, tx: bytes, cb: Optional[Callable] = None,
+                 tx_info: Optional[dict] = None) -> None:
+        tx = bytes(tx)
+        with self._lock:
+            if len(self._txs) >= self.max_txs or \
+                    self._txs_bytes + len(tx) > self.max_txs_bytes:
+                raise MempoolFullError(
+                    f"mempool is full: {len(self._txs)} txs")
+            if not self.cache.push(tx):
+                raise TxInMempoolError("tx already exists in cache")
+        if self.pre_check is not None:
+            err = self.pre_check(tx)
+            if err is not None:
+                self.cache.remove(tx)
+                raise ValueError(f"pre-check failed: {err}")
+        res = self.proxy_app.check_tx_sync(abci.RequestCheckTx(
+            tx=tx, type=abci.CHECK_TX_TYPE_NEW))
+        self._resolve_check_tx(tx, res, tx_info or {})
+        if cb is not None:
+            cb(res)
+
+    def _resolve_check_tx(self, tx: bytes, res: abci.ResponseCheckTx,
+                          tx_info: dict) -> None:
+        key = tmhash.sum(tx)
+        with self._lock:
+            if res.is_ok():
+                if key not in self._txs:
+                    self._txs[key] = {
+                        "tx": tx, "gas_wanted": res.gas_wanted,
+                        "height": self._height,
+                        "senders": set(filter(None, [tx_info.get("sender")])),
+                    }
+                    self._txs_bytes += len(tx)
+                    for fn in self._notify:
+                        fn()
+            else:
+                if not self.keep_invalid_txs_in_cache:
+                    self.cache.remove(tx)
+
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int
+                               ) -> List[bytes]:
+        with self._lock:
+            out, total_b, total_g = [], 0, 0
+            for info in self._txs.values():
+                # amino/proto overhead bound per tx, as the reference reaps
+                nb = total_b + len(info["tx"]) + 20
+                ng = total_g + max(info["gas_wanted"], 0)
+                if max_bytes > -1 and nb > max_bytes:
+                    break
+                if max_gas > -1 and ng > max_gas:
+                    break
+                total_b, total_g = nb, ng
+                out.append(info["tx"])
+            return out
+
+    def reap_max_txs(self, n: int) -> List[bytes]:
+        with self._lock:
+            txs = [i["tx"] for i in self._txs.values()]
+            return txs if n < 0 else txs[:n]
+
+    def lock(self) -> None:
+        self._update_lock.acquire()
+
+    def unlock(self) -> None:
+        self._update_lock.release()
+
+    def update(self, height: int, txs: List[bytes], deliver_tx_responses
+               ) -> None:
+        """Remove committed txs; recheck the rest (clist_mempool.go:435).
+        Caller must hold lock()."""
+        with self._lock:
+            self._height = height
+            for tx, res in zip(txs, deliver_tx_responses):
+                if res.is_ok():
+                    self.cache.push(tx)  # committed: keep in cache forever-ish
+                elif not self.keep_invalid_txs_in_cache:
+                    self.cache.remove(tx)
+                key = tmhash.sum(tx)
+                info = self._txs.pop(key, None)
+                if info is not None:
+                    self._txs_bytes -= len(info["tx"])
+            remaining = [i["tx"] for i in self._txs.values()]
+        # recheck outside the map lock (sync for simplicity; small mempools)
+        for tx in remaining:
+            res = self.proxy_app.check_tx_sync(abci.RequestCheckTx(
+                tx=tx, type=abci.CHECK_TX_TYPE_RECHECK))
+            if not res.is_ok():
+                with self._lock:
+                    info = self._txs.pop(tmhash.sum(tx), None)
+                    if info is not None:
+                        self._txs_bytes -= len(info["tx"])
+                if not self.keep_invalid_txs_in_cache:
+                    self.cache.remove(tx)
+
+    def flush(self) -> None:
+        with self._lock:
+            self._txs.clear()
+            self._txs_bytes = 0
+
+    def flush_app_conn(self) -> None:
+        self.proxy_app.flush_sync()
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._txs)
+
+    def size_bytes(self) -> int:
+        with self._lock:
+            return self._txs_bytes
+
+    def is_empty(self) -> bool:
+        return self.size() == 0
+
+    def txs_available(self, fn: Callable) -> None:
+        """Register a new-tx notification (EnableTxsAvailable analogue)."""
+        self._notify.append(fn)
+
+    def mark_sender(self, tx: bytes, sender) -> None:
+        with self._lock:
+            info = self._txs.get(tmhash.sum(tx))
+            if info is not None:
+                info["senders"].add(sender)
+
+    def senders(self, tx: bytes) -> set:
+        with self._lock:
+            info = self._txs.get(tmhash.sum(tx))
+            return set(info["senders"]) if info else set()

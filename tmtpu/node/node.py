@@ -1,0 +1,162 @@
+"""Node assembly (reference: node/node.go NewNode :706, OnStart :941).
+
+Wires, in the reference's order: DBs → state → proxyApp → EventBus →
+privval → handshake → mempool → block executor → consensus → RPC.
+(p2p switch + reactors attach here as they land; a single-node validator
+is fully functional without them — BASELINE config #1.)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from tmtpu.abci.example.kvstore import KVStoreApplication
+from tmtpu.config.config import Config
+from tmtpu.consensus.replay import Handshaker
+from tmtpu.consensus.state import ConsensusState
+from tmtpu.crypto import batch as crypto_batch
+from tmtpu.libs.db import DB, MemDB, SQLiteDB
+from tmtpu.libs.service import BaseService
+from tmtpu.mempool.clist_mempool import CListMempool
+from tmtpu.privval.file_pv import FilePV
+from tmtpu.proxy import AppConns, default_client_creator
+from tmtpu.state.execution import BlockExecutor
+from tmtpu.state.state import state_from_genesis
+from tmtpu.state.store import StateStore
+from tmtpu.store.block_store import BlockStore
+from tmtpu.types.event_bus import EventBus
+from tmtpu.types.genesis import GenesisDoc
+
+
+def _make_db(config: Config, name: str) -> DB:
+    if config.base.db_backend == "mem":
+        return MemDB()
+    path = config.rooted(os.path.join(config.base.db_dir, f"{name}.sqlite"))
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    return SQLiteDB(path)
+
+
+class Node(BaseService):
+    def __init__(self, config: Config,
+                 app=None,
+                 genesis_doc: Optional[GenesisDoc] = None,
+                 priv_validator=None):
+        super().__init__("Node")
+        self.config = config
+        crypto_batch.set_default_backend(config.base.crypto_backend)
+
+        # --- DBs + state (node.go initDBs / LoadStateFromDBOrGenesis) ---
+        self.block_store = BlockStore(_make_db(config, "blockstore"))
+        self.state_store = StateStore(
+            _make_db(config, "state"),
+            discard_abci_responses=config.storage.discard_abci_responses,
+        )
+        self.genesis_doc = genesis_doc or GenesisDoc.from_file(
+            config.genesis_path)
+        state = self.state_store.load()
+        if state is None:
+            state = state_from_genesis(self.genesis_doc)
+            self.state_store.save(state)
+
+        # --- proxy app (node.go createAndStartProxyAppConns) ---
+        if app is None:
+            if config.base.proxy_app == "kvstore":
+                app = KVStoreApplication(_make_db(config, "app"))
+            elif config.base.proxy_app == "noop":
+                from tmtpu.abci.types import Application
+
+                app = Application()
+            else:
+                app = config.base.proxy_app  # socket address
+        self.proxy_app = AppConns(default_client_creator(app))
+        self.proxy_app.start()
+
+        # --- event bus + tx indexer (node.go createAndStartEventBus /
+        # IndexerService) ---
+        self.event_bus = EventBus()
+        from tmtpu.state.txindex import (
+            IndexerService, KVTxIndexer, NullTxIndexer,
+        )
+
+        if config.tx_index.indexer == "kv":
+            self.tx_indexer = KVTxIndexer(_make_db(config, "txindex"))
+        else:
+            self.tx_indexer = NullTxIndexer()
+        self.indexer_service = IndexerService(self.tx_indexer, self.event_bus)
+
+        # --- privval ---
+        if priv_validator is None:
+            priv_validator = FilePV.load_or_generate(
+                config.rooted(config.base.priv_validator_key_file),
+                config.rooted(config.base.priv_validator_state_file),
+            )
+        self.priv_validator = priv_validator
+
+        # --- handshake: sync app with store (node.go doHandshake) ---
+        hs = Handshaker(self.state_store, state, self.block_store,
+                        self.genesis_doc, self.event_bus)
+        hs.handshake(self.proxy_app)
+        self.state = hs.state
+
+        # --- mempool ---
+        self.mempool = CListMempool(
+            self.proxy_app.mempool,
+            max_txs=config.mempool.size,
+            max_txs_bytes=config.mempool.max_txs_bytes,
+            cache_size=config.mempool.cache_size,
+            keep_invalid_txs_in_cache=config.mempool.keep_invalid_txs_in_cache,
+        )
+
+        # --- evidence pool ---
+        from tmtpu.evidence.pool import EvidencePool
+
+        self.evidence_pool = EvidencePool(
+            _make_db(config, "evidence"), self.state_store, self.block_store)
+
+        # --- block executor + consensus ---
+        self.block_exec = BlockExecutor(
+            self.state_store, self.proxy_app.consensus, self.mempool,
+            self.evidence_pool, self.event_bus,
+            verify_backend=None,  # BatchVerifier default (config'd above)
+        )
+        wal_path = config.wal_path
+        os.makedirs(os.path.dirname(wal_path), exist_ok=True)
+        self.consensus = ConsensusState(
+            config.consensus, self.state, self.block_exec, self.block_store,
+            self.mempool, self.evidence_pool, self.event_bus,
+            self.priv_validator, wal_path,
+        )
+
+        # --- RPC ---
+        self.rpc_server = None
+        if config.rpc.laddr:
+            from tmtpu.rpc.server import RPCServer
+
+            self.rpc_server = RPCServer(config.rpc.laddr, self)
+
+    def on_start(self) -> None:
+        self.indexer_service.start()
+        self.consensus.start()
+        if self.rpc_server is not None:
+            self.rpc_server.start()
+
+    def on_stop(self) -> None:
+        if self.rpc_server is not None:
+            self.rpc_server.stop()
+        self.consensus.stop()
+        self.indexer_service.stop()
+        self.proxy_app.stop()
+
+    # convenience used by RPC + tests
+    @property
+    def chain_id(self) -> str:
+        return self.genesis_doc.chain_id
+
+    def latest_state(self):
+        return self.consensus.state
+
+
+def default_node(config: Config) -> Node:
+    """node.go DefaultNewNode."""
+    return Node(config)

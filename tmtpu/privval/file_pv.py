@@ -1,0 +1,192 @@
+"""FilePV — file-backed private validator with double-sign protection
+(reference: privval/file.go:148).
+
+Two files: the immutable key file and the last-sign-state file. Before
+signing, the height/round/step (HRS) is compared against the persisted
+state (file.go:92 CheckHRS): signing an older HRS is refused; re-signing
+the exact same HRS returns the cached signature iff the sign bytes match
+(modulo timestamp), which is what makes crash-restart safe.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Optional, Tuple
+
+from tmtpu.crypto import ed25519
+from tmtpu.crypto.keys import KEY_TYPES
+from tmtpu.libs import protoio
+from tmtpu.types import pb
+from tmtpu.types.priv_validator import PrivValidator
+
+STEP_PROPOSAL = 1
+STEP_PREVOTE = 2
+STEP_PRECOMMIT = 3
+
+_VOTE_STEP = {pb.SIGNED_MSG_TYPE_PREVOTE: STEP_PREVOTE,
+              pb.SIGNED_MSG_TYPE_PRECOMMIT: STEP_PRECOMMIT}
+
+
+class DoubleSignError(Exception):
+    pass
+
+
+def _atomic_write(path: str, data: str) -> None:
+    d = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(dir=d)
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+class FilePV(PrivValidator):
+    def __init__(self, priv_key, key_file: str, state_file: str):
+        self.priv_key = priv_key
+        self.key_file = key_file
+        self.state_file = state_file
+        # last sign state
+        self.height = 0
+        self.round = 0
+        self.step = 0
+        self.signature: Optional[bytes] = None
+        self.sign_bytes: Optional[bytes] = None
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def generate(cls, key_file: str, state_file: str) -> "FilePV":
+        pv = cls(ed25519.gen_priv_key(), key_file, state_file)
+        pv.save()
+        return pv
+
+    @classmethod
+    def load(cls, key_file: str, state_file: str) -> "FilePV":
+        with open(key_file) as f:
+            kd = json.load(f)
+        ktype = kd["priv_key"]["type"]
+        entry = KEY_TYPES.get(ktype)
+        if entry is None:
+            raise ValueError(f"unknown key type {ktype!r}")
+        pv = cls(entry[1](bytes.fromhex(kd["priv_key"]["value"])),
+                 key_file, state_file)
+        if os.path.exists(state_file):
+            with open(state_file) as f:
+                sd = json.load(f)
+            pv.height = int(sd.get("height", 0))
+            pv.round = int(sd.get("round", 0))
+            pv.step = int(sd.get("step", 0))
+            sig = sd.get("signature")
+            pv.signature = bytes.fromhex(sig) if sig else None
+            sb = sd.get("signbytes")
+            pv.sign_bytes = bytes.fromhex(sb) if sb else None
+        return pv
+
+    @classmethod
+    def load_or_generate(cls, key_file: str, state_file: str) -> "FilePV":
+        if os.path.exists(key_file):
+            return cls.load(key_file, state_file)
+        os.makedirs(os.path.dirname(key_file) or ".", exist_ok=True)
+        os.makedirs(os.path.dirname(state_file) or ".", exist_ok=True)
+        return cls.generate(key_file, state_file)
+
+    def save(self) -> None:
+        pub = self.priv_key.pub_key()
+        _atomic_write(self.key_file, json.dumps({
+            "address": pub.address().hex().upper(),
+            "pub_key": {"type": pub.type_value(),
+                        "value": pub.bytes().hex()},
+            "priv_key": {"type": self.priv_key.type_value(),
+                         "value": self.priv_key.bytes().hex()},
+        }, indent=2))
+        self._save_state()
+
+    def _save_state(self) -> None:
+        _atomic_write(self.state_file, json.dumps({
+            "height": self.height, "round": self.round, "step": self.step,
+            "signature": self.signature.hex() if self.signature else None,
+            "signbytes": self.sign_bytes.hex() if self.sign_bytes else None,
+        }, indent=2))
+
+    # -- PrivValidator ------------------------------------------------------
+
+    def get_pub_key(self):
+        return self.priv_key.pub_key()
+
+    def address(self) -> bytes:
+        return self.get_pub_key().address()
+
+    def sign_vote(self, chain_id: str, vote) -> None:
+        step = _VOTE_STEP.get(vote.type)
+        if step is None:
+            raise ValueError(f"unknown vote type {vote.type}")
+        sb = vote.sign_bytes(chain_id)
+        same, cached = self._check_hrs(vote.height, vote.round, step, sb)
+        if same and cached is not None:
+            vote.signature = cached
+            return
+        vote.signature = self.priv_key.sign(sb)
+        self._update_state(vote.height, vote.round, step, sb, vote.signature)
+
+    def sign_proposal(self, chain_id: str, proposal) -> None:
+        sb = proposal.sign_bytes(chain_id)
+        same, cached = self._check_hrs(proposal.height, proposal.round,
+                                       STEP_PROPOSAL, sb)
+        if same and cached is not None:
+            proposal.signature = cached
+            return
+        proposal.signature = self.priv_key.sign(sb)
+        self._update_state(proposal.height, proposal.round, STEP_PROPOSAL,
+                          sb, proposal.signature)
+
+    # -- double-sign protection (file.go:92 CheckHRS) -----------------------
+
+    def _check_hrs(self, height: int, round: int, step: int,
+                   sign_bytes: bytes) -> Tuple[bool, Optional[bytes]]:
+        if (self.height, self.round, self.step) > (height, round, step):
+            raise DoubleSignError(
+                f"sign state is ahead: {self.height}/{self.round}/{self.step}"
+                f" > {height}/{round}/{step}"
+            )
+        if (self.height, self.round, self.step) == (height, round, step):
+            if self.sign_bytes is None:
+                raise DoubleSignError("no sign bytes cached for same HRS")
+            if self.sign_bytes == sign_bytes:
+                return True, self.signature
+            if _only_timestamp_differs(self.sign_bytes, sign_bytes, step):
+                return True, self.signature
+            raise DoubleSignError(
+                "conflicting data: same HRS, different sign bytes")
+        return False, None
+
+    def _update_state(self, height: int, round: int, step: int,
+                      sign_bytes: bytes, sig: bytes) -> None:
+        self.height, self.round, self.step = height, round, step
+        self.signature = sig
+        self.sign_bytes = sign_bytes
+        self._save_state()
+
+
+def _only_timestamp_differs(old: bytes, new: bytes, step: int) -> bool:
+    """file.go checkVotesOnlyDifferByTimestamp — strip the timestamp field
+    from both canonical encodings and compare."""
+    try:
+        if step == STEP_PROPOSAL:
+            a = pb.CanonicalProposal.decode(protoio.unmarshal_delimited(old))
+            b = pb.CanonicalProposal.decode(protoio.unmarshal_delimited(new))
+        else:
+            a = pb.CanonicalVote.decode(protoio.unmarshal_delimited(old))
+            b = pb.CanonicalVote.decode(protoio.unmarshal_delimited(new))
+    except Exception:
+        return False
+    a.timestamp = None
+    b.timestamp = None
+    return a.encode() == b.encode()

@@ -1,0 +1,70 @@
+"""Proxy app connections (reference: proxy/) — four named ABCI clients
+(consensus / mempool / query / snapshot, proxy/app_conn.go:13-56) over one
+ClientCreator (proxy/client.go:17)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from tmtpu.abci import types as abci
+from tmtpu.abci.client import Client, LocalClient, SocketClient
+
+
+class ClientCreator:
+    def new_abci_client(self) -> Client:
+        raise NotImplementedError
+
+
+class LocalClientCreator(ClientCreator):
+    """In-proc app shared behind one mutex (proxy/client.go
+    NewLocalClientCreator)."""
+
+    def __init__(self, app: abci.Application):
+        self.app = app
+        self.mtx = threading.RLock()
+
+    def new_abci_client(self) -> Client:
+        return LocalClient(self.app, self.mtx)
+
+
+class RemoteClientCreator(ClientCreator):
+    def __init__(self, addr: str):
+        self.addr = addr
+
+    def new_abci_client(self) -> Client:
+        c = SocketClient(self.addr)
+        c.start()
+        return c
+
+
+class AppConns:
+    """proxy/multi_app_conn.go — the four logical connections."""
+
+    def __init__(self, creator: ClientCreator):
+        self._creator = creator
+        self.consensus: Optional[Client] = None
+        self.mempool: Optional[Client] = None
+        self.query: Optional[Client] = None
+        self.snapshot: Optional[Client] = None
+
+    def start(self) -> None:
+        try:
+            self.query = self._creator.new_abci_client()
+            self.snapshot = self._creator.new_abci_client()
+            self.mempool = self._creator.new_abci_client()
+            self.consensus = self._creator.new_abci_client()
+        except Exception:
+            self.stop()  # roll back any clients already started
+            raise
+
+    def stop(self) -> None:
+        for c in (self.consensus, self.mempool, self.query, self.snapshot):
+            if c is not None:
+                c.stop()
+
+
+def default_client_creator(app_or_addr) -> ClientCreator:
+    if isinstance(app_or_addr, str):
+        return RemoteClientCreator(app_or_addr)
+    return LocalClientCreator(app_or_addr)

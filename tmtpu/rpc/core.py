@@ -1,0 +1,488 @@
+"""RPC route handlers (reference: rpc/core/ — one handler per route,
+route table rpc/core/routes.go:10-49; Environment rpc/core/env.go)."""
+
+from __future__ import annotations
+
+import base64
+import time
+from typing import Optional
+
+from tmtpu.abci import types as abci
+from tmtpu.types.event_bus import EVENT_TX
+from tmtpu.version import TMCoreSemVer
+
+
+def _b64(b: bytes) -> str:
+    return base64.b64encode(bytes(b)).decode()
+
+
+def _hex(b) -> str:
+    return bytes(b).hex().upper()
+
+
+def _decode_tx(tx: str) -> bytes:
+    """GET params pass txs as 0x-hex or quoted strings; POST as base64."""
+    if tx.startswith("0x"):
+        return bytes.fromhex(tx[2:])
+    try:
+        return base64.b64decode(tx, validate=True)
+    except Exception:
+        return tx.encode()
+
+
+class Environment:
+    """rpc/core/env.go — the node internals the handlers reach into."""
+
+    def __init__(self, node):
+        self.node = node
+
+    @property
+    def consensus(self):
+        return self.node.consensus
+
+    @property
+    def block_store(self):
+        return self.node.block_store
+
+    @property
+    def state_store(self):
+        return self.node.state_store
+
+    @property
+    def mempool(self):
+        return self.node.mempool
+
+    @property
+    def event_bus(self):
+        return self.node.event_bus
+
+
+def _header_json(h) -> dict:
+    return {
+        "version": {"block": str(h.version_block), "app": str(h.version_app)},
+        "chain_id": h.chain_id,
+        "height": str(h.height),
+        "time": _ns_to_rfc3339(h.time),
+        "last_block_id": _block_id_json(h.last_block_id),
+        "last_commit_hash": _hex(h.last_commit_hash),
+        "data_hash": _hex(h.data_hash),
+        "validators_hash": _hex(h.validators_hash),
+        "next_validators_hash": _hex(h.next_validators_hash),
+        "consensus_hash": _hex(h.consensus_hash),
+        "app_hash": _hex(h.app_hash),
+        "last_results_hash": _hex(h.last_results_hash),
+        "evidence_hash": _hex(h.evidence_hash),
+        "proposer_address": _hex(h.proposer_address),
+    }
+
+
+def _block_id_json(bid) -> dict:
+    return {"hash": _hex(bid.hash),
+            "parts": {"total": bid.parts_total, "hash": _hex(bid.parts_hash)}}
+
+
+def _commit_json(c) -> dict:
+    if c is None:
+        return None
+    return {
+        "height": str(c.height), "round": c.round,
+        "block_id": _block_id_json(c.block_id),
+        "signatures": [{
+            "block_id_flag": s.block_id_flag,
+            "validator_address": _hex(s.validator_address),
+            "timestamp": _ns_to_rfc3339(s.timestamp),
+            "signature": _b64(s.signature) if s.signature else None,
+        } for s in c.signatures],
+    }
+
+
+def _block_json(b) -> dict:
+    return {
+        "header": _header_json(b.header),
+        "data": {"txs": [_b64(t) for t in b.txs]},
+        "evidence": {"evidence": []},
+        "last_commit": _commit_json(b.last_commit),
+    }
+
+
+def _ns_to_rfc3339(ns: int) -> str:
+    secs, rem = divmod(ns, 1_000_000_000)
+    t = time.gmtime(secs)
+    return time.strftime("%Y-%m-%dT%H:%M:%S", t) + f".{rem:09d}Z"
+
+
+def _deliver_tx_json(r) -> dict:
+    return {
+        "code": r.code, "data": _b64(r.data) if r.data else None,
+        "log": r.log, "info": r.info,
+        "gas_wanted": str(r.gas_wanted), "gas_used": str(r.gas_used),
+        "events": [{
+            "type": e.type,
+            "attributes": [{"key": _b64(a.key), "value": _b64(a.value),
+                            "index": a.index} for a in e.attributes],
+        } for e in r.events],
+        "codespace": r.codespace,
+    }
+
+
+def build_routes(env: Environment) -> dict:
+    from tmtpu.rpc.server import RPCError
+
+    node = env.node
+
+    # --- info routes -------------------------------------------------------
+
+    def health():
+        return {}
+
+    def status():
+        state = node.latest_state()
+        latest_height = env.block_store.height()
+        meta = env.block_store.load_block_meta(latest_height) \
+            if latest_height else None
+        pub = node.priv_validator.get_pub_key() if node.priv_validator \
+            else None
+        return {
+            "node_info": {
+                "protocol_version": {"p2p": "8", "block": "11", "app": "1"},
+                "id": getattr(node, "node_id", ""),
+                "listen_addr": node.config.p2p.laddr,
+                "network": node.chain_id,
+                "version": TMCoreSemVer,
+                "moniker": node.config.base.moniker,
+            },
+            "sync_info": {
+                "latest_block_hash": _hex(meta.block_id.hash) if meta else "",
+                "latest_app_hash": _hex(state.app_hash),
+                "latest_block_height": str(latest_height),
+                "latest_block_time": _ns_to_rfc3339(state.last_block_time),
+                "earliest_block_height": str(env.block_store.base()),
+                "catching_up": getattr(node, "catching_up", False),
+            },
+            "validator_info": {
+                "address": _hex(pub.address()) if pub else "",
+                "pub_key": {"type": pub.type_value(),
+                            "value": _b64(pub.bytes())} if pub else None,
+                "voting_power": str(_own_power(node, state)),
+            },
+        }
+
+    def _own_power(node, state):
+        if node.priv_validator is None or state.validators is None:
+            return 0
+        _, val = state.validators.get_by_address(
+            node.priv_validator.get_pub_key().address())
+        return val.voting_power if val else 0
+
+    def genesis():
+        import json as _json
+
+        return {"genesis": _json.loads(node.genesis_doc.to_json())}
+
+    def net_info():
+        sw = getattr(node, "switch", None)
+        peers = sw.peers_list() if sw else []
+        return {
+            "listening": sw is not None,
+            "listeners": [node.config.p2p.laddr],
+            "n_peers": str(len(peers)),
+            "peers": [{"node_info": {"id": p.node_id, "moniker": p.moniker},
+                       "is_outbound": p.outbound,
+                       "remote_ip": p.remote_ip} for p in peers],
+        }
+
+    def blockchain(minHeight="0", maxHeight="0"):
+        mn, mx = int(minHeight), int(maxHeight)
+        store_h = env.block_store.height()
+        if mx <= 0:
+            mx = store_h
+        mx = min(mx, store_h)
+        mn = max(mn if mn > 0 else 1, env.block_store.base())
+        mn = max(mn, mx - 19)
+        metas = []
+        for h in range(mx, mn - 1, -1):
+            m = env.block_store.load_block_meta(h)
+            if m:
+                metas.append({
+                    "block_id": _block_id_json(m.block_id),
+                    "block_size": str(m.block_size),
+                    "header": _header_json(m.header),
+                    "num_txs": str(m.num_txs),
+                })
+        return {"last_height": str(store_h), "block_metas": metas}
+
+    def block(height=None):
+        h = int(height) if height is not None else env.block_store.height()
+        b = env.block_store.load_block(h)
+        if b is None:
+            raise RPCError(-32603, f"no block for height {h}")
+        meta = env.block_store.load_block_meta(h)
+        return {"block_id": _block_id_json(meta.block_id),
+                "block": _block_json(b)}
+
+    def block_by_hash(hash):
+        b = env.block_store.load_block_by_hash(bytes.fromhex(hash.replace("0x", "")))
+        if b is None:
+            raise RPCError(-32603, "block not found")
+        return block(height=str(b.header.height))
+
+    def block_results(height=None):
+        h = int(height) if height is not None else env.block_store.height()
+        res = env.state_store.load_abci_responses(h)
+        if res is None:
+            raise RPCError(-32603, f"no results for height {h}")
+        return {
+            "height": str(h),
+            "txs_results": [_deliver_tx_json(r) for r in res.deliver_txs],
+            "begin_block_events": [],
+            "end_block_events": [],
+            "validator_updates": [
+                {"pub_key": {"type": "ed25519",
+                             "value": _b64(v.pub_key.ed25519)},
+                 "power": str(v.power)}
+                for v in res.end_block.validator_updates
+            ],
+            "consensus_param_updates": None,
+        }
+
+    def commit(height=None):
+        h = int(height) if height is not None else env.block_store.height()
+        meta = env.block_store.load_block_meta(h)
+        if meta is None:
+            raise RPCError(-32603, f"no commit for height {h}")
+        c = env.block_store.load_block_commit(h) \
+            or env.block_store.load_seen_commit(h)
+        return {
+            "signed_header": {"header": _header_json(meta.header),
+                              "commit": _commit_json(c)},
+            "canonical": env.block_store.load_block_commit(h) is not None,
+        }
+
+    def validators(height=None, page="1", per_page="30"):
+        h = int(height) if height is not None else \
+            env.block_store.height() + 1
+        vals = env.state_store.load_validators(h)
+        if vals is None:
+            raise RPCError(-32603, f"no validators for height {h}")
+        p, pp = max(1, int(page)), min(100, max(1, int(per_page)))
+        chunk = vals.validators[(p - 1) * pp: p * pp]
+        return {
+            "block_height": str(h),
+            "validators": [{
+                "address": _hex(v.address),
+                "pub_key": {"type": v.pub_key.type_value(),
+                            "value": _b64(v.pub_key.bytes())},
+                "voting_power": str(v.voting_power),
+                "proposer_priority": str(v.proposer_priority),
+            } for v in chunk],
+            "count": str(len(chunk)),
+            "total": str(vals.size()),
+        }
+
+    def consensus_state():
+        rs = env.consensus.get_round_state()
+        return {"round_state": {
+            "height/round/step": rs.height_round_step(),
+            "height": str(rs.height), "round": rs.round,
+            "step": rs.step,
+            "start_time": _ns_to_rfc3339(rs.start_time),
+            "proposal_block_hash": _hex(rs.proposal_block.hash())
+            if rs.proposal_block else "",
+            "locked_block_hash": _hex(rs.locked_block.hash())
+            if rs.locked_block else "",
+            "valid_block_hash": _hex(rs.valid_block.hash())
+            if rs.valid_block else "",
+        }}
+
+    def dump_consensus_state():
+        return consensus_state()
+
+    def consensus_params(height=None):
+        state = node.latest_state()
+        p = state.consensus_params
+        return {"block_height": str(state.last_block_height), "consensus_params": {
+            "block": {"max_bytes": str(p.block_max_bytes),
+                      "max_gas": str(p.block_max_gas)},
+            "evidence": {
+                "max_age_num_blocks": str(p.evidence_max_age_num_blocks),
+                "max_age_duration": str(p.evidence_max_age_duration_ns),
+                "max_bytes": str(p.evidence_max_bytes)},
+            "validator": {"pub_key_types": p.pub_key_types},
+            "version": {"app_version": str(p.app_version)},
+        }}
+
+    # --- mempool routes ----------------------------------------------------
+
+    def unconfirmed_txs(limit="30"):
+        txs = env.mempool.reap_max_txs(int(limit))
+        return {"n_txs": str(len(txs)),
+                "total": str(env.mempool.size()),
+                "total_bytes": str(env.mempool.size_bytes()),
+                "txs": [_b64(t) for t in txs]}
+
+    def num_unconfirmed_txs():
+        return {"n_txs": str(env.mempool.size()),
+                "total": str(env.mempool.size()),
+                "total_bytes": str(env.mempool.size_bytes())}
+
+    def broadcast_tx_async(tx):
+        raw = _decode_tx(tx)
+        try:
+            env.mempool.check_tx(raw)
+        except Exception:
+            pass
+        from tmtpu.types.tx import tx_hash
+
+        return {"code": 0, "data": "", "log": "", "hash": _hex(tx_hash(raw))}
+
+    def broadcast_tx_sync(tx):
+        raw = _decode_tx(tx)
+        from tmtpu.types.tx import tx_hash
+
+        result = {}
+
+        def cb(res):
+            result["res"] = res
+
+        try:
+            env.mempool.check_tx(raw, cb=cb)
+        except Exception as e:
+            raise RPCError(-32603, "tx rejected", str(e))
+        res = result.get("res") or abci.ResponseCheckTx()
+        return {"code": res.code, "data": _b64(res.data), "log": res.log,
+                "codespace": res.codespace, "hash": _hex(tx_hash(raw))}
+
+    def broadcast_tx_commit(tx):
+        """rpc/core/mempool.go BroadcastTxCommit — CheckTx, then wait for
+        the tx to appear in a committed block (via the event bus)."""
+        from tmtpu.types.tx import tx_hash
+
+        raw = _decode_tx(tx)
+        want = tx_hash(raw)
+        sub = env.event_bus.subscribe(
+            f"rpc-btc-{want.hex()[:16]}",
+            lambda item: item.type == EVENT_TX and
+            tx_hash(item.data["tx_result"].tx) == want,
+            out_capacity=1,
+        )
+        try:
+            result = {}
+
+            def cb(res):
+                result["res"] = res
+
+            try:
+                env.mempool.check_tx(raw, cb=cb)
+            except Exception as e:
+                raise RPCError(-32603, "tx rejected from mempool", str(e))
+            check = result.get("res") or abci.ResponseCheckTx()
+            if not check.is_ok():
+                return {"check_tx": _deliver_tx_json(check),
+                        "deliver_tx": _deliver_tx_json(
+                            abci.ResponseDeliverTx()),
+                        "hash": _hex(want), "height": "0"}
+            timeout = node.config.rpc.timeout_broadcast_tx_commit_ns / 1e9
+            item = sub.next(timeout=timeout)
+            if item is None:
+                raise RPCError(-32603, "timed out waiting for tx to be "
+                                       "included in a block")
+            txr = item.data["tx_result"]
+            return {
+                "check_tx": _deliver_tx_json(check),
+                "deliver_tx": _deliver_tx_json(txr.result),
+                "hash": _hex(want),
+                "height": str(txr.height),
+            }
+        finally:
+            env.event_bus.unsubscribe(sub)
+
+    # --- abci routes -------------------------------------------------------
+
+    def abci_query(path="", data="", height="0", prove=False):
+        raw = bytes.fromhex(data[2:]) if data.startswith("0x") else \
+            data.encode()
+        res = node.proxy_app.query.query_sync(abci.RequestQuery(
+            data=raw, path=path, height=int(height),
+            prove=prove in (True, "true", "1")))
+        return {"response": {
+            "code": res.code, "log": res.log, "info": res.info,
+            "index": str(res.index),
+            "key": _b64(res.key) if res.key else None,
+            "value": _b64(res.value) if res.value else None,
+            "height": str(res.height), "codespace": res.codespace,
+        }}
+
+    def abci_info():
+        res = node.proxy_app.query.info_sync(abci.RequestInfo(
+            version=TMCoreSemVer))
+        return {"response": {
+            "data": res.data, "version": res.version,
+            "app_version": str(res.app_version),
+            "last_block_height": str(res.last_block_height),
+            "last_block_app_hash": _b64(res.last_block_app_hash),
+        }}
+
+    # --- tx lookup (via indexer when present) ------------------------------
+
+    def tx(hash, prove=False):
+        indexer = getattr(node, "tx_indexer", None)
+        if indexer is None:
+            raise RPCError(-32603, "transaction indexing is disabled")
+        h = bytes.fromhex(hash.replace("0x", ""))
+        res = indexer.get(h)
+        if res is None:
+            raise RPCError(-32603, f"tx ({hash}) not found")
+        out = {
+            "hash": _hex(h), "height": str(res.height),
+            "index": res.index, "tx_result": _deliver_tx_json(res.result),
+            "tx": _b64(res.tx),
+        }
+        if prove in (True, "true", "1"):
+            from tmtpu.types.tx import tx_proof
+
+            block = env.block_store.load_block(res.height)
+            root, proof = tx_proof(block.txs, res.index)
+            out["proof"] = {
+                "root_hash": _hex(root), "data": _b64(res.tx),
+                "proof": {"total": str(proof.total),
+                          "index": str(proof.index),
+                          "leaf_hash": _b64(proof.leaf_hash),
+                          "aunts": [_b64(a) for a in proof.aunts]},
+            }
+        return out
+
+    def tx_search(query="", prove=False, page="1", per_page="30",
+                  order_by="asc"):
+        indexer = getattr(node, "tx_indexer", None)
+        if indexer is None:
+            raise RPCError(-32603, "transaction indexing is disabled")
+        results = indexer.search(query)
+        if order_by == "desc":
+            results = list(reversed(results))
+        p, pp = max(1, int(page)), min(100, max(1, int(per_page)))
+        chunk = results[(p - 1) * pp: p * pp]
+        return {
+            "txs": [{
+                "hash": _hex(r.tx_hash), "height": str(r.height),
+                "index": r.index, "tx_result": _deliver_tx_json(r.result),
+                "tx": _b64(r.tx),
+            } for r in chunk],
+            "total_count": str(len(results)),
+        }
+
+    return {
+        "health": health, "status": status, "genesis": genesis,
+        "net_info": net_info, "blockchain": blockchain, "block": block,
+        "block_by_hash": block_by_hash, "block_results": block_results,
+        "commit": commit, "validators": validators,
+        "consensus_state": consensus_state,
+        "dump_consensus_state": dump_consensus_state,
+        "consensus_params": consensus_params,
+        "unconfirmed_txs": unconfirmed_txs,
+        "num_unconfirmed_txs": num_unconfirmed_txs,
+        "broadcast_tx_async": broadcast_tx_async,
+        "broadcast_tx_sync": broadcast_tx_sync,
+        "broadcast_tx_commit": broadcast_tx_commit,
+        "abci_query": abci_query, "abci_info": abci_info,
+        "tx": tx, "tx_search": tx_search,
+    }

@@ -1,0 +1,122 @@
+"""JSON-RPC server (reference: rpc/jsonrpc/server + rpc/core routes).
+
+HTTP GET (URI params) and POST (JSON-RPC 2.0) on the same routes, like the
+reference. Encodings follow the reference's JSON conventions: hashes are
+upper-hex, raw byte blobs (txs, app data) are base64, numbers are strings.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from tmtpu.abci import types as abci
+from tmtpu.rpc import core
+from tmtpu.version import TMCoreSemVer
+
+
+class RPCError(Exception):
+    def __init__(self, code: int, message: str, data: str = ""):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+        self.data = data
+
+
+class RPCServer:
+    def __init__(self, laddr: str, node):
+        addr = laddr[len("tcp://"):] if laddr.startswith("tcp://") else laddr
+        host, _, port = addr.rpartition(":")
+        self.host = host or "127.0.0.1"
+        if self.host == "0.0.0.0":
+            pass
+        self.port = int(port)
+        self.node = node
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        env = core.Environment(self.node)
+        routes = core.build_routes(env)
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _respond(self, obj, status=200):
+                body = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _run(self, method: str, params: dict, req_id):
+                fn = routes.get(method)
+                if fn is None:
+                    return {"jsonrpc": "2.0", "id": req_id, "error": {
+                        "code": -32601, "message": "Method not found"}}
+                try:
+                    result = fn(**params)
+                    return {"jsonrpc": "2.0", "id": req_id, "result": result}
+                except RPCError as e:
+                    return {"jsonrpc": "2.0", "id": req_id, "error": {
+                        "code": e.code, "message": e.message, "data": e.data}}
+                except TypeError as e:
+                    return {"jsonrpc": "2.0", "id": req_id, "error": {
+                        "code": -32602, "message": f"Invalid params: {e}"}}
+                except Exception as e:  # noqa: BLE001
+                    return {"jsonrpc": "2.0", "id": req_id, "error": {
+                        "code": -32603, "message": "Internal error",
+                        "data": str(e)}}
+
+            def do_GET(self):
+                parsed = urllib.parse.urlparse(self.path)
+                method = parsed.path.lstrip("/")
+                if method == "":
+                    # route list, like the reference's index page
+                    self._respond({"jsonrpc": "2.0", "id": -1,
+                                   "result": sorted(routes)})
+                    return
+                params = {}
+                for k, vals in urllib.parse.parse_qs(parsed.query).items():
+                    v = vals[0]
+                    if v.startswith('"') and v.endswith('"'):
+                        v = v[1:-1]
+                    params[k] = v
+                self._respond(self._run(method, params, -1))
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                except json.JSONDecodeError:
+                    self._respond({"jsonrpc": "2.0", "id": -1, "error": {
+                        "code": -32700, "message": "Parse error"}})
+                    return
+                if isinstance(req, list):
+                    self._respond([self._run(r.get("method", ""),
+                                             r.get("params") or {},
+                                             r.get("id", -1)) for r in req])
+                else:
+                    self._respond(self._run(req.get("method", ""),
+                                            req.get("params") or {},
+                                            req.get("id", -1)))
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="rpc-http")
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()

@@ -1,0 +1,234 @@
+"""BlockExecutor (reference: state/execution.go).
+
+ApplyBlock (:131): validate → exec over the consensus ABCI conn
+(BeginBlock / DeliverTx×N pipelined / EndBlock, :259) → save responses →
+updateState (:403, valset + params changes) → app Commit under mempool lock
+(:211) → save state → fire events.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Tuple
+
+from tmtpu.abci import types as abci
+from tmtpu.crypto.encoding import pubkey_from_proto
+from tmtpu.state.state import State
+from tmtpu.state.store import ABCIResponses, StateStore
+from tmtpu.state.validation import validate_block
+from tmtpu.types import pb
+from tmtpu.types.block import Block, BlockID
+from tmtpu.types.validator import Validator
+
+
+class BlockExecutionError(Exception):
+    pass
+
+
+class BlockExecutor:
+    def __init__(self, state_store: StateStore, proxy_app, mempool=None,
+                 evidence_pool=None, event_bus=None, verify_backend=None):
+        self.store = state_store
+        self.proxy_app = proxy_app  # consensus-connection abci client
+        self.mempool = mempool
+        self.evidence_pool = evidence_pool
+        self.event_bus = event_bus
+        self.verify_backend = verify_backend
+
+    # -- proposal -----------------------------------------------------------
+
+    def create_proposal_block(self, height: int, state: State,
+                              last_commit, proposer_address: bytes,
+                              time_ns: Optional[int] = None) -> Block:
+        """execution.go:94 CreateProposalBlock — reap mempool + evidence."""
+        max_bytes = state.consensus_params.block_max_bytes
+        max_gas = state.consensus_params.block_max_gas
+        evidence = (self.evidence_pool.pending_evidence(
+            state.consensus_params.evidence_max_bytes)
+            if self.evidence_pool else [])
+        txs = (self.mempool.reap_max_bytes_max_gas(max_bytes, max_gas)
+               if self.mempool else [])
+        if time_ns is None:
+            # MedianTime of LastCommit in the reference; wall clock for h=init
+            time_ns = time.time_ns()
+        header = state.make_block_header(
+            height, time_ns, txs, last_commit, evidence, proposer_address
+        )
+        block = Block(header, txs, evidence, last_commit)
+        block.fill_header()
+        return block
+
+    # -- apply --------------------------------------------------------------
+
+    def validate_block(self, state: State, block: Block) -> None:
+        validate_block(state, block, verify_backend=self.verify_backend)
+
+    def apply_block(self, state: State, block_id: BlockID, block: Block
+                    ) -> Tuple[State, int]:
+        """execution.go:131 ApplyBlock. Returns (new_state, retain_height)."""
+        self.validate_block(state, block)
+        abci_responses = self._exec_block_on_proxy_app(state, block)
+        self.store.save_abci_responses(block.header.height, abci_responses)
+
+        # validate validator updates per consensus params
+        val_updates = []
+        for vu in abci_responses.end_block.validator_updates:
+            pk = pubkey_from_proto(vu.pub_key)
+            if pk.type_value() not in state.consensus_params.pub_key_types:
+                raise BlockExecutionError(
+                    f"validator update with forbidden key type "
+                    f"{pk.type_value()!r}"
+                )
+            if vu.power < 0:
+                raise BlockExecutionError("validator update with negative power")
+            val_updates.append(Validator(pk, vu.power))
+
+        new_state = update_state(state, block_id, block.header,
+                                 abci_responses, val_updates)
+
+        # Commit: lock mempool, flush, app Commit, update mempool
+        app_hash, retain_height = self._commit(new_state, block,
+                                               abci_responses.deliver_txs)
+        if self.evidence_pool:
+            self.evidence_pool.update(new_state, block.evidence)
+        new_state.app_hash = app_hash
+        self.store.save(new_state)
+
+        if self.event_bus:
+            self._fire_events(block, block_id, abci_responses, val_updates)
+        return new_state, retain_height
+
+    def _exec_block_on_proxy_app(self, state: State, block: Block
+                                 ) -> ABCIResponses:
+        """execution.go:259 — BeginBlock, pipelined DeliverTxs, EndBlock."""
+        commit_info = self._begin_block_commit_info(state, block)
+        byz_vals = self._abci_evidence(state, block)
+        rbb = self.proxy_app.begin_block_sync(abci.RequestBeginBlock(
+            hash=block.hash() or b"",
+            header=block.header.to_proto(),
+            last_commit_info=commit_info,
+            byzantine_validators=byz_vals,
+        ))
+        reqres = [
+            self.proxy_app.deliver_tx_async(abci.RequestDeliverTx(tx=tx))
+            for tx in block.txs
+        ]
+        self.proxy_app.flush_sync()
+        deliver_txs = [rr.wait(timeout=60.0).deliver_tx for rr in reqres]
+        if any(dt is None for dt in deliver_txs):
+            raise BlockExecutionError("DeliverTx failed")
+        rend = self.proxy_app.end_block_sync(
+            abci.RequestEndBlock(height=block.header.height))
+        return ABCIResponses(deliver_txs, rbb, rend)
+
+    def _begin_block_commit_info(self, state: State, block: Block
+                                 ) -> abci.LastCommitInfo:
+        """execution.go getBeginBlockValidatorInfo."""
+        votes = []
+        if block.header.height > state.initial_height:
+            last_vals = self.store.load_validators(block.header.height - 1) \
+                or state.last_validators
+            for i, cs in enumerate(block.last_commit.signatures):
+                val = last_vals.validators[i]
+                votes.append(abci.VoteInfo(
+                    validator=abci.Validator(address=val.address,
+                                             power=val.voting_power),
+                    signed_last_block=not cs.is_absent(),
+                ))
+            round = block.last_commit.round
+        else:
+            round = 0
+        return abci.LastCommitInfo(round=round, votes=votes)
+
+    def _abci_evidence(self, state: State, block: Block) -> List[abci.Evidence]:
+        from tmtpu.types.evidence import DuplicateVoteEvidence
+
+        out = []
+        for ev in block.evidence:
+            if isinstance(ev, DuplicateVoteEvidence):
+                out.append(abci.Evidence(
+                    type=abci.EVIDENCE_TYPE_DUPLICATE_VOTE,
+                    validator=abci.Validator(
+                        address=ev.vote_a.validator_address,
+                        power=ev.validator_power),
+                    height=ev.height(),
+                    time=pb.Timestamp.from_unix_nanos(ev.time()),
+                    total_voting_power=ev.total_voting_power,
+                ))
+            else:
+                out.append(abci.Evidence(
+                    type=abci.EVIDENCE_TYPE_LIGHT_CLIENT_ATTACK,
+                    height=ev.height(),
+                    time=pb.Timestamp.from_unix_nanos(ev.time()),
+                    total_voting_power=ev.total_voting_power,
+                ))
+        return out
+
+    def _commit(self, state: State, block: Block, deliver_txs
+                ) -> Tuple[bytes, int]:
+        """execution.go:211 Commit — mempool locked around app commit."""
+        if self.mempool:
+            self.mempool.lock()
+        try:
+            res = self.proxy_app.commit_sync()
+            if self.mempool:
+                self.mempool.update(
+                    block.header.height, block.txs, deliver_txs
+                )
+        finally:
+            if self.mempool:
+                self.mempool.unlock()
+        return bytes(res.data), res.retain_height
+
+    def _fire_events(self, block, block_id, abci_responses, val_updates):
+        self.event_bus.publish_new_block(block, block_id,
+                                         abci_responses.begin_block,
+                                         abci_responses.end_block)
+        self.event_bus.publish_new_block_header(block.header)
+        for i, tx in enumerate(block.txs):
+            self.event_bus.publish_tx(abci.TxResult(
+                height=block.header.height, index=i, tx=tx,
+                result=abci_responses.deliver_txs[i],
+            ))
+        if val_updates:
+            self.event_bus.publish_validator_set_updates(val_updates)
+
+
+def update_state(state: State, block_id: BlockID, header,
+                 abci_responses: ABCIResponses, val_updates: List[Validator]
+                 ) -> State:
+    """execution.go:403 updateState."""
+    n_val_set = state.next_validators.copy()
+    last_height_vals_changed = state.last_height_validators_changed
+    if val_updates:
+        n_val_set.update_with_change_set(val_updates)
+        last_height_vals_changed = header.height + 1 + 1
+    n_val_set.increment_proposer_priority(1)
+
+    params = state.consensus_params
+    app_version = state.app_version
+    last_height_params_changed = state.last_height_consensus_params_changed
+    if abci_responses.end_block.consensus_param_updates is not None:
+        updates = abci_responses.end_block.consensus_param_updates
+        params = params.update(updates)
+        params.validate_basic()
+        if updates.version is not None:
+            app_version = params.app_version
+        last_height_params_changed = header.height + 1
+
+    return State(
+        chain_id=state.chain_id,
+        initial_height=state.initial_height,
+        last_block_height=header.height,
+        last_block_id=block_id,
+        last_block_time=header.time,
+        next_validators=n_val_set,
+        validators=state.next_validators.copy(),
+        last_validators=state.validators.copy(),
+        last_height_validators_changed=last_height_vals_changed,
+        consensus_params=params,
+        last_height_consensus_params_changed=last_height_params_changed,
+        last_results_hash=abci_responses.results_hash(),
+        app_hash=b"",  # set by caller after app Commit
+        app_version=app_version,
+    )

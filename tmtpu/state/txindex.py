@@ -1,0 +1,124 @@
+"""Tx indexing (reference: state/txindex/ — kv indexer + indexer service).
+
+The IndexerService consumes the EventBus Tx stream and indexes TxResults by
+hash plus event attributes (``type.key=value`` equality), powering /tx and
+/tx_search (rpc/core/tx.go)."""
+
+from __future__ import annotations
+
+import threading
+from typing import List, NamedTuple, Optional
+
+from tmtpu.abci import types as abci
+from tmtpu.libs.db import DB
+from tmtpu.types.tx import tx_hash
+
+
+class TxRecord(NamedTuple):
+    tx_hash: bytes
+    height: int
+    index: int
+    tx: bytes
+    result: abci.ResponseDeliverTx
+
+
+class KVTxIndexer:
+    def __init__(self, db: DB):
+        self.db = db
+
+    def index(self, txr: abci.TxResult) -> None:
+        h = tx_hash(txr.tx)
+        self.db.set(b"tx:" + h, txr.encode())
+        # event-attribute index: "evt:<type>.<key>=<value>:<hash>"
+        for ev in txr.result.events:
+            for attr in ev.attributes:
+                if not attr.index:
+                    continue
+                key = b"evt:%s.%s=%s:" % (
+                    ev.type.encode(), bytes(attr.key), bytes(attr.value)) + h
+                self.db.set(key, h)
+        # height index
+        self.db.set(b"txh:%020d:%08d" % (txr.height, txr.index), h)
+
+    def get(self, h: bytes) -> Optional[TxRecord]:
+        raw = self.db.get(b"tx:" + bytes(h))
+        if raw is None:
+            return None
+        txr = abci.TxResult.decode(raw)
+        return TxRecord(bytes(h), txr.height, txr.index, bytes(txr.tx),
+                        txr.result)
+
+    def search(self, query: str) -> List[TxRecord]:
+        """Supports 'tx.height=N' and '<type>.<key>=<value>' equality
+        conditions joined by AND (subset of libs/pubsub/query)."""
+        conds = [c.strip() for c in query.split(" AND ") if c.strip()]
+        result_sets = []
+        for cond in conds:
+            if "=" not in cond:
+                continue
+            key, _, value = cond.partition("=")
+            key = key.strip()
+            value = value.strip().strip("'\"")
+            hits = set()
+            if key == "tx.height":
+                prefix = b"txh:%020d:" % int(value)
+                for _, h in self.db.iter_prefix(prefix):
+                    hits.add(bytes(h))
+            else:
+                prefix = b"evt:%s=%s:" % (key.encode(), value.encode())
+                for _, h in self.db.iter_prefix(prefix):
+                    hits.add(bytes(h))
+            result_sets.append(hits)
+        if not result_sets:
+            return []
+        matched = set.intersection(*result_sets)
+        out = [self.get(h) for h in matched]
+        out = [r for r in out if r is not None]
+        out.sort(key=lambda r: (r.height, r.index))
+        return out
+
+
+class NullTxIndexer:
+    def index(self, txr) -> None:
+        pass
+
+    def get(self, h):
+        return None
+
+    def search(self, query):
+        return []
+
+
+class IndexerService:
+    """state/txindex/indexer_service.go — subscribes to the bus and feeds
+    the indexer."""
+
+    def __init__(self, indexer, event_bus):
+        self.indexer = indexer
+        self.event_bus = event_bus
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._sub = None
+
+    def start(self) -> None:
+        from tmtpu.types.event_bus import EVENT_TX
+
+        self._sub = self.event_bus.subscribe_type("indexer", EVENT_TX)
+
+        def run():
+            while not self._stop.is_set():
+                item = self._sub.next(timeout=0.2)
+                if item is not None:
+                    try:
+                        self.indexer.index(item.data["tx_result"])
+                    except Exception:
+                        pass
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="tx-indexer")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._sub is not None:
+            self.event_bus.unsubscribe(self._sub)

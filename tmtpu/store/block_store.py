@@ -1,0 +1,180 @@
+"""BlockStore (reference: store/store.go) — persists blocks (as parts),
+commits and block metas per height on a libs.db KV."""
+
+from __future__ import annotations
+
+import struct
+import threading
+from typing import Optional
+
+from tmtpu.libs.db import DB
+from tmtpu.types import pb
+from tmtpu.types.block import Block, BlockID, Commit, Header
+from tmtpu.types.part_set import Part, PartSet
+
+
+class BlockMeta:
+    """types/block_meta.go."""
+
+    def __init__(self, block_id: BlockID, block_size: int, header: Header,
+                 num_txs: int):
+        self.block_id = block_id
+        self.block_size = block_size
+        self.header = header
+        self.num_txs = num_txs
+
+    def encode(self) -> bytes:
+        return _BlockMetaPB(
+            block_id=self.block_id.to_proto(),
+            block_size=self.block_size,
+            header=self.header.to_proto(),
+            num_txs=self.num_txs,
+        ).encode()
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "BlockMeta":
+        m = _BlockMetaPB.decode(buf)
+        return cls(BlockID.from_proto(m.block_id), m.block_size,
+                   Header.from_proto(m.header), m.num_txs)
+
+
+class _BlockMetaPB(pb.ProtoMessage):
+    FIELDS = [
+        (1, "block_id", ("msg!", pb.BlockID)),
+        (2, "block_size", "int64"),
+        (3, "header", ("msg!", pb.Header)),
+        (4, "num_txs", "int64"),
+    ]
+
+
+def _k_meta(h: int) -> bytes:
+    return b"H:%d" % h
+
+
+def _k_part(h: int, i: int) -> bytes:
+    return b"P:%d:%d" % (h, i)
+
+
+def _k_commit(h: int) -> bytes:
+    return b"C:%d" % h
+
+
+def _k_seen_commit(h: int) -> bytes:
+    return b"SC:%d" % h
+
+
+def _k_hash(block_hash: bytes) -> bytes:
+    return b"BH:" + block_hash
+
+
+class BlockStore:
+    def __init__(self, db: DB):
+        self.db = db
+        self._lock = threading.RLock()
+        raw = self.db.get(b"blockStore")
+        if raw:
+            self._base, self._height = struct.unpack(">qq", raw)
+        else:
+            self._base, self._height = 0, 0
+
+    def base(self) -> int:
+        with self._lock:
+            return self._base
+
+    def height(self) -> int:
+        with self._lock:
+            return self._height
+
+    def size(self) -> int:
+        with self._lock:
+            return self._height - self._base + 1 if self._height else 0
+
+    def _save_height(self) -> None:
+        self.db.set(b"blockStore", struct.pack(">qq", self._base, self._height))
+
+    def save_block(self, block: Block, part_set: PartSet,
+                   seen_commit: Commit) -> None:
+        """store.go:332 SaveBlock."""
+        height = block.header.height
+        with self._lock:
+            if self._height and height != self._height + 1:
+                raise ValueError(
+                    f"cannot save block at height {height}, expected "
+                    f"{self._height + 1}"
+                )
+            bid = BlockID(block.hash(), part_set.total, part_set.hash)
+            meta = BlockMeta(bid, part_set.byte_size(), block.header,
+                             len(block.txs))
+            sets = [(_k_meta(height), meta.encode()),
+                    (_k_hash(block.hash()), b"%d" % height)]
+            for i in range(part_set.total):
+                sets.append((_k_part(height, i),
+                             part_set.get_part(i).to_proto().encode()))
+            if block.last_commit is not None:
+                sets.append((_k_commit(height - 1),
+                             block.last_commit.to_proto().encode()))
+            sets.append((_k_seen_commit(height),
+                         seen_commit.to_proto().encode()))
+            self.db.write_batch(sets)
+            if self._base == 0:
+                self._base = height
+            self._height = height
+            self._save_height()
+
+    def load_block_meta(self, height: int) -> Optional[BlockMeta]:
+        raw = self.db.get(_k_meta(height))
+        return BlockMeta.decode(raw) if raw else None
+
+    def load_block(self, height: int) -> Optional[Block]:
+        meta = self.load_block_meta(height)
+        if meta is None:
+            return None
+        parts = []
+        for i in range(meta.block_id.parts_total):
+            raw = self.db.get(_k_part(height, i))
+            if raw is None:
+                return None
+            parts.append(Part.from_proto(pb.Part.decode(raw)))
+        data = b"".join(p.bytes for p in parts)
+        return Block.decode(data)
+
+    def load_block_by_hash(self, block_hash: bytes) -> Optional[Block]:
+        raw = self.db.get(_k_hash(block_hash))
+        if raw is None:
+            return None
+        return self.load_block(int(raw))
+
+    def load_block_part(self, height: int, index: int) -> Optional[Part]:
+        raw = self.db.get(_k_part(height, index))
+        return Part.from_proto(pb.Part.decode(raw)) if raw else None
+
+    def load_block_commit(self, height: int) -> Optional[Commit]:
+        """The canonical commit for height (stored with block height+1)."""
+        raw = self.db.get(_k_commit(height))
+        return Commit.from_proto(pb.Commit.decode(raw)) if raw else None
+
+    def load_seen_commit(self, height: int) -> Optional[Commit]:
+        raw = self.db.get(_k_seen_commit(height))
+        return Commit.from_proto(pb.Commit.decode(raw)) if raw else None
+
+    def prune_blocks(self, retain_height: int) -> int:
+        """store.go:248 PruneBlocks — drop everything below retain_height."""
+        with self._lock:
+            if retain_height <= self._base:
+                return 0
+            if retain_height > self._height:
+                raise ValueError("cannot prune beyond latest height")
+            pruned = 0
+            deletes = []
+            for h in range(self._base, retain_height):
+                meta = self.load_block_meta(h)
+                if meta is not None:
+                    deletes.append(_k_hash(meta.block_id.hash))
+                    for i in range(meta.block_id.parts_total):
+                        deletes.append(_k_part(h, i))
+                deletes += [_k_meta(h), _k_commit(h - 1), _k_seen_commit(h)]
+                pruned += 1
+            self.db.write_batch([], deletes)
+            self._base = retain_height
+            self._save_height()
+            return pruned

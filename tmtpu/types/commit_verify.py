@@ -129,12 +129,16 @@ def verify_commit_light_trusting(vals: ValidatorSet, chain_id: str,
     bv = crypto_batch.new_batch_verifier(backend)
     powers = []
     seen = set()
+    # one O(n) index instead of an O(n) scan per signature (10k x 10k
+    # address comparisons would dwarf the batch dispatch)
+    by_address = {v.address: (i, v) for i, v in enumerate(vals.validators)}
     for idx, cs in enumerate(commit.signatures):
         if not cs.for_block():
             continue
-        val_idx, val = vals.get_by_address(cs.validator_address)
-        if val is None:
+        entry = by_address.get(cs.validator_address)
+        if entry is None:
             continue  # unknown validator: skip (not in the trusted set)
+        val_idx, val = entry
         if val_idx in seen:
             raise VerificationError(
                 f"double vote from validator {cs.validator_address.hex()}"

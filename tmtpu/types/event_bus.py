@@ -1,0 +1,145 @@
+"""EventBus (reference: types/event_bus.go over libs/pubsub) — typed
+pub/sub for new blocks, votes, txs; feeds RPC subscriptions and indexers.
+
+Queries are predicate callables (the full query-language parser lives in
+tmtpu.libs.pubsub_query and compiles to these predicates).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Dict, List, Optional
+
+# event types (types/events.go)
+EVENT_NEW_BLOCK = "NewBlock"
+EVENT_NEW_BLOCK_HEADER = "NewBlockHeader"
+EVENT_NEW_ROUND = "NewRound"
+EVENT_NEW_ROUND_STEP = "NewRoundStep"
+EVENT_TX = "Tx"
+EVENT_VOTE = "Vote"
+EVENT_VALIDATOR_SET_UPDATES = "ValidatorSetUpdates"
+EVENT_COMPLETE_PROPOSAL = "CompleteProposal"
+EVENT_LOCK = "Lock"
+EVENT_POLKA = "Polka"
+EVENT_TIMEOUT_PROPOSE = "TimeoutPropose"
+EVENT_TIMEOUT_WAIT = "TimeoutWait"
+EVENT_VALID_BLOCK = "ValidBlock"
+EVENT_NEW_BLOCK_VALUE = "tm.event='NewBlock'"
+EVENT_TX_VALUE = "tm.event='Tx'"
+
+
+class EventItem:
+    __slots__ = ("type", "data", "events")
+
+    def __init__(self, type: str, data, events: Optional[dict] = None):
+        self.type = type
+        self.data = data
+        # ABCI-style composite event attrs: {"tx.hash": ["AB..."], ...}
+        self.events = events or {}
+
+
+class Subscription:
+    def __init__(self, subscriber: str, predicate: Callable[[EventItem], bool],
+                 out_capacity: int = 100):
+        self.subscriber = subscriber
+        self.predicate = predicate
+        self.queue: "queue.Queue[EventItem]" = queue.Queue(maxsize=out_capacity)
+        self.canceled = threading.Event()
+
+    def next(self, timeout: Optional[float] = None) -> Optional[EventItem]:
+        try:
+            return self.queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+
+class EventBus:
+    def __init__(self):
+        self._subs: List[Subscription] = []
+        self._lock = threading.Lock()
+
+    def subscribe(self, subscriber: str,
+                  predicate: Callable[[EventItem], bool],
+                  out_capacity: int = 100) -> Subscription:
+        sub = Subscription(subscriber, predicate, out_capacity)
+        with self._lock:
+            self._subs.append(sub)
+        return sub
+
+    def subscribe_type(self, subscriber: str, event_type: str) -> Subscription:
+        return self.subscribe(subscriber,
+                              lambda item: item.type == event_type)
+
+    def unsubscribe(self, sub: Subscription) -> None:
+        sub.canceled.set()
+        with self._lock:
+            if sub in self._subs:
+                self._subs.remove(sub)
+
+    def unsubscribe_all(self, subscriber: str) -> None:
+        with self._lock:
+            for s in [s for s in self._subs if s.subscriber == subscriber]:
+                s.canceled.set()
+                self._subs.remove(s)
+
+    def _publish(self, item: EventItem) -> None:
+        with self._lock:
+            subs = list(self._subs)
+        for s in subs:
+            try:
+                if s.predicate(item):
+                    try:
+                        s.queue.put_nowait(item)
+                    except queue.Full:
+                        pass  # slow subscriber: drop (reference cancels)
+            except Exception:
+                pass
+
+    # -- typed publishers (event_bus.go:134-233) ----------------------------
+
+    def publish_new_block(self, block, block_id, result_begin_block,
+                          result_end_block) -> None:
+        self._publish(EventItem(EVENT_NEW_BLOCK, {
+            "block": block, "block_id": block_id,
+            "result_begin_block": result_begin_block,
+            "result_end_block": result_end_block,
+        }))
+
+    def publish_new_block_header(self, header) -> None:
+        self._publish(EventItem(EVENT_NEW_BLOCK_HEADER, {"header": header}))
+
+    def publish_vote(self, vote) -> None:
+        self._publish(EventItem(EVENT_VOTE, {"vote": vote}))
+
+    def publish_tx(self, tx_result, events: Optional[dict] = None) -> None:
+        self._publish(EventItem(EVENT_TX, {"tx_result": tx_result},
+                                events or {}))
+
+    def publish_validator_set_updates(self, updates) -> None:
+        self._publish(EventItem(EVENT_VALIDATOR_SET_UPDATES,
+                                {"validator_updates": updates}))
+
+    def publish_new_round_step(self, rs) -> None:
+        self._publish(EventItem(EVENT_NEW_ROUND_STEP, {"round_state": rs}))
+
+    def publish_new_round(self, rs) -> None:
+        self._publish(EventItem(EVENT_NEW_ROUND, {"round_state": rs}))
+
+    def publish_complete_proposal(self, rs) -> None:
+        self._publish(EventItem(EVENT_COMPLETE_PROPOSAL, {"round_state": rs}))
+
+    def publish_polka(self, rs) -> None:
+        self._publish(EventItem(EVENT_POLKA, {"round_state": rs}))
+
+    def publish_lock(self, rs) -> None:
+        self._publish(EventItem(EVENT_LOCK, {"round_state": rs}))
+
+    def publish_valid_block(self, rs) -> None:
+        self._publish(EventItem(EVENT_VALID_BLOCK, {"round_state": rs}))
+
+    def publish_timeout_propose(self, rs) -> None:
+        self._publish(EventItem(EVENT_TIMEOUT_PROPOSE, {"round_state": rs}))
+
+    def publish_timeout_wait(self, rs) -> None:
+        self._publish(EventItem(EVENT_TIMEOUT_WAIT, {"round_state": rs}))

@@ -137,6 +137,9 @@ class ErrVoteConflictingVotes(VoteError):
                          f"{vote_a.validator_address.hex().upper()}")
         self.vote_a = vote_a
         self.vote_b = vote_b
+        # set by VoteSet.add_votes: per-vote added flags for the batch that
+        # surfaced the conflict (the batch IS fully processed before raising)
+        self.results = None
 
 
 class Proposal:

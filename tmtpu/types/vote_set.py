@@ -56,6 +56,9 @@ class VoteSet:
             raise ValueError("cannot make VoteSet for height == 0")
         if not is_vote_type_valid(signed_msg_type):
             raise ValueError(f"invalid vote type {signed_msg_type}")
+        if val_set.size() > MAX_VOTES_COUNT:
+            raise ValueError(
+                f"validator set larger than MaxVotesCount {MAX_VOTES_COUNT}")
         self.chain_id = chain_id
         self.height = height
         self.round = round
@@ -168,6 +171,9 @@ class VoteSet:
                         conflict = ErrVoteConflictingVotes(conflicting, vote)
 
             if conflict is not None:
+                # the batch was fully processed; expose what was added so
+                # callers can still publish events for accepted votes
+                conflict.results = results
                 raise conflict
             if first_err is not None and not any(results):
                 raise first_err
@@ -309,3 +315,24 @@ class VoteSet:
         return (f"VoteSet{{H:{self.height} R:{self.round} "
                 f"T:{self.signed_msg_type} +2/3:{self._maj23} "
                 f"{self._votes_bit_array}}}")
+
+
+def commit_to_vote_set(chain_id: str, commit: Commit,
+                       val_set: ValidatorSet) -> VoteSet:
+    """types/vote_set.go CommitToVoteSet — rebuild the precommit VoteSet a
+    Commit was made from (crash recovery: reconstructLastCommit)."""
+    vs = VoteSet(chain_id, commit.height, commit.round, PRECOMMIT, val_set)
+    votes = []
+    for idx, cs in enumerate(commit.signatures):
+        if cs.is_absent():
+            continue
+        votes.append(Vote(
+            type=PRECOMMIT, height=commit.height, round=commit.round,
+            block_id=cs.block_id(commit.block_id), timestamp=cs.timestamp,
+            validator_address=cs.validator_address, validator_index=idx,
+            signature=cs.signature,
+        ))
+    added = vs.add_votes(votes)
+    if not all(added):
+        raise VoteError("failed to reconstruct last commit")
+    return vs
