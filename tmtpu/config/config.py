@@ -187,6 +187,8 @@ class Config:
         c = cls()
         c.consensus = ConsensusConfig.test_config()
         c.base.db_backend = "mem"
+        c.p2p.laddr = "tcp://127.0.0.1:0"  # ephemeral port
+        c.p2p.allow_duplicate_ip = True
         return c
 
     def to_dict(self) -> dict:

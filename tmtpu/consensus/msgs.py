@@ -1,0 +1,101 @@
+"""Consensus wire messages (reference: proto/tendermint/consensus/types.proto
++ consensus/msgs.go) — field numbers match the reference."""
+
+from __future__ import annotations
+
+from tmtpu.libs.protoio import ProtoMessage
+from tmtpu.types import pb
+
+
+class NewRoundStepPB(ProtoMessage):
+    FIELDS = [
+        (1, "height", "int64"),
+        (2, "round", "int32"),
+        (3, "step", "uint32"),
+        (4, "seconds_since_start_time", "int64"),
+        (5, "last_commit_round", "int32"),
+    ]
+
+
+class NewValidBlockPB(ProtoMessage):
+    FIELDS = [
+        (1, "height", "int64"),
+        (2, "round", "int32"),
+        (3, "block_part_set_header", ("msg!", pb.PartSetHeader)),
+        (4, "block_parts", "bytes"),  # bitarray json form
+        (5, "is_commit", "bool"),
+    ]
+
+
+class ProposalPB(ProtoMessage):
+    FIELDS = [(1, "proposal", ("msg!", pb.Proposal))]
+
+
+class ProposalPOLPB(ProtoMessage):
+    FIELDS = [
+        (1, "height", "int64"),
+        (2, "proposal_pol_round", "int32"),
+        (3, "proposal_pol", "bytes"),
+    ]
+
+
+class BlockPartPB(ProtoMessage):
+    FIELDS = [
+        (1, "height", "int64"),
+        (2, "round", "int32"),
+        (3, "part", ("msg!", pb.Part)),
+    ]
+
+
+class VotePB(ProtoMessage):
+    FIELDS = [(1, "vote", ("msg!", pb.Vote))]
+
+
+class HasVotePB(ProtoMessage):
+    FIELDS = [
+        (1, "height", "int64"),
+        (2, "round", "int32"),
+        (3, "type", "enum"),
+        (4, "index", "int32"),
+    ]
+
+
+class VoteSetMaj23PB(ProtoMessage):
+    FIELDS = [
+        (1, "height", "int64"),
+        (2, "round", "int32"),
+        (3, "type", "enum"),
+        (4, "block_id", ("msg!", pb.BlockID)),
+    ]
+
+
+class VoteSetBitsPB(ProtoMessage):
+    FIELDS = [
+        (1, "height", "int64"),
+        (2, "round", "int32"),
+        (3, "type", "enum"),
+        (4, "block_id", ("msg!", pb.BlockID)),
+        (5, "votes", "bytes"),
+    ]
+
+
+class ConsensusMessagePB(ProtoMessage):
+    """The channel envelope (oneof)."""
+
+    FIELDS = [
+        (1, "new_round_step", ("msg", NewRoundStepPB)),
+        (2, "new_valid_block", ("msg", NewValidBlockPB)),
+        (3, "proposal", ("msg", ProposalPB)),
+        (4, "proposal_pol", ("msg", ProposalPOLPB)),
+        (5, "block_part", ("msg", BlockPartPB)),
+        (6, "vote", ("msg", VotePB)),
+        (7, "has_vote", ("msg", HasVotePB)),
+        (8, "vote_set_maj23", ("msg", VoteSetMaj23PB)),
+        (9, "vote_set_bits", ("msg", VoteSetBitsPB)),
+    ]
+
+    def which(self) -> str:
+        for _, name, _s in self.FIELDS:
+            if getattr(self, name) is not None:
+                return name
+        return ""

@@ -1,0 +1,401 @@
+"""Consensus reactor (reference: consensus/reactor.go).
+
+Four p2p channels (:142): State (0x20), Data (0x21), Vote (0x22),
+VoteSetBits (0x23). Per-peer gossip threads (:199-201 — data and votes)
+push what each peer is missing, tracked in a PeerState updated from
+NewRoundStep/HasVote/VoteSetMaj23 messages; catchup feeds lagging peers
+block parts + commit votes from the block store.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, Optional
+
+from tmtpu.consensus import msgs as cm
+from tmtpu.consensus.state import ConsensusState
+from tmtpu.consensus.types import (
+    STEP_COMMIT, STEP_NEW_HEIGHT, STEP_PRECOMMIT, STEP_PREVOTE,
+)
+from tmtpu.libs.bits import BitArray
+from tmtpu.p2p.conn.connection import ChannelDescriptor
+from tmtpu.p2p.switch import Peer, Reactor
+from tmtpu.types import pb
+from tmtpu.types.block import BlockID
+from tmtpu.types.part_set import Part
+from tmtpu.types.vote import PRECOMMIT, PREVOTE, Proposal, Vote
+from tmtpu.types.vote_set import commit_to_vote_set
+
+STATE_CHANNEL = 0x20
+DATA_CHANNEL = 0x21
+VOTE_CHANNEL = 0x22
+VOTE_SET_BITS_CHANNEL = 0x23
+
+GOSSIP_SLEEP_S = 0.01  # peerGossipSleepDuration (100ms in ref; faster here)
+
+
+class PeerState:
+    """consensus/reactor.go PeerState — what we know the peer knows."""
+
+    def __init__(self):
+        self.height = 0
+        self.round = -1
+        self.step = 0
+        self.proposal = False
+        self.proposal_block_parts: Optional[BitArray] = None
+        self.proposal_parts_total = 0
+        self.prevotes: Dict[int, BitArray] = {}
+        self.precommits: Dict[int, BitArray] = {}
+        self.catchup_commit: Optional[BitArray] = None
+        self.catchup_height = 0
+        self.lock = threading.RLock()
+
+    def apply_new_round_step(self, m: cm.NewRoundStepPB) -> None:
+        with self.lock:
+            if m.height != self.height or m.round != self.round:
+                self.proposal = False
+                self.proposal_block_parts = None
+                self.proposal_parts_total = 0
+            if m.height != self.height:
+                self.prevotes.clear()
+                self.precommits.clear()
+                self.catchup_commit = None
+                self.catchup_height = 0
+            self.height = m.height
+            self.round = m.round
+            self.step = m.step
+
+    def vote_bits(self, round: int, vote_type: int, n: int) -> BitArray:
+        with self.lock:
+            table = self.prevotes if vote_type == PREVOTE else self.precommits
+            ba = table.get(round)
+            if ba is None or ba.size() != n:
+                ba = BitArray(n)
+                table[round] = ba
+            return ba
+
+    def set_has_vote(self, height: int, round: int, vote_type: int,
+                     index: int, n: int = 0) -> None:
+        with self.lock:
+            if height != self.height:
+                if height == self.catchup_height and \
+                        self.catchup_commit is not None:
+                    self.catchup_commit.set_index(index, True)
+                return
+            table = self.prevotes if vote_type == PREVOTE else self.precommits
+            ba = table.get(round)
+            if ba is None:
+                ba = BitArray(max(n, index + 1))
+                table[round] = ba
+            if index >= ba.size():
+                grown = BitArray(index + 1)
+                for i in ba.true_indices():
+                    grown.set_index(i, True)
+                table[round] = ba = grown
+            ba.set_index(index, True)
+
+    def set_has_part(self, height: int, index: int, total: int) -> None:
+        with self.lock:
+            if height != self.height:
+                return
+            if self.proposal_block_parts is None or \
+                    self.proposal_parts_total != total:
+                self.proposal_block_parts = BitArray(total)
+                self.proposal_parts_total = total
+            self.proposal_block_parts.set_index(index, True)
+
+    def ensure_catchup(self, height: int, n_vals: int) -> BitArray:
+        with self.lock:
+            if self.catchup_height != height or self.catchup_commit is None:
+                self.catchup_commit = BitArray(n_vals)
+                self.catchup_height = height
+            return self.catchup_commit
+
+
+class ConsensusReactor(Reactor):
+    def __init__(self, cs: ConsensusState, wait_sync: bool = False):
+        super().__init__("CONSENSUS")
+        self.cs = cs
+        self.wait_sync = wait_sync  # true while block sync is running
+        self._peer_threads: Dict[str, list] = {}
+        self._stopped = threading.Event()
+        # outbound hooks from the state machine
+        cs.on_own_vote = self._broadcast_own_vote
+        cs.on_own_proposal = self._broadcast_own_proposal
+        # step-change broadcast
+        if cs.event_bus is not None:
+            self._step_sub = cs.event_bus.subscribe_type(
+                "reactor-steps", "NewRoundStep")
+        else:
+            self._step_sub = None
+
+    # -- reactor interface --------------------------------------------------
+
+    def get_channels(self):
+        return [
+            ChannelDescriptor(STATE_CHANNEL, priority=6,
+                              send_queue_capacity=100),
+            ChannelDescriptor(DATA_CHANNEL, priority=10,
+                              send_queue_capacity=100),
+            ChannelDescriptor(VOTE_CHANNEL, priority=7,
+                              send_queue_capacity=100),
+            ChannelDescriptor(VOTE_SET_BITS_CHANNEL, priority=1,
+                              send_queue_capacity=2),
+        ]
+
+    def on_start(self) -> None:
+        if self._step_sub is not None:
+            t = threading.Thread(target=self._step_broadcast_routine,
+                                 daemon=True, name="cs-step-bcast")
+            t.start()
+
+    def on_stop(self) -> None:
+        self._stopped.set()
+
+    def switch_to_consensus(self, state, skip_wal: bool = False) -> None:
+        """blockchain reactor hands over after catchup
+        (consensus/reactor.go:108 SwitchToConsensus)."""
+        self.wait_sync = False
+        self.cs.update_to_state(state)
+        self.cs.start()
+
+    def add_peer(self, peer: Peer) -> None:
+        ps = PeerState()
+        peer.set("consensus_peer_state", ps)
+        # announce our current state (reactor.go AddPeer sendNewRoundStep)
+        peer.send(STATE_CHANNEL, self._new_round_step_msg().encode())
+        threads = []
+        for fn, name in ((self._gossip_data_routine, "gossip-data"),
+                         (self._gossip_votes_routine, "gossip-votes")):
+            t = threading.Thread(target=fn, args=(peer, ps), daemon=True,
+                                 name=f"{name}-{peer.node_id[:8]}")
+            t.start()
+            threads.append(t)
+        self._peer_threads[peer.node_id] = threads
+
+    def remove_peer(self, peer: Peer, reason) -> None:
+        self._peer_threads.pop(peer.node_id, None)
+
+    def receive(self, channel_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        m = cm.ConsensusMessagePB.decode(msg_bytes)
+        ps: Optional[PeerState] = peer.get("consensus_peer_state")
+        if ps is None:
+            return
+        kind = m.which()
+        if channel_id == STATE_CHANNEL:
+            if kind == "new_round_step":
+                ps.apply_new_round_step(m.new_round_step)
+            elif kind == "has_vote":
+                hv = m.has_vote
+                ps.set_has_vote(hv.height, hv.round, hv.type, hv.index)
+            elif kind == "vote_set_maj23":
+                vm = m.vote_set_maj23
+                rs = self.cs.get_round_state()
+                if rs.height == vm.height and rs.votes is not None:
+                    try:
+                        rs.votes.set_peer_maj23(
+                            vm.round, vm.type, peer.node_id,
+                            BlockID.from_proto(vm.block_id))
+                    except Exception:
+                        pass
+        elif channel_id == DATA_CHANNEL:
+            if self.wait_sync:
+                return
+            if kind == "proposal":
+                self.cs.add_proposal(Proposal.from_proto(m.proposal.proposal),
+                                     peer.node_id)
+                with ps.lock:
+                    ps.proposal = True
+            elif kind == "block_part":
+                bp = m.block_part
+                part = Part.from_proto(bp.part)
+                ps.set_has_part(bp.height, part.index, part.proof.total)
+                self.cs.add_block_part(bp.height, bp.round, part,
+                                       peer.node_id)
+        elif channel_id == VOTE_CHANNEL:
+            if self.wait_sync:
+                return
+            if kind == "vote":
+                vote = Vote.from_proto(m.vote.vote)
+                rs = self.cs.get_round_state()
+                n = rs.validators.size() if rs.validators else 0
+                ps.set_has_vote(vote.height, vote.round, vote.type,
+                                vote.validator_index, n)
+                self.cs.add_vote_msg(vote, peer.node_id)
+
+    # -- outbound -----------------------------------------------------------
+
+    def _new_round_step_msg(self) -> cm.ConsensusMessagePB:
+        rs = self.cs.get_round_state()
+        lc_round = -1
+        if rs.last_commit is not None:
+            lc_round = rs.last_commit.round
+        return cm.ConsensusMessagePB(new_round_step=cm.NewRoundStepPB(
+            height=rs.height, round=rs.round, step=rs.step,
+            seconds_since_start_time=max(
+                0, (time.time_ns() - rs.start_time) // 10**9),
+            last_commit_round=lc_round,
+        ))
+
+    def _step_broadcast_routine(self) -> None:
+        while not self._stopped.is_set():
+            item = self._step_sub.next(timeout=0.2)
+            if item is None:
+                continue
+            if self.switch is not None:
+                self.switch.broadcast(STATE_CHANNEL,
+                                      self._new_round_step_msg().encode())
+
+    def _broadcast_own_vote(self, vote: Vote) -> None:
+        if self.switch is None:
+            return
+        msg = cm.ConsensusMessagePB(vote=cm.VotePB(vote=vote.to_proto()))
+        self.switch.broadcast(VOTE_CHANNEL, msg.encode())
+        hv = cm.ConsensusMessagePB(has_vote=cm.HasVotePB(
+            height=vote.height, round=vote.round, type=vote.type,
+            index=vote.validator_index))
+        self.switch.broadcast(STATE_CHANNEL, hv.encode())
+
+    def _broadcast_own_proposal(self, proposal: Proposal, parts) -> None:
+        if self.switch is None:
+            return
+        self.switch.broadcast(DATA_CHANNEL, cm.ConsensusMessagePB(
+            proposal=cm.ProposalPB(proposal=proposal.to_proto())).encode())
+        for i in range(parts.total):
+            self.switch.broadcast(DATA_CHANNEL, cm.ConsensusMessagePB(
+                block_part=cm.BlockPartPB(
+                    height=proposal.height, round=proposal.round,
+                    part=parts.get_part(i).to_proto())).encode())
+
+    # -- gossip routines (reactor.go:559 gossipDataRoutine, :716
+    # gossipVotesRoutine) ---------------------------------------------------
+
+    def _gossip_data_routine(self, peer: Peer, ps: PeerState) -> None:
+        while peer.is_running() and not self._stopped.is_set():
+            rs = self.cs.get_round_state()
+            with ps.lock:
+                prs_h, prs_r = ps.height, ps.round
+                has_proposal = ps.proposal
+                peer_parts = ps.proposal_block_parts
+            if prs_h == 0:
+                time.sleep(GOSSIP_SLEEP_S)
+                continue
+            # catchup: peer is on an older height -> send stored block parts
+            if 0 < prs_h < rs.height and \
+                    prs_h >= self.cs.block_store.base():
+                self._gossip_catchup_part(peer, ps, prs_h)
+                time.sleep(GOSSIP_SLEEP_S)
+                continue
+            if prs_h != rs.height:
+                time.sleep(GOSSIP_SLEEP_S)
+                continue
+            # same height: proposal + parts
+            if rs.proposal is not None and not has_proposal:
+                peer.try_send(DATA_CHANNEL, cm.ConsensusMessagePB(
+                    proposal=cm.ProposalPB(
+                        proposal=rs.proposal.to_proto())).encode())
+                with ps.lock:
+                    ps.proposal = True
+            if rs.proposal_block_parts is not None:
+                ours = rs.proposal_block_parts.bit_array()
+                total = rs.proposal_block_parts.total
+                theirs = peer_parts if peer_parts is not None and \
+                    peer_parts.size() == total else BitArray(total)
+                missing = ours.sub(theirs)
+                idx = missing.pick_random()
+                if idx is not None:
+                    part = rs.proposal_block_parts.get_part(idx)
+                    if part is not None and peer.try_send(
+                            DATA_CHANNEL, cm.ConsensusMessagePB(
+                                block_part=cm.BlockPartPB(
+                                    height=rs.height, round=rs.round,
+                                    part=part.to_proto())).encode()):
+                        ps.set_has_part(rs.height, idx, total)
+                        continue  # keep pushing without sleeping
+            time.sleep(GOSSIP_SLEEP_S)
+
+    def _gossip_catchup_part(self, peer: Peer, ps: PeerState,
+                             height: int) -> None:
+        meta = self.cs.block_store.load_block_meta(height)
+        if meta is None:
+            return
+        total = meta.block_id.parts_total
+        with ps.lock:
+            theirs = ps.proposal_block_parts if \
+                ps.proposal_block_parts is not None and \
+                ps.proposal_block_parts.size() == total else BitArray(total)
+        missing = theirs.not_()
+        idx = missing.pick_random()
+        if idx is None:
+            return
+        part = self.cs.block_store.load_block_part(height, idx)
+        if part is None:
+            return
+        if peer.try_send(DATA_CHANNEL, cm.ConsensusMessagePB(
+                block_part=cm.BlockPartPB(
+                    height=height, round=0,
+                    part=part.to_proto())).encode()):
+            ps.set_has_part(height, idx, total)
+
+    def _gossip_votes_routine(self, peer: Peer, ps: PeerState) -> None:
+        while peer.is_running() and not self._stopped.is_set():
+            rs = self.cs.get_round_state()
+            with ps.lock:
+                prs_h, prs_r = ps.height, ps.round
+            sent = False
+            if prs_h == rs.height and rs.votes is not None:
+                # current-round prevotes then precommits
+                for vote_type in (PREVOTE, PRECOMMIT):
+                    vs = rs.votes.prevotes(prs_r) if vote_type == PREVOTE \
+                        else rs.votes.precommits(prs_r)
+                    if vs is None or prs_r < 0:
+                        continue
+                    theirs = ps.vote_bits(prs_r, vote_type, vs.size())
+                    missing = vs.bit_array().sub(theirs)
+                    idx = missing.pick_random()
+                    if idx is not None:
+                        vote = vs.get_by_index(idx)
+                        if vote is not None and self._send_vote(peer, ps,
+                                                                vote):
+                            sent = True
+                            break
+                # last commit for peers entering the height
+                if not sent and rs.last_commit is not None and \
+                        prs_h >= 1 and rs.votes is not None:
+                    pass
+            elif 0 < prs_h < rs.height and \
+                    prs_h >= self.cs.block_store.base():
+                # catchup votes: precommits from the stored seen commit
+                commit = self.cs.block_store.load_seen_commit(prs_h) or \
+                    self.cs.block_store.load_block_commit(prs_h)
+                if commit is not None:
+                    n = len(commit.signatures)
+                    theirs = ps.ensure_catchup(prs_h, n)
+                    for i, csig in enumerate(commit.signatures):
+                        if csig.is_absent() or theirs.get_index(i):
+                            continue
+                        vote = Vote(
+                            type=PRECOMMIT, height=commit.height,
+                            round=commit.round,
+                            block_id=csig.block_id(commit.block_id),
+                            timestamp=csig.timestamp,
+                            validator_address=csig.validator_address,
+                            validator_index=i, signature=csig.signature)
+                        if peer.try_send(VOTE_CHANNEL, cm.ConsensusMessagePB(
+                                vote=cm.VotePB(
+                                    vote=vote.to_proto())).encode()):
+                            theirs.set_index(i, True)
+                            sent = True
+                        break
+            if not sent:
+                time.sleep(GOSSIP_SLEEP_S)
+
+    def _send_vote(self, peer: Peer, ps: PeerState, vote: Vote) -> bool:
+        ok = peer.try_send(VOTE_CHANNEL, cm.ConsensusMessagePB(
+            vote=cm.VotePB(vote=vote.to_proto())).encode())
+        if ok:
+            ps.set_has_vote(vote.height, vote.round, vote.type,
+                            vote.validator_index)
+        return ok

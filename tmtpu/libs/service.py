@@ -24,8 +24,15 @@ class BaseService:
                 raise ServiceError(f"{self._name} already started")
             if self._stopped:
                 raise ServiceError(f"{self._name} already stopped")
-            self.on_start()
+            # mark running BEFORE on_start: threads spawned there check
+            # is_running() immediately (the reference sets the atomic flag
+            # first too — service.go Start)
             self._started = True
+            try:
+                self.on_start()
+            except BaseException:
+                self._started = False
+                raise
 
     def stop(self) -> None:
         with self._svc_lock:

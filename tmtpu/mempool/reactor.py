@@ -1,0 +1,83 @@
+"""Mempool reactor (reference: mempool/v0/reactor.go) — gossips txs on
+channel 0x30 via per-peer broadcast threads; received txs go through
+CheckTx with the sender recorded so they aren't echoed back."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+from tmtpu.libs.protoio import ProtoMessage
+from tmtpu.mempool.clist_mempool import CListMempool, MempoolFullError, \
+    TxInMempoolError
+from tmtpu.p2p.conn.connection import ChannelDescriptor
+from tmtpu.p2p.switch import Peer, Reactor
+
+MEMPOOL_CHANNEL = 0x30
+
+
+class TxsPB(ProtoMessage):
+    """proto/tendermint/mempool/types.proto Txs."""
+
+    FIELDS = [(1, "txs", ("rep", "bytes"))]
+
+
+class MempoolReactor(Reactor):
+    def __init__(self, mempool: CListMempool, broadcast: bool = True):
+        super().__init__("MEMPOOL")
+        self.mempool = mempool
+        self.broadcast = broadcast
+        self._stopped = threading.Event()
+
+    def get_channels(self):
+        return [ChannelDescriptor(MEMPOOL_CHANNEL, priority=5,
+                                  send_queue_capacity=1000)]
+
+    def on_stop(self) -> None:
+        self._stopped.set()
+
+    def add_peer(self, peer: Peer) -> None:
+        if not self.broadcast:
+            return
+        t = threading.Thread(target=self._broadcast_routine, args=(peer,),
+                             daemon=True,
+                             name=f"mempool-bcast-{peer.node_id[:8]}")
+        t.start()
+
+    def receive(self, channel_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        m = TxsPB.decode(msg_bytes)
+        for tx in m.txs:
+            try:
+                self.mempool.check_tx(bytes(tx),
+                                      tx_info={"sender": peer.node_id})
+            except (TxInMempoolError, MempoolFullError):
+                self.mempool.mark_sender(bytes(tx), peer.node_id)
+            except Exception:
+                pass
+
+    def _broadcast_routine(self, peer: Peer) -> None:
+        """mempool/v0/reactor.go:148 broadcastTxRoutine — iterate the
+        mempool, send txs the peer hasn't seen."""
+        sent: set = set()
+        while peer.is_running() and not self._stopped.is_set():
+            batch = []
+            for tx in self.mempool.reap_max_txs(-1):
+                key = hash(tx)
+                if key in sent:
+                    continue
+                if peer.node_id in self.mempool.senders(tx):
+                    sent.add(key)
+                    continue
+                batch.append(tx)
+                sent.add(key)
+                if len(batch) >= 100:
+                    break
+            if batch:
+                if not peer.send(MEMPOOL_CHANNEL, TxsPB(txs=batch).encode()):
+                    for tx in batch:
+                        sent.discard(hash(tx))
+            else:
+                time.sleep(0.02)
+            if len(sent) > 100_000:
+                sent.clear()

@@ -128,6 +128,51 @@ class Node(BaseService):
             self.priv_validator, wal_path,
         )
 
+        # --- p2p stack (node.go createTransport/createSwitch) ---
+        self.node_key = None
+        self.switch = None
+        self.node_id = ""
+        if config.p2p.laddr:
+            from tmtpu.consensus.reactor import ConsensusReactor
+            from tmtpu.mempool.reactor import MempoolReactor
+            from tmtpu.p2p.key import NodeKey
+            from tmtpu.p2p.switch import Switch
+            from tmtpu.p2p.transport import NodeInfo, Transport
+            from tmtpu.version import BlockProtocol, P2PProtocol, TMCoreSemVer
+
+            self.node_key = NodeKey.load_or_gen(
+                config.rooted(config.base.node_key_file))
+            self.node_id = self.node_key.node_id
+            channels = bytes([0x20, 0x21, 0x22, 0x23, 0x30, 0x38, 0x40])
+            node_info = NodeInfo(
+                node_id=self.node_key.node_id,
+                listen_addr=config.p2p.laddr,
+                network=self.genesis_doc.chain_id,
+                version=TMCoreSemVer,
+                channels=channels,
+                moniker=config.base.moniker,
+                p2p_version=P2PProtocol,
+                block_version=BlockProtocol,
+                rpc_address=config.rpc.laddr,
+            )
+            transport = Transport(
+                self.node_key, node_info,
+                dial_timeout=config.p2p.dial_timeout_ns / 1e9,
+                handshake_timeout=config.p2p.handshake_timeout_ns / 1e9,
+            )
+            transport.listen(config.p2p.laddr)
+            self.transport = transport
+            self.switch = Switch(transport,
+                                 max_inbound=config.p2p.max_num_inbound_peers,
+                                 max_outbound=config.p2p.max_num_outbound_peers)
+            self.consensus_reactor = ConsensusReactor(self.consensus)
+            self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
+            self.switch.add_reactor("MEMPOOL", MempoolReactor(
+                self.mempool, broadcast=config.mempool.broadcast))
+            self.switch.set_persistent_peers(
+                [a.strip() for a in config.p2p.persistent_peers.split(",")
+                 if a.strip()])
+
         # --- RPC ---
         self.rpc_server = None
         if config.rpc.laddr:
@@ -137,6 +182,8 @@ class Node(BaseService):
 
     def on_start(self) -> None:
         self.indexer_service.start()
+        if self.switch is not None:
+            self.switch.start()
         self.consensus.start()
         if self.rpc_server is not None:
             self.rpc_server.start()
@@ -145,8 +192,14 @@ class Node(BaseService):
         if self.rpc_server is not None:
             self.rpc_server.stop()
         self.consensus.stop()
+        if self.switch is not None:
+            self.switch.stop()
         self.indexer_service.stop()
         self.proxy_app.stop()
+
+    @property
+    def p2p_port(self) -> int:
+        return self.transport.listen_port if self.switch else 0
 
     # convenience used by RPC + tests
     @property

@@ -1,0 +1,239 @@
+"""MConnection (reference: p2p/conn/connection.go:78) — multiplexes N
+prioritized channels over one (secret) stream.
+
+Wire format: uvarint-length-delimited Packet protos —
+PacketPing / PacketPong / PacketMsg{channel_id, eof, data} (the reference's
+proto/tendermint/p2p/conn.proto). Messages are chunked into
+``max_packet_msg_payload_size`` packets with an EOF marker.
+
+One send thread drains per-channel queues by priority; one recv thread
+reassembles packets and hands complete messages to the owner's
+``on_receive(channel_id, msg_bytes)``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from tmtpu.libs.protoio import ProtoMessage, encode_uvarint, decode_uvarint
+from tmtpu.types import pb
+
+
+class PacketPing(ProtoMessage):
+    FIELDS: list = []
+
+
+class PacketPong(ProtoMessage):
+    FIELDS: list = []
+
+
+class PacketMsg(ProtoMessage):
+    FIELDS = [(1, "channel_id", "int32"), (2, "eof", "bool"),
+              (3, "data", "bytes")]
+
+
+class Packet(ProtoMessage):
+    FIELDS = [
+        (1, "ping", ("msg", PacketPing)),
+        (2, "pong", ("msg", PacketPong)),
+        (3, "msg", ("msg", PacketMsg)),
+    ]
+
+
+class ChannelDescriptor:
+    def __init__(self, channel_id: int, priority: int = 1,
+                 send_queue_capacity: int = 100,
+                 recv_message_capacity: int = 22 * 1024 * 1024):
+        self.channel_id = channel_id
+        self.priority = priority
+        self.send_queue_capacity = send_queue_capacity
+        self.recv_message_capacity = recv_message_capacity
+
+
+class _Channel:
+    def __init__(self, desc: ChannelDescriptor):
+        self.desc = desc
+        self.send_queue: "queue.Queue[bytes]" = queue.Queue(
+            maxsize=desc.send_queue_capacity)
+        self.sending = b""
+        self.recv_buf = b""
+        self.recently_sent = 0
+
+
+class MConnection:
+    PING_INTERVAL = 30.0
+    FLUSH_INTERVAL = 0.01
+
+    def __init__(self, conn, channel_descs: List[ChannelDescriptor],
+                 on_receive: Callable[[int, bytes], None],
+                 on_error: Callable[[Exception], None],
+                 max_packet_payload: int = 1024):
+        self._conn = conn  # SecretConnection or raw socket-like
+        self._channels: Dict[int, _Channel] = {
+            d.channel_id: _Channel(d) for d in channel_descs
+        }
+        self._on_receive = on_receive
+        self._on_error = on_error
+        self._max_payload = max_packet_payload
+        self._send_event = threading.Event()
+        self._pong_pending = False
+        self._stopped = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    def start(self) -> None:
+        for fn, name in ((self._send_routine, "mconn-send"),
+                         (self._recv_routine, "mconn-recv")):
+            t = threading.Thread(target=fn, daemon=True, name=name)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        self._send_event.set()
+        if hasattr(self._conn, "close"):
+            self._conn.close()
+
+    def is_running(self) -> bool:
+        return not self._stopped.is_set()
+
+    # -- sending ------------------------------------------------------------
+
+    def send(self, channel_id: int, msg: bytes, timeout: float = 10.0) -> bool:
+        """Queue a complete message on a channel (connection.go Send)."""
+        ch = self._channels.get(channel_id)
+        if ch is None or self._stopped.is_set():
+            return False
+        try:
+            ch.send_queue.put(bytes(msg), timeout=timeout)
+        except queue.Full:
+            return False
+        self._send_event.set()
+        return True
+
+    def try_send(self, channel_id: int, msg: bytes) -> bool:
+        ch = self._channels.get(channel_id)
+        if ch is None or self._stopped.is_set():
+            return False
+        try:
+            ch.send_queue.put_nowait(bytes(msg))
+        except queue.Full:
+            return False
+        self._send_event.set()
+        return True
+
+    def _write_packet(self, p: Packet) -> None:
+        data = p.encode()
+        self._conn.write(encode_uvarint(len(data)) + data)
+
+    def _send_routine(self) -> None:
+        last_ping = time.monotonic()
+        try:
+            while not self._stopped.is_set():
+                fired = self._send_event.wait(timeout=0.05)
+                self._send_event.clear()
+                if self._stopped.is_set():
+                    return
+                if self._pong_pending:
+                    self._write_packet(Packet(pong=PacketPong()))
+                    self._pong_pending = False
+                now = time.monotonic()
+                if now - last_ping > self.PING_INTERVAL:
+                    self._write_packet(Packet(ping=PacketPing()))
+                    last_ping = now
+                # drain by priority until all queues empty
+                while self._send_some():
+                    pass
+        except Exception as e:  # noqa: BLE001
+            if not self._stopped.is_set():
+                self._on_error(e)
+                self.stop()
+
+    def _send_some(self) -> bool:
+        """Send one packet from the least-recently-served highest-priority
+        channel with pending data (connection.go sendSomePacketMsgs)."""
+        best: Optional[_Channel] = None
+        best_ratio = None
+        for ch in self._channels.values():
+            if not ch.sending and not ch.send_queue.empty():
+                try:
+                    ch.sending = ch.send_queue.get_nowait()
+                except queue.Empty:
+                    pass
+            if ch.sending:
+                ratio = ch.recently_sent / max(1, ch.desc.priority)
+                if best_ratio is None or ratio < best_ratio:
+                    best, best_ratio = ch, ratio
+        if best is None:
+            return False
+        chunk = best.sending[:self._max_payload]
+        rest = best.sending[self._max_payload:]
+        eof = not rest
+        self._write_packet(Packet(msg=PacketMsg(
+            channel_id=best.desc.channel_id, eof=eof, data=chunk)))
+        best.sending = rest
+        best.recently_sent += len(chunk)
+        # decay so long-lived connections keep rotating fairly
+        if best.recently_sent > 10 * 1024 * 1024:
+            for ch in self._channels.values():
+                ch.recently_sent //= 2
+        return True
+
+    # -- receiving ----------------------------------------------------------
+
+    def _read_uvarint(self) -> int:
+        buf = b""
+        while True:
+            b = self._conn.read_exact(1) if hasattr(self._conn, "read_exact") \
+                else self._conn.recv(1)
+            if not b:
+                raise ConnectionError("eof")
+            buf += b
+            try:
+                n, _ = decode_uvarint(buf, 0)
+                return n
+            except EOFError:
+                continue
+
+    def _read_exact(self, n: int) -> bytes:
+        if hasattr(self._conn, "read_exact"):
+            return self._conn.read_exact(n)
+        out = b""
+        while len(out) < n:
+            chunk = self._conn.recv(n - len(out))
+            if not chunk:
+                raise ConnectionError("eof")
+            out += chunk
+        return out
+
+    def _recv_routine(self) -> None:
+        try:
+            while not self._stopped.is_set():
+                n = self._read_uvarint()
+                if n > 30 * 1024 * 1024:
+                    raise ConnectionError(f"packet too big: {n}")
+                pkt = Packet.decode(self._read_exact(n))
+                if pkt.ping is not None:
+                    self._pong_pending = True
+                    self._send_event.set()
+                elif pkt.pong is not None:
+                    pass
+                elif pkt.msg is not None:
+                    ch = self._channels.get(pkt.msg.channel_id)
+                    if ch is None:
+                        raise ConnectionError(
+                            f"unknown channel {pkt.msg.channel_id}")
+                    ch.recv_buf += bytes(pkt.msg.data)
+                    if len(ch.recv_buf) > ch.desc.recv_message_capacity:
+                        raise ConnectionError("recv message too big")
+                    if pkt.msg.eof:
+                        msg, ch.recv_buf = ch.recv_buf, b""
+                        self._on_receive(ch.desc.channel_id, msg)
+        except Exception as e:  # noqa: BLE001
+            if not self._stopped.is_set():
+                self._on_error(e)
+                self.stop()

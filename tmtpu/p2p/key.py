@@ -1,0 +1,41 @@
+"""Node identity (reference: p2p/key.go) — ed25519 node key; the node ID is
+the hex of the pubkey address."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from tmtpu.crypto import ed25519
+
+
+class NodeKey:
+    def __init__(self, priv_key):
+        self.priv_key = priv_key
+
+    @property
+    def node_id(self) -> str:
+        """ID = hex(address(pubkey)) (p2p/key.go PubKeyToID)."""
+        return self.priv_key.pub_key().address().hex()
+
+    def pub_key(self):
+        return self.priv_key.pub_key()
+
+    @classmethod
+    def generate(cls) -> "NodeKey":
+        return cls(ed25519.gen_priv_key())
+
+    @classmethod
+    def load_or_gen(cls, path: str) -> "NodeKey":
+        if os.path.exists(path):
+            with open(path) as f:
+                d = json.load(f)
+            return cls(ed25519.PrivKeyEd25519(
+                bytes.fromhex(d["priv_key"]["value"])))
+        nk = cls.generate()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"priv_key": {"type": "ed25519",
+                                    "value": nk.priv_key.bytes().hex()}}, f)
+        return nk

@@ -1,0 +1,275 @@
+"""Peer, Reactor and Switch (reference: p2p/peer.go:23, p2p/base_reactor.go,
+p2p/switch.go).
+
+The Switch owns the transport, the reactor registry (channel id → reactor)
+and the peer set; it accepts inbound peers, dials persistent peers with
+backoff, and fans Broadcast out over all peers' MConnections.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from tmtpu.libs.service import BaseService
+from tmtpu.p2p.conn.connection import ChannelDescriptor, MConnection
+from tmtpu.p2p.transport import NodeInfo, Transport, parse_peer_addr
+
+
+class Reactor:
+    """p2p/base_reactor.go Reactor interface."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.switch: Optional["Switch"] = None
+
+    def get_channels(self) -> List[ChannelDescriptor]:
+        return []
+
+    def add_peer(self, peer: "Peer") -> None:
+        pass
+
+    def remove_peer(self, peer: "Peer", reason) -> None:
+        pass
+
+    def receive(self, channel_id: int, peer: "Peer", msg_bytes: bytes) -> None:
+        pass
+
+    def on_start(self) -> None:
+        pass
+
+    def on_stop(self) -> None:
+        pass
+
+
+class Peer:
+    """p2p/peer.go — a connected peer wrapping its MConnection."""
+
+    def __init__(self, conn, node_info: NodeInfo, remote_ip: str,
+                 outbound: bool, channel_descs, on_receive, on_error):
+        self.node_info = node_info
+        self.remote_ip = remote_ip
+        self.outbound = outbound
+        self.mconn = MConnection(conn, channel_descs,
+                                 lambda ch, msg: on_receive(self, ch, msg),
+                                 lambda err: on_error(self, err))
+        self._data: Dict[str, object] = {}
+        self._data_lock = threading.Lock()
+
+    @property
+    def node_id(self) -> str:
+        return self.node_info.node_id
+
+    @property
+    def moniker(self) -> str:
+        return self.node_info.moniker
+
+    def start(self) -> None:
+        self.mconn.start()
+
+    def stop(self) -> None:
+        self.mconn.stop()
+
+    def is_running(self) -> bool:
+        return self.mconn.is_running()
+
+    def send(self, channel_id: int, msg: bytes) -> bool:
+        return self.mconn.send(channel_id, msg)
+
+    def try_send(self, channel_id: int, msg: bytes) -> bool:
+        return self.mconn.try_send(channel_id, msg)
+
+    def get(self, key: str):
+        with self._data_lock:
+            return self._data.get(key)
+
+    def set(self, key: str, value) -> None:
+        with self._data_lock:
+            self._data[key] = value
+
+    def __repr__(self):
+        return f"Peer{{{self.node_id[:12]} {self.remote_ip}}}"
+
+
+class Switch(BaseService):
+    RECONNECT_BASE_S = 0.5
+    RECONNECT_MAX_TRIES = 20
+
+    def __init__(self, transport: Transport,
+                 max_inbound: int = 40, max_outbound: int = 10):
+        super().__init__("Switch")
+        self.transport = transport
+        self.reactors: Dict[str, Reactor] = {}
+        self._channel_descs: List[ChannelDescriptor] = []
+        self._reactor_by_channel: Dict[int, Reactor] = {}
+        self.peers: Dict[str, Peer] = {}
+        self._peers_lock = threading.RLock()
+        self._persistent: List[str] = []  # "id@host:port"
+        self.max_inbound = max_inbound
+        self.max_outbound = max_outbound
+        self._threads: List[threading.Thread] = []
+
+    # -- wiring -------------------------------------------------------------
+
+    def add_reactor(self, name: str, reactor: Reactor) -> None:
+        for d in reactor.get_channels():
+            if d.channel_id in self._reactor_by_channel:
+                raise ValueError(f"channel {d.channel_id} already claimed")
+            self._reactor_by_channel[d.channel_id] = reactor
+            self._channel_descs.append(d)
+        reactor.switch = self
+        self.reactors[name] = reactor
+
+    @property
+    def node_id(self) -> str:
+        return self.transport.node_key.node_id
+
+    def set_persistent_peers(self, addrs: List[str]) -> None:
+        self._persistent = [a for a in addrs if a]
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def on_start(self) -> None:
+        for r in self.reactors.values():
+            r.on_start()
+        t = threading.Thread(target=self._accept_routine, daemon=True,
+                             name="switch-accept")
+        t.start()
+        self._threads.append(t)
+        for addr in self._persistent:
+            t = threading.Thread(target=self._dial_persistent, args=(addr,),
+                                 daemon=True, name=f"dial-{addr[:16]}")
+            t.start()
+            self._threads.append(t)
+
+    def on_stop(self) -> None:
+        self.transport.close()
+        with self._peers_lock:
+            peers = list(self.peers.values())
+        for p in peers:
+            p.stop()
+        for r in self.reactors.values():
+            r.on_stop()
+
+    # -- peer lifecycle -----------------------------------------------------
+
+    def _accept_routine(self) -> None:
+        # each upgrade runs in its own thread so a stalled client can't
+        # block inbound connectivity (transport.go accepts concurrently)
+        while self.is_running():
+            try:
+                conn, addr = self.transport._listener.accept()
+            except OSError:
+                if not self.is_running():
+                    return
+                time.sleep(0.05)
+                continue
+            threading.Thread(target=self._upgrade_inbound,
+                             args=(conn, addr[0]), daemon=True,
+                             name="switch-upgrade").start()
+
+    def _upgrade_inbound(self, conn, ip: str) -> None:
+        try:
+            sc, ni = self.transport._upgrade(conn)
+        except Exception:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        with self._peers_lock:
+            n_in = sum(1 for p in self.peers.values() if not p.outbound)
+        if n_in >= self.max_inbound:
+            sc.close()
+            return
+        self._add_peer_conn(sc, ni, ip, outbound=False)
+
+    def _dial_persistent(self, addr: str) -> None:
+        """Persistent peers are redialed forever with capped exponential
+        backoff (switch.go reconnectToPeer — never give up on persistent)."""
+        pid, hp = parse_peer_addr(addr)
+        tries = 0
+        while self.is_running():
+            with self._peers_lock:
+                connected = bool(pid) and pid in self.peers
+            if connected:
+                tries = 0
+            else:
+                try:
+                    sc, ni, ip = self.transport.dial(hp, expected_id=pid)
+                    self._add_peer_conn(sc, ni, ip, outbound=True)
+                    tries = 0
+                except Exception:
+                    tries += 1
+            time.sleep(min(self.RECONNECT_BASE_S * (2 ** min(tries, 6)), 30)
+                       if tries else 1.0)
+
+    def dial_peer(self, addr: str) -> Optional[Peer]:
+        pid, hp = parse_peer_addr(addr)
+        sc, ni, ip = self.transport.dial(hp, expected_id=pid)
+        return self._add_peer_conn(sc, ni, ip, outbound=True)
+
+    def _add_peer_conn(self, sc, ni: NodeInfo, ip: str, outbound: bool
+                       ) -> Optional[Peer]:
+        if ni.node_id == self.node_id:
+            sc.close()  # self-connection (switch.go filters these)
+            return None
+        with self._peers_lock:
+            if ni.node_id in self.peers:
+                sc.close()
+                return None
+            peer = Peer(sc, ni, ip, outbound, self._channel_descs,
+                        self._on_peer_receive, self._on_peer_error)
+            self.peers[ni.node_id] = peer
+        peer.start()
+        for r in self.reactors.values():
+            try:
+                r.add_peer(peer)
+            except Exception:
+                pass
+        return peer
+
+    def stop_peer_for_error(self, peer: Peer, reason) -> None:
+        self._remove_peer(peer, reason)
+
+    def _on_peer_error(self, peer: Peer, err: Exception) -> None:
+        self._remove_peer(peer, err)
+
+    def _remove_peer(self, peer: Peer, reason) -> None:
+        with self._peers_lock:
+            existing = self.peers.pop(peer.node_id, None)
+        if existing is None:
+            return
+        peer.stop()
+        for r in self.reactors.values():
+            try:
+                r.remove_peer(peer, reason)
+            except Exception:
+                pass
+
+    def _on_peer_receive(self, peer: Peer, channel_id: int, msg: bytes
+                         ) -> None:
+        reactor = self._reactor_by_channel.get(channel_id)
+        if reactor is None:
+            return
+        try:
+            reactor.receive(channel_id, peer, msg)
+        except Exception as e:  # noqa: BLE001
+            self.stop_peer_for_error(peer, e)
+
+    # -- broadcast (switch.go:306) ------------------------------------------
+
+    def broadcast(self, channel_id: int, msg: bytes) -> None:
+        with self._peers_lock:
+            peers = list(self.peers.values())
+        for p in peers:
+            p.try_send(channel_id, msg)
+
+    def peers_list(self) -> List[Peer]:
+        with self._peers_lock:
+            return list(self.peers.values())
+
+    def num_peers(self) -> int:
+        with self._peers_lock:
+            return len(self.peers)

@@ -1,0 +1,201 @@
+"""Transport + NodeInfo handshake (reference: p2p/transport.go
+MultiplexTransport, p2p/node_info.go).
+
+Dial/accept TCP, upgrade to SecretConnection, then swap DefaultNodeInfo
+protos and validate compatibility (chain network, ID match)."""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import List, Optional, Tuple
+
+from tmtpu.libs.protoio import ProtoMessage, encode_uvarint, decode_uvarint
+from tmtpu.p2p.conn.secret_connection import SecretConnection
+from tmtpu.p2p.key import NodeKey
+
+
+class ProtocolVersionPB(ProtoMessage):
+    FIELDS = [(1, "p2p", "uint64"), (2, "block", "uint64"), (3, "app", "uint64")]
+
+
+class NodeInfoOtherPB(ProtoMessage):
+    FIELDS = [(1, "tx_index", "string"), (2, "rpc_address", "string")]
+
+
+class NodeInfoPB(ProtoMessage):
+    """proto/tendermint/p2p/types.proto DefaultNodeInfo."""
+
+    FIELDS = [
+        (1, "protocol_version", ("msg!", ProtocolVersionPB)),
+        (2, "default_node_id", "string"),
+        (3, "listen_addr", "string"),
+        (4, "network", "string"),
+        (5, "version", "string"),
+        (6, "channels", "bytes"),
+        (7, "moniker", "string"),
+        (8, "other", ("msg!", NodeInfoOtherPB)),
+    ]
+
+
+class NodeInfo:
+    def __init__(self, node_id: str, listen_addr: str, network: str,
+                 version: str, channels: bytes, moniker: str,
+                 p2p_version: int = 8, block_version: int = 11,
+                 rpc_address: str = ""):
+        self.node_id = node_id
+        self.listen_addr = listen_addr
+        self.network = network
+        self.version = version
+        self.channels = channels
+        self.moniker = moniker
+        self.p2p_version = p2p_version
+        self.block_version = block_version
+        self.rpc_address = rpc_address
+
+    def to_proto(self) -> NodeInfoPB:
+        return NodeInfoPB(
+            protocol_version=ProtocolVersionPB(p2p=self.p2p_version,
+                                               block=self.block_version),
+            default_node_id=self.node_id,
+            listen_addr=self.listen_addr,
+            network=self.network,
+            version=self.version,
+            channels=self.channels,
+            moniker=self.moniker,
+            other=NodeInfoOtherPB(tx_index="on",
+                                  rpc_address=self.rpc_address),
+        )
+
+    @classmethod
+    def from_proto(cls, m: NodeInfoPB) -> "NodeInfo":
+        return cls(m.default_node_id, m.listen_addr, m.network, m.version,
+                   bytes(m.channels), m.moniker,
+                   m.protocol_version.p2p if m.protocol_version else 0,
+                   m.protocol_version.block if m.protocol_version else 0,
+                   m.other.rpc_address if m.other else "")
+
+    def compatible_with(self, other: "NodeInfo") -> Optional[str]:
+        """node_info.go CompatibleWith — None if ok, else reason."""
+        if self.block_version != other.block_version:
+            return f"peer block version {other.block_version} != {self.block_version}"
+        if self.network != other.network:
+            return f"peer network {other.network!r} != {self.network!r}"
+        if not set(self.channels) & set(other.channels):
+            return "no common channels"
+        return None
+
+
+MAX_NODE_INFO_SIZE = 10240  # node_info.go MaxNodeInfoSize
+
+
+class TransportError(Exception):
+    pass
+
+
+class Transport:
+    """p2p/transport.go MultiplexTransport."""
+
+    def __init__(self, node_key: NodeKey, node_info: NodeInfo,
+                 dial_timeout: float = 3.0, handshake_timeout: float = 20.0):
+        self.node_key = node_key
+        self.node_info = node_info
+        self.dial_timeout = dial_timeout
+        self.handshake_timeout = handshake_timeout
+        self._listener: Optional[socket.socket] = None
+        self._closed = threading.Event()
+
+    def listen(self, addr: str) -> None:
+        host, port = _split_addr(addr)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(32)
+
+    @property
+    def listen_port(self) -> int:
+        return self._listener.getsockname()[1]
+
+    def accept(self) -> Tuple[SecretConnection, NodeInfo, str]:
+        """Block until an inbound peer completes the upgrade.
+        Returns (secret_conn, peer_node_info, remote_ip)."""
+        conn, addr = self._listener.accept()
+        try:
+            return self._upgrade(conn) + (addr[0],)
+        except Exception:
+            conn.close()
+            raise
+
+    def dial(self, addr: str, expected_id: str = ""
+             ) -> Tuple[SecretConnection, NodeInfo, str]:
+        host, port = _split_addr(addr)
+        conn = socket.create_connection((host, port),
+                                        timeout=self.dial_timeout)
+        conn.settimeout(self.handshake_timeout)
+        try:
+            sc, ni = self._upgrade(conn)
+        except Exception:
+            conn.close()
+            raise
+        if expected_id and ni.node_id != expected_id:
+            sc.close()
+            raise TransportError(
+                f"dialed {expected_id} but got {ni.node_id}")
+        conn.settimeout(None)
+        return sc, ni, host
+
+    def _upgrade(self, conn: socket.socket
+                 ) -> Tuple[SecretConnection, NodeInfo]:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conn.settimeout(self.handshake_timeout)
+        sc = SecretConnection(conn, self.node_key.priv_key)
+        # verify the authenticated key matches the claimed node id later;
+        # now swap NodeInfo (transport.go handshake)
+        data = self.node_info.to_proto().encode()
+        sc.write(encode_uvarint(len(data)) + data)
+        buf = b""
+        while True:
+            buf += sc.read_exact(1)
+            try:
+                n, _ = decode_uvarint(buf, 0)
+                break
+            except EOFError:
+                continue
+        if n > MAX_NODE_INFO_SIZE:
+            raise TransportError(f"node info too big: {n}")
+        peer_info = NodeInfo.from_proto(NodeInfoPB.decode(sc.read_exact(n)))
+        # the wire identity must match the claimed id (transport.go:...)
+        wire_id = sc.remote_pub_key.address().hex()
+        if peer_info.node_id != wire_id:
+            raise TransportError(
+                f"peer claimed id {peer_info.node_id} but wire identity is "
+                f"{wire_id}")
+        reason = self.node_info.compatible_with(peer_info)
+        if reason is not None:
+            raise TransportError(f"incompatible peer: {reason}")
+        conn.settimeout(None)
+        return sc, peer_info
+
+    def close(self) -> None:
+        self._closed.set()
+        if self._listener is not None:
+            self._listener.close()
+
+
+def _split_addr(addr: str) -> Tuple[str, int]:
+    if addr.startswith("tcp://"):
+        addr = addr[len("tcp://"):]
+    if "@" in addr:  # id@host:port
+        addr = addr.split("@", 1)[1]
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+def parse_peer_addr(addr: str) -> Tuple[str, str]:
+    """'id@host:port' -> (id, 'host:port')."""
+    if addr.startswith("tcp://"):
+        addr = addr[len("tcp://"):]
+    if "@" in addr:
+        pid, hp = addr.split("@", 1)
+        return pid, hp
+    return "", addr
