@@ -1,51 +1,176 @@
-"""North-star benchmark: ed25519 batch-verify throughput at a 10k-validator
+"""North-star benchmark: ed25519 batch-verify throughput for a 10k-validator
 VoteSet (BASELINE.md: Go stdlib serial verify ≈ 50-60 µs/sig ⇒ ~18.2k sig/s
 per core; target ≥10×).
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "sig/s", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "sig/s", "vs_baseline": N, ...}
 
-Measures the steady-state device pipeline (verify_core: decompress +
-Straus/Shamir ladder + compressed compare) on whatever jax.devices() offers
-(the real TPU chip under the driver), batch = 10,000 lanes — one full
-VoteSet at MaxVotesCount (types/vote_set.go:18).
+What is measured (end-to-end, VERDICT r1 weak #3): the full
+bytes → validity-mask + power-tally + bitarray pipeline for 10,000 REAL
+distinct votes (distinct keys, distinct canonical vote sign-bytes) —
+host prep (length/canonicality checks, SHA-512 challenge hashing, mod-L
+reduction, digit extraction), H2D transfer, and the device
+verify+tally step (tmtpu.tpu.sharding.verify_tally_step). Steady state is
+double-buffered: batch k+1 preps on the host while batch k runs on the
+device, exactly how the consensus batching window uses it.
+
+Backend init is hardened (VERDICT r1 weak #1): the TPU tunnel in this image
+can wedge backend init indefinitely, so the device backend is probed in a
+SUBPROCESS with a hard timeout; on failure the benchmark falls back to host
+CPU and still reports a number (with "backend": "cpu" so the result is
+interpretable) instead of dying rc=1.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-
 GO_SERIAL_SIG_S = 1e6 / 55.0  # 55 µs/sig Go stdlib midpoint (BASELINE.md)
+LANES = 10_000  # MaxVotesCount (types/vote_set.go:18)
+PROBE_TIMEOUT_S = float(os.environ.get("TMTPU_BENCH_PROBE_TIMEOUT", "180"))
+
+
+def _probe_device_backend() -> bool:
+    """Check in a subprocess (a wedged PJRT tunnel must not hang *us*)
+    whether jax can initialize a non-CPU device backend."""
+    code = (
+        "import jax; ds = jax.devices(); "
+        "import sys; sys.exit(0 if ds and ds[0].platform != 'cpu' else 3)"
+    )
+    # Popen + process-group kill rather than subprocess.run: a wedged PJRT
+    # plugin can fork helpers that inherit the output pipes, and run()'s
+    # post-timeout communicate() would then block forever on the pipe drain.
+    import signal
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        start_new_session=True,
+    )
+    try:
+        rc = proc.wait(timeout=PROBE_TIMEOUT_S)
+        if rc == 0:
+            return True
+        print(f"bench: device probe rc={rc} — falling back to CPU",
+              file=sys.stderr)
+        return False
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        print(f"bench: device probe timed out after {PROBE_TIMEOUT_S}s "
+              "(wedged TPU tunnel?) — falling back to CPU", file=sys.stderr)
+        return False
+
+
+def _init_backend() -> str:
+    # two attempts: TPU tunnel init failures can be transient (rc=1 in r1)
+    for attempt in range(2):
+        if _probe_device_backend():
+            return "device"
+        print(f"bench: device probe attempt {attempt + 1} failed",
+              file=sys.stderr)
+    from tmtpu.tpu.compat import force_cpu_backend
+
+    force_cpu_backend(1)
+    return "cpu"
+
+
+def _make_votes(n: int):
+    """n distinct validators, one signed precommit each — real canonical
+    sign-bytes (types/vote.go:93 semantics), distinct per lane because the
+    timestamps differ (types/block.go:807)."""
+    import numpy as np
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+
+    from tmtpu.types.block import BlockID
+    from tmtpu.types.vote import PRECOMMIT, Vote
+
+    rng = np.random.default_rng(7)
+    seeds = rng.integers(0, 256, (n, 32), dtype=np.uint8)
+    sks = [Ed25519PrivateKey.from_private_bytes(seeds[i].tobytes())
+           for i in range(n)]
+    raw = serialization.Encoding.Raw, serialization.PublicFormat.Raw
+    pks = [k.public_key().public_bytes(*raw) for k in sks]
+    bid = BlockID(hash=bytes(range(32)), parts_total=1, parts_hash=bytes(32))
+    base_ns = 1_700_000_000 * 10**9
+    msgs = [
+        Vote(type=PRECOMMIT, height=12345, round=0, block_id=bid,
+             timestamp=base_ns + i, validator_address=bytes(20),
+             validator_index=i).sign_bytes("bench-chain")
+        for i in range(n)
+    ]
+    sigs = [sks[i].sign(msgs[i]) for i in range(n)]
+    return pks, msgs, sigs
 
 
 def main():
+    backend = _init_backend()
+    import jax
+    import jax.numpy as jnp
+
     from tmtpu.tpu import sharding as sh
     from tmtpu.tpu import verify as tv
 
-    lanes = 10_000
-    args = sh.example_batch(lanes)
-    powers = jnp.asarray(sh.powers_to_limbs([1000] * lanes))
-    table = tv.base_table_f32()
+    t0 = time.perf_counter()
+    pks, msgs, sigs = _make_votes(LANES)
+    print(f"bench: generated {LANES} votes in "
+          f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
+    powers = jnp.asarray(sh.powers_to_limbs([1000] * LANES))
+    table = tv.base_table_f32()
     step = jax.jit(sh.verify_tally_step)
+
+    def prep():
+        args, host_ok = tv.prepare_batch(pks, msgs, sigs)
+        assert host_ok.all()
+        return args
+
     # warmup / compile
+    t0 = time.perf_counter()
+    args = prep()
     out = jax.block_until_ready(step(*args, powers, table))
     assert bool(jnp.all(out[0])), "bench lanes must verify"
+    assert sh.limb_sums_to_int(out[1]) == 1000 * LANES
+    print(f"bench: compile+warmup {time.perf_counter() - t0:.1f}s "
+          f"on {jax.devices()[0].platform}", file=sys.stderr)
 
+    # device-only steady state (pre-staged args), for the breakdown
     n_iters = 5
     t0 = time.perf_counter()
     for _ in range(n_iters):
         out = jax.block_until_ready(step(*args, powers, table))
-    dt = (time.perf_counter() - t0) / n_iters
-    sig_s = lanes / dt
+    dev_dt = (time.perf_counter() - t0) / n_iters
 
+    # end-to-end pipelined steady state: prep batch k+1 on host while the
+    # device runs batch k (async dispatch), as the consensus window does.
+    # Every timed iteration contains exactly one prep and one device step.
+    t0 = time.perf_counter()
+    pending = None
+    for _ in range(n_iters):
+        nxt = prep()                      # host work overlaps device work
+        if pending is not None:
+            jax.block_until_ready(pending)  # drain batch k
+        pending = step(*nxt, powers, table)
+    jax.block_until_ready(pending)
+    e2e_dt = (time.perf_counter() - t0) / n_iters
+
+    sig_s = LANES / e2e_dt
     print(json.dumps({
-        "metric": "ed25519_batch_verify_10k_voteset",
+        "metric": "ed25519_batch_verify_10k_voteset_e2e",
         "value": round(sig_s, 1),
         "unit": "sig/s",
         "vs_baseline": round(sig_s / GO_SERIAL_SIG_S, 2),
+        "backend": backend if backend == "cpu" else jax.devices()[0].platform,
+        "device_only_sig_s": round(LANES / dev_dt, 1),
+        "e2e_ms_per_10k": round(e2e_dt * 1e3, 2),
     }))
 
 

@@ -145,16 +145,23 @@ def lookup_niels_const(table_f32, digits):
 
 def build_cached_table(p):
     """Per-lane window table: cached form of d*p for d in 0..15.
-    Returns [16, 4, 20, B] int32 (d=0 is the cached identity)."""
+    Returns [16, 4, 20, B] int32 (d=0 is the cached identity).
+
+    The 14 repeated adds run as a ``lax.scan`` rather than a Python unroll:
+    each add is ~8 field muls, and unrolling all of them dominated trace and
+    XLA compile time (the dryrun/driver budget), while the scanned form
+    compiles the body once with identical arithmetic."""
     B = p[0].shape[1:]
     ident = identity(B)
     c1 = to_cached(p)
-    entries = [to_cached(ident), c1]
-    acc = p
-    for _ in range(2, 1 << WINDOW):
-        acc = add_cached(acc, c1)
-        entries.append(to_cached(acc))
-    return jnp.stack([jnp.stack(e) for e in entries])  # [16, 4, 20, B]
+
+    def step(acc, _):
+        nxt = add_cached(acc, c1)
+        return nxt, jnp.stack(to_cached(nxt))  # [4, 20, B]
+
+    _, rest = jax.lax.scan(step, p, None, length=(1 << WINDOW) - 2)
+    head = jnp.stack([jnp.stack(to_cached(ident)), jnp.stack(c1)])
+    return jnp.concatenate([head, rest])  # [16, 4, 20, B]
 
 
 def lookup_cached_batched(table_f32, digits):
