@@ -15,3 +15,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from tmtpu.tpu.compat import force_cpu_backend
 
 force_cpu_backend(8)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running integration tests (TPU graph on CPU)"
+    )

@@ -1,0 +1,42 @@
+"""Block sync wire messages (reference: proto/tendermint/blockchain/
+types.proto) — field numbers match the reference."""
+
+from __future__ import annotations
+
+from tmtpu.libs.protoio import ProtoMessage
+from tmtpu.types import pb
+
+
+class BlockRequestPB(ProtoMessage):
+    FIELDS = [(1, "height", "int64")]
+
+
+class NoBlockResponsePB(ProtoMessage):
+    FIELDS = [(1, "height", "int64")]
+
+
+class BlockResponsePB(ProtoMessage):
+    FIELDS = [(1, "block", ("msg!", pb.Block))]
+
+
+class StatusRequestPB(ProtoMessage):
+    FIELDS = []
+
+
+class StatusResponsePB(ProtoMessage):
+    FIELDS = [
+        (1, "height", "int64"),
+        (2, "base", "int64"),
+    ]
+
+
+class BlocksyncMessagePB(ProtoMessage):
+    """Message oneof wrapper."""
+
+    FIELDS = [
+        (1, "block_request", ("msg", BlockRequestPB)),
+        (2, "no_block_response", ("msg", NoBlockResponsePB)),
+        (3, "block_response", ("msg", BlockResponsePB)),
+        (4, "status_request", ("msg", StatusRequestPB)),
+        (5, "status_response", ("msg", StatusResponsePB)),
+    ]

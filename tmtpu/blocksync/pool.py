@@ -1,0 +1,239 @@
+"""BlockPool — fast-sync block request scheduling (reference:
+blockchain/v0/pool.go).
+
+Idiomatic redesign: the reference spawns one goroutine per in-flight height
+(up to 600 bpRequesters, pool.go:33). Python threads at that count are all
+overhead, so the pool here is a passive, lock-protected scheduler driven by
+the reactor's single pool-routine thread: ``make_requests()`` assigns
+pending heights to peers with spare capacity and returns the (peer, height)
+pairs to send, ``add_block`` matches responses to assignments, and timed-out
+assignments are recycled on the next scheduling pass. Semantics kept from
+the reference: only the assigned peer may answer a height (pool.go
+AddBlock), per-peer pending caps, ban-on-timeout, ``IsCaughtUp`` =
+max-peer-height reached (pool.go:170-186).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tmtpu.types.block import Block
+
+# pool.go:30-47
+REQUEST_WINDOW = 400           # max heights in flight (maxTotalRequesters)
+MAX_PENDING_PER_PEER = 20      # maxPendingRequestsPerPeer
+REQUEST_RETRY_S = 30.0         # requestRetrySeconds
+PEER_TIMEOUT_S = 15.0          # peerTimeout
+
+
+class _PoolPeer:
+    __slots__ = ("peer_id", "base", "height", "n_pending", "last_recv")
+
+    def __init__(self, peer_id: str, base: int, height: int):
+        self.peer_id = peer_id
+        self.base = base
+        self.height = height
+        self.n_pending = 0
+        self.last_recv = time.monotonic()
+
+
+class _Request:
+    __slots__ = ("height", "peer_id", "block", "sent_at", "tries")
+
+    def __init__(self, height: int):
+        self.height = height
+        self.peer_id: Optional[str] = None
+        self.block: Optional[Block] = None
+        self.sent_at = 0.0
+        self.tries = 0
+
+
+class BlockPool:
+    def __init__(self, start_height: int,
+                 on_peer_error: Optional[Callable[[str, str], None]] = None):
+        self._lock = threading.RLock()
+        self.height = start_height          # next height to apply
+        self._start_height = start_height
+        self._peers: Dict[str, _PoolPeer] = {}
+        self._requests: Dict[int, _Request] = {}
+        self._max_peer_height = 0
+        self._on_peer_error = on_peer_error
+        self._started_at = time.monotonic()
+
+    # -- peer bookkeeping (pool.go SetPeerRange / RemovePeer) ---------------
+
+    def set_peer_range(self, peer_id: str, base: int, height: int) -> None:
+        with self._lock:
+            p = self._peers.get(peer_id)
+            if p is None:
+                p = _PoolPeer(peer_id, base, height)
+                self._peers[peer_id] = p
+            else:
+                p.base = base
+                p.height = height
+            p.last_recv = time.monotonic()
+            if height > self._max_peer_height:
+                self._max_peer_height = height
+
+    def remove_peer(self, peer_id: str) -> None:
+        with self._lock:
+            self._remove_peer_locked(peer_id)
+
+    def _remove_peer_locked(self, peer_id: str) -> None:
+        p = self._peers.pop(peer_id, None)
+        if p is None:
+            return
+        for req in self._requests.values():
+            if req.peer_id == peer_id and req.block is None:
+                req.peer_id = None  # recycle on next scheduling pass
+        if p.height == self._max_peer_height:
+            self._max_peer_height = max(
+                (q.height for q in self._peers.values()), default=0)
+
+    # -- scheduling ---------------------------------------------------------
+
+    def make_requests(self) -> List[Tuple[str, int]]:
+        """One scheduling pass: create requesters up to the window, assign
+        unassigned/timed-out heights to peers with capacity. Returns
+        (peer_id, height) pairs the reactor should send BlockRequests for.
+        Peers that time out (no block for PEER_TIMEOUT_S while assigned) are
+        reported through on_peer_error."""
+        out: List[Tuple[str, int]] = []
+        errors: List[Tuple[str, str]] = []
+        now = time.monotonic()
+        with self._lock:
+            # grow the request window
+            top = self.height + REQUEST_WINDOW - 1
+            for h in range(self.height, min(top, self._max_peer_height) + 1):
+                if h not in self._requests:
+                    self._requests[h] = _Request(h)
+            # recycle timed-out assignments; drop timed-out peers
+            for req in self._requests.values():
+                if (req.peer_id is not None and req.block is None
+                        and now - req.sent_at > REQUEST_RETRY_S):
+                    p = self._peers.get(req.peer_id)
+                    if p is not None:
+                        errors.append((req.peer_id, "block request timed out"))
+                        self._remove_peer_locked(req.peer_id)
+                    req.peer_id = None
+            # assign
+            pending = sorted(h for h, r in self._requests.items()
+                             if r.peer_id is None)
+            for h in pending:
+                peer = self._pick_peer_locked(h)
+                if peer is None:
+                    continue
+                req = self._requests[h]
+                req.peer_id = peer.peer_id
+                req.sent_at = now
+                req.tries += 1
+                peer.n_pending += 1
+                out.append((peer.peer_id, h))
+        for pid, reason in errors:
+            if self._on_peer_error:
+                self._on_peer_error(pid, reason)
+        return out
+
+    def _pick_peer_locked(self, height: int) -> Optional[_PoolPeer]:
+        best = None
+        for p in self._peers.values():
+            if p.n_pending >= MAX_PENDING_PER_PEER:
+                continue
+            if not (p.base <= height <= p.height):
+                continue
+            if best is None or p.n_pending < best.n_pending:
+                best = p
+        return best
+
+    # -- responses (pool.go AddBlock) ---------------------------------------
+
+    def add_block(self, peer_id: str, block: Block, _size: int = 0) -> bool:
+        """Accept a block only from the peer assigned to that height."""
+        err = None
+        with self._lock:
+            req = self._requests.get(block.header.height)
+            if req is None or req.peer_id != peer_id or req.block is not None:
+                # unsolicited block — the reference treats this as peer
+                # misbehavior (pool.go:244-255)
+                if peer_id in self._peers:
+                    err = f"unsolicited block at height {block.header.height}"
+            else:
+                req.block = block
+                p = self._peers.get(peer_id)
+                if p is not None:
+                    p.n_pending = max(0, p.n_pending - 1)
+                    p.last_recv = time.monotonic()
+                return True
+        if err and self._on_peer_error:
+            self._on_peer_error(peer_id, err)
+        return False
+
+    # -- the verify/apply interface (pool.go PeekTwoBlocks/PopRequest) ------
+
+    def peek_two_blocks(self) -> Tuple[Optional[Block], Optional[Block]]:
+        with self._lock:
+            first = self._requests.get(self.height)
+            second = self._requests.get(self.height + 1)
+            return (first.block if first else None,
+                    second.block if second else None)
+
+    def peek_run(self, max_blocks: int) -> List[Block]:
+        """Contiguous run of fetched blocks starting at pool.height — the
+        reactor batch-verifies run[:-1] against run[1:]'s LastCommits in one
+        device dispatch (new vs reference's block-at-a-time PeekTwoBlocks)."""
+        out = []
+        with self._lock:
+            h = self.height
+            while len(out) < max_blocks:
+                req = self._requests.get(h)
+                if req is None or req.block is None:
+                    break
+                out.append(req.block)
+                h += 1
+            return out
+
+    def pop_request(self) -> None:
+        with self._lock:
+            self._requests.pop(self.height, None)
+            self.height += 1
+
+    def redo_request(self, height: int) -> Optional[str]:
+        """Validation failed: forget the block and re-request from another
+        peer; returns the peer that served it (to be punished)."""
+        with self._lock:
+            req = self._requests.get(height)
+            if req is None:
+                return None
+            bad = req.peer_id
+            req.block = None
+            req.peer_id = None
+            if bad is not None:
+                self._remove_peer_locked(bad)
+            return bad
+
+    # -- progress -----------------------------------------------------------
+
+    def max_peer_height(self) -> int:
+        with self._lock:
+            return self._max_peer_height
+
+    def num_pending(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._requests.values() if r.block is None)
+
+    def is_caught_up(self) -> bool:
+        """pool.go:170-186 IsCaughtUp: need >=1 peer; then caught up once a
+        block arrived (or 5s elapsed) and our height is within 1 of the best
+        reported peer height."""
+        with self._lock:
+            if not self._peers:
+                return False
+            received_or_timed_out = (
+                self.height > self._start_height
+                or time.monotonic() - self._started_at > 5.0
+            )
+            longest = (self._max_peer_height == 0
+                       or self.height >= self._max_peer_height - 1)
+            return received_or_timed_out and longest

@@ -1,0 +1,245 @@
+"""Fast-sync (block sync) reactor (reference: blockchain/v0/reactor.go).
+
+Serves blocks to catching-up peers and, when started in fast-sync mode,
+drives the BlockPool: request blocks from taller peers, verify each block
+with its successor's LastCommit, apply through the BlockExecutor, and hand
+over to the consensus reactor once caught up (SwitchToConsensus,
+reactor.go:303-330).
+
+TPU-first deviation from the reference: instead of one VerifyCommitLight
+per block (reactor.go:366), a contiguous run of fetched blocks is verified
+with ONE batched dispatch over all their commits' signatures
+(types.commit_verify.verify_commits_light_batch) — fast-sync replay is the
+BASELINE "per-block Commit batch verification" config, batched further
+across blocks.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from tmtpu.blocksync.msgs import (
+    BlockRequestPB, BlockResponsePB, BlocksyncMessagePB, NoBlockResponsePB,
+    StatusRequestPB, StatusResponsePB,
+)
+from tmtpu.blocksync.pool import BlockPool
+from tmtpu.p2p.conn.connection import ChannelDescriptor
+from tmtpu.p2p.switch import Peer, Reactor
+from tmtpu.types import commit_verify
+from tmtpu.types.block import Block, BlockID
+from tmtpu.types.part_set import PartSet
+
+BLOCKCHAIN_CHANNEL = 0x40
+
+TRY_SYNC_INTERVAL_S = 0.01          # trySyncIntervalMS
+STATUS_UPDATE_INTERVAL_S = 10.0     # statusUpdateIntervalSeconds
+SWITCH_TO_CONSENSUS_INTERVAL_S = 1.0
+MAX_BATCH_BLOCKS = 32               # commits fused per device dispatch
+
+
+class BlocksyncReactor(Reactor):
+    def __init__(self, state, block_exec, block_store, fast_sync: bool,
+                 consensus_reactor=None, verify_backend: Optional[str] = None):
+        super().__init__("BLOCKSYNC")
+        if state.last_block_height != block_store.height():
+            raise ValueError(
+                f"state ({state.last_block_height}) and store "
+                f"({block_store.height()}) height mismatch")
+        self.initial_state = state
+        self.state = state
+        self.block_exec = block_exec
+        self.store = block_store
+        self.fast_sync = fast_sync
+        self.consensus_reactor = consensus_reactor
+        self.verify_backend = verify_backend
+        start = block_store.height() + 1
+        if start == 1:
+            start = state.initial_height
+        self.pool = BlockPool(start, on_peer_error=self._stop_peer)
+        self.blocks_synced = 0
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- reactor interface --------------------------------------------------
+
+    def get_channels(self):
+        return [ChannelDescriptor(BLOCKCHAIN_CHANNEL, priority=5,
+                                  send_queue_capacity=1000)]
+
+    def on_start(self) -> None:
+        if self.fast_sync:
+            self._thread = threading.Thread(
+                target=self._pool_routine, daemon=True, name="blocksync-pool")
+            self._thread.start()
+
+    def on_stop(self) -> None:
+        self._stopped.set()
+
+    def add_peer(self, peer: Peer) -> None:
+        # reactor.go AddPeer: send our status so the peer can request
+        peer.send(BLOCKCHAIN_CHANNEL, self._status_msg())
+
+    def remove_peer(self, peer: Peer, reason) -> None:
+        self.pool.remove_peer(peer.node_id)
+
+    def receive(self, channel_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        msg = BlocksyncMessagePB.decode(msg_bytes)
+        if msg.block_request is not None:
+            self._respond_to_peer(msg.block_request.height, peer)
+        elif msg.block_response is not None:
+            block = Block.from_proto(msg.block_response.block)
+            self.pool.add_block(peer.node_id, block, len(msg_bytes))
+        elif msg.status_request is not None:
+            peer.try_send(BLOCKCHAIN_CHANNEL, self._status_msg())
+        elif msg.status_response is not None:
+            self.pool.set_peer_range(peer.node_id,
+                                     msg.status_response.base,
+                                     msg.status_response.height)
+        elif msg.no_block_response is not None:
+            pass  # reactor.go just logs it
+
+    # -- serving ------------------------------------------------------------
+
+    def _status_msg(self) -> bytes:
+        return BlocksyncMessagePB(status_response=StatusResponsePB(
+            height=self.store.height(), base=self.store.base(),
+        )).encode()
+
+    def _respond_to_peer(self, height: int, peer: Peer) -> None:
+        block = self.store.load_block(height)
+        if block is not None:
+            m = BlocksyncMessagePB(
+                block_response=BlockResponsePB(block=block.to_proto()))
+        else:
+            m = BlocksyncMessagePB(
+                no_block_response=NoBlockResponsePB(height=height))
+        peer.try_send(BLOCKCHAIN_CHANNEL, m.encode())
+
+    def _stop_peer(self, peer_id: str, reason: str) -> None:
+        if self.switch is None:
+            return
+        peer = self.switch.peers.get(peer_id)
+        if peer is not None:
+            self.switch.stop_peer_for_error(peer, reason)
+
+    def broadcast_status_request(self) -> None:
+        if self.switch is not None:
+            self.switch.broadcast(
+                BLOCKCHAIN_CHANNEL,
+                BlocksyncMessagePB(status_request=StatusRequestPB()).encode())
+
+    # -- the sync loop (reactor.go poolRoutine) -----------------------------
+
+    def _pool_routine(self, state_synced: bool = False) -> None:
+        last_status = 0.0
+        last_switch_check = 0.0
+        while not self._stopped.is_set():
+            now = time.monotonic()
+            if now - last_status > STATUS_UPDATE_INTERVAL_S:
+                last_status = now
+                self.broadcast_status_request()
+            for peer_id, height in self.pool.make_requests():
+                peer = self.switch.peers.get(peer_id) if self.switch else None
+                if peer is not None:
+                    peer.try_send(
+                        BLOCKCHAIN_CHANNEL,
+                        BlocksyncMessagePB(
+                            block_request=BlockRequestPB(height=height)
+                        ).encode())
+            if now - last_switch_check > SWITCH_TO_CONSENSUS_INTERVAL_S:
+                last_switch_check = now
+                if self.pool.is_caught_up():
+                    self._switch_to_consensus(state_synced)
+                    return
+            if not self._try_sync_batch():
+                self._stopped.wait(TRY_SYNC_INTERVAL_S)
+
+    def _try_sync_batch(self) -> bool:
+        """Verify + apply a contiguous run of fetched blocks. The commits of
+        the whole run are batch-verified in one dispatch; the verified
+        prefix is applied, the first failure re-requested. Returns True if
+        any block was applied."""
+        run = self.pool.peek_run(MAX_BATCH_BLOCKS + 1)
+        if len(run) < 2:
+            return False
+        # block h is verified by block h+1's LastCommit (reactor.go:366);
+        # the fused path needs one valset for the whole run — valset changes
+        # mid-run (rare) fall back to block-at-a-time
+        blocks, successors = run[:-1], run[1:]
+        vals_now = self.state.validators
+        if any(b.header.validators_hash != vals_now.hash() for b in blocks):
+            return self._try_sync_one()
+        chain_id = self.state.chain_id
+        entries = []
+        for blk, nxt in zip(blocks, successors):
+            parts = PartSet.from_data(blk.encode())
+            bid = BlockID(blk.hash(), parts.total, parts.hash)
+            entries.append((vals_now, chain_id, bid, blk.header.height,
+                            nxt.last_commit))
+        results = commit_verify.verify_commits_light_batch(
+            entries, backend=self.verify_backend)
+        applied = False
+        for blk, nxt, err in zip(blocks, successors, results):
+            if err is not None:
+                self._handle_bad_block(blk.header.height, err)
+                return applied
+            if not self._apply_one(blk, nxt):
+                return applied
+            applied = True
+        return applied
+
+    def _try_sync_one(self) -> bool:
+        first, second = self.pool.peek_two_blocks()
+        if first is None or second is None:
+            return False
+        parts = PartSet.from_data(first.encode())
+        bid = BlockID(first.hash(), parts.total, parts.hash)
+        try:
+            self.state.validators.verify_commit_light(
+                self.state.chain_id, bid, first.header.height,
+                second.last_commit, backend=self.verify_backend)
+        except commit_verify.VerificationError as e:
+            self._handle_bad_block(first.header.height, e)
+            return False
+        return self._apply_one(first, second)
+
+    def _apply_one(self, block: Block, successor: Block) -> bool:
+        parts = PartSet.from_data(block.encode())
+        bid = BlockID(block.hash(), parts.total, parts.hash)
+        try:
+            self.block_exec.validate_block(self.state, block)
+        except Exception as e:  # noqa: BLE001
+            self._handle_bad_block(block.header.height, e)
+            return False
+        self.pool.pop_request()
+        self.store.save_block(block, parts, successor.last_commit)
+        self.state, _ = self.block_exec.apply_block(self.state, bid, block)
+        self.blocks_synced += 1
+        return True
+
+    def _handle_bad_block(self, height: int, err) -> None:
+        # punish the server of the bad block and its successor's server
+        # (either could have lied — reactor.go:377-390)
+        for h in (height, height + 1):
+            bad = self.pool.redo_request(h)
+            if bad is not None:
+                self._stop_peer(bad, f"blocksync validation error: {err}")
+
+    def _switch_to_consensus(self, state_synced: bool) -> None:
+        if self.consensus_reactor is not None:
+            self.consensus_reactor.switch_to_consensus(
+                self.state, skip_wal=self.blocks_synced > 0 or state_synced)
+
+    # -- statesync handoff (reactor.go SwitchToFastSync) --------------------
+
+    def switch_to_fast_sync(self, state) -> None:
+        self.state = state
+        self.initial_state = state
+        self.fast_sync = True
+        self.pool.height = state.last_block_height + 1
+        self._thread = threading.Thread(
+            target=self._pool_routine, args=(True,), daemon=True,
+            name="blocksync-pool")
+        self._thread.start()

@@ -32,7 +32,9 @@ from tmtpu.crypto.keys import PubKey
 
 ED25519 = "ed25519"
 
-_TPU_MIN_BATCH = 8  # below this, device dispatch overhead beats CPU serial
+# below this, device dispatch overhead beats CPU serial (env-overridable so
+# small-validator integration tests can force the device path)
+_TPU_MIN_BATCH = int(os.environ.get("TMTPU_TPU_MIN_BATCH", "8"))
 
 _default_backend = os.environ.get("TMTPU_CRYPTO_BACKEND", "auto")
 _probe_lock = threading.Lock()
@@ -77,13 +79,14 @@ def _tpu_available() -> bool:
 
 
 class BatchVerifier(keys.BatchVerifier):
-    """Accumulate (pubkey, msg, sig) items, then verify them all at once."""
+    """Accumulate (pubkey, msg, sig[, power]) items, then verify at once."""
 
     def __init__(self):
-        self._items: List[Tuple[PubKey, bytes, bytes]] = []
+        self._items: List[Tuple[PubKey, bytes, bytes, int]] = []
 
-    def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
-        self._items.append((pub_key, bytes(msg), bytes(sig)))
+    def add(self, pub_key: PubKey, msg: bytes, sig: bytes,
+            power: int = 0) -> None:
+        self._items.append((pub_key, bytes(msg), bytes(sig), int(power)))
 
     def count(self) -> int:
         return len(self._items)
@@ -94,38 +97,81 @@ class BatchVerifier(keys.BatchVerifier):
     def verify(self) -> Tuple[bool, List[bool]]:
         raise NotImplementedError
 
+    def verify_tally(self) -> Tuple[bool, List[bool], int]:
+        all_ok, mask = self.verify()
+        tallied = sum(
+            it[3] for it, ok in zip(self._items, mask) if ok
+        )
+        return all_ok, mask, tallied
+
 
 class CPUBatchVerifier(BatchVerifier):
     def verify(self) -> Tuple[bool, List[bool]]:
-        mask = [pk.verify_signature(msg, sig) for pk, msg, sig in self._items]
+        mask = [pk.verify_signature(msg, sig)
+                for pk, msg, sig, _ in self._items]
         return all(mask), mask
 
 
 class TPUBatchVerifier(BatchVerifier):
-    def verify(self) -> Tuple[bool, List[bool]]:
-        ed_idx, ed_pks, ed_msgs, ed_sigs = [], [], [], []
-        mask: List[bool] = [False] * len(self._items)
-        for i, (pk, msg, sig) in enumerate(self._items):
+    def _split(self):
+        """Partition items into device-eligible ed25519 lanes and CPU lanes."""
+        ed_idx, ed_pks, ed_msgs, ed_sigs, ed_powers = [], [], [], [], []
+        cpu_idx = []
+        for i, (pk, msg, sig, power) in enumerate(self._items):
             if pk.type_value() == ED25519 and len(sig) == 64:
                 ed_idx.append(i)
                 ed_pks.append(pk.bytes())
                 ed_msgs.append(msg)
                 ed_sigs.append(sig)
+                ed_powers.append(power)
             else:
-                mask[i] = pk.verify_signature(msg, sig)
+                cpu_idx.append(i)
+        return ed_idx, ed_pks, ed_msgs, ed_sigs, ed_powers, cpu_idx
+
+    def verify(self) -> Tuple[bool, List[bool]]:
+        all_ok, mask, _ = self._run(tally=False)
+        return all_ok, mask
+
+    def verify_tally(self) -> Tuple[bool, List[bool], int]:
+        """Fused verify + power tally: ed25519 lanes get ONE device dispatch
+        that returns both the validity mask and the psum of valid lanes'
+        powers (tmtpu.tpu.sharding.verify_tally_step); other curves fall
+        back to serial verify with host-side summation."""
+        return self._run(tally=True)
+
+    def _run(self, tally: bool) -> Tuple[bool, List[bool], int]:
+        ed_idx, ed_pks, ed_msgs, ed_sigs, ed_powers, cpu_idx = self._split()
+        mask: List[bool] = [False] * len(self._items)
+        tallied = 0
+        for i in cpu_idx:
+            pk, msg, sig, power = self._items[i]
+            mask[i] = pk.verify_signature(msg, sig)
+            if mask[i]:
+                tallied += power
         if ed_idx:
             if len(ed_idx) < _TPU_MIN_BATCH:
                 for j, i in enumerate(ed_idx):
                     mask[i] = self._items[i][0].verify_signature(
                         ed_msgs[j], ed_sigs[j]
                     )
+                    if mask[i]:
+                        tallied += ed_powers[j]
+            elif tally:
+                from tmtpu.tpu import sharding as sh
+
+                dev_mask, dev_sum = sh.batch_verify_tally(
+                    ed_pks, ed_msgs, ed_sigs, ed_powers
+                )
+                for j, i in enumerate(ed_idx):
+                    mask[i] = bool(dev_mask[j])
+                tallied += dev_sum
             else:
                 from tmtpu.tpu import verify as tv
 
                 dev_mask = tv.batch_verify(ed_pks, ed_msgs, ed_sigs)
                 for j, i in enumerate(ed_idx):
                     mask[i] = bool(dev_mask[j])
-        return all(mask), mask
+        return all(mask), mask, tallied
 
 
 def new_batch_verifier(backend: Optional[str] = None) -> BatchVerifier:

@@ -83,15 +83,27 @@ class BatchVerifier(ABC):
     ``verify()`` returns (all_ok, per-item validity list).  Implementations:
     ``tmtpu.crypto.batch.CPUBatchVerifier`` and ``tmtpu.tpu.engine``'s TPU
     verifier.
+
+    ``add`` optionally takes the item's voting power; ``verify_tally`` then
+    additionally returns the summed power of the VALID items — the fused
+    verify+tally reduction the TPU backend runs entirely on device (the
+    north-star rewiring of types/vote_set.go:233-304's host bookkeeping).
     """
 
     @abstractmethod
-    def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
+    def add(self, pub_key: PubKey, msg: bytes, sig: bytes,
+            power: int = 0) -> None:
         ...
 
     @abstractmethod
     def verify(self) -> "tuple[bool, list[bool]]":
         ...
+
+    def verify_tally(self) -> "tuple[bool, list[bool], int]":
+        """(all_ok, mask, summed voting power of valid items). Base
+        implementation tallies on the host; the TPU backend overrides with
+        the fused on-device reduction."""
+        raise NotImplementedError
 
     @abstractmethod
     def count(self) -> int:

@@ -132,6 +132,7 @@ class Node(BaseService):
         self.node_key = None
         self.switch = None
         self.node_id = ""
+        self.fast_sync = False
         if config.p2p.laddr:
             from tmtpu.consensus.reactor import ConsensusReactor
             from tmtpu.mempool.reactor import MempoolReactor
@@ -165,10 +166,21 @@ class Node(BaseService):
             self.switch = Switch(transport,
                                  max_inbound=config.p2p.max_num_inbound_peers,
                                  max_outbound=config.p2p.max_num_outbound_peers)
-            self.consensus_reactor = ConsensusReactor(self.consensus)
+            # fast sync only makes sense when someone else has blocks
+            # (node.go:450 createBlockchainReactor + onlyValidatorIsUs)
+            self.fast_sync = (config.block_sync.enable
+                              and not self._only_validator_is_us())
+            self.consensus_reactor = ConsensusReactor(
+                self.consensus, wait_sync=self.fast_sync)
             self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
             self.switch.add_reactor("MEMPOOL", MempoolReactor(
                 self.mempool, broadcast=config.mempool.broadcast))
+            from tmtpu.blocksync.reactor import BlocksyncReactor
+
+            self.blocksync_reactor = BlocksyncReactor(
+                self.state, self.block_exec, self.block_store,
+                self.fast_sync, consensus_reactor=self.consensus_reactor)
+            self.switch.add_reactor("BLOCKSYNC", self.blocksync_reactor)
             self.switch.set_persistent_peers(
                 [a.strip() for a in config.p2p.persistent_peers.split(",")
                  if a.strip()])
@@ -180,11 +192,25 @@ class Node(BaseService):
 
             self.rpc_server = RPCServer(config.rpc.laddr, self)
 
+    def _only_validator_is_us(self) -> bool:
+        """node.go onlyValidatorIsUs — a single-validator chain where we ARE
+        the validator has no one to sync from."""
+        if self.state.validators is None or self.state.validators.size() != 1:
+            return False
+        try:
+            addr = self.priv_validator.get_pub_key().address()
+        except Exception:  # noqa: BLE001
+            return False
+        return self.state.validators.validators[0].address == addr
+
     def on_start(self) -> None:
         self.indexer_service.start()
         if self.switch is not None:
             self.switch.start()
-        self.consensus.start()
+        if not self.fast_sync:
+            # with fast sync on, the blocksync reactor starts consensus via
+            # SwitchToConsensus once caught up (blockchain/v0/reactor.go:303)
+            self.consensus.start()
         if self.rpc_server is not None:
             self.rpc_server.start()
 

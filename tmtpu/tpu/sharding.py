@@ -97,6 +97,43 @@ def sharded_verify_tally(mesh: Mesh):
     )
 
 
+_fused_jit = None
+
+
+def _fused_step():
+    global _fused_jit
+    if _fused_jit is None:
+        _fused_jit = jax.jit(verify_tally_step)
+    return _fused_jit
+
+
+def batch_verify_tally(pks, msgs, sigs, powers):
+    """Host-facing fused entry: bytes -> (validity mask [B] bool ndarray,
+    summed voting power of valid lanes as a Python int). One device dispatch
+    runs verify + power-psum + bitarray pack (verify_tally_step); this is
+    what crypto.batch.TPUBatchVerifier.verify_tally calls.
+
+    Lanes failing the host-side checks (bad lengths, s >= L, non-canonical
+    A.y) are masked out AND their power is zeroed before the device sum.
+    """
+    B = len(sigs)
+    if B == 0:
+        return np.zeros(0, dtype=bool), 0
+    args, host_ok = tv.prepare_batch(pks, msgs, sigs)
+    p = np.asarray(powers, dtype=np.int64).copy()
+    assert p.shape == (B,)
+    p[~host_ok] = 0
+    padded = tv._pad_to_bucket(B)
+    power_limbs = np.zeros((POWER_LIMBS, padded), dtype=np.int32)
+    power_limbs[:, :B] = powers_to_limbs(p)
+    args = tv.pad_args_to_bucket(args, B, padded)
+    mask, power_sums, _bits = _fused_step()(
+        *args, jnp.asarray(power_limbs), tv.base_table_f32()
+    )
+    mask = np.asarray(mask)[:B] & host_ok
+    return mask, limb_sums_to_int(power_sums)
+
+
 def _tile(a, reps):
     return jnp.repeat(a, reps, axis=-1)
 

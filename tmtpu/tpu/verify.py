@@ -166,8 +166,11 @@ def prepare_batch(pks, msgs, sigs):
         sigs_b = [s if ok else _ZERO64 for s, ok in zip(sigs_b, len_ok)]
     sig_arr = np.frombuffer(b"".join(sigs_b), dtype=np.uint8).reshape(B, 64)
     pk_arr = np.frombuffer(b"".join(pks_b), dtype=np.uint8).reshape(B, 32)
-    r_arr = np.ascontiguousarray(sig_arr[:, :32])
-    s_arr = np.ascontiguousarray(sig_arr[:, 32:])
+    # .copy(), not ascontiguousarray: for B=1 the slice of the frombuffer
+    # view is already contiguous and would stay READ-ONLY, breaking the
+    # invalid-lane zeroing below
+    r_arr = sig_arr[:, :32].copy()
+    s_arr = sig_arr[:, 32:].copy()
     host_ok = len_ok & _s_below_l(s_arr)
     # keep the documented invariant: the device never sees s >= L
     if not host_ok.all():
@@ -218,11 +221,29 @@ def _verify_jit(pk_y, pk_sign, r_y, r_sign, s_digits, h_digits, table):
 
 def _pad_to_bucket(n: int) -> int:
     """Round the batch up to a small set of sizes so jit caches stay warm
-    (recompiling per odd batch size would dwarf the verify itself)."""
+    (recompiling per odd batch size would dwarf the verify itself).
+    Powers of two up to 4096, then multiples of 2048 (a 10k VoteSet pads to
+    10240 instead of 16384 — padding waste matters more than cache entries
+    at commit-verify scale)."""
+    if n > 4096:
+        return (n + 2047) // 2048 * 2048
     b = 8
     while b < n:
         b *= 2
     return b
+
+
+def pad_args_to_bucket(args, B: int, padded: int):
+    """Tile each lane array out to the bucket size by replicating lane 0
+    (a known-wellformed lane; pad results are discarded)."""
+    if padded == B:
+        return args
+    return tuple(
+        jnp.concatenate(
+            [a, jnp.repeat(a[..., :1], padded - B, axis=-1)], axis=-1
+        )
+        for a in args
+    )
 
 
 def batch_verify(pks, msgs, sigs) -> np.ndarray:
@@ -236,13 +257,6 @@ def batch_verify(pks, msgs, sigs) -> np.ndarray:
     if B == 0:
         return np.zeros(0, dtype=bool)
     args, host_ok = prepare_batch(pks, msgs, sigs)
-    padded = _pad_to_bucket(B)
-    if padded != B:
-        args = tuple(
-            jnp.concatenate(
-                [a, jnp.repeat(a[..., :1], padded - B, axis=-1)], axis=-1
-            )
-            for a in args
-        )
+    args = pad_args_to_bucket(args, B, _pad_to_bucket(B))
     mask = np.asarray(_verify_jit(*args, base_table_f32()))[:B]
     return mask & host_ok

@@ -153,10 +153,27 @@ class VoteSet:
 
             if prepared:
                 bv = crypto_batch.new_batch_verifier(self.verify_backend)
+                # Fused-tally fast path: when every prepared vote is a fresh
+                # add from a distinct validator (the normal round: no
+                # conflicts, no replays), voting powers ride the batch and
+                # the device returns Σ power over the VALID lanes — the
+                # on-device replacement for vote_set.go:233-304's per-vote
+                # host sum. Mixed/conflicting batches fall back to per-vote
+                # bookkeeping off the plain mask.
+                fused = (
+                    all(existing is None for *_r, existing in prepared)
+                    and len({v.validator_index for _, v, *_r in prepared})
+                    == len(prepared)
+                )
                 for _, vote, val, _ in prepared:
                     bv.add(val.pub_key, vote.sign_bytes(self.chain_id),
-                           vote.signature)
-                _, mask = bv.verify()
+                           vote.signature,
+                           power=val.voting_power if fused else 0)
+                if fused:
+                    _, mask, dev_sum = bv.verify_tally()
+                else:
+                    _, mask = bv.verify()
+                applied_power = 0
                 for (i, vote, val, existing), ok in zip(prepared, mask):
                     if not ok:
                         err = VoteError(
@@ -165,10 +182,24 @@ class VoteSet:
                         if first_err is None:
                             first_err = err
                         continue
-                    added, conflicting = self._add_verified(vote, val)
+                    added, conflicting = self._add_verified(
+                        vote, val, defer_sum=fused
+                    )
+                    if added and fused:
+                        applied_power += val.voting_power
                     results[i] = added
                     if conflicting is not None and conflict is None:
                         conflict = ErrVoteConflictingVotes(conflicting, vote)
+                if fused:
+                    # every valid lane was a fresh add, so the device sum IS
+                    # the _sum delta; a divergence from the host bookkeeping
+                    # means the device graph and the mask disagree — fail
+                    # loudly rather than corrupt the tally
+                    if dev_sum != applied_power:
+                        raise RuntimeError(
+                            f"device/host tally divergence: device "
+                            f"{dev_sum} vs host {applied_power}")
+                    self._sum += dev_sum
 
             if conflict is not None:
                 # the batch was fully processed; expose what was added so
@@ -216,9 +247,11 @@ class VoteSet:
             return val, existing
         return val, None
 
-    def _add_verified(self, vote: Vote, val):
+    def _add_verified(self, vote: Vote, val, defer_sum: bool = False):
         """vote_set.go:233 addVerifiedVote (signature already checked).
-        Returns (added, conflicting_vote_or_None)."""
+        Returns (added, conflicting_vote_or_None). With ``defer_sum`` the
+        total-power update is skipped — the caller applies the device-fused
+        tally for the whole batch instead."""
         idx = vote.validator_index
         key = vote.block_id.key()
         conflicting = None
@@ -234,7 +267,8 @@ class VoteSet:
         else:
             self._votes[idx] = vote
             self._votes_bit_array.set_index(idx, True)
-            self._sum += val.voting_power
+            if not defer_sum:
+                self._sum += val.voting_power
 
         bv = self._votes_by_block.get(key)
         if bv is not None:
