@@ -120,6 +120,15 @@ class Handshaker:
                 self.state, _ = exec_.apply_block(
                     self.state, meta.block_id, block)
                 app_hash = self.state.app_hash
+        # replay.go assertAppHashEqualsOneFromState — once app and state are
+        # at the same height their app hashes must agree; silent divergence
+        # here would let a corrupted app state pass crash recovery.
+        if self.state.last_block_height == store_height and \
+                app_hash != self.state.app_hash:
+            raise HandshakeError(
+                f"app hash mismatch after replay: app "
+                f"{app_hash.hex().upper()} != state "
+                f"{self.state.app_hash.hex().upper()}")
         return app_hash
 
 

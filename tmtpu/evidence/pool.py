@@ -41,12 +41,23 @@ class EvidencePool:
         self.verify_backend = verify_backend
         self._lock = threading.Lock()
         self._state = None  # latest sm.State, set on update()
+        # generation counter + condition so gossip threads can sleep until
+        # evidence actually arrives (the reference uses a clist waitChan)
+        self._gen = 0
+        self._new_ev = threading.Condition()
 
     # -- ingestion ----------------------------------------------------------
 
     def report_conflicting_votes(self, vote_a, vote_b) -> None:
         """pool.go:179 — equivocation straight from consensus; the votes'
         signatures were already verified by the VoteSet."""
+        # guard against misreports: real equivocation is same validator,
+        # same H/R/S, different blocks (verify.go:162 enforces the same)
+        if vote_a.block_id == vote_b.block_id or \
+                vote_a.validator_address != vote_b.validator_address or \
+                (vote_a.height, vote_a.round, vote_a.type) != \
+                (vote_b.height, vote_b.round, vote_b.type):
+            return
         state = self._state or self.state_store.load()
         if state is None:
             return
@@ -59,6 +70,7 @@ class EvidencePool:
                 return
             self.db.set(_k_pending(ev.height(), ev.hash()),
                         evidence_to_proto(ev).encode())
+        self._notify()
 
     def add_evidence(self, ev) -> None:
         """pool.go AddEvidence — gossiped evidence must be verified."""
@@ -69,6 +81,20 @@ class EvidencePool:
         with self._lock:
             self.db.set(_k_pending(ev.height(), ev.hash()),
                         evidence_to_proto(ev).encode())
+        self._notify()
+
+    def _notify(self) -> None:
+        with self._new_ev:
+            self._gen += 1
+            self._new_ev.notify_all()
+
+    def wait_for_evidence(self, gen: int, timeout: float) -> int:
+        """Block until the pool's contents changed since ``gen`` (or
+        timeout); returns the current generation."""
+        with self._new_ev:
+            if self._gen == gen:
+                self._new_ev.wait(timeout)
+            return self._gen
 
     # -- verification (verify.go) ------------------------------------------
 

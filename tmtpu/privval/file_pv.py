@@ -78,16 +78,21 @@ class FilePV(PrivValidator):
             raise ValueError(f"unknown key type {ktype!r}")
         pv = cls(entry[1](bytes.fromhex(kd["priv_key"]["value"])),
                  key_file, state_file)
-        if os.path.exists(state_file):
-            with open(state_file) as f:
-                sd = json.load(f)
-            pv.height = int(sd.get("height", 0))
-            pv.round = int(sd.get("round", 0))
-            pv.step = int(sd.get("step", 0))
-            sig = sd.get("signature")
-            pv.signature = bytes.fromhex(sig) if sig else None
-            sb = sd.get("signbytes")
-            pv.sign_bytes = bytes.fromhex(sb) if sb else None
+        # file.go LoadFilePV fails loudly when the state file is unreadable:
+        # a silently-fresh sign state would disable double-sign protection.
+        if not os.path.exists(state_file):
+            raise FileNotFoundError(
+                f"privval state file {state_file!r} missing; refusing to "
+                f"start with empty sign state (double-sign risk)")
+        with open(state_file) as f:
+            sd = json.load(f)
+        pv.height = int(sd.get("height", 0))
+        pv.round = int(sd.get("round", 0))
+        pv.step = int(sd.get("step", 0))
+        sig = sd.get("signature")
+        pv.signature = bytes.fromhex(sig) if sig else None
+        sb = sd.get("signbytes")
+        pv.sign_bytes = bytes.fromhex(sb) if sb else None
         return pv
 
     @classmethod

@@ -13,7 +13,7 @@ from typing import List, Optional, Tuple
 
 from tmtpu.abci import types as abci
 from tmtpu.crypto.encoding import pubkey_from_proto
-from tmtpu.state.state import State
+from tmtpu.state.state import State, median_time
 from tmtpu.state.store import ABCIResponses, StateStore
 from tmtpu.state.validation import validate_block
 from tmtpu.types import pb
@@ -49,8 +49,12 @@ class BlockExecutor:
         txs = (self.mempool.reap_max_bytes_max_gas(max_bytes, max_gas)
                if self.mempool else [])
         if time_ns is None:
-            # MedianTime of LastCommit in the reference; wall clock for h=init
-            time_ns = time.time_ns()
+            # state.go:244-249 — genesis time for the initial block, else
+            # the weighted median of the LastCommit timestamps
+            if height == state.initial_height:
+                time_ns = state.last_block_time
+            else:
+                time_ns = median_time(last_commit, state.last_validators)
         header = state.make_block_header(
             height, time_ns, txs, last_commit, evidence, proposer_address
         )
@@ -61,7 +65,18 @@ class BlockExecutor:
     # -- apply --------------------------------------------------------------
 
     def validate_block(self, state: State, block: Block) -> None:
+        """execution.go:117 ValidateBlock — structural/state checks, then
+        every piece of block evidence is verified through the pool
+        (execution.go:122 evpool.CheckEvidence). Without this a byzantine
+        proposer could embed fabricated evidence framing honest validators."""
         validate_block(state, block, verify_backend=self.verify_backend)
+        if self.evidence_pool is not None and block.evidence:
+            from tmtpu.evidence.pool import EvidenceError
+
+            try:
+                self.evidence_pool.check_evidence(block.evidence)
+            except EvidenceError as e:
+                raise BlockExecutionError(f"invalid evidence: {e}") from e
 
     def apply_block(self, state: State, block_id: BlockID, block: Block
                     ) -> Tuple[State, int]:

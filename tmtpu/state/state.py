@@ -101,6 +101,28 @@ class State:
         )
 
 
+def median_time(commit, validators) -> int:
+    """state.go:268 MedianTime — weighted median (by voting power) of the
+    non-absent commit sig timestamps; bounded by honest validators' clocks
+    since >1/3 of the weight is honest. Returns unix nanos."""
+    weighted = []
+    total = 0
+    for cs in commit.signatures:
+        if cs.is_absent():
+            continue
+        _, val = validators.get_by_address(cs.validator_address)
+        if val is not None:
+            total += val.voting_power
+            weighted.append((cs.timestamp, val.voting_power))
+    weighted.sort()
+    median = total // 2
+    for t, w in weighted:
+        if median <= w:
+            return t
+        median -= w
+    return 0
+
+
 def state_from_genesis(gen: GenesisDoc) -> State:
     """state.go MakeGenesisState."""
     val_set = gen.validator_set()

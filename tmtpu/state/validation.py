@@ -7,7 +7,7 @@ verify_commit (one TPU dispatch per block).
 
 from __future__ import annotations
 
-from tmtpu.state.state import State, STATE_VERSION
+from tmtpu.state.state import State, STATE_VERSION, median_time
 from tmtpu.types import commit_verify  # noqa: F401 (binds ValidatorSet methods)
 from tmtpu.types.block import Block
 
@@ -70,3 +70,29 @@ def validate_block(state: State, block: Block, verify_backend=None) -> None:
             f"block proposer is not a validator: "
             f"{h.proposer_address.hex().upper()}"
         )
+
+    # Block time (validation.go:114-143): for the initial block it must be
+    # the genesis time; afterwards it must be strictly after LastBlockTime
+    # and exactly the weighted median of the LastCommit timestamps.
+    if h.height == state.initial_height:
+        if h.time != state.last_block_time:
+            raise BlockValidationError(
+                f"block time {h.time} != genesis time {state.last_block_time}")
+    else:
+        if h.time <= state.last_block_time:
+            raise BlockValidationError(
+                f"block time {h.time} not greater than last block time "
+                f"{state.last_block_time}")
+        mt = median_time(block.last_commit, state.last_validators)
+        if h.time != mt:
+            raise BlockValidationError(
+                f"invalid block time: expected median {mt}, got {h.time}")
+
+    # Evidence size cap (validation.go:146)
+    from tmtpu.types.evidence import evidence_to_proto
+
+    ev_size = sum(len(evidence_to_proto(e).encode()) for e in block.evidence)
+    if ev_size > state.consensus_params.evidence_max_bytes:
+        raise BlockValidationError(
+            f"evidence bytes {ev_size} exceed max "
+            f"{state.consensus_params.evidence_max_bytes}")

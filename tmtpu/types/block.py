@@ -405,6 +405,10 @@ class Block:
             raise ValueError("wrong LastCommitHash")
         if self.header.data_hash != self.data_hash():
             raise ValueError("wrong DataHash")
+        from tmtpu.types.evidence import evidence_list_hash
+
+        if self.header.evidence_hash != evidence_list_hash(self.evidence):
+            raise ValueError("wrong EvidenceHash")
 
     def to_proto(self) -> pb.Block:
         from tmtpu.types.evidence import evidence_to_proto

@@ -257,8 +257,12 @@ class VoteSet:
         conflicting = None
 
         existing = self._votes[idx]
+        if existing is not None and existing.block_id == vote.block_id:
+            # intra-batch duplicate: the copy was prepared while _votes[idx]
+            # was still empty (only _pre_validate filters pre-existing
+            # duplicates) — benign, NOT an equivocation
+            return False, None
         if existing is not None:
-            # (exact duplicates were filtered in _pre_validate)
             conflicting = existing
             # Replace in the main array only if this block already has maj23.
             if self._maj23 is not None and self._maj23.key() == key:
