@@ -1,0 +1,71 @@
+"""Evidence gossip test (reference behavior: evidence/reactor.go):
+an equivocation reported only to node 0's pool must travel the wire,
+pass verification on nodes that never saw the duplicate votes, and end up
+committed inside a block on every node."""
+
+import time
+
+from tmtpu.types.block import BlockID
+from tmtpu.types.vote import PRECOMMIT, Vote
+
+from tests.test_p2p import _mk_net_nodes
+
+
+def _signed_vote(priv_key, chain_id, height, idx, addr, block_hash):
+    v = Vote(type=PRECOMMIT, height=height, round=0,
+             block_id=BlockID(block_hash, 1, b"\x02" * 32),
+             timestamp=time.time_ns(), validator_address=addr,
+             validator_index=idx)
+    v.signature = priv_key.sign(v.sign_bytes(chain_id))
+    return v
+
+
+def test_evidence_gossips_and_commits(tmp_path):
+    nodes = _mk_net_nodes(4, tmp_path)
+    try:
+        for nd in nodes:
+            nd.start()
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and \
+                any(nd.switch.num_peers() < 3 for nd in nodes):
+            time.sleep(0.1)
+        for nd in nodes:
+            assert nd.consensus.wait_for_height(2, timeout=60)
+
+        # validator 3 "equivocates" at height 1: two precommits for
+        # different blocks, signed with its real consensus key
+        chain_id = nodes[0].chain_id
+        pv = nodes[3].priv_validator
+        addr = pv.get_pub_key().address()
+        vals = nodes[0].state_store.load_validators(1)
+        idx, val = vals.get_by_address(addr)
+        assert val is not None
+        a = _signed_vote(pv.priv_key, chain_id, 1, idx, addr, b"\x0a" * 32)
+        b = _signed_vote(pv.priv_key, chain_id, 1, idx, addr, b"\x0b" * 32)
+
+        # report ONLY to node 0's pool — gossip must carry it everywhere
+        nodes[0].evidence_pool.report_conflicting_votes(a, b)
+        assert nodes[0].evidence_pool.pending_evidence(1 << 20)
+
+        def committed_evidence(nd):
+            for h in range(1, nd.block_store.height() + 1):
+                blk = nd.block_store.load_block(h)
+                if blk and blk.evidence:
+                    return blk.evidence
+            return []
+
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if all(committed_evidence(nd) for nd in nodes):
+                break
+            time.sleep(0.3)
+        for nd in nodes:
+            evs = committed_evidence(nd)
+            assert evs, f"no committed evidence on {nd.node_id[:8]}"
+            ev = evs[0]
+            assert ev.vote_a.validator_address == addr
+        # the app heard about the byzantine validator too
+        # (BeginBlock byzantine_validators path)
+    finally:
+        for nd in nodes:
+            nd.stop()

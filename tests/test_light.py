@@ -1,0 +1,331 @@
+"""Light client tests (reference behaviors: light/verifier.go,
+light/client.go:613/706, light/detector.go).
+
+A fabricated chain (signed headers + rotating valsets, no consensus run)
+backs an in-memory provider; tests cover adjacent/non-adjacent verify,
+sequential vs skipping provider-call counts over 1k blocks, backwards
+verification, and the detector producing LightClientAttackEvidence on a
+forked witness.
+"""
+
+import time
+
+import pytest
+
+from tmtpu.light import client as light_client
+from tmtpu.light import provider as prov
+from tmtpu.light import verifier
+from tmtpu.light.client import Client, ErrLightClientAttack, SEQUENTIAL, \
+    SKIPPING, TrustOptions
+from tmtpu.types.block import BlockID, Commit, Header
+from tmtpu.types.light_block import LightBlock, SignedHeader
+from tmtpu.types.priv_validator import MockPV
+from tmtpu.types.validator import Validator, ValidatorSet
+from tmtpu.types.vote import PRECOMMIT, Vote
+from tmtpu.version import BlockProtocol
+
+CHAIN_ID = "light-chain"
+HOUR_NS = 3600 * 1_000_000_000
+WEEK_NS = 7 * 24 * HOUR_NS
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _cpu_backend():
+    """Pin the CPU verifier: these tests cover light-client logic, not the
+    device graph (test_tpu_integration covers commit-verify on the device),
+    and jax-on-CPU recompiles per batch-size bucket — minutes of overhead."""
+    from tmtpu.crypto import batch as crypto_batch
+
+    old = crypto_batch._default_backend
+    crypto_batch.set_default_backend("cpu")
+    yield
+    crypto_batch.set_default_backend(old)
+
+
+def _sign_commit(pvs_by_addr, vals, header, t):
+    bid = BlockID(header.hash(), 1, b"\x02" * 32)
+    sigs_by_addr = {}
+    for idx, v in enumerate(vals.validators):
+        pv = pvs_by_addr[v.address]
+        vote = Vote(type=PRECOMMIT, height=header.height, round=0,
+                    block_id=bid, timestamp=t,
+                    validator_address=v.address, validator_index=idx)
+        pv.sign_vote(CHAIN_ID, vote)
+        sigs_by_addr[v.address] = vote
+    from tmtpu.types.block import CommitSig, BLOCK_ID_FLAG_COMMIT
+
+    sigs = [CommitSig(BLOCK_ID_FLAG_COMMIT, v.address, t,
+                      sigs_by_addr[v.address].signature)
+            for v in vals.validators]
+    return Commit(header.height, 0, bid, sigs)
+
+
+class FabChain:
+    """Fabricated chain: per-height (LightBlock) with optional valset
+    rotation and forking."""
+
+    def __init__(self, n_heights, n_vals=4, rotate_every=0,
+                 start_time=None):
+        self.pvs = {}
+        pool = [MockPV() for _ in range(n_vals + n_heights + 1)]
+        for pv in pool:
+            self.pvs[pv.get_pub_key().address()] = pv
+        cur_vals = [Validator(pv.get_pub_key(), 10) for pv in pool[:n_vals]]
+        next_i = n_vals
+        t0 = start_time or (time.time_ns() - n_heights * 2_000_000_000)
+        self.blocks = {}
+        prev_hash = b""
+        valsets = {}
+        # valset at height h signs height h; next_validators at h = valset
+        # at h+1
+        for h in range(1, n_heights + 2):
+            valsets[h] = ValidatorSet(list(cur_vals))
+            if rotate_every and h % rotate_every == 0:
+                cur_vals = cur_vals[1:] + \
+                    [Validator(pool[next_i].get_pub_key(), 10)]
+                next_i += 1
+        for h in range(1, n_heights + 1):
+            header = Header(
+                version_block=BlockProtocol, chain_id=CHAIN_ID, height=h,
+                time=t0 + h * 1_000_000_000,
+                last_block_id=BlockID(prev_hash, 1, b"\x02" * 32)
+                if prev_hash else BlockID(),
+                validators_hash=valsets[h].hash(),
+                next_validators_hash=valsets[h + 1].hash(),
+                consensus_hash=b"\x03" * 32,
+                app_hash=b"\x04" * 32,
+                proposer_address=valsets[h].validators[0].address,
+            )
+            commit = _sign_commit(self.pvs, valsets[h], header,
+                                  header.time + 500_000_000)
+            self.blocks[h] = LightBlock(SignedHeader(header, commit),
+                                        valsets[h])
+            prev_hash = header.hash()
+        self.valsets = valsets
+        self.height = n_heights
+
+    def fork_from(self, fork_height):
+        """A fork diverging at fork_height (different app_hash), signed by
+        the same validator sets — an equivocation-style attack chain."""
+        forked = FabChain.__new__(FabChain)
+        forked.pvs = self.pvs
+        forked.valsets = self.valsets
+        forked.height = self.height
+        forked.blocks = dict(self.blocks)
+        prev_hash = self.blocks[fork_height - 1].header.hash() \
+            if fork_height > 1 else b""
+        for h in range(fork_height, self.height + 1):
+            vals = self.valsets[h]
+            header = Header(
+                version_block=BlockProtocol, chain_id=CHAIN_ID, height=h,
+                time=self.blocks[h].header.time + 1,
+                last_block_id=BlockID(prev_hash, 1, b"\x02" * 32)
+                if prev_hash else BlockID(),
+                validators_hash=vals.hash(),
+                next_validators_hash=self.valsets[h + 1].hash(),
+                consensus_hash=b"\x03" * 32,
+                app_hash=b"\x66" * 32,  # diverged
+                proposer_address=vals.validators[0].address,
+            )
+            commit = _sign_commit(self.pvs, vals, header,
+                                  header.time + 500_000_000)
+            forked.blocks[h] = LightBlock(SignedHeader(header, commit), vals)
+            prev_hash = header.hash()
+        return forked
+
+
+class ChainProvider(prov.Provider):
+    def __init__(self, chain, name="fab"):
+        self.chain = chain
+        self.name = name
+        self.calls = 0
+        self.reported = []
+
+    def id(self):
+        return self.name
+
+    def light_block(self, height):
+        self.calls += 1
+        if height is None:
+            height = self.chain.height
+        lb = self.chain.blocks.get(height)
+        if lb is None:
+            raise prov.ErrLightBlockNotFound(f"height {height}")
+        return lb
+
+    def report_evidence(self, ev):
+        self.reported.append(ev)
+
+
+@pytest.fixture(scope="module")
+def chain1k():
+    return FabChain(1000)
+
+
+def _client(chain, provider=None, witnesses=None, mode=SKIPPING, **kw):
+    p = provider or ChainProvider(chain)
+    opts = TrustOptions(WEEK_NS, 1, chain.blocks[1].header.hash())
+    return Client(CHAIN_ID, opts, p, witnesses=witnesses or [],
+                  mode=mode, **kw), p
+
+
+# --- verifier unit tests -----------------------------------------------------
+
+
+def test_verify_adjacent_ok_and_bad_valset_hash():
+    chain = FabChain(3)
+    b1, b2 = chain.blocks[1], chain.blocks[2]
+    now = b2.header.time + HOUR_NS
+    verifier.verify_adjacent(b1.signed_header, b2.signed_header,
+                             b2.validator_set, WEEK_NS, now, HOUR_NS)
+    # wrong valset for the new header
+    other = ValidatorSet([Validator(MockPV().get_pub_key(), 10)])
+    with pytest.raises(verifier.LightError):
+        verifier.verify_adjacent(b1.signed_header, b2.signed_header,
+                                 other, WEEK_NS, now, HOUR_NS)
+
+
+def test_verify_adjacent_expired_trusted():
+    chain = FabChain(3)
+    b1, b2 = chain.blocks[1], chain.blocks[2]
+    with pytest.raises(verifier.ErrOldHeaderExpired):
+        verifier.verify_adjacent(b1.signed_header, b2.signed_header,
+                                 b2.validator_set, trusting_period_ns=1,
+                                 now_ns=b1.header.time + HOUR_NS,
+                                 max_clock_drift_ns=HOUR_NS)
+
+
+def test_verify_non_adjacent_static_valset():
+    chain = FabChain(100)
+    b1, b100 = chain.blocks[1], chain.blocks[100]
+    now = b100.header.time + HOUR_NS
+    verifier.verify_non_adjacent(
+        b1.signed_header, b1.validator_set, b100.signed_header,
+        b100.validator_set, WEEK_NS, now, HOUR_NS)
+
+
+def test_verify_non_adjacent_rotated_valset_cant_be_trusted():
+    # rotating 1-of-4 every height: by height 5 only 1 original remains
+    chain = FabChain(10, rotate_every=1)
+    b1, b6 = chain.blocks[1], chain.blocks[6]
+    now = b6.header.time + HOUR_NS
+    with pytest.raises(verifier.ErrNewValSetCantBeTrusted):
+        verifier.verify_non_adjacent(
+            b1.signed_header, b1.validator_set, b6.signed_header,
+            b6.validator_set, WEEK_NS, now, HOUR_NS)
+
+
+def test_verify_backwards():
+    chain = FabChain(3)
+    b2, b3 = chain.blocks[2], chain.blocks[3]
+    verifier.verify_backwards(b2.signed_header, b3.signed_header)
+    with pytest.raises(verifier.ErrInvalidHeader):
+        verifier.verify_backwards(chain.blocks[1].signed_header,
+                                  b3.signed_header)
+
+
+def test_verify_adjacent_run_fused():
+    chain = FabChain(20)
+    run = [chain.blocks[h] for h in range(2, 21)]
+    now = chain.blocks[20].header.time + HOUR_NS
+    n = verifier.verify_adjacent_run(chain.blocks[1], run, WEEK_NS, now,
+                                     HOUR_NS)
+    assert n == len(run)
+    # corrupt a commit mid-run: verified prefix only
+    import copy
+
+    bad = copy.deepcopy(run)
+    bad[10].commit.signatures[0].signature = bytes(64)
+    n = verifier.verify_adjacent_run(chain.blocks[1], bad, WEEK_NS, now,
+                                     HOUR_NS)
+    assert n == 10
+
+
+# --- client ------------------------------------------------------------------
+
+
+def test_client_sequential_1k(chain1k):
+    c, p = _client(chain1k, mode=SEQUENTIAL)
+    lb = c.verify_light_block_at_height(1000)
+    assert lb.header.hash() == chain1k.blocks[1000].header.hash()
+    assert c.last_trusted_height() == 1000
+    # sequential touched every height once (plus the init fetch)
+    assert p.calls >= 1000
+
+
+def test_client_skipping_1k(chain1k):
+    c, p = _client(chain1k, mode=SKIPPING)
+    lb = c.verify_light_block_at_height(1000)
+    assert lb.header.hash() == chain1k.blocks[1000].header.hash()
+    # static valset: ONE non-adjacent hop suffices — calls stay tiny
+    assert p.calls <= 5, f"skipping made {p.calls} provider calls"
+
+
+def test_client_skipping_bisects_on_rotation():
+    chain = FabChain(64, rotate_every=2)  # full turnover every 8 heights
+    c, p = _client(chain, mode=SKIPPING)
+    lb = c.verify_light_block_at_height(64)
+    assert lb.header.hash() == chain.blocks[64].header.hash()
+    # needed intermediate hops but far fewer than sequential
+    assert 2 < p.calls < 64
+
+
+def test_client_backwards():
+    chain = FabChain(50)
+    p = ChainProvider(chain)
+    opts = TrustOptions(WEEK_NS, 40, chain.blocks[40].header.hash())
+    c = Client(CHAIN_ID, opts, p)
+    lb = c.verify_light_block_at_height(30)
+    assert lb.header.hash() == chain.blocks[30].header.hash()
+
+
+def test_client_update(chain1k):
+    c, _ = _client(chain1k)
+    lb = c.update()
+    assert lb is not None and lb.height() == 1000
+
+
+def test_client_detector_divergence():
+    honest = FabChain(30)
+    forked = honest.fork_from(20)
+    primary = ChainProvider(honest, "primary")
+    witness = ChainProvider(forked, "witness")
+    opts = TrustOptions(WEEK_NS, 1, honest.blocks[1].header.hash())
+    c = Client(CHAIN_ID, opts, primary, witnesses=[witness])
+    with pytest.raises(ErrLightClientAttack) as ei:
+        c.verify_light_block_at_height(30)
+    evs = ei.value.evidence
+    assert evs, "no evidence formed"
+    # equivocation fork (same valsets): common height = conflicting height
+    # range start; evidence was reported to both sides
+    assert witness.reported and primary.reported
+    for ev in evs:
+        ev.validate_basic()
+
+
+def test_client_witness_agreement_no_evidence():
+    honest = FabChain(30)
+    primary = ChainProvider(honest, "primary")
+    witness = ChainProvider(honest, "witness")
+    opts = TrustOptions(WEEK_NS, 1, honest.blocks[1].header.hash())
+    c = Client(CHAIN_ID, opts, primary, witnesses=[witness])
+    lb = c.verify_light_block_at_height(30)
+    assert lb.height() == 30
+    assert not witness.reported and not primary.reported
+
+
+def test_client_persists_and_restores_trust():
+    from tmtpu.libs.db import MemDB
+    from tmtpu.light.store import LightStore
+
+    chain = FabChain(20)
+    db = MemDB()
+    store = LightStore(db)
+    c1, _ = _client(chain, store=store)
+    c1.verify_light_block_at_height(20)
+    # new client over the same store: no re-init needed, trust restored
+    p2 = ChainProvider(chain)
+    opts = TrustOptions(WEEK_NS, 1, chain.blocks[1].header.hash())
+    c2 = Client(CHAIN_ID, opts, p2, store=LightStore(db))
+    assert c2.last_trusted_height() == 20
+    assert p2.calls == 0  # restored purely from the store

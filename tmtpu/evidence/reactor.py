@@ -1,0 +1,105 @@
+"""Evidence gossip reactor (reference: evidence/reactor.go).
+
+Channel 0x38. Each peer gets a broadcast thread that ships pending
+evidence the peer hasn't been sent yet; received evidence is verified by
+the pool before being stored (and therefore re-gossiped) — a node that
+never witnessed an equivocation still learns of it and can commit it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from tmtpu.evidence.pool import EvidenceError, EvidencePool
+from tmtpu.libs.protoio import ProtoMessage
+from tmtpu.p2p.conn.connection import ChannelDescriptor
+from tmtpu.p2p.switch import Peer, Reactor
+from tmtpu.types import pb
+from tmtpu.types.evidence import evidence_from_proto, evidence_to_proto
+
+EVIDENCE_CHANNEL = 0x38
+
+# reactor.go broadcastEvidenceRoutine pacing
+_PEER_RETRY_S = 0.05
+_MAX_BATCH = 20
+
+
+class EvidenceListPB(ProtoMessage):
+    """proto/tendermint/evidence/types.proto EvidenceList."""
+
+    FIELDS = [(1, "evidence", ("rep", ("msg!", pb.Evidence)))]
+
+
+class EvidenceReactor(Reactor):
+    def __init__(self, pool: EvidencePool):
+        super().__init__("EVIDENCE")
+        self.pool = pool
+        self._stopped = threading.Event()
+
+    def get_channels(self):
+        return [ChannelDescriptor(EVIDENCE_CHANNEL, priority=6,
+                                  send_queue_capacity=100)]
+
+    def on_stop(self) -> None:
+        self._stopped.set()
+
+    def add_peer(self, peer: Peer) -> None:
+        if not peer.has_channel(EVIDENCE_CHANNEL):
+            return
+        t = threading.Thread(target=self._broadcast_routine, args=(peer,),
+                             daemon=True,
+                             name=f"evidence-bcast-{peer.node_id[:8]}")
+        t.start()
+
+    def receive(self, channel_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        m = EvidenceListPB.decode(msg_bytes)
+        for raw in m.evidence:
+            ev = evidence_from_proto(raw)
+            try:
+                ev.validate_basic()
+                self.pool.add_evidence(ev)
+            except EvidenceError as e:
+                # invalid evidence is a punishable offense
+                # (reactor.go ReceiveEnvelope -> evpool.AddEvidence err)
+                if "too old" not in str(e):
+                    if self.switch:
+                        self.switch.stop_peer_for_error(peer, e)
+                    return
+            except ValueError as e:
+                if self.switch:
+                    self.switch.stop_peer_for_error(peer, e)
+                return
+
+    def _broadcast_routine(self, peer: Peer) -> None:
+        """reactor.go broadcastEvidenceRoutine — stream pending evidence
+        this peer hasn't seen; sleeps on the pool's condition (the
+        reference's clist waitChan) instead of polling the DB."""
+        sent = {}  # insertion-ordered dedup set
+        gen = -1   # force one initial scan, then wait for pool changes
+        while peer.is_running() and not self._stopped.is_set():
+            batch = []
+            # no byte cap for the gossip scan: the block-proposal path caps
+            # evidence bytes, but gossip must see ALL pending items or
+            # fresh high-height evidence starves behind stale low-height
+            # entries that never commit
+            for ev in self.pool.pending_evidence(1 << 62):
+                h = ev.hash()
+                if h in sent:
+                    continue
+                batch.append(evidence_to_proto(ev))
+                sent[h] = None
+                if len(batch) >= _MAX_BATCH:
+                    break
+            if batch:
+                if not peer.send(EVIDENCE_CHANNEL,
+                                 EvidenceListPB(evidence=batch).encode()):
+                    for raw in batch:
+                        sent.pop(evidence_from_proto(raw).hash(), None)
+                    time.sleep(_PEER_RETRY_S)  # send queue full: back off
+            else:
+                gen = self.pool.wait_for_evidence(gen, timeout=1.0)
+            if len(sent) > 100_000:  # bound memory: drop the oldest half
+                for h in list(sent)[:50_000]:
+                    del sent[h]

@@ -1,0 +1,24 @@
+"""Light client package (reference: light/).
+
+- verifier: pure VerifyAdjacent/VerifyNonAdjacent + fused run verification
+- client: trusted store + primary/witness providers, sequential & skipping
+  (bisection) verification, divergence detector
+- provider: LightBlock sources (HTTP against a full node's RPC)
+- store: DB-backed trusted light block store
+"""
+
+from tmtpu.light.client import (  # noqa: F401
+    Client, ErrLightClientAttack, ErrNoWitnesses, SEQUENTIAL, SKIPPING,
+    TrustOptions,
+)
+from tmtpu.light.provider import (  # noqa: F401
+    ErrBadLightBlock, ErrLightBlockNotFound, HTTPProvider, Provider,
+    ProviderError,
+)
+from tmtpu.light.store import LightStore  # noqa: F401
+from tmtpu.light.verifier import (  # noqa: F401
+    DEFAULT_TRUST_LEVEL, ErrInvalidHeader, ErrNewValSetCantBeTrusted,
+    ErrOldHeaderExpired, LightError, header_expired, verify,
+    verify_adjacent, verify_adjacent_run, verify_backwards,
+    verify_non_adjacent,
+)

@@ -1,0 +1,188 @@
+"""Light block providers (reference: light/provider/provider.go,
+light/provider/http/http.go).
+
+A Provider serves LightBlocks (signed header + validator set) by height.
+The HTTP provider speaks this repo's JSON-RPC (/commit, /validators) —
+the same wire a reference light client uses against a full node.
+"""
+
+from __future__ import annotations
+
+import base64
+import calendar
+import json
+import time
+import urllib.request
+from typing import Optional
+
+from tmtpu.crypto.keys import KEY_TYPES
+from tmtpu.types.block import BlockID, Commit, CommitSig, Header
+from tmtpu.types.light_block import LightBlock, SignedHeader
+from tmtpu.types.validator import Validator, ValidatorSet
+
+
+class ProviderError(Exception):
+    pass
+
+
+class ErrLightBlockNotFound(ProviderError):
+    """provider.go ErrLightBlockNotFound — benign: the provider simply
+    doesn't have the block."""
+
+
+class ErrHeightTooHigh(ProviderError):
+    """provider.go ErrHeightTooHigh — requested beyond the provider's tip."""
+
+
+class ErrBadLightBlock(ProviderError):
+    """provider.go ErrBadLightBlock — malformed/invalid response; the
+    provider should be dropped."""
+
+
+class ErrNoResponse(ProviderError):
+    """provider.go ErrNoResponse."""
+
+
+class Provider:
+    def light_block(self, height: Optional[int]) -> LightBlock:
+        """Return the light block at height (or the latest for None)."""
+        raise NotImplementedError
+
+    def report_evidence(self, ev) -> None:
+        raise NotImplementedError
+
+    def id(self) -> str:
+        raise NotImplementedError
+
+
+def _rfc3339_to_ns(s: str) -> int:
+    """Inverse of rpc/core._ns_to_rfc3339."""
+    if not s or s.startswith("0001-01-01"):
+        return 0
+    base, _, frac = s.rstrip("Z").partition(".")
+    secs = calendar.timegm(time.strptime(base, "%Y-%m-%dT%H:%M:%S"))
+    ns = int((frac or "0").ljust(9, "0")[:9])
+    return secs * 1_000_000_000 + ns
+
+
+def _hexb(s: Optional[str]) -> bytes:
+    return bytes.fromhex(s) if s else b""
+
+
+def _block_id_from_json(d: dict) -> BlockID:
+    parts = d.get("parts") or {}
+    return BlockID(_hexb(d.get("hash")), int(parts.get("total", 0)),
+                   _hexb(parts.get("hash")))
+
+
+def header_from_json(d: dict) -> Header:
+    ver = d.get("version") or {}
+    return Header(
+        version_block=int(ver.get("block", 0)),
+        version_app=int(ver.get("app", 0)),
+        chain_id=d["chain_id"],
+        height=int(d["height"]),
+        time=_rfc3339_to_ns(d.get("time", "")),
+        last_block_id=_block_id_from_json(d.get("last_block_id") or {}),
+        last_commit_hash=_hexb(d.get("last_commit_hash")),
+        data_hash=_hexb(d.get("data_hash")),
+        validators_hash=_hexb(d.get("validators_hash")),
+        next_validators_hash=_hexb(d.get("next_validators_hash")),
+        consensus_hash=_hexb(d.get("consensus_hash")),
+        app_hash=_hexb(d.get("app_hash")),
+        last_results_hash=_hexb(d.get("last_results_hash")),
+        evidence_hash=_hexb(d.get("evidence_hash")),
+        proposer_address=_hexb(d.get("proposer_address")),
+    )
+
+
+def commit_from_json(d: dict) -> Commit:
+    sigs = []
+    for s in d.get("signatures", []):
+        sig = s.get("signature")
+        sigs.append(CommitSig(
+            block_id_flag=int(s["block_id_flag"]),
+            validator_address=_hexb(s.get("validator_address")),
+            timestamp=_rfc3339_to_ns(s.get("timestamp", "")),
+            signature=base64.b64decode(sig) if sig else b"",
+        ))
+    return Commit(int(d["height"]), int(d["round"]),
+                  _block_id_from_json(d.get("block_id") or {}), sigs)
+
+
+def validator_from_json(d: dict) -> Validator:
+    pk = d["pub_key"]
+    entry = KEY_TYPES.get(pk["type"])
+    if entry is None:
+        raise ErrBadLightBlock(f"unknown key type {pk['type']!r}")
+    return Validator(entry[0](base64.b64decode(pk["value"])),
+                     int(d["voting_power"]),
+                     int(d.get("proposer_priority", 0)))
+
+
+class HTTPProvider(Provider):
+    """light/provider/http — a full node's RPC as a light block source."""
+
+    def __init__(self, chain_id: str, base_url: str, timeout: float = 10.0):
+        self.chain_id = chain_id
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def id(self) -> str:
+        return self.base_url
+
+    def _call(self, method: str, params: dict) -> dict:
+        req = urllib.request.Request(
+            self.base_url + "/",
+            data=json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                             "params": params}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                body = json.loads(r.read())
+        except Exception as e:
+            raise ErrNoResponse(f"{method}: {e}") from e
+        if body.get("error"):
+            msg = str(body["error"].get("message", "")) + \
+                str(body["error"].get("data", ""))
+            if "no commit" in msg or "no validators" in msg or \
+                    "not found" in msg:
+                raise ErrLightBlockNotFound(msg)
+            raise ProviderError(msg)
+        return body["result"]
+
+    def light_block(self, height: Optional[int]) -> LightBlock:
+        params = {} if height is None else {"height": str(height)}
+        c = self._call("commit", params)
+        sh = SignedHeader(header_from_json(c["signed_header"]["header"]),
+                          commit_from_json(c["signed_header"]["commit"]))
+        h = sh.header.height
+        vals = []
+        page, total = 1, None
+        while total is None or len(vals) < total:
+            v = self._call("validators", {"height": str(h),
+                                          "page": str(page),
+                                          "per_page": "100"})
+            total = int(v["total"])
+            got = [validator_from_json(x) for x in v["validators"]]
+            if not got:
+                break
+            vals.extend(got)
+            page += 1
+        vs = ValidatorSet.restore(vals)
+        lb = LightBlock(sh, vs)
+        try:
+            lb.validate_basic(self.chain_id)
+        except ValueError as e:
+            raise ErrBadLightBlock(str(e)) from e
+        return lb
+
+    def report_evidence(self, ev) -> None:
+        import base64 as b64
+
+        from tmtpu.types.evidence import evidence_to_proto
+
+        self._call("broadcast_evidence", {
+            "evidence": b64.b64encode(
+                evidence_to_proto(ev).encode()).decode()})

@@ -1,0 +1,82 @@
+"""Trusted light block store (reference: light/store/db/db.go).
+
+Key layout: ``lb/<20-digit height>`` so iteration order is height order on
+any of the repo's KV backends (MemDB / SQLiteDB). min/max/count are cached
+so per-block client bookkeeping (size check, latest lookup) doesn't scan
+the whole store.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from tmtpu.libs.db import DB
+from tmtpu.types import pb
+from tmtpu.types.light_block import LightBlock
+
+_PREFIX = b"lb/"
+
+
+def _key(height: int) -> bytes:
+    return _PREFIX + b"%020d" % height
+
+
+class LightStore:
+    def __init__(self, db: DB):
+        self.db = db
+        self._lock = threading.Lock()
+        self._heights = sorted(
+            int(k[len(_PREFIX):]) for k, _ in self.db.iter_prefix(_PREFIX))
+
+    def save_light_block(self, lb: LightBlock) -> None:
+        if lb.height() <= 0:
+            raise ValueError("height <= 0")
+        with self._lock:
+            self.db.set(_key(lb.height()), lb.to_proto().encode())
+            h = lb.height()
+            if h not in self._heights:
+                import bisect
+
+                bisect.insort(self._heights, h)
+
+    def delete_light_block(self, height: int) -> None:
+        with self._lock:
+            self.db.delete(_key(height))
+            if height in self._heights:
+                self._heights.remove(height)
+
+    def light_block(self, height: int) -> Optional[LightBlock]:
+        raw = self.db.get(_key(height))
+        if raw is None:
+            return None
+        return LightBlock.from_proto(pb.LightBlock.decode(raw))
+
+    def last_light_block_height(self) -> int:
+        with self._lock:
+            return self._heights[-1] if self._heights else -1
+
+    def first_light_block_height(self) -> int:
+        with self._lock:
+            return self._heights[0] if self._heights else -1
+
+    def light_block_before(self, height: int) -> Optional[LightBlock]:
+        """db.go:191 LightBlockBefore — the latest stored block < height."""
+        import bisect
+
+        with self._lock:
+            i = bisect.bisect_left(self._heights, height)
+            best = self._heights[i - 1] if i > 0 else None
+        return self.light_block(best) if best is not None else None
+
+    def prune(self, size: int) -> None:
+        """db.go:224 Prune — keep only the newest ``size`` blocks."""
+        with self._lock:
+            drop = self._heights[:max(0, len(self._heights) - size)]
+            for h in drop:
+                self.db.delete(_key(h))
+            self._heights = self._heights[len(drop):]
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._heights)

@@ -38,7 +38,7 @@ class MempoolReactor(Reactor):
         self._stopped.set()
 
     def add_peer(self, peer: Peer) -> None:
-        if not self.broadcast:
+        if not self.broadcast or not peer.has_channel(MEMPOOL_CHANNEL):
             return
         t = threading.Thread(target=self._broadcast_routine, args=(peer,),
                              daemon=True,

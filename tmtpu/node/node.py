@@ -144,13 +144,12 @@ class Node(BaseService):
             self.node_key = NodeKey.load_or_gen(
                 config.rooted(config.base.node_key_file))
             self.node_id = self.node_key.node_id
-            channels = bytes([0x20, 0x21, 0x22, 0x23, 0x30, 0x38, 0x40])
             node_info = NodeInfo(
                 node_id=self.node_key.node_id,
                 listen_addr=config.p2p.laddr,
                 network=self.genesis_doc.chain_id,
                 version=TMCoreSemVer,
-                channels=channels,
+                channels=b"",  # filled from registered reactors below
                 moniker=config.base.moniker,
                 p2p_version=P2PProtocol,
                 block_version=BlockProtocol,
@@ -163,6 +162,15 @@ class Node(BaseService):
             )
             transport.listen(config.p2p.laddr)
             self.transport = transport
+            # advertise the RESOLVED port (ephemeral ":0" binds would
+            # otherwise gossip undialable addresses through PEX); an
+            # explicit external_address wins (node.go:498 createTransport)
+            if config.p2p.external_address:
+                node_info.listen_addr = config.p2p.external_address
+            elif config.p2p.laddr.endswith(":0"):
+                node_info.listen_addr = \
+                    config.p2p.laddr.rsplit(":", 1)[0] + \
+                    f":{transport.listen_port}"
             self.switch = Switch(transport,
                                  max_inbound=config.p2p.max_num_inbound_peers,
                                  max_outbound=config.p2p.max_num_outbound_peers)
@@ -181,6 +189,29 @@ class Node(BaseService):
                 self.state, self.block_exec, self.block_store,
                 self.fast_sync, consensus_reactor=self.consensus_reactor)
             self.switch.add_reactor("BLOCKSYNC", self.blocksync_reactor)
+            from tmtpu.evidence.reactor import EvidenceReactor
+
+            self.switch.add_reactor("EVIDENCE",
+                                    EvidenceReactor(self.evidence_pool))
+            # PEX + addrbook (node.go:627 createPEXReactorAndAddToSwitch)
+            self.addr_book = None
+            if config.p2p.pex:
+                from tmtpu.p2p.pex import AddrBook, PexReactor
+
+                self.addr_book = AddrBook(
+                    config.rooted("config/addrbook.json"),
+                    our_id=self.node_id)
+                seeds = [a.strip() for a in config.p2p.seeds.split(",")
+                         if a.strip()]
+                self.pex_reactor = PexReactor(
+                    self.addr_book, seed_mode=config.p2p.seed_mode,
+                    seeds=seeds)
+                self.switch.add_reactor("PEX", self.pex_reactor)
+            # advertise exactly the channels with a registered reactor:
+            # claiming a channel we can't serve makes peers' sends fatal
+            # (MConnection errors on packets for unknown channels)
+            node_info.channels = bytes(sorted(
+                d.channel_id for d in self.switch._channel_descs))
             self.switch.set_persistent_peers(
                 [a.strip() for a in config.p2p.persistent_peers.split(",")
                  if a.strip()])

@@ -1,0 +1,4 @@
+"""Peer exchange (reference: p2p/pex/)."""
+
+from tmtpu.p2p.pex.addrbook import AddrBook  # noqa: F401
+from tmtpu.p2p.pex.reactor import PEX_CHANNEL, PexReactor  # noqa: F401

@@ -1,0 +1,248 @@
+"""Address book (reference: p2p/pex/addrbook.go).
+
+Known peer addresses split into NEW (heard about, never connected) and OLD
+(connected successfully at least once) sets, hashed into buckets so one
+gossiping peer can't flood the whole book (addrbook.go bucket design).
+Persisted as JSON (addrbook.go saveToFile / file.go).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+NEW_BUCKET_COUNT = 256
+OLD_BUCKET_COUNT = 64
+BUCKET_SIZE = 64
+# addrbook.go: max failed attempts before an address is dropped
+MAX_ATTEMPTS = 5
+GET_SELECTION_PCT = 23  # getSelection: % of book returned per PEX reply
+MAX_GET_SELECTION = 250
+
+
+class KnownAddress:
+    __slots__ = ("addr", "src", "attempts", "last_attempt", "last_success",
+                 "bucket_type")
+
+    def __init__(self, addr: str, src: str):
+        self.addr = addr          # "id@host:port"
+        self.src = src            # node_id that told us
+        self.attempts = 0
+        self.last_attempt = 0.0
+        self.last_success = 0.0
+        self.bucket_type = "new"
+
+    def to_json(self) -> dict:
+        return {"addr": self.addr, "src": self.src,
+                "attempts": self.attempts,
+                "last_attempt": self.last_attempt,
+                "last_success": self.last_success,
+                "bucket_type": self.bucket_type}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "KnownAddress":
+        ka = cls(d["addr"], d.get("src", ""))
+        ka.attempts = int(d.get("attempts", 0))
+        ka.last_attempt = float(d.get("last_attempt", 0))
+        ka.last_success = float(d.get("last_success", 0))
+        ka.bucket_type = d.get("bucket_type", "new")
+        return ka
+
+
+def _addr_id(addr: str) -> str:
+    return addr.split("@", 1)[0] if "@" in addr else ""
+
+
+class AddrBook:
+    def __init__(self, file_path: str = "", our_id: str = ""):
+        self.file_path = file_path
+        self.our_id = our_id
+        self._lock = threading.Lock()
+        self._by_id: Dict[str, KnownAddress] = {}
+        # bucket index: (type, bucket) -> list of ids, to cap per-source
+        # flooding the way addrbook.go's hashed buckets do
+        self._buckets: Dict[Tuple[str, int], List[str]] = {}
+        self._key = os.urandom(8).hex()  # addrbook.go:  randomized hashing
+        if file_path and os.path.exists(file_path):
+            self._load()
+
+    # -- bucket hashing (addrbook.go calcNewBucket/calcOldBucket) ----------
+
+    def _bucket_of(self, ka: KnownAddress) -> Tuple[str, int]:
+        n = NEW_BUCKET_COUNT if ka.bucket_type == "new" else OLD_BUCKET_COUNT
+        h = hashlib.sha256(
+            (self._key + ka.src + ka.addr).encode()).digest()
+        return (ka.bucket_type, int.from_bytes(h[:4], "big") % n)
+
+    # -- mutation -----------------------------------------------------------
+
+    def add_address(self, addr: str, src: str = "") -> bool:
+        """addrbook.go:262 AddAddress. Returns True if stored."""
+        pid = _addr_id(addr)
+        if not pid or pid == self.our_id:
+            return False
+        with self._lock:
+            ka = self._by_id.get(pid)
+            if ka is not None:
+                # vetted (old-bucket) entries are never overwritten by
+                # gossip; a NEW entry refreshes its address if it moved
+                if ka.bucket_type == "new" and ka.addr != addr:
+                    b = self._bucket_of(ka)
+                    if pid in self._buckets.get(b, []):
+                        self._buckets[b].remove(pid)
+                    ka.addr = addr
+                    ka.src = src
+                    self._buckets.setdefault(self._bucket_of(ka),
+                                             []).append(pid)
+                return False
+            ka = KnownAddress(addr, src)
+            bucket = self._bucket_of(ka)
+            ids = self._buckets.setdefault(bucket, [])
+            if len(ids) >= BUCKET_SIZE:
+                # evict the stalest new-bucket entry (addrbook.go
+                # expireNew picks the worst)
+                worst = min(ids, key=lambda i: self._by_id[i].last_success)
+                ids.remove(worst)
+                del self._by_id[worst]
+            ids.append(pid)
+            self._by_id[pid] = ka
+            return True
+
+    def mark_attempt(self, addr: str) -> None:
+        with self._lock:
+            ka = self._by_id.get(_addr_id(addr))
+            if ka:
+                ka.attempts += 1
+                ka.last_attempt = time.time()
+
+    def mark_good(self, addr: str) -> None:
+        """addrbook.go MarkGood — promote to the old bucket."""
+        with self._lock:
+            ka = self._by_id.get(_addr_id(addr))
+            if ka:
+                ka.attempts = 0
+                ka.last_success = time.time()
+                if ka.bucket_type == "new":
+                    self._rebucket(ka, "old")
+
+    def mark_bad(self, addr: str) -> None:
+        self.remove_address(addr)
+
+    def remove_address(self, addr: str) -> None:
+        with self._lock:
+            pid = _addr_id(addr)
+            ka = self._by_id.pop(pid, None)
+            if ka:
+                b = self._bucket_of(ka)
+                if pid in self._buckets.get(b, []):
+                    self._buckets[b].remove(pid)
+
+    def _rebucket(self, ka: KnownAddress, new_type: str) -> None:
+        pid = _addr_id(ka.addr)
+        old_b = self._bucket_of(ka)
+        if pid in self._buckets.get(old_b, []):
+            self._buckets[old_b].remove(pid)
+        ka.bucket_type = new_type
+        self._buckets.setdefault(self._bucket_of(ka), []).append(pid)
+
+    # -- selection ----------------------------------------------------------
+
+    def pick_address(self, new_bias_pct: int = 30,
+                     exclude: Optional[set] = None) -> Optional[str]:
+        """addrbook.go:303 PickAddress — biased pick between new/old."""
+        with self._lock:
+            exclude = exclude or set()
+            news = [k for k in self._by_id.values()
+                    if k.bucket_type == "new"
+                    and _addr_id(k.addr) not in exclude
+                    and k.attempts < MAX_ATTEMPTS]
+            olds = [k for k in self._by_id.values()
+                    if k.bucket_type == "old"
+                    and _addr_id(k.addr) not in exclude
+                    and k.attempts < MAX_ATTEMPTS]
+            pools = []
+            if news:
+                pools.append((new_bias_pct, news))
+            if olds:
+                pools.append((100 - new_bias_pct, olds))
+            if not pools:
+                return None
+            total = sum(w for w, _ in pools)
+            r = random.uniform(0, total)
+            for w, pool in pools:
+                if r <= w:
+                    return random.choice(pool).addr
+                r -= w
+            return random.choice(pools[-1][1]).addr
+
+    def get_selection(self) -> List[str]:
+        """addrbook.go:386 GetSelection — random subset for a PEX reply."""
+        with self._lock:
+            addrs = [k.addr for k in self._by_id.values()]
+        random.shuffle(addrs)
+        n = max(min(len(addrs) * GET_SELECTION_PCT // 100,
+                    MAX_GET_SELECTION), min(len(addrs), 32))
+        return addrs[:n]
+
+    def has_address(self, addr: str) -> bool:
+        with self._lock:
+            return _addr_id(addr) in self._by_id
+
+    def is_good(self, addr: str) -> bool:
+        with self._lock:
+            ka = self._by_id.get(_addr_id(addr))
+            return bool(ka and ka.bucket_type == "old")
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._by_id)
+
+    def need_more_addrs(self) -> bool:
+        return self.size() < 1000  # addrbook.go needAddressThreshold
+
+    def empty(self) -> bool:
+        return self.size() == 0
+
+    # -- persistence --------------------------------------------------------
+
+    def save(self) -> None:
+        if not self.file_path:
+            return
+        import tempfile
+
+        with self._lock:
+            data = {"key": self._key,
+                    "addrs": [k.to_json() for k in self._by_id.values()]}
+        d = os.path.dirname(self.file_path) or "."
+        os.makedirs(d, exist_ok=True)
+        # unique temp name: concurrent saves (ensure loop vs on_stop) must
+        # not race each other's rename
+        fd, tmp = tempfile.mkstemp(dir=d)
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(data, f, indent=1)
+            os.replace(tmp, self.file_path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    def _load(self) -> None:
+        try:
+            with open(self.file_path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return
+        self._key = data.get("key", self._key)
+        for d in data.get("addrs", []):
+            ka = KnownAddress.from_json(d)
+            pid = _addr_id(ka.addr)
+            if pid and pid != self.our_id:
+                self._by_id[pid] = ka
+                self._buckets.setdefault(self._bucket_of(ka),
+                                         []).append(pid)

@@ -1,0 +1,225 @@
+"""PEX reactor (reference: p2p/pex/pex_reactor.go).
+
+Channel 0x00. New peers are asked for addresses (rate-limited); requests
+are answered with a random book selection; learned addresses feed the
+addrbook; an ensure-peers loop dials book addresses until the outbound
+target is met. Seed mode answers one exchange then hangs up
+(pex_reactor.go seed crawl behavior, simplified: no dedicated crawler).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from tmtpu.libs.protoio import ProtoMessage
+from tmtpu.p2p.conn.connection import ChannelDescriptor
+from tmtpu.p2p.pex.addrbook import AddrBook
+from tmtpu.p2p.switch import Peer, Reactor
+
+PEX_CHANNEL = 0x00
+
+_ENSURE_PERIOD_S = 1.0   # pex_reactor.go defaultEnsurePeersPeriod is 30s;
+# shortened: Python nets in tests need to converge fast
+_REQUEST_INTERVAL_S = 10.0  # per-peer request rate limit
+_SAVE_PERIOD_S = 30.0
+
+
+class NetAddressPB(ProtoMessage):
+    """proto/tendermint/p2p/pex.proto NetAddress."""
+
+    FIELDS = [(1, "id", "string"), (2, "ip", "string"), (3, "port", "uint32")]
+
+
+class PexRequestPB(ProtoMessage):
+    FIELDS = []
+
+
+class PexAddrsPB(ProtoMessage):
+    FIELDS = [(1, "addrs", ("rep", ("msg!", NetAddressPB)))]
+
+
+class PexMessagePB(ProtoMessage):
+    """oneof sum: pex_request=1 | pex_addrs=2."""
+
+    FIELDS = [
+        (1, "pex_request", ("msg", PexRequestPB)),
+        (2, "pex_addrs", ("msg", PexAddrsPB)),
+    ]
+
+
+def _to_net_addr(addr: str) -> Optional[NetAddressPB]:
+    if "@" not in addr:
+        return None
+    pid, hp = addr.split("@", 1)
+    host, _, port = hp.rpartition(":")
+    try:
+        return NetAddressPB(id=pid, ip=host, port=int(port))
+    except ValueError:
+        return None
+
+
+class PexReactor(Reactor):
+    def __init__(self, book: AddrBook, seed_mode: bool = False,
+                 seeds: Optional[list] = None):
+        super().__init__("PEX")
+        self.book = book
+        self.seed_mode = seed_mode
+        self.seeds = [s for s in (seeds or []) if s]
+        self._last_requested: Dict[str, float] = {}  # rate-limit our requests
+        self._pending_reply: set = set()   # peers we await one reply from
+        self._asked_us: Dict[str, float] = {}    # rate-limit inbound requests
+        self._stopped = threading.Event()
+        self._threads = []
+
+    def get_channels(self):
+        return [ChannelDescriptor(PEX_CHANNEL, priority=1,
+                                  send_queue_capacity=10)]
+
+    def on_start(self) -> None:
+        t = threading.Thread(target=self._ensure_peers_routine, daemon=True,
+                             name="pex-ensure")
+        t.start()
+        self._threads.append(t)
+
+    def on_stop(self) -> None:
+        self._stopped.set()
+        self.book.save()
+
+    # -- peer events --------------------------------------------------------
+
+    def add_peer(self, peer: Peer) -> None:
+        # learn the dialable address of outbound peers (pex_reactor.go:174:
+        # inbound peers' listen ports are unverified, only ask them)
+        addr = self._peer_addr(peer)
+        if peer.outbound:
+            if addr:
+                self.book.mark_good(addr)
+        else:
+            if addr:
+                self.book.add_address(addr, src=peer.node_id)
+        self._maybe_request(peer)
+
+    def remove_peer(self, peer: Peer, reason) -> None:
+        self._last_requested.pop(peer.node_id, None)
+        self._pending_reply.discard(peer.node_id)
+        self._asked_us.pop(peer.node_id, None)
+
+    def receive(self, channel_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        m = PexMessagePB.decode(msg_bytes)
+        if m.pex_request is not None:
+            now = time.time()
+            last = self._asked_us.get(peer.node_id, 0)
+            if now - last < _REQUEST_INTERVAL_S / 2:
+                if self.switch:  # flooding us with requests
+                    self.switch.stop_peer_for_error(
+                        peer, ValueError("pex request flood"))
+                return
+            self._asked_us[peer.node_id] = now
+            addrs = []
+            for a in self.book.get_selection():
+                na = _to_net_addr(a)
+                if na is not None and na.id != peer.node_id:
+                    addrs.append(na)
+            peer.send(PEX_CHANNEL,
+                      PexMessagePB(pex_addrs=PexAddrsPB(addrs=addrs)).encode())
+            if self.seed_mode and self.switch:
+                # seeds serve addresses then free the slot
+                # (pex_reactor.go:478 attemptDisconnects)
+                threading.Timer(
+                    0.5, lambda: self.switch.stop_peer_for_error(
+                        peer, "seed exchange complete")).start()
+        elif m.pex_addrs is not None:
+            # one reply per request (pex_reactor.go:307 ReceiveAddrs deletes
+            # the request marker first — repeats are unsolicited)
+            if peer.node_id not in self._pending_reply:
+                if self.switch:
+                    self.switch.stop_peer_for_error(
+                        peer, ValueError("unsolicited pex addrs"))
+                return
+            self._pending_reply.discard(peer.node_id)
+            from tmtpu.p2p.pex.addrbook import MAX_GET_SELECTION
+
+            for na in m.pex_addrs.addrs[:MAX_GET_SELECTION]:
+                if na.id and na.ip and na.port:
+                    self.book.add_address(f"{na.id}@{na.ip}:{na.port}",
+                                          src=peer.node_id)
+
+    # -- internals ----------------------------------------------------------
+
+    def _peer_addr(self, peer: Peer) -> Optional[str]:
+        la = peer.node_info.listen_addr
+        if not la:
+            return None
+        hp = la.rsplit("/", 1)[-1]
+        host, _, port = hp.rpartition(":")
+        if host in ("0.0.0.0", "::", ""):
+            host = peer.remote_ip
+        return f"{peer.node_id}@{host}:{port}"
+
+    def _maybe_request(self, peer: Peer) -> None:
+        if not peer.has_channel(PEX_CHANNEL):
+            return
+        now = time.time()
+        if now - self._last_requested.get(peer.node_id, 0) \
+                < _REQUEST_INTERVAL_S:
+            return
+        self._last_requested[peer.node_id] = now
+        self._pending_reply.add(peer.node_id)
+        peer.send(PEX_CHANNEL,
+                  PexMessagePB(pex_request=PexRequestPB()).encode())
+
+    def _ensure_peers_routine(self) -> None:
+        """pex_reactor.go:388 ensurePeers — keep outbound slots filled from
+        the book; fall back to seeds when the book is dry."""
+        last_save = time.time()
+        while not self._stopped.is_set():
+            time.sleep(_ENSURE_PERIOD_S)
+            sw = self.switch
+            if sw is None or not sw.is_running():
+                continue
+            peers = sw.peers_list()
+            out = sum(1 for p in peers if p.outbound)
+            need = sw.max_outbound - out
+            connected = {p.node_id for p in peers} | {sw.node_id}
+            if need > 0:
+                dialed = 0
+                tried = set()
+                while dialed < need:
+                    addr = self.book.pick_address(exclude=connected | tried)
+                    if addr is None:
+                        break
+                    tried.add(addr.split("@", 1)[0])
+                    self.book.mark_attempt(addr)
+                    try:
+                        if sw.dial_peer(addr) is not None:
+                            self.book.mark_good(addr)
+                            dialed += 1
+                    except Exception:  # noqa: BLE001
+                        pass
+                if dialed == 0 and self.book.empty() and self.seeds:
+                    self._dial_seeds(sw)
+            # ask a connected peer for more when the book is thin
+            if self.book.need_more_addrs() and peers:
+                import random as _r
+
+                self._maybe_request(_r.choice(peers))
+            if time.time() - last_save > _SAVE_PERIOD_S:
+                try:
+                    self.book.save()
+                except OSError:
+                    pass  # disk hiccups must not kill the ensure loop
+                last_save = time.time()
+
+    def _dial_seeds(self, sw) -> None:
+        import random as _r
+
+        seeds = list(self.seeds)
+        _r.shuffle(seeds)
+        for s in seeds:
+            try:
+                if sw.dial_peer(s) is not None:
+                    return
+            except Exception:  # noqa: BLE001
+                continue

@@ -74,10 +74,20 @@ class Peer:
     def is_running(self) -> bool:
         return self.mconn.is_running()
 
+    def has_channel(self, channel_id: int) -> bool:
+        """peer.go hasChannel — did the peer advertise this channel in its
+        NodeInfo? Sending on an unadvertised channel is a fatal 'unknown
+        channel' error on the remote's MConnection."""
+        return channel_id in self.node_info.channels
+
     def send(self, channel_id: int, msg: bytes) -> bool:
+        if not self.has_channel(channel_id):
+            return False
         return self.mconn.send(channel_id, msg)
 
     def try_send(self, channel_id: int, msg: bytes) -> bool:
+        if not self.has_channel(channel_id):
+            return False
         return self.mconn.try_send(channel_id, msg)
 
     def get(self, key: str):

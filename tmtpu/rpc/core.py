@@ -396,6 +396,29 @@ def build_routes(env: Environment) -> dict:
         finally:
             env.event_bus.unsubscribe(sub)
 
+    def broadcast_evidence(evidence):
+        """rpc/core/evidence.go BroadcastEvidence — verify + add to the
+        pool (light clients report attack evidence here)."""
+        import base64
+
+        from tmtpu.types import pb as _pb
+        from tmtpu.types.evidence import evidence_from_proto
+
+        pool = getattr(node, "evidence_pool", None)
+        if pool is None:
+            raise RPCError(-32603, "evidence pool is disabled")
+        try:
+            ev = evidence_from_proto(
+                _pb.Evidence.decode(base64.b64decode(evidence)))
+            ev.validate_basic()
+        except Exception as e:
+            raise RPCError(-32602, "invalid evidence", str(e))
+        try:
+            pool.add_evidence(ev)
+        except Exception as e:
+            raise RPCError(-32603, "failed to add evidence", str(e))
+        return {"hash": _hex(ev.hash())}
+
     # --- abci routes -------------------------------------------------------
 
     def abci_query(path="", data="", height="0", prove=False):
@@ -484,5 +507,6 @@ def build_routes(env: Environment) -> dict:
         "broadcast_tx_sync": broadcast_tx_sync,
         "broadcast_tx_commit": broadcast_tx_commit,
         "abci_query": abci_query, "abci_info": abci_info,
+        "broadcast_evidence": broadcast_evidence,
         "tx": tx, "tx_search": tx_search,
     }

@@ -328,6 +328,19 @@ class ValidatorSet:
         )
 
     @classmethod
+    def restore(cls, validators: List[Validator],
+                proposer: Optional[Validator] = None) -> "ValidatorSet":
+        """Rebuild a set from already-ordered validators carrying their
+        proposer priorities (RPC /validators, light provider) — no re-sort,
+        no priority reset, so hash() matches the originating node's set."""
+        vs = cls()
+        vs.validators = [v.copy() for v in validators]
+        vs.proposer = proposer.copy() if proposer else \
+            (vs._get_val_with_most_priority() if vs.validators else None)
+        vs._update_total_voting_power()
+        return vs
+
+    @classmethod
     def from_proto(cls, m: pb.ValidatorSet) -> "ValidatorSet":
         vs = cls()
         vs.validators = [Validator.from_proto(v) for v in m.validators]
