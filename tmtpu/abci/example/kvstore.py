@@ -20,8 +20,13 @@ from tmtpu.types import pb
 VALIDATOR_TX_PREFIX = b"val:"
 
 
+SNAPSHOT_CHUNK_SIZE = 64 * 1024
+SNAPSHOT_FORMAT = 1
+
+
 class KVStoreApplication(abci.Application):
-    def __init__(self, db=None):
+    def __init__(self, db=None, snapshot_interval: int = 0,
+                 snapshot_keep: int = 5):
         self.db = db  # optional tmtpu.libs.db KV store for persistence
         self.state: Dict[bytes, bytes] = {}
         self.size = 0
@@ -29,6 +34,13 @@ class KVStoreApplication(abci.Application):
         self.app_hash = b"\x00" * 8
         self.val_updates: List[abci.ValidatorUpdate] = []
         self.validators: Dict[bytes, abci.ValidatorUpdate] = {}
+        # snapshots for statesync (the reference kvstore doesn't snapshot;
+        # its e2e app does — abci semantics per abci/types/application.go)
+        self.snapshot_interval = snapshot_interval
+        self.snapshot_keep = snapshot_keep
+        self.snapshots: Dict[int, tuple] = {}  # height -> (Snapshot, chunks)
+        self._restore_chunks: Optional[list] = None
+        self._restore_snapshot = None
         if db is not None:
             self._load()
 
@@ -105,7 +117,102 @@ class KVStoreApplication(abci.Application):
     def commit(self) -> abci.ResponseCommit:
         self.app_hash = struct.pack(">q", self.size)
         self._persist()
+        if self.snapshot_interval and self.height and \
+                self.height % self.snapshot_interval == 0:
+            self._take_snapshot()
         return abci.ResponseCommit(data=self.app_hash)
+
+    # -- snapshots (statesync serving + restore) ---------------------------
+
+    def _take_snapshot(self) -> None:
+        import hashlib
+        import json
+
+        payload = json.dumps({
+            "height": self.height, "size": self.size,
+            "app_hash": self.app_hash.hex(),
+            "state": {k.hex(): v.hex() for k, v in self.state.items()},
+            "validators": {k.hex(): v.hex()
+                           for k, v in ((key, vu.encode())
+                                        for key, vu in self.validators.items())},
+        }, sort_keys=True).encode()
+        # chunks are always non-empty (the JSON payload is never empty):
+        # zero-length chunks are indistinguishable from 'missing' on the
+        # statesync wire (proto3 empty bytes)
+        chunks = [payload[i:i + SNAPSHOT_CHUNK_SIZE]
+                  for i in range(0, len(payload), SNAPSHOT_CHUNK_SIZE)]
+        snap = abci.Snapshot(
+            height=self.height, format=SNAPSHOT_FORMAT, chunks=len(chunks),
+            hash=hashlib.sha256(payload).digest(), metadata=b"")
+        self.snapshots[self.height] = (snap, chunks)
+        # keep only the newest snapshot_keep snapshots
+        keep = max(1, self.snapshot_keep)
+        for h in sorted(self.snapshots)[:-keep]:
+            del self.snapshots[h]
+
+    def list_snapshots(self, req: abci.RequestListSnapshots
+                       ) -> abci.ResponseListSnapshots:
+        return abci.ResponseListSnapshots(
+            snapshots=[s for s, _ in self.snapshots.values()])
+
+    def load_snapshot_chunk(self, req: abci.RequestLoadSnapshotChunk
+                            ) -> abci.ResponseLoadSnapshotChunk:
+        entry = self.snapshots.get(req.height)
+        if entry is None or req.format != SNAPSHOT_FORMAT or \
+                not 0 <= req.chunk < len(entry[1]):
+            return abci.ResponseLoadSnapshotChunk()
+        return abci.ResponseLoadSnapshotChunk(chunk=entry[1][req.chunk])
+
+    def offer_snapshot(self, req: abci.RequestOfferSnapshot
+                       ) -> abci.ResponseOfferSnapshot:
+        snap = req.snapshot
+        if snap is None or snap.format != SNAPSHOT_FORMAT or \
+                snap.chunks <= 0:
+            return abci.ResponseOfferSnapshot(
+                result=abci.OFFER_SNAPSHOT_REJECT_FORMAT)
+        self._restore_snapshot = snap
+        self._restore_chunks = []
+        return abci.ResponseOfferSnapshot(result=abci.OFFER_SNAPSHOT_ACCEPT)
+
+    def apply_snapshot_chunk(self, req: abci.RequestApplySnapshotChunk
+                             ) -> abci.ResponseApplySnapshotChunk:
+        import hashlib
+        import json
+
+        if self._restore_chunks is None or self._restore_snapshot is None:
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.APPLY_CHUNK_ABORT)
+        if req.index != len(self._restore_chunks):
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.APPLY_CHUNK_RETRY)
+        self._restore_chunks.append(bytes(req.chunk))
+        if len(self._restore_chunks) < self._restore_snapshot.chunks:
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.APPLY_CHUNK_ACCEPT)
+        payload = b"".join(self._restore_chunks)
+        if hashlib.sha256(payload).digest() != self._restore_snapshot.hash:
+            self._restore_chunks = None
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.APPLY_CHUNK_RETRY_SNAPSHOT)
+        d = json.loads(payload)
+        self.height = int(d["height"])
+        self.size = int(d["size"])
+        self.app_hash = bytes.fromhex(d["app_hash"])
+        self.state = {bytes.fromhex(k): bytes.fromhex(v)
+                      for k, v in d["state"].items()}
+        self.validators = {
+            bytes.fromhex(k): abci.ValidatorUpdate.decode(bytes.fromhex(v))
+            for k, v in d["validators"].items()}
+        if self.db is not None:
+            for k, v in self.state.items():
+                self.db.set(b"kvstore:data:" + k, v)
+            for k, vu in self.validators.items():
+                self.db.set(b"kvstore:val:" + k, vu.encode())
+            self._persist()
+        self._restore_chunks = None
+        self._restore_snapshot = None
+        return abci.ResponseApplySnapshotChunk(
+            result=abci.APPLY_CHUNK_ACCEPT)
 
     def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
         if req.path == "/val":

@@ -239,6 +239,12 @@ class BlocksyncReactor(Reactor):
         self.initial_state = state
         self.fast_sync = True
         self.pool.height = state.last_block_height + 1
+        # restart the caught-up grace period: both the wall clock AND the
+        # start height, or is_caught_up()'s height > _start_height check
+        # passes instantly with a stale _max_peer_height and we'd hand over
+        # to consensus without fetching the tail
+        self.pool._started_at = time.monotonic()
+        self.pool._start_height = self.pool.height
         self._thread = threading.Thread(
             target=self._pool_routine, args=(True,), daemon=True,
             name="blocksync-pool")

@@ -133,6 +133,7 @@ class Node(BaseService):
         self.switch = None
         self.node_id = ""
         self.fast_sync = False
+        self.state_sync = False
         if config.p2p.laddr:
             from tmtpu.consensus.reactor import ConsensusReactor
             from tmtpu.mempool.reactor import MempoolReactor
@@ -178,16 +179,23 @@ class Node(BaseService):
             # (node.go:450 createBlockchainReactor + onlyValidatorIsUs)
             self.fast_sync = (config.block_sync.enable
                               and not self._only_validator_is_us())
+            # statesync: fresh node + config opt-in (node.go:649)
+            self.state_sync = (config.state_sync.enable
+                               and self.state.last_block_height == 0)
             self.consensus_reactor = ConsensusReactor(
-                self.consensus, wait_sync=self.fast_sync)
+                self.consensus,
+                wait_sync=self.fast_sync or self.state_sync)
             self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
             self.switch.add_reactor("MEMPOOL", MempoolReactor(
                 self.mempool, broadcast=config.mempool.broadcast))
             from tmtpu.blocksync.reactor import BlocksyncReactor
 
+            # with statesync pending, blocksync starts LATER via
+            # switch_to_fast_sync once the snapshot state is planted
             self.blocksync_reactor = BlocksyncReactor(
                 self.state, self.block_exec, self.block_store,
-                self.fast_sync, consensus_reactor=self.consensus_reactor)
+                self.fast_sync and not self.state_sync,
+                consensus_reactor=self.consensus_reactor)
             self.switch.add_reactor("BLOCKSYNC", self.blocksync_reactor)
             from tmtpu.evidence.reactor import EvidenceReactor
 
@@ -207,6 +215,26 @@ class Node(BaseService):
                     self.addr_book, seed_mode=config.p2p.seed_mode,
                     seeds=seeds)
                 self.switch.add_reactor("PEX", self.pex_reactor)
+            # statesync reactor (node.go:839) — always serves snapshots;
+            # the syncing side activates when state_sync.enable on a fresh
+            # node (see on_start)
+            from tmtpu.statesync import StatesyncReactor, Syncer
+
+            self.statesync_reactor = StatesyncReactor(self.proxy_app)
+            if self.state_sync:
+                # state_provider is attached in _statesync_routine: its
+                # light client does network I/O at construction, which must
+                # not block or fail Node.__init__ (node.go builds it inside
+                # startStateSync for the same reason)
+                self.statesync_reactor.syncer = Syncer(
+                    self.proxy_app, None,
+                    self.statesync_reactor.request_chunk,
+                    chunk_timeout_s=config.state_sync
+                    .chunk_request_timeout_ns / 1e9,
+                    request_snapshots=self.statesync_reactor
+                    .request_snapshots,
+                    get_peers=self.statesync_reactor.statesync_peers)
+            self.switch.add_reactor("STATESYNC", self.statesync_reactor)
             # advertise exactly the channels with a registered reactor:
             # claiming a channel we can't serve makes peers' sends fatal
             # (MConnection errors on packets for unknown channels)
@@ -223,6 +251,66 @@ class Node(BaseService):
 
             self.rpc_server = RPCServer(config.rpc.laddr, self)
 
+    def _make_state_provider(self):
+        """stateprovider.go:48 — light client over the configured RPC
+        servers, anchored at the configured trust height/hash."""
+        from tmtpu.light.client import TrustOptions
+        from tmtpu.light.provider import HTTPProvider
+        from tmtpu.statesync import LightClientStateProvider
+
+        ss = self.config.state_sync
+        providers = [HTTPProvider(self.chain_id, url)
+                     for url in ss.rpc_servers]
+        return LightClientStateProvider(
+            self.chain_id,
+            TrustOptions(ss.trust_period_ns, ss.trust_height,
+                         bytes.fromhex(ss.trust_hash)),
+            providers,
+            initial_height=self.genesis_doc.initial_height,
+            consensus_params=self.genesis_doc.consensus_params,
+        )
+
+    def _statesync_routine(self) -> None:
+        """node.go startStateSync: discover → sync → bootstrap stores →
+        hand over to blocksync (which later hands over to consensus)."""
+        import time as _time
+
+        import sys
+
+        syncer = self.statesync_reactor.syncer
+        discovery_s = self.config.state_sync.discovery_time_ns / 1e9
+        # wait for at least one peer, then ask everyone for snapshots
+        deadline = _time.monotonic() + 60
+        while _time.monotonic() < deadline and self.is_running() and \
+                self.switch.num_peers() == 0:
+            _time.sleep(0.1)
+        # trust anchor over the network — retried, never done in __init__
+        while self.is_running():
+            try:
+                syncer.state_provider = self._make_state_provider()
+                break
+            except Exception as e:  # noqa: BLE001 — RPC flake, retry
+                print(f"statesync: state provider init failed: {e}; "
+                      f"retrying", file=sys.stderr)
+                _time.sleep(discovery_s)
+        if syncer.state_provider is None:
+            return
+        self.statesync_reactor.request_snapshots()
+        try:
+            state, commit = syncer.sync_any(discovery_time_s=discovery_s)
+        except Exception as e:  # noqa: BLE001 — node stays in wait_sync
+            print(f"statesync FAILED: {type(e).__name__}: {e} — node is "
+                  f"waiting in sync mode; check state_sync config",
+                  file=sys.stderr)
+            return
+        self.state_store.bootstrap(state)
+        self.block_store.save_seen_commit(state.last_block_height, commit)
+        self.state = state
+        self.state_sync = False
+        # blocksync fetches the tail and hands consensus the final state
+        # via ConsensusReactor.switch_to_consensus
+        self.blocksync_reactor.switch_to_fast_sync(state)
+
     def _only_validator_is_us(self) -> bool:
         """node.go onlyValidatorIsUs — a single-validator chain where we ARE
         the validator has no one to sync from."""
@@ -238,7 +326,12 @@ class Node(BaseService):
         self.indexer_service.start()
         if self.switch is not None:
             self.switch.start()
-        if not self.fast_sync:
+        if self.state_sync:
+            import threading
+
+            threading.Thread(target=self._statesync_routine, daemon=True,
+                             name="statesync").start()
+        elif not self.fast_sync:
             # with fast sync on, the blocksync reactor starts consensus via
             # SwitchToConsensus once caught up (blockchain/v0/reactor.go:303)
             self.consensus.start()

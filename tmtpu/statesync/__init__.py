@@ -1,0 +1,7 @@
+"""Statesync package (reference: statesync/)."""
+
+from tmtpu.statesync.reactor import StatesyncReactor  # noqa: F401
+from tmtpu.statesync.stateprovider import (  # noqa: F401
+    LightClientStateProvider, StateProviderError,
+)
+from tmtpu.statesync.syncer import ErrNoSnapshots, SyncError, Syncer  # noqa: F401

@@ -1,0 +1,52 @@
+"""Statesync wire messages (reference: proto/tendermint/statesync/types.proto
++ statesync/reactor.go channel constants)."""
+
+from __future__ import annotations
+
+from tmtpu.libs.protoio import ProtoMessage
+
+SNAPSHOT_CHANNEL = 0x60
+CHUNK_CHANNEL = 0x61
+
+
+class SnapshotsRequestPB(ProtoMessage):
+    FIELDS = []
+
+
+class SnapshotsResponsePB(ProtoMessage):
+    FIELDS = [
+        (1, "height", "uint64"),
+        (2, "format", "uint32"),
+        (3, "chunks", "uint32"),
+        (4, "hash", "bytes"),
+        (5, "metadata", "bytes"),
+    ]
+
+
+class ChunkRequestPB(ProtoMessage):
+    FIELDS = [
+        (1, "height", "uint64"),
+        (2, "format", "uint32"),
+        (3, "index", "uint32"),
+    ]
+
+
+class ChunkResponsePB(ProtoMessage):
+    FIELDS = [
+        (1, "height", "uint64"),
+        (2, "format", "uint32"),
+        (3, "index", "uint32"),
+        (4, "chunk", "bytes"),
+        (5, "missing", "bool"),
+    ]
+
+
+class StatesyncMessagePB(ProtoMessage):
+    """oneof sum (types.proto Message)."""
+
+    FIELDS = [
+        (1, "snapshots_request", ("msg", SnapshotsRequestPB)),
+        (2, "snapshots_response", ("msg", SnapshotsResponsePB)),
+        (3, "chunk_request", ("msg", ChunkRequestPB)),
+        (4, "chunk_response", ("msg", ChunkResponsePB)),
+    ]

@@ -1,0 +1,114 @@
+"""Statesync reactor (reference: statesync/reactor.go).
+
+Two channels: snapshot discovery/offers on 0x60, chunk transfer on 0x61.
+Serving side answers from the app's snapshot connection; the syncing side
+feeds a Syncer that the node drives at boot.
+
+Wire note: a zero-length chunk is indistinguishable from a missing one
+(proto3 empty bytes ≍ absent), so ``missing = not chunk``; apps must emit
+non-empty chunks (the reference's Go nil-vs-empty distinction does not
+survive proto3 round-trips either).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from tmtpu.abci import types as abci
+from tmtpu.p2p.conn.connection import ChannelDescriptor
+from tmtpu.p2p.switch import Peer, Reactor
+from tmtpu.statesync.msgs import (
+    CHUNK_CHANNEL, ChunkRequestPB, ChunkResponsePB, SNAPSHOT_CHANNEL,
+    SnapshotsRequestPB, SnapshotsResponsePB, StatesyncMessagePB,
+)
+from tmtpu.statesync.syncer import Syncer
+
+# reactor.go recentSnapshots
+_RECENT_SNAPSHOTS = 10
+
+
+class StatesyncReactor(Reactor):
+    def __init__(self, proxy_app, syncer: Optional[Syncer] = None):
+        super().__init__("STATESYNC")
+        self.proxy_app = proxy_app
+        self.syncer = syncer
+
+    def get_channels(self):
+        return [
+            ChannelDescriptor(SNAPSHOT_CHANNEL, priority=5,
+                              send_queue_capacity=10),
+            ChannelDescriptor(CHUNK_CHANNEL, priority=3,
+                              send_queue_capacity=16),
+        ]
+
+    def add_peer(self, peer: Peer) -> None:
+        if self.syncer is not None and self.syncer.syncing and \
+                peer.has_channel(SNAPSHOT_CHANNEL):
+            peer.send(SNAPSHOT_CHANNEL, StatesyncMessagePB(
+                snapshots_request=SnapshotsRequestPB()).encode())
+
+    def remove_peer(self, peer: Peer, reason) -> None:
+        if self.syncer is not None:
+            self.syncer.remove_peer(peer.node_id)
+
+    def statesync_peers(self):
+        if self.switch is None:
+            return []
+        from tmtpu.statesync.msgs import CHUNK_CHANNEL as _CC
+
+        return [p.node_id for p in self.switch.peers_list()
+                if p.has_channel(_CC)]
+
+    def request_snapshots(self) -> None:
+        if self.switch is not None:
+            self.switch.broadcast(SNAPSHOT_CHANNEL, StatesyncMessagePB(
+                snapshots_request=SnapshotsRequestPB()).encode())
+
+    def request_chunk(self, peer_id: str, height: int, format: int,
+                      index: int) -> None:
+        peer = self.switch.peers.get(peer_id) if self.switch else None
+        if peer is not None:
+            peer.send(CHUNK_CHANNEL, StatesyncMessagePB(
+                chunk_request=ChunkRequestPB(
+                    height=height, format=format, index=index)).encode())
+
+    def receive(self, channel_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        m = StatesyncMessagePB.decode(msg_bytes)
+        if m.snapshots_request is not None:
+            for snap in self._recent_snapshots():
+                peer.send(SNAPSHOT_CHANNEL, StatesyncMessagePB(
+                    snapshots_response=SnapshotsResponsePB(
+                        height=snap.height, format=snap.format,
+                        chunks=snap.chunks, hash=snap.hash,
+                        metadata=snap.metadata)).encode())
+        elif m.snapshots_response is not None:
+            if self.syncer is not None:
+                r = m.snapshots_response
+                self.syncer.add_snapshot(peer.node_id, r.height, r.format,
+                                         r.chunks, bytes(r.hash),
+                                         bytes(r.metadata))
+        elif m.chunk_request is not None:
+            r = m.chunk_request
+            res = self.proxy_app.snapshot.load_snapshot_chunk_sync(
+                abci.RequestLoadSnapshotChunk(
+                    height=r.height, format=r.format, chunk=r.index))
+            chunk = bytes(res.chunk or b"")
+            peer.send(CHUNK_CHANNEL, StatesyncMessagePB(
+                chunk_response=ChunkResponsePB(
+                    height=r.height, format=r.format, index=r.index,
+                    chunk=chunk, missing=not chunk)).encode())
+        elif m.chunk_response is not None:
+            if self.syncer is not None:
+                r = m.chunk_response
+                self.syncer.add_chunk(r.height, r.format, r.index,
+                                      bytes(r.chunk or b""), bool(r.missing))
+
+    def _recent_snapshots(self):
+        try:
+            res = self.proxy_app.snapshot.list_snapshots_sync(
+                abci.RequestListSnapshots())
+        except Exception:  # noqa: BLE001 — app without snapshot support
+            return []
+        snaps = sorted(res.snapshots, key=lambda s: (s.height, s.format),
+                       reverse=True)
+        return snaps[:_RECENT_SNAPSHOTS]

@@ -1,0 +1,71 @@
+"""State provider for statesync (reference: statesync/stateprovider.go:48
+NewLightClientStateProvider).
+
+Bootstraps trusted chain state at a snapshot height through the light
+client: AppHash(h) comes from the verified header at h+1, Commit(h) from
+the light block at h, and State(h) is assembled from the light blocks at
+h, h+1 and h+2 — all signature checks ride the light client's batched
+commit verification.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from tmtpu.light.client import Client, TrustOptions
+from tmtpu.light.provider import Provider
+from tmtpu.state.state import State
+from tmtpu.types.params import ConsensusParams
+
+
+class StateProviderError(Exception):
+    pass
+
+
+class LightClientStateProvider:
+    def __init__(self, chain_id: str, trust_options: TrustOptions,
+                 providers: List[Provider],
+                 initial_height: int = 1,
+                 consensus_params: Optional[ConsensusParams] = None,
+                 backend: Optional[str] = None):
+        if not providers:
+            raise StateProviderError("at least one provider required")
+        self.chain_id = chain_id
+        self.initial_height = initial_height
+        self.consensus_params = consensus_params or ConsensusParams()
+        self.client = Client(
+            chain_id, trust_options, providers[0],
+            witnesses=providers[1:], backend=backend)
+
+    def app_hash(self, height: int) -> bytes:
+        """stateprovider.go AppHash — the app hash AFTER height is in the
+        NEXT header."""
+        lb = self.client.verify_light_block_at_height(height + 1)
+        return lb.header.app_hash
+
+    def commit(self, height: int):
+        return self.client.verify_light_block_at_height(height).commit
+
+    def state(self, height: int) -> State:
+        """stateprovider.go State — needs light blocks at h, h+1, h+2."""
+        last = self.client.verify_light_block_at_height(height)
+        cur = self.client.verify_light_block_at_height(height + 1)
+        nxt = self.client.verify_light_block_at_height(height + 2)
+        if cur.header.validators_hash != last.header.next_validators_hash:
+            raise StateProviderError("validator set hash chain broken")
+        return State(
+            chain_id=self.chain_id,
+            initial_height=self.initial_height,
+            last_block_height=last.height(),
+            last_block_id=last.commit.block_id,
+            last_block_time=last.header.time,
+            last_validators=last.validator_set,
+            validators=cur.validator_set,
+            next_validators=nxt.validator_set,
+            last_height_validators_changed=nxt.height(),
+            consensus_params=self.consensus_params,
+            last_height_consensus_params_changed=self.initial_height,
+            last_results_hash=cur.header.last_results_hash,
+            app_hash=cur.header.app_hash,
+            app_version=cur.header.version_app,
+        )

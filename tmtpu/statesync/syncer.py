@@ -1,0 +1,255 @@
+"""Statesync syncer (reference: statesync/syncer.go).
+
+Discovers snapshots from peers, offers them to the app (OfferSnapshot),
+streams chunks (LoadSnapshotChunk on the serving side /
+ApplySnapshotChunk on ours), verifies the restored app against the light
+client's app hash, and hands back the bootstrapped (state, commit) for
+the blocksync tail. Chunk fetching here is pipelined per-snapshot but
+applied in order (syncer.go:358 applyChunks); the reference's concurrent
+chunk fetchers are an optimization over the same protocol.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from tmtpu.abci import types as abci
+
+
+class SyncError(Exception):
+    pass
+
+
+class ErrNoSnapshots(SyncError):
+    pass
+
+
+class ErrRejected(SyncError):
+    pass
+
+
+class ErrRetryLater(SyncError):
+    """Transient: e.g. the light provider can't serve height h+2 yet
+    because the chain tip hasn't reached it — retry without discarding."""
+
+
+class _Snapshot:
+    def __init__(self, height: int, format: int, chunks: int, hash: bytes,
+                 metadata: bytes):
+        self.height = height
+        self.format = format
+        self.chunks = chunks
+        self.hash = bytes(hash)
+        self.metadata = bytes(metadata)
+
+    def key(self) -> tuple:
+        return (self.height, self.format, self.chunks, self.hash)
+
+
+class Syncer:
+    def __init__(self, proxy_app, state_provider,
+                 request_chunk: Callable[[str, int, int, int], None],
+                 chunk_timeout_s: float = 10.0,
+                 request_snapshots: Optional[Callable[[], None]] = None,
+                 get_peers: Optional[Callable[[], List[str]]] = None):
+        self.proxy_app = proxy_app
+        self.state_provider = state_provider
+        self.request_chunk = request_chunk  # (peer_id, height, format, idx)
+        self.request_snapshots = request_snapshots  # broadcast discovery
+        self.get_peers = get_peers  # currently-connected candidate peers
+        self.chunk_timeout_s = chunk_timeout_s
+        self._lock = threading.Lock()
+        self._snapshots: Dict[tuple, _Snapshot] = {}
+        self._peers: Dict[tuple, Set[str]] = {}   # snapshot key -> peer ids
+        self._rejected: Set[tuple] = set()
+        self._chunks: "queue.Queue[tuple]" = queue.Queue()
+        self.syncing = False
+
+    # -- discovery ----------------------------------------------------------
+
+    def add_snapshot(self, peer_id: str, height: int, format: int,
+                     chunks: int, hash: bytes, metadata: bytes) -> bool:
+        snap = _Snapshot(height, format, chunks, hash, metadata)
+        k = snap.key()
+        with self._lock:
+            if k in self._rejected:
+                return False
+            new = k not in self._snapshots
+            self._snapshots[k] = snap
+            self._peers.setdefault(k, set()).add(peer_id)
+            return new
+
+    def remove_peer(self, peer_id: str) -> None:
+        with self._lock:
+            for peers in self._peers.values():
+                peers.discard(peer_id)
+
+    def add_chunk(self, height: int, format: int, index: int, chunk: bytes,
+                  missing: bool) -> None:
+        self._chunks.put((height, format, index, bytes(chunk), missing))
+
+    # -- the sync loop (syncer.go:145 SyncAny) -------------------------------
+
+    def sync_any(self, discovery_time_s: float = 5.0,
+                 deadline_s: float = 300.0) -> Tuple[object, object]:
+        self.syncing = True
+        try:
+            deadline = time.monotonic() + deadline_s
+            last_discovery = 0.0
+            while time.monotonic() < deadline:
+                snap = self._best_snapshot()
+                if snap is None:
+                    # keep discovery rolling: snapshots are pruned server-
+                    # side as the chain advances, so a one-shot request at
+                    # boot can go permanently stale (syncer.go:145 re-asks
+                    # every discoveryTime)
+                    if self.request_snapshots is not None and \
+                            time.monotonic() - last_discovery > \
+                            discovery_time_s:
+                        last_discovery = time.monotonic()
+                        self.request_snapshots()
+                    time.sleep(discovery_time_s / 5)
+                    continue
+                try:
+                    return self._sync(snap)
+                except ErrRetryLater:
+                    time.sleep(discovery_time_s / 5)
+                except ErrRejected:
+                    with self._lock:
+                        self._rejected.add(snap.key())
+                        self._snapshots.pop(snap.key(), None)
+                except SyncError:
+                    with self._lock:
+                        self._snapshots.pop(snap.key(), None)
+            raise ErrNoSnapshots("no syncable snapshot within deadline")
+        finally:
+            self.syncing = False
+
+    def _best_snapshot(self) -> Optional[_Snapshot]:
+        with self._lock:
+            candidates = [s for k, s in self._snapshots.items()
+                          if self._peers.get(k)]
+            if not candidates:
+                return None
+            # highest height, then most peers (snapshot.go:  sortSnapshots)
+            return max(candidates,
+                       key=lambda s: (s.height, len(self._peers[s.key()])))
+
+    def _sync(self, snap: _Snapshot):
+        """syncer.go:241 Sync — one snapshot attempt end-to-end."""
+        # trusted facts from the light client BEFORE trusting the snapshot
+        from tmtpu.light.provider import ProviderError
+        from tmtpu.light.verifier import LightError
+
+        try:
+            app_hash = self.state_provider.app_hash(snap.height)
+            state = self.state_provider.state(snap.height)
+            commit = self.state_provider.commit(snap.height)
+        except (ProviderError, LightError) as e:
+            # most commonly the chain hasn't reached snap.height+2 yet
+            raise ErrRetryLater(str(e)) from e
+
+        res = self.proxy_app.snapshot.offer_snapshot_sync(
+            abci.RequestOfferSnapshot(
+                snapshot=abci.Snapshot(
+                    height=snap.height, format=snap.format,
+                    chunks=snap.chunks, hash=snap.hash,
+                    metadata=snap.metadata),
+                app_hash=app_hash,
+            ))
+        if res.result != abci.OFFER_SNAPSHOT_ACCEPT:
+            if res.result == abci.OFFER_SNAPSHOT_ABORT:
+                raise SyncError("app aborted snapshot restore")
+            raise ErrRejected(f"snapshot offer result {res.result}")
+
+        self._apply_chunks(snap)
+        self._verify_app(snap, app_hash)
+        return state, commit
+
+    def _fetch_peers(self, snap: _Snapshot) -> List[str]:
+        with self._lock:
+            peers = list(self._peers.get(snap.key(), ()))
+        if not peers and self.get_peers is not None:
+            # the discovery peers churned away (reconnects drain the
+            # per-snapshot sets): any connected statesync peer may still
+            # serve the chunks — deterministic snapshots are identical
+            # across nodes
+            peers = self.get_peers()
+        return peers
+
+    def _apply_chunks(self, snap: _Snapshot) -> None:
+        """syncer.go:358 applyChunks — in-order apply with re-request."""
+        # drain stale chunks from a previous attempt
+        while not self._chunks.empty():
+            try:
+                self._chunks.get_nowait()
+            except queue.Empty:
+                break
+        index = 0
+        misses = 0
+        while index < snap.chunks:
+            peers = self._fetch_peers(snap)
+            if not peers:
+                raise SyncError("no peers serving the snapshot")
+            peer = peers[(index + misses) % len(peers)]
+            self.request_chunk(peer, snap.height, snap.format, index)
+            chunk = self._await_chunk(snap, index)
+            if chunk is None:
+                # peer didn't deliver: drop it for this snapshot and retry
+                # elsewhere — bounded, or a fully-pruned snapshot would
+                # spin on the connected-peer fallback forever
+                misses += 1
+                if misses > 2 * len(peers) + 3:
+                    raise SyncError("snapshot chunks unavailable")
+                with self._lock:
+                    self._peers.get(snap.key(), set()).discard(peer)
+                continue
+            misses = 0
+            res = self.proxy_app.snapshot.apply_snapshot_chunk_sync(
+                abci.RequestApplySnapshotChunk(
+                    index=index, chunk=chunk, sender=peer))
+            if res.result == abci.APPLY_CHUNK_ACCEPT:
+                index += 1
+            elif res.result == abci.APPLY_CHUNK_RETRY:
+                # bounded: an app stuck returning RETRY (e.g. restore state
+                # out of step) must fail the attempt, not spin forever
+                misses += 1
+                if misses > 2 * len(peers) + 3:
+                    raise SyncError("app kept returning chunk RETRY")
+                continue
+            elif res.result == abci.APPLY_CHUNK_RETRY_SNAPSHOT:
+                raise SyncError("app requested snapshot retry")
+            elif res.result == abci.APPLY_CHUNK_REJECT_SNAPSHOT:
+                raise ErrRejected("app rejected snapshot during apply")
+            else:
+                raise SyncError(f"chunk apply result {res.result}")
+
+    def _await_chunk(self, snap: _Snapshot, index: int) -> Optional[bytes]:
+        deadline = time.monotonic() + self.chunk_timeout_s
+        while time.monotonic() < deadline:
+            try:
+                h, f, i, chunk, missing = self._chunks.get(timeout=0.25)
+            except queue.Empty:
+                continue
+            if (h, f, i) != (snap.height, snap.format, index):
+                continue  # stale response from a previous attempt
+            if missing:
+                return None  # peer pruned the snapshot: drop it immediately
+            return chunk
+        return None
+
+    def _verify_app(self, snap: _Snapshot, app_hash: bytes) -> None:
+        """syncer.go verifyApp — the restored app must agree with the
+        light-client-verified app hash."""
+        res = self.proxy_app.query.info_sync(abci.RequestInfo(version=""))
+        if res.last_block_height != snap.height:
+            raise SyncError(
+                f"app restored to height {res.last_block_height}, "
+                f"expected {snap.height}")
+        if bytes(res.last_block_app_hash) != app_hash:
+            raise SyncError(
+                f"restored app hash {bytes(res.last_block_app_hash).hex()} "
+                f"!= verified {app_hash.hex()}")

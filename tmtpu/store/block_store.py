@@ -121,6 +121,12 @@ class BlockStore:
             self._height = height
             self._save_height()
 
+    def save_seen_commit(self, height: int, commit: Commit) -> None:
+        """store.go SaveSeenCommit — used by statesync to plant the
+        light-verified commit at the snapshot height."""
+        with self._lock:
+            self.db.set(_k_seen_commit(height), commit.to_proto().encode())
+
     def load_block_meta(self, height: int) -> Optional[BlockMeta]:
         raw = self.db.get(_k_meta(height))
         return BlockMeta.decode(raw) if raw else None
