@@ -1,0 +1,213 @@
+"""Remote signer + sr25519 tests (reference behaviors:
+privval/signer_client.go round-trips, crypto/sr25519).
+
+- the signer protocol round-trips pubkey/vote/proposal over a unix socket
+  and over tcp (SecretConnection), preserving FilePV's double-sign refusal
+- a single-validator NODE runs with its key in a separate signer process
+  (thread here) and commits blocks
+- sr25519 sign/verify, batch verification, and a mixed-curve valset
+  commit verification (BASELINE mixed-curve config)
+"""
+
+import threading
+import time
+
+import pytest
+
+from tmtpu.crypto import sr25519
+from tmtpu.crypto.batch import new_batch_verifier
+from tmtpu.privval.file_pv import FilePV
+from tmtpu.privval.signer import (
+    SignerClient, SignerListenerEndpoint, SignerServer,
+)
+from tmtpu.types import pb
+from tmtpu.types.block import BlockID
+from tmtpu.types.priv_validator import MockPV
+from tmtpu.types.validator import Validator, ValidatorSet
+from tmtpu.types.vote import PRECOMMIT, Vote
+from tmtpu.types.vote_set import VoteSet
+
+CHAIN_ID = "signer-chain"
+
+
+def _mk_vote(height=1, round=0, idx=0, addr=b"\x01" * 20):
+    return Vote(type=PRECOMMIT, height=height, round=round,
+                block_id=BlockID(b"\x01" * 32, 1, b"\x02" * 32),
+                timestamp=time.time_ns(), validator_address=addr,
+                validator_index=idx)
+
+
+def _start_pair(tmp_path, addr):
+    pv = FilePV.generate(str(tmp_path / "key.json"),
+                         str(tmp_path / "state.json"))
+    endpoint = SignerListenerEndpoint(addr)
+    if addr.startswith("tcp://") and endpoint.port:
+        addr = f"tcp://127.0.0.1:{endpoint.port}"
+    server = SignerServer(addr, CHAIN_ID, pv)
+    server.start()
+    endpoint.accept(timeout=10)
+    return pv, endpoint, server
+
+
+@pytest.mark.parametrize("scheme", ["unix", "tcp"])
+def test_signer_roundtrip_and_double_sign_protection(tmp_path, scheme):
+    addr = f"unix://{tmp_path}/signer.sock" if scheme == "unix" \
+        else "tcp://127.0.0.1:0"
+    pv, endpoint, server = _start_pair(tmp_path, addr)
+    try:
+        client = SignerClient(endpoint, CHAIN_ID)
+        assert client.ping()
+        assert client.get_pub_key().bytes() == pv.get_pub_key().bytes()
+
+        v = _mk_vote(addr=pv.get_pub_key().address())
+        client.sign_vote(CHAIN_ID, v)
+        assert pv.get_pub_key().verify_signature(
+            v.sign_bytes(CHAIN_ID), v.signature)
+
+        # conflicting vote at the same HRS must come back as an error
+        v2 = _mk_vote(addr=pv.get_pub_key().address())
+        v2.block_id = BlockID(b"\x07" * 32, 1, b"\x08" * 32)
+        from tmtpu.privval.signer import RemoteSignerError
+
+        with pytest.raises(RemoteSignerError, match="conflicting"):
+            client.sign_vote(CHAIN_ID, v2)
+    finally:
+        server.stop()
+        endpoint.close()
+
+
+def test_node_with_remote_signer(tmp_path):
+    """A validator node whose key lives in a separate signer commits
+    blocks (BASELINE remote-signer parity)."""
+    from tmtpu.config.config import Config
+    from tmtpu.node.node import Node
+    from tmtpu.types.genesis import GenesisDoc, GenesisValidator
+
+    home = tmp_path / "node"
+    (home / "config").mkdir(parents=True)
+    (home / "data").mkdir(parents=True)
+    cfg = Config.test_config()
+    cfg.base.home = str(home)
+    cfg.base.crypto_backend = "cpu"
+    cfg.rpc.laddr = ""
+    cfg.p2p.laddr = ""
+    sock = f"unix://{tmp_path}/nodesigner.sock"
+    cfg.base.priv_validator_laddr = sock
+
+    pv = FilePV.generate(str(tmp_path / "signer_key.json"),
+                         str(tmp_path / "signer_state.json"))
+    gen = GenesisDoc(chain_id="rs-chain", genesis_time=time.time_ns(),
+                     validators=[GenesisValidator(pv.get_pub_key(), 10)])
+    gen.save_as(cfg.genesis_path)
+
+    server = SignerServer(sock, "rs-chain", pv)
+    # node constructor blocks in accept() until the signer dials
+    server.start()
+    node = Node(cfg)
+    try:
+        node.start()
+        assert node.consensus.wait_for_height(3, timeout=60), \
+            f"stuck at {node.consensus.rs.height_round_step()}"
+    finally:
+        node.stop()
+        server.stop()
+
+
+# --- sr25519 -----------------------------------------------------------------
+
+
+def test_sr25519_sign_verify_adversarial():
+    pv = sr25519.gen_priv_key()
+    pub = pv.pub_key()
+    msg = b"attack at dawn"
+    sig = pv.sign(msg)
+    assert len(sig) == 64 and sig[63] & 0x80
+    assert pub.verify_signature(msg, sig)
+    assert not pub.verify_signature(b"attack at dusk", sig)
+    for i in (0, 31, 32, 63):
+        bad = bytearray(sig)
+        bad[i] ^= 0x01
+        assert not pub.verify_signature(msg, bytes(bad))
+    # ed25519-style signature (marker bit clear) must be rejected
+    nomark = bytearray(sig)
+    nomark[63] &= 0x7F
+    assert not pub.verify_signature(msg, bytes(nomark))
+    # non-canonical scalar rejected
+    L = 2**252 + 27742317777372353535851937790883648493
+    s = int.from_bytes(sig[32:63] + bytes([sig[63] & 0x7F]), "little")
+    bad_s = (s + L).to_bytes(32, "little")
+    bad = bytearray(sig[:32] + bad_s)
+    bad[63] |= 0x80
+    assert not pub.verify_signature(msg, bytes(bad))
+
+
+def test_sr25519_substrate_alice_key_derivation():
+    """Interop anchor: the publicly-known Substrate Alice sr25519 pair."""
+    mini = bytes.fromhex("e5be9a5092b81bca64be81d212e7f2f9"
+                         "eba183bb7a90954f7b76361f6edb5c0a")
+    pub = sr25519.PrivKeySr25519(mini).pub_key().bytes()
+    assert pub.hex() == ("d43593c715fdd31c61141abd04a99fd6"
+                         "822c8558854ccde39a5684e7a56da27d")
+
+
+def test_sr25519_proto_roundtrip_and_json():
+    from tmtpu.crypto.encoding import pubkey_from_proto, pubkey_to_proto
+
+    pv = sr25519.gen_priv_key_from_secret(b"roundtrip")
+    pub = pv.pub_key()
+    m = pubkey_to_proto(pub)
+    back = pubkey_from_proto(pb.PublicKey.decode(m.encode()))
+    assert back.bytes() == pub.bytes()
+    assert back.type_value() == "sr25519"
+    assert len(pub.address()) == 20
+
+
+def test_mixed_curve_valset_commit_verification():
+    """BASELINE config: ed25519 + sr25519 + secp256k1 in one valset; the
+    batch verifier routes per-curve and the commit still verifies."""
+    from tmtpu.crypto import ed25519, secp256k1
+
+    privs = [ed25519.gen_priv_key(), sr25519.gen_priv_key(),
+             secp256k1.gen_priv_key(), ed25519.gen_priv_key()]
+
+    class _PV(MockPV):
+        def __init__(self, priv):
+            super().__init__()
+            self.priv_key = priv
+
+    pvs = [_PV(p) for p in privs]
+    vals = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+    by_addr = {pv.get_pub_key().address(): pv for pv in pvs}
+    vs = VoteSet(CHAIN_ID, 1, 0, PRECOMMIT, vals)
+    bid = BlockID(b"\x01" * 32, 1, b"\x02" * 32)
+    for i, val in enumerate(vals.validators):
+        v = _mk_vote(idx=i, addr=val.address)
+        v.block_id = bid
+        by_addr[val.address].sign_vote(CHAIN_ID, v)
+        vs.add_vote(v)
+    commit = vs.make_commit()
+    from tmtpu.types import commit_verify
+
+    vals.verify_commit(CHAIN_ID, bid, 1, commit)
+    vals.verify_commit_light(CHAIN_ID, bid, 1, commit)
+    # tamper the sr25519 lane: the whole commit must fail
+    sr_idx = next(i for i, v in enumerate(vals.validators)
+                  if v.pub_key.type_value() == "sr25519")
+    commit.signatures[sr_idx].signature = bytes(64)
+    with pytest.raises(commit_verify.VerificationError):
+        vals.verify_commit(CHAIN_ID, bid, 1, commit)
+
+
+def test_batch_verifier_mixed_curves():
+    from tmtpu.crypto import ed25519
+
+    bv = new_batch_verifier("cpu")
+    ed = ed25519.gen_priv_key()
+    sr = sr25519.gen_priv_key()
+    msgs = [b"m%d" % i for i in range(4)]
+    bv.add(ed.pub_key(), msgs[0], ed.sign(msgs[0]))
+    bv.add(sr.pub_key(), msgs[1], sr.sign(msgs[1]))
+    bv.add(ed.pub_key(), msgs[2], ed.sign(msgs[0]))  # wrong msg
+    bv.add(sr.pub_key(), msgs[3], sr.sign(msgs[1]))  # wrong msg
+    all_ok, mask = bv.verify()
+    assert not all_ok and mask == [True, True, False, False]

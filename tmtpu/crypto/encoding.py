@@ -12,6 +12,7 @@ from tmtpu.types import pb
 # ensure curve modules have registered themselves
 from tmtpu.crypto import ed25519 as _ed  # noqa: F401
 from tmtpu.crypto import secp256k1 as _secp  # noqa: F401
+from tmtpu.crypto import sr25519 as _sr  # noqa: F401
 
 
 def pubkey_to_proto(pk: PubKey) -> pb.PublicKey:

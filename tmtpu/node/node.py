@@ -86,11 +86,26 @@ class Node(BaseService):
         self.indexer_service = IndexerService(self.tx_indexer, self.event_bus)
 
         # --- privval ---
+        self.signer_endpoint = None
         if priv_validator is None:
-            priv_validator = FilePV.load_or_generate(
-                config.rooted(config.base.priv_validator_key_file),
-                config.rooted(config.base.priv_validator_state_file),
-            )
+            if config.base.priv_validator_laddr:
+                # remote signer (node.go:1449): listen and wait for the
+                # signer process to dial in before consensus can start
+                from tmtpu.privval.signer import (
+                    SignerClient, SignerListenerEndpoint,
+                )
+
+                self.signer_endpoint = SignerListenerEndpoint(
+                    config.base.priv_validator_laddr)
+                self.signer_endpoint.accept(timeout=60.0)
+                self.signer_endpoint.start_accept_loop()
+                priv_validator = SignerClient(self.signer_endpoint,
+                                              self.genesis_doc.chain_id)
+            else:
+                priv_validator = FilePV.load_or_generate(
+                    config.rooted(config.base.priv_validator_key_file),
+                    config.rooted(config.base.priv_validator_state_file),
+                )
         self.priv_validator = priv_validator
 
         # --- handshake: sync app with store (node.go doHandshake) ---
@@ -304,6 +319,7 @@ class Node(BaseService):
                   file=sys.stderr)
             return
         self.state_store.bootstrap(state)
+        self.block_store.bootstrap(state.last_block_height)
         self.block_store.save_seen_commit(state.last_block_height, commit)
         self.state = state
         self.state_sync = False
@@ -346,6 +362,8 @@ class Node(BaseService):
             self.switch.stop()
         self.indexer_service.stop()
         self.proxy_app.stop()
+        if self.signer_endpoint is not None:
+            self.signer_endpoint.close()
 
     @property
     def p2p_port(self) -> int:

@@ -1,0 +1,390 @@
+"""Remote signer protocol (reference: privval/signer_client.go,
+privval/signer_listener_endpoint.go, privval/signer_server.go,
+proto/tendermint/privval/types.proto).
+
+Topology matches the reference: the NODE listens on
+``priv_validator_laddr`` (tcp:// or unix://); the SIGNER process — which
+holds the key — dials in and then serves sign requests over the
+connection. Messages are length-delimited protos; tcp connections are
+upgraded with SecretConnection, unix sockets run in the clear.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from typing import Optional, Tuple
+
+from tmtpu.crypto.encoding import pubkey_from_proto, pubkey_to_proto
+from tmtpu.libs import protoio
+from tmtpu.libs.protoio import ProtoMessage
+from tmtpu.types import pb
+from tmtpu.types.priv_validator import PrivValidator
+from tmtpu.types.vote import Proposal, Vote
+
+
+class RemoteSignerErrorPB(ProtoMessage):
+    FIELDS = [(1, "code", "int32"), (2, "description", "string")]
+
+
+class PubKeyRequestPB(ProtoMessage):
+    FIELDS = [(1, "chain_id", "string")]
+
+
+class PubKeyResponsePB(ProtoMessage):
+    FIELDS = [(1, "pub_key", ("msg", pb.PublicKey)),
+              (2, "error", ("msg", RemoteSignerErrorPB))]
+
+
+class SignVoteRequestPB(ProtoMessage):
+    FIELDS = [(1, "vote", ("msg", pb.Vote)), (2, "chain_id", "string")]
+
+
+class SignedVoteResponsePB(ProtoMessage):
+    FIELDS = [(1, "vote", ("msg", pb.Vote)),
+              (2, "error", ("msg", RemoteSignerErrorPB))]
+
+
+class SignProposalRequestPB(ProtoMessage):
+    FIELDS = [(1, "proposal", ("msg", pb.Proposal)),
+              (2, "chain_id", "string")]
+
+
+class SignedProposalResponsePB(ProtoMessage):
+    FIELDS = [(1, "proposal", ("msg", pb.Proposal)),
+              (2, "error", ("msg", RemoteSignerErrorPB))]
+
+
+class PingRequestPB(ProtoMessage):
+    FIELDS = []
+
+
+class PingResponsePB(ProtoMessage):
+    FIELDS = []
+
+
+class SignerMessagePB(ProtoMessage):
+    """privval Message oneof sum."""
+
+    FIELDS = [
+        (1, "pub_key_request", ("msg", PubKeyRequestPB)),
+        (2, "pub_key_response", ("msg", PubKeyResponsePB)),
+        (3, "sign_vote_request", ("msg", SignVoteRequestPB)),
+        (4, "signed_vote_response", ("msg", SignedVoteResponsePB)),
+        (5, "sign_proposal_request", ("msg", SignProposalRequestPB)),
+        (6, "signed_proposal_response", ("msg", SignedProposalResponsePB)),
+        (7, "ping_request", ("msg", PingRequestPB)),
+        (8, "ping_response", ("msg", PingResponsePB)),
+    ]
+
+
+class RemoteSignerError(Exception):
+    pass
+
+
+def _parse_addr(addr: str) -> Tuple[str, object]:
+    if addr.startswith("unix://"):
+        return "unix", addr[len("unix://"):]
+    if addr.startswith("tcp://"):
+        hp = addr[len("tcp://"):]
+        host, _, port = hp.rpartition(":")
+        return "tcp", (host or "127.0.0.1", int(port))
+    raise ValueError(f"unsupported privval address {addr!r}")
+
+
+class _Conn:
+    """Length-delimited proto messages over a socket or SecretConnection."""
+
+    def __init__(self, sock, secret=None):
+        self.sock = sock
+        self.secret = secret
+        self._lock = threading.Lock()
+
+    def send_msg(self, m: SignerMessagePB) -> None:
+        data = protoio.marshal_delimited(m.encode())
+        with self._lock:
+            if self.secret is not None:
+                self.secret.write(data)
+            else:
+                self.sock.sendall(data)
+
+    def recv_msg(self) -> SignerMessagePB:
+        # uvarint length prefix, then the message
+        shift = 0
+        n = 0
+        while True:
+            b = self._read_exact(1)[0]
+            n |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+            if shift > 63:
+                raise ValueError("varint overflow")
+        if n > 16 * 1024 * 1024:
+            raise ValueError("signer message too large")
+        return SignerMessagePB.decode(self._read_exact(n))
+
+    def _read_exact(self, n: int) -> bytes:
+        if self.secret is not None:
+            return self.secret.read_exact(n)
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("signer connection closed")
+            buf += chunk
+        return buf
+
+    def close(self) -> None:
+        try:
+            if self.secret is not None:
+                self.secret.close()
+            else:
+                self.sock.close()
+        except OSError:
+            pass
+
+
+class SignerListenerEndpoint:
+    """Node side (privval/signer_listener_endpoint.go): listen, accept ONE
+    signer connection at a time, issue requests over it."""
+
+    def __init__(self, addr: str, node_priv_key=None,
+                 timeout_read_s: float = 30.0):
+        self.addr = addr
+        self.node_priv_key = node_priv_key
+        self.timeout_read_s = timeout_read_s
+        self._conn: Optional[_Conn] = None
+        self._lock = threading.Lock()
+        kind, target = _parse_addr(addr)
+        if kind == "unix":
+            if os.path.exists(target):
+                os.unlink(target)
+            self._listener = socket.socket(socket.AF_UNIX)
+            self._listener.bind(target)
+        else:
+            self._listener = socket.socket(socket.AF_INET)
+            self._listener.setsockopt(socket.SOL_SOCKET,
+                                      socket.SO_REUSEADDR, 1)
+            self._listener.bind(target)
+        self._listener.listen(1)
+        self._kind = kind
+
+    @property
+    def port(self) -> int:
+        return self._listener.getsockname()[1] if self._kind == "tcp" else 0
+
+    def accept(self, timeout: Optional[float] = None) -> None:
+        """Block until a signer dials in."""
+        self._listener.settimeout(timeout)
+        sock, _ = self._listener.accept()
+        sock.settimeout(self.timeout_read_s)
+        secret = None
+        if self._kind == "tcp":
+            from tmtpu.crypto import ed25519
+            from tmtpu.p2p.conn.secret_connection import SecretConnection
+
+            secret = SecretConnection(
+                sock, self.node_priv_key or ed25519.gen_priv_key())
+        with self._lock:
+            if self._conn is not None:
+                self._conn.close()
+            self._conn = _Conn(sock, secret)
+
+    def start_accept_loop(self) -> None:
+        """Keep re-accepting so a restarted signer can reconnect (the
+        reference's listener endpoint does the same); the freshest
+        connection replaces the old one."""
+        def loop():
+            while True:
+                try:
+                    self.accept(timeout=None)
+                except OSError:
+                    return  # listener closed
+
+        threading.Thread(target=loop, daemon=True,
+                         name="signer-accept").start()
+
+    def request(self, m: SignerMessagePB) -> SignerMessagePB:
+        with self._lock:
+            conn = self._conn
+        if conn is None:
+            raise RemoteSignerError("no signer connected")
+        try:
+            conn.send_msg(m)
+            return conn.recv_msg()
+        except (ConnectionError, OSError) as e:
+            with self._lock:
+                if self._conn is conn:
+                    self._conn = None
+            conn.close()
+            raise RemoteSignerError(f"signer connection lost: {e}") from e
+
+    def close(self) -> None:
+        with self._lock:
+            if self._conn is not None:
+                self._conn.close()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+class SignerClient(PrivValidator):
+    """privval/signer_client.go — PrivValidator over the endpoint."""
+
+    def __init__(self, endpoint: SignerListenerEndpoint, chain_id: str):
+        self.endpoint = endpoint
+        self.chain_id = chain_id
+        self._pub_key = None
+
+    def ping(self) -> bool:
+        res = self.endpoint.request(
+            SignerMessagePB(ping_request=PingRequestPB()))
+        return res.ping_response is not None
+
+    def get_pub_key(self):
+        if self._pub_key is None:
+            res = self.endpoint.request(SignerMessagePB(
+                pub_key_request=PubKeyRequestPB(chain_id=self.chain_id)))
+            r = res.pub_key_response
+            if r is None or r.error is not None:
+                raise RemoteSignerError(
+                    r.error.description if r and r.error else "bad response")
+            self._pub_key = pubkey_from_proto(r.pub_key)
+        return self._pub_key
+
+    def address(self) -> bytes:
+        return self.get_pub_key().address()
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> None:
+        res = self.endpoint.request(SignerMessagePB(
+            sign_vote_request=SignVoteRequestPB(
+                vote=vote.to_proto(), chain_id=chain_id)))
+        r = res.signed_vote_response
+        if r is None:
+            raise RemoteSignerError("bad sign vote response")
+        if r.error is not None:
+            raise RemoteSignerError(r.error.description)
+        if r.vote is None:
+            raise RemoteSignerError("signer returned neither vote nor error")
+        vote.signature = bytes(r.vote.signature)
+        # remote may also have adjusted the timestamp (cached HRS re-sign)
+        if r.vote.timestamp is not None:
+            vote.timestamp = r.vote.timestamp.to_unix_nanos()
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        res = self.endpoint.request(SignerMessagePB(
+            sign_proposal_request=SignProposalRequestPB(
+                proposal=proposal.to_proto(), chain_id=chain_id)))
+        r = res.signed_proposal_response
+        if r is None:
+            raise RemoteSignerError("bad sign proposal response")
+        if r.error is not None:
+            raise RemoteSignerError(r.error.description)
+        if r.proposal is None:
+            raise RemoteSignerError(
+                "signer returned neither proposal nor error")
+        proposal.signature = bytes(r.proposal.signature)
+        if r.proposal.timestamp is not None:
+            proposal.timestamp = r.proposal.timestamp.to_unix_nanos()
+
+
+class SignerServer:
+    """Signer side (privval/signer_server.go + signer_dialer_endpoint.go):
+    dial the node and serve sign requests from the wrapped PrivValidator
+    (usually a FilePV with its double-sign protection intact)."""
+
+    def __init__(self, addr: str, chain_id: str, priv_validator,
+                 dial_priv_key=None, retries: int = 10,
+                 retry_wait_s: float = 0.5):
+        self.addr = addr
+        self.chain_id = chain_id
+        self.priv_validator = priv_validator
+        self.dial_priv_key = dial_priv_key
+        self.retries = retries
+        self.retry_wait_s = retry_wait_s
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._serve_loop,
+                                        daemon=True, name="signer-server")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def _dial(self) -> _Conn:
+        kind, target = _parse_addr(self.addr)
+        last_err = None
+        for _ in range(self.retries):
+            if self._stopped.is_set():
+                raise ConnectionError("signer stopped")
+            try:
+                if kind == "unix":
+                    sock = socket.socket(socket.AF_UNIX)
+                    sock.connect(target)
+                    return _Conn(sock)
+                sock = socket.create_connection(target, timeout=10)
+                from tmtpu.crypto import ed25519
+                from tmtpu.p2p.conn.secret_connection import SecretConnection
+
+                secret = SecretConnection(
+                    sock, self.dial_priv_key or ed25519.gen_priv_key())
+                return _Conn(sock, secret)
+            except OSError as e:
+                last_err = e
+                time.sleep(self.retry_wait_s)
+        raise ConnectionError(f"cannot reach node: {last_err}")
+
+    def _serve_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                conn = self._dial()
+            except ConnectionError:
+                return
+            try:
+                while not self._stopped.is_set():
+                    req = conn.recv_msg()
+                    conn.send_msg(self._handle(req))
+            except (ConnectionError, OSError, ValueError):
+                conn.close()
+                time.sleep(self.retry_wait_s)
+
+    def _handle(self, req: SignerMessagePB) -> SignerMessagePB:
+        if req.ping_request is not None:
+            return SignerMessagePB(ping_response=PingResponsePB())
+        if req.pub_key_request is not None:
+            return SignerMessagePB(pub_key_response=PubKeyResponsePB(
+                pub_key=pubkey_to_proto(self.priv_validator.get_pub_key())))
+        if req.sign_vote_request is not None:
+            vote = Vote.from_proto(req.sign_vote_request.vote)
+            try:
+                self.priv_validator.sign_vote(
+                    req.sign_vote_request.chain_id or self.chain_id, vote)
+                return SignerMessagePB(
+                    signed_vote_response=SignedVoteResponsePB(
+                        vote=vote.to_proto()))
+            except Exception as e:  # noqa: BLE001 — double sign etc.
+                return SignerMessagePB(
+                    signed_vote_response=SignedVoteResponsePB(
+                        error=RemoteSignerErrorPB(code=1,
+                                                  description=str(e))))
+        if req.sign_proposal_request is not None:
+            prop = Proposal.from_proto(req.sign_proposal_request.proposal)
+            try:
+                self.priv_validator.sign_proposal(
+                    req.sign_proposal_request.chain_id or self.chain_id,
+                    prop)
+                return SignerMessagePB(
+                    signed_proposal_response=SignedProposalResponsePB(
+                        proposal=prop.to_proto()))
+            except Exception as e:  # noqa: BLE001
+                return SignerMessagePB(
+                    signed_proposal_response=SignedProposalResponsePB(
+                        error=RemoteSignerErrorPB(code=1,
+                                                  description=str(e))))
+        raise ValueError("unknown signer request")

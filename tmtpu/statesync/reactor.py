@@ -54,10 +54,8 @@ class StatesyncReactor(Reactor):
     def statesync_peers(self):
         if self.switch is None:
             return []
-        from tmtpu.statesync.msgs import CHUNK_CHANNEL as _CC
-
         return [p.node_id for p in self.switch.peers_list()
-                if p.has_channel(_CC)]
+                if p.has_channel(CHUNK_CHANNEL)]
 
     def request_snapshots(self) -> None:
         if self.switch is not None:

@@ -65,6 +65,7 @@ class Syncer:
         self._snapshots: Dict[tuple, _Snapshot] = {}
         self._peers: Dict[tuple, Set[str]] = {}   # snapshot key -> peer ids
         self._rejected: Set[tuple] = set()
+        self._retries: Dict[tuple, int] = {}      # ErrRetryLater per key
         self._chunks: "queue.Queue[tuple]" = queue.Queue()
         self.syncing = False
 
@@ -116,6 +117,13 @@ class Syncer:
                 try:
                     return self._sync(snap)
                 except ErrRetryLater:
+                    # bounded: a bogus sky-high snapshot (malicious peer)
+                    # must not starve real, syncable ones forever
+                    k = snap.key()
+                    self._retries[k] = self._retries.get(k, 0) + 1
+                    if self._retries[k] > 8:
+                        with self._lock:
+                            self._snapshots.pop(k, None)
                     time.sleep(discovery_time_s / 5)
                 except ErrRejected:
                     with self._lock:

@@ -127,6 +127,18 @@ class BlockStore:
         with self._lock:
             self.db.set(_k_seen_commit(height), commit.to_proto().encode())
 
+    def bootstrap(self, height: int) -> None:
+        """Plant the store height after statesync (no block data exists —
+        queries below base get no_block_response, like a pruned node).
+        Without this, a crash before blocksync persists its first block
+        leaves state at H vs store at 0 and the node can never restart."""
+        with self._lock:
+            if self._height:
+                raise ValueError("cannot bootstrap a non-empty block store")
+            self._base = height
+            self._height = height
+            self._save_height()
+
     def load_block_meta(self, height: int) -> Optional[BlockMeta]:
         raw = self.db.get(_k_meta(height))
         return BlockMeta.decode(raw) if raw else None
