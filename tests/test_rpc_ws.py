@@ -1,0 +1,211 @@
+"""WebSocket subscriptions + pubsub query language + metrics +
+block_search (reference behaviors: rpc/jsonrpc/server/ws_handler.go,
+libs/pubsub/query, consensus/metrics.go, rpc/core/blocks.go BlockSearch).
+"""
+
+import base64
+import hashlib
+import json
+import os
+import socket
+import struct
+import time
+
+import pytest
+
+from tmtpu.libs.pubsub_query import Query, QueryError
+
+from tests.test_node_rpc import node, rpc_get  # noqa: F401  (fixture)
+
+
+# --- query language ----------------------------------------------------------
+
+
+def test_query_language_matching():
+    ev = {"tm.event": ["NewBlock"], "block.height": ["42"],
+          "app.key": ["alpha", "beta"], "tx.hash": ["AB12"]}
+    assert Query("tm.event='NewBlock'").matches(ev)
+    assert not Query("tm.event='Tx'").matches(ev)
+    assert Query("block.height=42").matches(ev)
+    assert Query("block.height>41 AND block.height<=42").matches(ev)
+    assert not Query("block.height>42").matches(ev)
+    assert Query("app.key CONTAINS 'et'").matches(ev)  # matches 'beta'
+    assert not Query("app.key CONTAINS 'gamma'").matches(ev)
+    assert Query("tx.hash EXISTS").matches(ev)
+    assert not Query("tx.signature EXISTS").matches(ev)
+    assert Query("tm.event='NewBlock' AND app.key='alpha'").matches(ev)
+    # quoted AND should not split
+    assert Query("app.key='alpha AND beta'").matches(
+        {"app.key": ["alpha AND beta"]})
+
+
+def test_query_language_time_and_errors():
+    ev = {"block.time": ["1700000000000000000"]}
+    assert Query("block.time >= TIME 2023-11-14T00:00:00Z").matches(ev)
+    assert not Query("block.time < DATE 2001-01-01").matches(ev)
+    for bad in ("", "height ~ 3", "x CONTAINS 5", "y EXISTS 'z'"):
+        with pytest.raises(QueryError):
+            Query(bad)
+
+
+# --- minimal ws client -------------------------------------------------------
+
+
+class WSClient:
+    def __init__(self, host, port, path="/websocket"):
+        self.sock = socket.create_connection((host, port), timeout=15)
+        key = base64.b64encode(os.urandom(16)).decode()
+        req = (f"GET {path} HTTP/1.1\r\nHost: {host}\r\n"
+               f"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+               f"Sec-WebSocket-Key: {key}\r\n"
+               f"Sec-WebSocket-Version: 13\r\n\r\n")
+        self.sock.sendall(req.encode())
+        resp = b""
+        while b"\r\n\r\n" not in resp:
+            resp += self.sock.recv(4096)
+        assert b"101" in resp.split(b"\r\n", 1)[0], resp
+        guid = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+        expect = base64.b64encode(
+            hashlib.sha1((key + guid).encode()).digest()).decode()
+        assert expect.encode() in resp
+        self.buf = b""
+
+    def send_json(self, obj):
+        payload = json.dumps(obj).encode()
+        mask = os.urandom(4)
+        n = len(payload)
+        hdr = bytearray([0x81])
+        if n < 126:
+            hdr.append(0x80 | n)
+        else:
+            hdr.append(0x80 | 126)
+            hdr += struct.pack(">H", n)
+        masked = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        self.sock.sendall(bytes(hdr) + mask + masked)
+
+    def _read_exact(self, n):
+        while len(self.buf) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("closed")
+            self.buf += chunk
+        out, self.buf = self.buf[:n], self.buf[n:]
+        return out
+
+    def recv_json(self, timeout=15):
+        self.sock.settimeout(timeout)
+        b0, b1 = self._read_exact(2)
+        n = b1 & 0x7F
+        if n == 126:
+            n = struct.unpack(">H", self._read_exact(2))[0]
+        elif n == 127:
+            n = struct.unpack(">Q", self._read_exact(8))[0]
+        payload = self._read_exact(n)
+        if b0 & 0x0F != 0x1:
+            return self.recv_json(timeout)
+        return json.loads(payload)
+
+    def close(self):
+        self.sock.close()
+
+
+# --- ws subscription tests (reuse the module-scoped live node) --------------
+
+
+def test_ws_subscribe_new_block(node):  # noqa: F811
+    c = WSClient("127.0.0.1", node.rpc_server.port)
+    try:
+        c.send_json({"jsonrpc": "2.0", "id": 7, "method": "subscribe",
+                     "params": {"query": "tm.event='NewBlock'"}})
+        ack = c.recv_json()
+        assert ack["id"] == 7 and "error" not in ack
+        ev = c.recv_json(timeout=30)
+        assert ev["id"] == 7
+        data = ev["result"]["data"]
+        assert data["type"] == "tendermint/event/NewBlock"
+        h = int(data["value"]["block"]["header"]["height"])
+        assert h > 0
+        assert ev["result"]["events"]["tm.event"] == ["NewBlock"]
+        # events keep flowing with increasing heights
+        ev2 = c.recv_json(timeout=30)
+        h2 = int(ev2["result"]["data"]["value"]["block"]["header"]["height"])
+        assert h2 > h
+    finally:
+        c.close()
+
+
+def test_ws_subscribe_tx_and_unsubscribe(node):  # noqa: F811
+    c = WSClient("127.0.0.1", node.rpc_server.port)
+    try:
+        c.send_json({"jsonrpc": "2.0", "id": 3, "method": "subscribe",
+                     "params": {"query": "tm.event='Tx'"}})
+        assert "error" not in c.recv_json()
+        rpc_get(node, "broadcast_tx_commit", tx='"wskey=wsval"')
+        ev = c.recv_json(timeout=30)
+        assert ev["id"] == 3
+        val = ev["result"]["data"]["value"]["TxResult"]
+        assert base64.b64decode(val["tx"]) == b"wskey=wsval"
+        assert "tx.hash" in ev["result"]["events"]
+        # regular RPC call over the same ws connection
+        c.send_json({"jsonrpc": "2.0", "id": 9, "method": "status",
+                     "params": {}})
+        while True:
+            st = c.recv_json(timeout=15)
+            if st.get("id") == 9:
+                break
+        assert "sync_info" in st["result"]
+        # unsubscribe stops the stream
+        c.send_json({"jsonrpc": "2.0", "id": 4, "method": "unsubscribe",
+                     "params": {"query": "tm.event='Tx'"}})
+        while True:
+            r = c.recv_json(timeout=15)
+            if r.get("id") == 4:
+                assert "error" not in r
+                break
+    finally:
+        c.close()
+
+
+def test_ws_bad_query_rejected(node):  # noqa: F811
+    c = WSClient("127.0.0.1", node.rpc_server.port)
+    try:
+        c.send_json({"jsonrpc": "2.0", "id": 1, "method": "subscribe",
+                     "params": {"query": "not a query!!"}})
+        r = c.recv_json()
+        assert r["error"]["code"] == -32602
+    finally:
+        c.close()
+
+
+# --- metrics + block_search --------------------------------------------------
+
+
+def test_metrics_endpoint(node):  # noqa: F811
+    import urllib.request
+
+    # let a couple of blocks commit so gauges move
+    time.sleep(1.0)
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{node.rpc_server.port}/metrics",
+            timeout=10) as r:
+        body = r.read().decode()
+    assert "# TYPE tendermint_consensus_height gauge" in body
+    h = next(float(line.rsplit(" ", 1)[1])
+             for line in body.splitlines()
+             if line.startswith("tendermint_consensus_height "))
+    assert h >= 1
+    assert "tendermint_consensus_block_interval_seconds_bucket" in body
+    assert "tendermint_consensus_total_txs" in body
+
+
+def test_block_search(node):  # noqa: F811
+    res = rpc_get(node, "broadcast_tx_commit", tx='"bskey=bsval"')
+    height = int(res["height"])
+    time.sleep(0.5)  # indexer drains async
+    out = rpc_get(node, "block_search",
+                  query=f"block.height={height}")
+    assert int(out["total_count"]) >= 1
+    assert any(int(b["block"]["header"]["height"]) == height
+               for b in out["blocks"])
+    out2 = rpc_get(node, "block_search", query="block.height>999999")
+    assert out2["blocks"] == []

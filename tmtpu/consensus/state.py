@@ -731,9 +731,29 @@ class ConsensusState(BaseService):
                 self.block_store.prune_blocks(retain_height)
             except Exception:
                 pass
+        self._record_metrics(block, rs.commit_round, new_state)
         self.update_to_state(new_state)
         self._schedule_round0()
         self._done_first_block.set()
+
+    def _record_metrics(self, block, commit_round: int, new_state) -> None:
+        """consensus/metrics.go:18 metric set, updated per commit."""
+        from tmtpu.libs import metrics as m
+
+        m.consensus_height.set(block.header.height)
+        m.consensus_rounds.set(commit_round)
+        m.consensus_num_txs.set(len(block.txs))
+        m.consensus_total_txs.inc(len(block.txs))
+        m.consensus_block_size.set(len(block.encode()))
+        if new_state.validators is not None:
+            m.consensus_validators.set(new_state.validators.size())
+            m.consensus_validators_power.set(
+                new_state.validators.total_voting_power())
+        prev = getattr(self, "_last_commit_time_ns", 0)
+        if prev:
+            m.consensus_block_interval.observe(
+                (block.header.time - prev) / 1e9)
+        self._last_commit_time_ns = block.header.time
 
     def _new_step(self) -> None:
         if self.wal is not None:

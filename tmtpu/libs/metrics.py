@@ -1,0 +1,177 @@
+"""Prometheus-style metrics (reference: libs' go-kit prometheus wiring,
+consensus/metrics.go:18, p2p/metrics.go:29).
+
+A process-global registry of counters/gauges/histograms with text
+exposition (served at the RPC /metrics endpoint). Lock-light: values are
+plain floats guarded by a registry lock only on creation; updates use
+per-metric locks.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+_NAMESPACE = "tendermint"
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, labels: Tuple[str, ...]):
+        self.name = name
+        self.help = help_
+        self.label_names = labels
+        self._values: Dict[Tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
+        return tuple(str(labels.get(k, "")) for k in self.label_names)
+
+    def render(self, kind: str) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} {kind}"]
+        with self._lock:
+            items = sorted(self._values.items())
+        if not items and not self.label_names:
+            out.append(f"{self.name} 0")
+        for key, v in items:
+            if self.label_names:
+                lbl = ",".join(f'{k}="{val}"' for k, val in
+                               zip(self.label_names, key))
+                out.append(f"{self.name}{{{lbl}}} {_fmt(v)}")
+            else:
+                out.append(f"{self.name} {_fmt(v)}")
+        return out
+
+
+def _fmt(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else repr(v)
+
+
+class Counter(_Metric):
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        k = self._key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + amount
+
+
+class Gauge(_Metric):
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[self._key(labels)] = float(value)
+
+    def add(self, amount: float, **labels) -> None:
+        k = self._key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + amount
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (Prometheus semantics)."""
+
+    def __init__(self, name, help_, labels, buckets):
+        super().__init__(name, help_, labels)
+        self.buckets = sorted(buckets)
+        self._counts: Dict[Tuple[str, ...], List[int]] = {}
+        self._sums: Dict[Tuple[str, ...], float] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        k = self._key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(
+                k, [0] * (len(self.buckets) + 1))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            counts[-1] += 1  # +Inf
+            self._sums[k] = self._sums.get(k, 0.0) + value
+
+    def render(self, kind: str) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        with self._lock:
+            for k, counts in sorted(self._counts.items()):
+                lbl_base = list(zip(self.label_names, k))
+                for i, b in enumerate(self.buckets):
+                    labels = lbl_base + [("le", _fmt(b))]
+                    ls = ",".join(f'{a}="{v}"' for a, v in labels)
+                    out.append(f"{self.name}_bucket{{{ls}}} {counts[i]}")
+                inf = lbl_base + [("le", "+Inf")]
+                ls = ",".join(f'{a}="{v}"' for a, v in inf)
+                out.append(f"{self.name}_bucket{{{ls}}} {counts[-1]}")
+                base = ",".join(f'{a}="{v}"' for a, v in lbl_base)
+                suffix = f"{{{base}}}" if base else ""
+                out.append(f"{self.name}_sum{suffix} "
+                           f"{_fmt(self._sums.get(k, 0.0))}")
+                out.append(f"{self.name}_count{suffix} {counts[-1]}")
+        return out
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: Dict[str, Tuple[str, _Metric]] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, subsystem: str, name: str, help_: str = "",
+                labels: Tuple[str, ...] = ()) -> Counter:
+        return self._get(subsystem, name, help_, labels, Counter, "counter")
+
+    def gauge(self, subsystem: str, name: str, help_: str = "",
+              labels: Tuple[str, ...] = ()) -> Gauge:
+        return self._get(subsystem, name, help_, labels, Gauge, "gauge")
+
+    def histogram(self, subsystem: str, name: str, help_: str = "",
+                  labels: Tuple[str, ...] = (),
+                  buckets=(0.1, 0.5, 1, 2, 5, 10, 30)) -> Histogram:
+        full = f"{_NAMESPACE}_{subsystem}_{name}"
+        with self._lock:
+            if full not in self._metrics:
+                self._metrics[full] = (
+                    "histogram", Histogram(full, help_, labels, buckets))
+            return self._metrics[full][1]
+
+    def _get(self, subsystem, name, help_, labels, cls, kind):
+        full = f"{_NAMESPACE}_{subsystem}_{name}"
+        with self._lock:
+            if full not in self._metrics:
+                self._metrics[full] = (kind, cls(full, help_, tuple(labels)))
+            return self._metrics[full][1]
+
+    def render(self) -> str:
+        with self._lock:
+            items = sorted(self._metrics.items())
+        lines: List[str] = []
+        for _name, (kind, m) in items:
+            lines.extend(m.render(kind))
+        return "\n".join(lines) + "\n"
+
+
+DEFAULT = Registry()
+
+
+def render_prometheus() -> str:
+    return DEFAULT.render()
+
+
+# --- the consensus/p2p/mempool metric set (consensus/metrics.go:18) ---------
+
+consensus_height = DEFAULT.gauge("consensus", "height",
+                                 "Height of the chain")
+consensus_rounds = DEFAULT.gauge("consensus", "rounds",
+                                 "Round of the current height")
+consensus_validators = DEFAULT.gauge("consensus", "validators",
+                                     "Number of validators")
+consensus_validators_power = DEFAULT.gauge(
+    "consensus", "validators_power", "Total voting power of validators")
+consensus_block_interval = DEFAULT.histogram(
+    "consensus", "block_interval_seconds",
+    "Time between this and the last block",
+    buckets=(0.05, 0.1, 0.25, 0.5, 1, 2, 5, 10))
+consensus_num_txs = DEFAULT.gauge("consensus", "num_txs",
+                                  "Number of txs in the latest block")
+consensus_total_txs = DEFAULT.counter("consensus", "total_txs",
+                                      "Total txs committed")
+consensus_block_size = DEFAULT.gauge("consensus", "block_size_bytes",
+                                     "Size of the latest block")
+p2p_peers = DEFAULT.gauge("p2p", "peers", "Number of connected peers")
+mempool_size = DEFAULT.gauge("mempool", "size",
+                             "Number of uncommitted txs")

@@ -1,0 +1,153 @@
+"""Pubsub query language (reference: libs/pubsub/query/query.go).
+
+Grammar (query.peg): conditions joined by AND; each condition is
+``<composite-key> <op> <operand>`` with ops =, <, <=, >, >=, CONTAINS,
+EXISTS. Operands are 'single-quoted strings', numbers, TIME <RFC3339>, or
+DATE <YYYY-MM-DD>. Matching runs against ABCI-style composite event maps
+``{"tx.hash": ["AB12..."], "app.key": ["k1", "k2"], ...}`` — a condition
+matches if ANY value under the key satisfies it (query.go Matches).
+"""
+
+from __future__ import annotations
+
+import calendar
+import re
+import time
+from typing import Dict, List, Optional, Tuple
+
+_OPS = ("<=", ">=", "=", "<", ">")
+
+_CONDITION_RE = re.compile(
+    r"\s*([\w.\-/]+)\s*"
+    r"(<=|>=|=|<|>|\bCONTAINS\b|\bEXISTS\b)\s*"
+    r"(.*?)\s*$"
+)
+
+
+class QueryError(ValueError):
+    pass
+
+
+def _parse_operand(raw: str):
+    """Returns ("str"|"num"|"time", value)."""
+    raw = raw.strip()
+    if raw.startswith("'") and raw.endswith("'") and len(raw) >= 2:
+        return ("str", raw[1:-1])
+    if raw.startswith("TIME "):
+        t = raw[5:].strip()
+        base, _, frac = t.rstrip("Z").partition(".")
+        secs = calendar.timegm(time.strptime(base, "%Y-%m-%dT%H:%M:%S"))
+        ns = int((frac or "0").ljust(9, "0")[:9])
+        return ("time", secs * 1_000_000_000 + ns)
+    if raw.startswith("DATE "):
+        d = raw[5:].strip()
+        secs = calendar.timegm(time.strptime(d, "%Y-%m-%d"))
+        return ("time", secs * 1_000_000_000)
+    try:
+        if "." in raw:
+            return ("num", float(raw))
+        return ("num", int(raw))
+    except ValueError:
+        raise QueryError(f"invalid operand {raw!r}")
+
+
+class _Condition:
+    __slots__ = ("key", "op", "kind", "value")
+
+    def __init__(self, key: str, op: str, kind: Optional[str], value):
+        self.key = key
+        self.op = op
+        self.kind = kind
+        self.value = value
+
+    def matches(self, events: Dict[str, List[str]]) -> bool:
+        vals = events.get(self.key)
+        if self.op == "EXISTS":
+            return vals is not None
+        if not vals:
+            return False
+        return any(self._match_one(str(v)) for v in vals)
+
+    def _match_one(self, v: str) -> bool:
+        if self.op == "CONTAINS":
+            return self.kind == "str" and self.value in v
+        if self.kind == "str":
+            return self.op == "=" and v == self.value
+        # numeric / time comparisons coerce the event value
+        try:
+            ev = float(v) if isinstance(self.value, float) else int(v)
+        except ValueError:
+            try:
+                ev = float(v)
+            except ValueError:
+                return False
+        w = self.value
+        if self.op == "=":
+            return ev == w
+        if self.op == "<":
+            return ev < w
+        if self.op == "<=":
+            return ev <= w
+        if self.op == ">":
+            return ev > w
+        if self.op == ">=":
+            return ev >= w
+        return False
+
+
+class Query:
+    """Compiled query; ``matches(events)`` is the hot call."""
+
+    def __init__(self, s: str):
+        self.raw = s.strip()
+        if not self.raw:
+            raise QueryError("empty query")
+        self.conditions: List[_Condition] = []
+        for part in _split_and(self.raw):
+            m = _CONDITION_RE.match(part)
+            if m is None:
+                raise QueryError(f"cannot parse condition {part!r}")
+            key, op, operand = m.group(1), m.group(2), m.group(3)
+            if op == "EXISTS":
+                if operand:
+                    raise QueryError("EXISTS takes no operand")
+                self.conditions.append(_Condition(key, op, None, None))
+                continue
+            kind, value = _parse_operand(operand)
+            if op == "CONTAINS" and kind != "str":
+                raise QueryError("CONTAINS needs a string operand")
+            self.conditions.append(_Condition(key, op, kind, value))
+
+    def matches(self, events: Dict[str, List[str]]) -> bool:
+        return all(c.matches(events) for c in self.conditions)
+
+    def __str__(self) -> str:
+        return self.raw
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Query) and self.raw == other.raw
+
+
+def _split_and(s: str) -> List[str]:
+    """Split on AND outside single quotes."""
+    parts, buf, in_q = [], [], False
+    i = 0
+    while i < len(s):
+        c = s[i]
+        if c == "'":
+            in_q = not in_q
+            buf.append(c)
+            i += 1
+        elif not in_q and s[i:i + 5].upper() == " AND " :
+            parts.append("".join(buf))
+            buf = []
+            i += 5
+        else:
+            buf.append(c)
+            i += 1
+    parts.append("".join(buf))
+    return [p.strip() for p in parts if p.strip()]
+
+
+def parse(s: str) -> Query:
+    return Query(s)

@@ -107,6 +107,9 @@ class CListMempool:
             else:
                 if not self.keep_invalid_txs_in_cache:
                     self.cache.remove(tx)
+        from tmtpu.libs import metrics as _m
+
+        _m.mempool_size.set(self.size())
 
     def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int
                                ) -> List[bytes]:
@@ -162,6 +165,9 @@ class CListMempool:
                         self._txs_bytes -= len(info["tx"])
                 if not self.keep_invalid_txs_in_cache:
                     self.cache.remove(tx)
+        from tmtpu.libs import metrics as _m
+
+        _m.mempool_size.set(self.size())
 
     def flush(self) -> None:
         with self._lock:

@@ -80,10 +80,17 @@ class Node(BaseService):
         )
 
         if config.tx_index.indexer == "kv":
+            from tmtpu.state.txindex import KVBlockIndexer
+
             self.tx_indexer = KVTxIndexer(_make_db(config, "txindex"))
+            self.block_indexer = KVBlockIndexer(
+                _make_db(config, "blockindex"))
         else:
             self.tx_indexer = NullTxIndexer()
-        self.indexer_service = IndexerService(self.tx_indexer, self.event_bus)
+            self.block_indexer = None
+        self.indexer_service = IndexerService(
+            self.tx_indexer, self.event_bus,
+            block_indexer=self.block_indexer)
 
         # --- privval ---
         self.signer_endpoint = None

@@ -232,6 +232,9 @@ class Switch(BaseService):
             peer = Peer(sc, ni, ip, outbound, self._channel_descs,
                         self._on_peer_receive, self._on_peer_error)
             self.peers[ni.node_id] = peer
+            from tmtpu.libs import metrics as _m
+
+            _m.p2p_peers.set(len(self.peers))
         peer.start()
         for r in self.reactors.values():
             try:
@@ -247,8 +250,12 @@ class Switch(BaseService):
         self._remove_peer(peer, err)
 
     def _remove_peer(self, peer: Peer, reason) -> None:
+        from tmtpu.libs import metrics as _m
+
         with self._peers_lock:
             existing = self.peers.pop(peer.node_id, None)
+            if existing is not None:
+                _m.p2p_peers.set(len(self.peers))
         if existing is None:
             return
         peer.stop()

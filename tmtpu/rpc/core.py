@@ -105,6 +105,44 @@ def _block_json(b) -> dict:
     }
 
 
+def event_data_json(item) -> dict:
+    """EventItem → the WS 'data' payload (jsonrpc ResultEvent.Data),
+    tagged like the reference's tmjson type registry."""
+    from tmtpu.types.event_bus import (
+        EVENT_NEW_BLOCK, EVENT_NEW_BLOCK_HEADER, EVENT_TX,
+        EVENT_VALIDATOR_SET_UPDATES, EVENT_VOTE,
+    )
+
+    if item.type == EVENT_NEW_BLOCK:
+        return {"type": "tendermint/event/NewBlock", "value": {
+            "block": _block_json(item.data["block"]),
+            "block_id": _block_id_json(item.data["block_id"]),
+        }}
+    if item.type == EVENT_NEW_BLOCK_HEADER:
+        return {"type": "tendermint/event/NewBlockHeader", "value": {
+            "header": _header_json(item.data["header"]),
+        }}
+    if item.type == EVENT_TX:
+        txr = item.data["tx_result"]
+        return {"type": "tendermint/event/Tx", "value": {"TxResult": {
+            "height": str(txr.height), "index": txr.index,
+            "tx": _b64(txr.tx), "result": _deliver_tx_json(txr.result),
+        }}}
+    if item.type == EVENT_VOTE:
+        v = item.data["vote"]
+        return {"type": "tendermint/event/Vote", "value": {
+            "height": str(v.height), "round": v.round, "type": v.type,
+            "validator_address": _hex(v.validator_address),
+        }}
+    if item.type == EVENT_VALIDATOR_SET_UPDATES:
+        return {"type": "tendermint/event/ValidatorSetUpdates", "value": {
+            "validator_updates": [{
+                "address": _hex(v.address), "power": str(v.voting_power),
+            } for v in item.data["validator_updates"]],
+        }}
+    return {"type": f"tendermint/event/{item.type}", "value": {}}
+
+
 def _ns_to_rfc3339(ns: int) -> str:
     secs, rem = divmod(ns, 1_000_000_000)
     t = time.gmtime(secs)
@@ -474,6 +512,31 @@ def build_routes(env: Environment) -> dict:
             }
         return out
 
+    def block_search(query="", page="1", per_page="30", order_by="asc"):
+        """rpc/core/blocks.go BlockSearch over the block-event indexer."""
+        from tmtpu.libs.pubsub_query import QueryError
+
+        indexer = getattr(node, "block_indexer", None)
+        if indexer is None:
+            raise RPCError(-32603, "block indexing is disabled")
+        try:
+            heights = sorted(indexer.search(query))
+        except QueryError as e:
+            raise RPCError(-32602, "invalid query", str(e))
+        if order_by == "desc":
+            heights.reverse()
+        p, pp = max(1, int(page)), min(100, max(1, int(per_page)))
+        chunk = heights[(p - 1) * pp: p * pp]
+        blocks = []
+        for h in chunk:
+            meta = env.block_store.load_block_meta(h)
+            blk = env.block_store.load_block(h)
+            if blk is None:
+                continue
+            blocks.append({"block_id": _block_id_json(meta.block_id),
+                           "block": _block_json(blk)})
+        return {"blocks": blocks, "total_count": str(len(heights))}
+
     def tx_search(query="", prove=False, page="1", per_page="30",
                   order_by="asc"):
         indexer = getattr(node, "tx_indexer", None)
@@ -508,5 +571,5 @@ def build_routes(env: Environment) -> dict:
         "broadcast_tx_commit": broadcast_tx_commit,
         "abci_query": abci_query, "abci_info": abci_info,
         "broadcast_evidence": broadcast_evidence,
-        "tx": tx, "tx_search": tx_search,
+        "tx": tx, "tx_search": tx_search, "block_search": block_search,
     }

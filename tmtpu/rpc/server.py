@@ -16,7 +16,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from tmtpu.abci import types as abci
-from tmtpu.rpc import core
+from tmtpu.rpc import core, websocket
 from tmtpu.version import TMCoreSemVer
 
 
@@ -80,6 +80,21 @@ class RPCServer:
             def do_GET(self):
                 parsed = urllib.parse.urlparse(self.path)
                 method = parsed.path.lstrip("/")
+                if method == "websocket" and \
+                        websocket.is_websocket_upgrade(self.headers):
+                    self._upgrade_websocket()
+                    return
+                if method == "metrics":
+                    from tmtpu.libs import metrics as _metrics
+
+                    body = _metrics.render_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if method == "":
                     # route list, like the reference's index page
                     self._respond({"jsonrpc": "2.0", "id": -1,
@@ -92,6 +107,24 @@ class RPCServer:
                         v = v[1:-1]
                     params[k] = v
                 self._respond(self._run(method, params, -1))
+
+            def _upgrade_websocket(self):
+                """RFC 6455 server handshake, then hand the socket to a
+                WSSession (ws_handler.go)."""
+                key = self.headers.get("Sec-WebSocket-Key", "")
+                if not key:
+                    self.send_error(400, "missing Sec-WebSocket-Key")
+                    return
+                self.send_response(101, "Switching Protocols")
+                self.send_header("Upgrade", "websocket")
+                self.send_header("Connection", "Upgrade")
+                self.send_header("Sec-WebSocket-Accept",
+                                 websocket.handshake_accept_key(key))
+                self.end_headers()
+                self.close_connection = True
+                session = websocket.WSSession(self, env, routes,
+                                              core.event_data_json)
+                session.serve()
 
             def do_POST(self):
                 n = int(self.headers.get("Content-Length", 0))

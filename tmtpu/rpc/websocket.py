@@ -1,0 +1,249 @@
+"""WebSocket endpoint for event subscriptions (reference:
+rpc/jsonrpc/server/ws_handler.go + rpc/core/events.go).
+
+Implements the server side of RFC 6455 directly over the HTTP handler's
+socket: handshake, frame codec (client frames are masked), ping/pong, and
+the subscribe/unsubscribe/unsubscribe_all JSON-RPC methods whose matches
+are pushed as JSON-RPC responses with the subscription's original id
+(the reference's convention: the client correlates events by request id).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import struct
+import threading
+from typing import Dict, Optional
+
+from tmtpu.libs.pubsub_query import Query, QueryError
+
+_WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+OP_TEXT = 0x1
+OP_CLOSE = 0x8
+OP_PING = 0x9
+OP_PONG = 0xA
+
+
+def is_websocket_upgrade(headers) -> bool:
+    return "websocket" in (headers.get("Upgrade", "").lower())
+
+
+def handshake_accept_key(key: str) -> str:
+    return base64.b64encode(
+        hashlib.sha1((key + _WS_GUID).encode()).digest()).decode()
+
+
+def write_frame(sock, opcode: int, payload: bytes) -> None:
+    n = len(payload)
+    hdr = bytearray([0x80 | opcode])
+    if n < 126:
+        hdr.append(n)
+    elif n < 1 << 16:
+        hdr.append(126)
+        hdr += struct.pack(">H", n)
+    else:
+        hdr.append(127)
+        hdr += struct.pack(">Q", n)
+    sock.sendall(bytes(hdr) + payload)
+
+
+def _read_raw_frame(rfile):
+    """One frame: (fin, opcode, payload) or None on EOF."""
+    b0 = rfile.read(1)
+    if not b0:
+        return None
+    b1 = rfile.read(1)
+    if not b1:
+        return None
+    fin = bool(b0[0] & 0x80)
+    opcode = b0[0] & 0x0F
+    masked = b1[0] & 0x80
+    n = b1[0] & 0x7F
+    if n == 126:
+        n = struct.unpack(">H", rfile.read(2))[0]
+    elif n == 127:
+        n = struct.unpack(">Q", rfile.read(8))[0]
+    if n > 16 * 1024 * 1024:
+        return None
+    mask = rfile.read(4) if masked else b"\x00" * 4
+    data = rfile.read(n)
+    if masked:
+        data = bytes(b ^ mask[i % 4] for i, b in enumerate(data))
+    return fin, opcode, data
+
+
+def read_frame(rfile):
+    """Returns a complete (opcode, payload) message, reassembling
+    RFC 6455 fragmentation (FIN=0 + continuation frames); None on EOF."""
+    first = _read_raw_frame(rfile)
+    if first is None:
+        return None
+    fin, opcode, data = first
+    parts = [data]
+    while not fin:
+        nxt = _read_raw_frame(rfile)
+        if nxt is None:
+            return None
+        fin, cont_op, chunk = nxt
+        if cont_op != 0x0:  # interleaved control frame: handle solo
+            return cont_op, chunk
+        parts.append(chunk)
+    return opcode, b"".join(parts)
+
+
+class WSSession:
+    """One connected websocket client: its subscriptions + write lock."""
+
+    def __init__(self, handler, env, routes, event_encoder):
+        self.handler = handler
+        self.sock = handler.connection
+        self.rfile = handler.rfile
+        self.env = env
+        self.routes = routes
+        self.event_encoder = event_encoder
+        self._write_lock = threading.Lock()
+        self._subs: Dict[str, tuple] = {}  # query str -> (sub, thread, id)
+        self._closed = threading.Event()
+        self.remote = f"{handler.client_address[0]}:{handler.client_address[1]}"
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _send_json(self, obj) -> None:
+        with self._write_lock:
+            write_frame(self.sock, OP_TEXT,
+                        json.dumps(obj).encode())
+
+    def _respond(self, req_id, result=None, error=None) -> None:
+        msg = {"jsonrpc": "2.0", "id": req_id}
+        if error is not None:
+            msg["error"] = error
+        else:
+            msg["result"] = result
+        try:
+            self._send_json(msg)
+        except OSError:
+            self.close()
+
+    # -- main loop ----------------------------------------------------------
+
+    def serve(self) -> None:
+        """ws_handler.go readRoutine — blocks until the client leaves."""
+        try:
+            while not self._closed.is_set():
+                frame = read_frame(self.rfile)
+                if frame is None:
+                    break
+                opcode, payload = frame
+                if opcode == OP_CLOSE:
+                    break
+                if opcode == OP_PING:
+                    with self._write_lock:
+                        write_frame(self.sock, OP_PONG, payload)
+                    continue
+                if opcode != OP_TEXT:
+                    continue
+                try:
+                    req = json.loads(payload)
+                except json.JSONDecodeError:
+                    self._respond(-1, error={"code": -32700,
+                                             "message": "Parse error"})
+                    continue
+                self._handle(req)
+        except OSError:
+            pass
+        finally:
+            self.close()
+
+    def _handle(self, req: dict) -> None:
+        method = req.get("method", "")
+        params = req.get("params") or {}
+        req_id = req.get("id", -1)
+        if method == "subscribe":
+            self._subscribe(params.get("query", ""), req_id)
+        elif method == "unsubscribe":
+            self._unsubscribe(params.get("query", ""), req_id)
+        elif method == "unsubscribe_all":
+            for q in list(self._subs):
+                self._do_unsubscribe(q)
+            self._respond(req_id, result={})
+        else:
+            fn = self.routes.get(method)
+            if fn is None:
+                self._respond(req_id, error={"code": -32601,
+                                             "message": "Method not found"})
+                return
+            try:
+                self._respond(req_id, result=fn(**params))
+            except Exception as e:  # noqa: BLE001
+                self._respond(req_id, error={"code": -32603,
+                                             "message": str(e)})
+
+    # -- subscriptions (rpc/core/events.go Subscribe) ------------------------
+
+    def _subscribe(self, query_str: str, req_id) -> None:
+        if len(self._subs) >= 5:  # max_subscriptions_per_client default
+            self._respond(req_id, error={
+                "code": -32603, "message": "max subscriptions reached"})
+            return
+        try:
+            q = Query(query_str)
+        except QueryError as e:
+            self._respond(req_id, error={"code": -32602,
+                                         "message": f"bad query: {e}"})
+            return
+        if query_str in self._subs:
+            self._respond(req_id, error={"code": -32603,
+                                         "message": "already subscribed"})
+            return
+        sub = self.env.event_bus.subscribe(
+            f"ws-{self.remote}-{query_str}",
+            lambda item: q.matches(item.events))
+        t = threading.Thread(target=self._pump, args=(sub, q, req_id),
+                             daemon=True, name=f"ws-pump-{self.remote}")
+        self._subs[query_str] = (sub, t, req_id)
+        # ack BEFORE events can flow: clients correlate the first response
+        # with this id as the subscribe result
+        self._respond(req_id, result={})
+        t.start()
+
+    def _pump(self, sub, q: Query, req_id) -> None:
+        while not self._closed.is_set() and not sub.canceled.is_set():
+            item = sub.next(timeout=0.5)
+            if item is None:
+                continue
+            try:
+                self._respond(req_id, result={
+                    "query": str(q),
+                    "data": self.event_encoder(item),
+                    "events": item.events,
+                })
+            except OSError:
+                self.close()
+                return
+
+    def _unsubscribe(self, query_str: str, req_id) -> None:
+        if query_str not in self._subs:
+            self._respond(req_id, error={"code": -32603,
+                                         "message": "subscription not found"})
+            return
+        self._do_unsubscribe(query_str)
+        self._respond(req_id, result={})
+
+    def _do_unsubscribe(self, query_str: str) -> None:
+        sub, _t, _id = self._subs.pop(query_str, (None, None, None))
+        if sub is not None:
+            self.env.event_bus.unsubscribe(sub)
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        for q in list(self._subs):
+            self._do_unsubscribe(q)
+        try:
+            self.sock.close()
+        except OSError:
+            pass

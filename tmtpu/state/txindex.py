@@ -78,6 +78,44 @@ class KVTxIndexer:
         return out
 
 
+class KVBlockIndexer:
+    """Block-event indexer (reference: state/indexer/block/kv) — stores the
+    composite event map per height; /block_search matches it with the
+    pubsub query language."""
+
+    def __init__(self, db: DB):
+        self.db = db
+
+    def index(self, height: int, events: dict) -> None:
+        import json
+
+        self.db.set(b"blkevt:%020d" % height,
+                    json.dumps(events).encode())
+
+    def search(self, query: str) -> List[int]:
+        import json
+        import re
+
+        from tmtpu.libs.pubsub_query import Query
+
+        q = Query(query)
+        # fast path: a block.height=N equality narrows the scan to one key
+        # (the common /block_search shape); everything else is a full scan
+        # with the query matcher — the reference's kv block indexer keys
+        # per attribute, worth doing if block_search gets hot
+        m = re.fullmatch(r"\s*block\.height\s*=\s*(\d+)\s*", query)
+        if m is not None:
+            h = int(m.group(1))
+            raw = self.db.get(b"blkevt:%020d" % h)
+            return [h] if raw is not None else []
+        out = []
+        for k, raw in self.db.iter_prefix(b"blkevt:"):
+            events = json.loads(raw)
+            if q.matches(events):
+                out.append(int(k[len(b"blkevt:"):]))
+        return out
+
+
 class NullTxIndexer:
     def index(self, txr) -> None:
         pass
@@ -93,26 +131,38 @@ class IndexerService:
     """state/txindex/indexer_service.go — subscribes to the bus and feeds
     the indexer."""
 
-    def __init__(self, indexer, event_bus):
+    def __init__(self, indexer, event_bus, block_indexer=None):
         self.indexer = indexer
+        self.block_indexer = block_indexer
         self.event_bus = event_bus
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._sub = None
 
     def start(self) -> None:
-        from tmtpu.types.event_bus import EVENT_TX
+        from tmtpu.types.event_bus import EVENT_NEW_BLOCK, EVENT_TX
 
-        self._sub = self.event_bus.subscribe_type("indexer", EVENT_TX)
+        self._sub = self.event_bus.subscribe(
+            "indexer",
+            lambda item: item.type in (EVENT_TX, EVENT_NEW_BLOCK))
 
         def run():
+            from tmtpu.types.event_bus import EVENT_NEW_BLOCK as _NB
+
             while not self._stop.is_set():
                 item = self._sub.next(timeout=0.2)
-                if item is not None:
-                    try:
+                if item is None:
+                    continue
+                try:
+                    if item.type == _NB:
+                        if self.block_indexer is not None:
+                            self.block_indexer.index(
+                                item.data["block"].header.height,
+                                item.events)
+                    else:
                         self.indexer.index(item.data["tx_result"])
-                    except Exception:
-                        pass
+                except Exception:
+                    pass
 
         self._thread = threading.Thread(target=run, daemon=True,
                                         name="tx-indexer")

@@ -54,6 +54,17 @@ class Subscription:
             return None
 
 
+def _merge_abci_events(out: dict, abci_events) -> None:
+    """{type.key: [values...]} from ABCI Event lists (events.go)."""
+    for ev in abci_events or []:
+        if not ev.type:
+            continue
+        for attr in ev.attributes:
+            key = f"{ev.type}.{attr.key.decode('utf-8', 'replace')}"
+            out.setdefault(key, []).append(
+                attr.value.decode("utf-8", "replace"))
+
+
 class EventBus:
     def __init__(self):
         self._subs: List[Subscription] = []
@@ -97,28 +108,46 @@ class EventBus:
                 pass
 
     # -- typed publishers (event_bus.go:134-233) ----------------------------
+    # Each publisher attaches the composite event map the pubsub query
+    # language matches against (event_bus.go validateAndStringifyEvents +
+    # the implicit tm.event key).
 
     def publish_new_block(self, block, block_id, result_begin_block,
                           result_end_block) -> None:
+        events = {"tm.event": [EVENT_NEW_BLOCK],
+                  "block.height": [str(block.header.height)]}
+        for res in (result_begin_block, result_end_block):
+            _merge_abci_events(events, getattr(res, "events", None))
         self._publish(EventItem(EVENT_NEW_BLOCK, {
             "block": block, "block_id": block_id,
             "result_begin_block": result_begin_block,
             "result_end_block": result_end_block,
-        }))
+        }, events))
 
     def publish_new_block_header(self, header) -> None:
-        self._publish(EventItem(EVENT_NEW_BLOCK_HEADER, {"header": header}))
+        self._publish(EventItem(EVENT_NEW_BLOCK_HEADER, {"header": header},
+                                {"tm.event": [EVENT_NEW_BLOCK_HEADER],
+                                 "header.height": [str(header.height)]}))
 
     def publish_vote(self, vote) -> None:
-        self._publish(EventItem(EVENT_VOTE, {"vote": vote}))
+        self._publish(EventItem(EVENT_VOTE, {"vote": vote},
+                                {"tm.event": [EVENT_VOTE]}))
 
     def publish_tx(self, tx_result, events: Optional[dict] = None) -> None:
-        self._publish(EventItem(EVENT_TX, {"tx_result": tx_result},
-                                events or {}))
+        if events is None:
+            from tmtpu.types.tx import tx_hash
+
+            events = {"tm.event": [EVENT_TX],
+                      "tx.hash": [tx_hash(tx_result.tx).hex().upper()],
+                      "tx.height": [str(tx_result.height)]}
+            _merge_abci_events(events,
+                               getattr(tx_result.result, "events", None))
+        self._publish(EventItem(EVENT_TX, {"tx_result": tx_result}, events))
 
     def publish_validator_set_updates(self, updates) -> None:
         self._publish(EventItem(EVENT_VALIDATOR_SET_UPDATES,
-                                {"validator_updates": updates}))
+                                {"validator_updates": updates},
+                                {"tm.event": [EVENT_VALIDATOR_SET_UPDATES]}))
 
     def publish_new_round_step(self, rs) -> None:
         self._publish(EventItem(EVENT_NEW_ROUND_STEP, {"round_state": rs}))
