@@ -104,16 +104,21 @@ def test_consensus_commits_blocks_on_tpu_backend(monkeypatch):
     monkeypatch.setattr(crypto_batch, "_default_backend", "tpu")
     monkeypatch.setattr(crypto_batch, "_tpu_usable", True)
 
-    # pre-warm the two bucket-8 device graphs (verify, verify+tally) so the
-    # ~60s-per-graph CPU compiles don't eat the consensus timeouts mid-round
+    # pre-warm EVERY bucket shape this net can hit (batches of 1..4 votes
+    # with MIN_BATCH=1 → buckets 1/2/4, plus 8 for headroom) for both
+    # verify and verify+tally: a ~30-60s CPU compile landing mid-round
+    # would otherwise eat the consensus timeouts and flake the test under
+    # full-suite load
     vals, pvs = mk_valset(1)
     warm = mk_vote(pvs[0], vals, 0)
     for fn in ("verify", "verify_tally"):
-        bv = crypto_batch.new_batch_verifier("tpu")
-        bv.add(vals.validators[0].pub_key, warm.sign_bytes(CHAIN_ID),
-               warm.signature, power=1)
-        all_ok, *_rest = getattr(bv, fn)()
-        assert all_ok
+        for lanes in (1, 2, 4, 8):
+            bv = crypto_batch.new_batch_verifier("tpu")
+            for _ in range(lanes):
+                bv.add(vals.validators[0].pub_key,
+                       warm.sign_bytes(CHAIN_ID), warm.signature, power=1)
+            all_ok, *_rest = getattr(bv, fn)()
+            assert all_ok
 
     nodes = make_network(4)
     for cs in nodes:

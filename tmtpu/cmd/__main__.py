@@ -1,8 +1,8 @@
 """CLI (reference: cmd/tendermint/main.go:15-56) —
 ``python -m tmtpu.cmd <command>``.
 
-Commands: init, start, version, show-node-id, show-validator,
-gen-validator, unsafe-reset-all, replay.
+Commands: init, start, testnet, rollback, replay, version, show-node-id,
+show-validator, gen-validator, unsafe-reset-all.
 """
 
 from __future__ import annotations
@@ -20,6 +20,16 @@ from tmtpu.config.config import Config
 
 
 def _load_config(home: str) -> Config:
+    """config.toml (reference layout) wins; legacy config.json still
+    loads; env TMTPU_<SECTION>_<FIELD> overrides either."""
+    from tmtpu.config import toml as cfg_toml
+
+    toml_path = os.path.join(os.path.expanduser(home), "config",
+                             "config.toml")
+    if os.path.exists(toml_path):
+        cfg = cfg_toml.load_config(toml_path)
+        cfg.base.home = home
+        return cfg
     cfg = Config.default()
     cfg.base.home = home
     cfg_path = os.path.join(os.path.expanduser(home), "config",
@@ -60,11 +70,12 @@ def cmd_init(args) -> int:
         print(f"Generated genesis file: {gen_path}")
     else:
         print(f"Found genesis file: {gen_path}")
-    # write default config.json if absent
-    cfg_path = os.path.join(home, "config", "config.json")
+    # write default config.toml if absent (config/toml.go writer)
+    cfg_path = os.path.join(home, "config", "config.toml")
     if not os.path.exists(cfg_path):
-        with open(cfg_path, "w") as f:
-            json.dump(cfg.to_dict(), f, indent=2)
+        from tmtpu.config import toml as cfg_toml
+
+        cfg_toml.write_config(cfg, cfg_path)
         print(f"Generated config file: {cfg_path}")
     print(f"Validator address: {pv.address().hex().upper()}")
     return 0
@@ -144,6 +155,102 @@ def cmd_unsafe_reset_all(args) -> int:
     return 0
 
 
+def cmd_show_node_id(args) -> int:
+    from tmtpu.p2p.key import NodeKey
+
+    cfg = _load_config(args.home)
+    nk = NodeKey.load_or_gen(cfg.rooted(cfg.base.node_key_file))
+    print(nk.node_id)
+    return 0
+
+
+def cmd_rollback(args) -> int:
+    """rollback — state back one height (commands/rollback.go)."""
+    from tmtpu.cmd.__main__ import _load_config  # self-import safe
+    from tmtpu.state.rollback import RollbackError, rollback
+    from tmtpu.state.store import StateStore
+    from tmtpu.store.block_store import BlockStore
+    from tmtpu.libs.db import SQLiteDB
+
+    cfg = _load_config(args.home)
+    if cfg.base.db_backend != "sqlite":
+        print("rollback requires a persistent (sqlite) db_backend",
+              file=sys.stderr)
+        return 1
+    data = cfg.rooted(cfg.base.db_dir)
+    bs = BlockStore(SQLiteDB(os.path.join(data, "blockstore.sqlite")))
+    ss = StateStore(SQLiteDB(os.path.join(data, "state.sqlite")))
+    try:
+        height, app_hash = rollback(bs, ss)
+    except RollbackError as e:
+        print(f"rollback failed: {e}", file=sys.stderr)
+        return 1
+    print(f"Rolled back state to height {height} and hash "
+          f"{app_hash.hex().upper()}")
+    return 0
+
+
+def cmd_replay(args) -> int:
+    """replay — re-sync the app from the block store via handshake
+    (commands/replay.go)."""
+    from tmtpu.node.node import Node
+
+    cfg = _load_config(args.home)
+    cfg.rpc.laddr = ""
+    cfg.p2p.laddr = ""
+    node = Node(cfg)  # the constructor's handshake IS the replay
+    print(f"Replayed to height {node.state.last_block_height}, app hash "
+          f"{node.state.app_hash.hex().upper()}")
+    node.stop()
+    return 0
+
+
+def cmd_testnet(args) -> int:
+    """testnet — N validator home dirs wired full-mesh
+    (commands/testnet.go)."""
+    from tmtpu.config import toml as cfg_toml
+    from tmtpu.privval.file_pv import FilePV
+    from tmtpu.p2p.key import NodeKey
+    from tmtpu.types.genesis import GenesisDoc, GenesisValidator
+
+    out = os.path.expanduser(args.output_dir)
+    n = args.validators
+    base_p2p, base_rpc = args.starting_port, args.starting_port + 1000
+    pvs, node_ids = [], []
+    homes = []
+    for i in range(n):
+        home = os.path.join(out, f"node{i}")
+        os.makedirs(os.path.join(home, "config"), exist_ok=True)
+        os.makedirs(os.path.join(home, "data"), exist_ok=True)
+        homes.append(home)
+        cfg = Config.default()
+        cfg.base.home = home
+        pvs.append(FilePV.load_or_generate(
+            cfg.rooted(cfg.base.priv_validator_key_file),
+            cfg.rooted(cfg.base.priv_validator_state_file)))
+        node_ids.append(NodeKey.load_or_gen(
+            cfg.rooted(cfg.base.node_key_file)).node_id)
+    gen = GenesisDoc(
+        chain_id=args.chain_id or f"testnet-{os.urandom(3).hex()}",
+        genesis_time=time.time_ns(),
+        validators=[GenesisValidator(pv.get_pub_key(), 1) for pv in pvs],
+    )
+    peers = [f"{node_ids[i]}@127.0.0.1:{base_p2p + i}" for i in range(n)]
+    for i, home in enumerate(homes):
+        cfg = Config.default()
+        cfg.base.home = home
+        cfg.base.moniker = f"node{i}"
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{base_p2p + i}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{base_rpc + i}"
+        cfg.p2p.persistent_peers = ",".join(
+            p for j, p in enumerate(peers) if j != i)
+        gen.save_as(cfg.genesis_path)
+        cfg_toml.write_config(
+            cfg, os.path.join(home, "config", "config.toml"))
+    print(f"Successfully initialized {n} node directories in {out}")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tmtpu",
                                 description="TPU-native BFT consensus node")
@@ -172,6 +279,23 @@ def main(argv=None) -> int:
 
     sp = sub.add_parser("unsafe-reset-all")
     sp.set_defaults(fn=cmd_unsafe_reset_all)
+
+    sp = sub.add_parser("show-node-id")
+    sp.set_defaults(fn=cmd_show_node_id)
+
+    sp = sub.add_parser("rollback", help="roll state back one height")
+    sp.set_defaults(fn=cmd_rollback)
+
+    sp = sub.add_parser("replay", help="re-sync the app from the stores")
+    sp.set_defaults(fn=cmd_replay)
+
+    sp = sub.add_parser("testnet", help="generate N validator home dirs")
+    sp.add_argument("--validators", type=int, default=4)
+    sp.add_argument("--output-dir", dest="output_dir", default="./mytestnet")
+    sp.add_argument("--chain-id", default="")
+    sp.add_argument("--starting-port", dest="starting_port", type=int,
+                    default=26656)
+    sp.set_defaults(fn=cmd_testnet)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -1,0 +1,139 @@
+"""TOML config round-trip (reference: config/toml.go — template writer +
+viper loader).
+
+``write_config`` emits ``config.toml`` from the dataclass sections with
+field comments derived from defaults; ``load_config`` reads it back via
+the stdlib ``tomllib`` and overlays onto a fresh Config, so unknown keys
+fail loudly and missing keys keep their defaults. Env overrides:
+``TMTPU_<SECTION>_<FIELD>`` (the reference's TM_ prefix convention).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tomllib
+from typing import Any
+
+from tmtpu.config.config import Config
+
+# section order mirrors the reference's template (base fields are top-level)
+_SECTIONS = ("base", "rpc", "p2p", "mempool", "consensus", "block_sync",
+             "state_sync", "storage", "tx_index", "instrumentation")
+
+
+def _toml_value(v: Any) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return str(v)
+    if isinstance(v, list):
+        return "[" + ", ".join(_toml_value(x) for x in v) + "]"
+    s = str(v).replace("\\", "\\\\").replace('"', '\\"')
+    return f'"{s}"'
+
+
+def render_config(cfg: Config) -> str:
+    lines = ["# tmtpu configuration (written by `tmtpu init`; see",
+             "# config/toml.go in the reference for the section layout)",
+             ""]
+    for section in _SECTIONS:
+        obj = getattr(cfg, section)
+        if section == "base":
+            # base fields are top-level, like the reference template
+            for f in dataclasses.fields(obj):
+                lines.append(f"{f.name} = "
+                             f"{_toml_value(getattr(obj, f.name))}")
+            lines.append("")
+            continue
+        lines.append(f"[{section}]")
+        for f in dataclasses.fields(obj):
+            lines.append(f"{f.name} = {_toml_value(getattr(obj, f.name))}")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def write_config(cfg: Config, path: str) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(render_config(cfg))
+    os.replace(tmp, path)
+
+
+def load_config(path: str, env: bool = True) -> Config:
+    with open(path, "rb") as f:
+        data = tomllib.load(f)
+    cfg = Config()
+    base_fields = {f.name for f in dataclasses.fields(cfg.base)}
+    for key, value in data.items():
+        if isinstance(value, dict):
+            if key not in _SECTIONS or key == "base":
+                raise ValueError(f"unknown config section {key!r}")
+            obj = getattr(cfg, key)
+            known = {f.name for f in dataclasses.fields(obj)}
+            for k, v in value.items():
+                if k not in known:
+                    raise ValueError(f"unknown key {key}.{k!r}")
+                setattr(obj, k, v)
+        else:
+            if key not in base_fields:
+                raise ValueError(f"unknown top-level key {key!r}")
+            setattr(cfg.base, key, value)
+    if env:
+        _apply_env_overrides(cfg)
+    validate(cfg)
+    return cfg
+
+
+def _apply_env_overrides(cfg: Config) -> None:
+    """TMTPU_P2P_LADDR=... style overrides (config.go env prefix)."""
+    for section in _SECTIONS:
+        obj = getattr(cfg, section)
+        for f in dataclasses.fields(obj):
+            env_key = f"TMTPU_{section.upper()}_{f.name.upper()}"
+            raw = os.environ.get(env_key)
+            if raw is None:
+                continue
+            cur = getattr(obj, f.name)
+            if isinstance(cur, bool):
+                setattr(obj, f.name, raw.lower() in ("1", "true", "yes"))
+            elif isinstance(cur, int):
+                setattr(obj, f.name, int(raw))
+            elif isinstance(cur, float):
+                setattr(obj, f.name, float(raw))
+            elif isinstance(cur, list):
+                setattr(obj, f.name,
+                        [x.strip() for x in raw.split(",") if x.strip()])
+            else:
+                setattr(obj, f.name, raw)
+
+
+def validate(cfg: Config) -> None:
+    """config.go ValidateBasic — the checks that catch real footguns."""
+    if cfg.base.db_backend not in ("sqlite", "mem"):
+        raise ValueError(f"unknown db_backend {cfg.base.db_backend!r}")
+    if cfg.base.crypto_backend not in ("auto", "cpu", "tpu"):
+        raise ValueError(
+            f"unknown crypto_backend {cfg.base.crypto_backend!r}")
+    if cfg.base.abci not in ("socket", "grpc", "local"):
+        raise ValueError(f"unknown abci transport {cfg.base.abci!r}")
+    for name, v in (("timeout_propose", cfg.consensus.timeout_propose_ns),
+                    ("timeout_prevote", cfg.consensus.timeout_prevote_ns),
+                    ("timeout_precommit",
+                     cfg.consensus.timeout_precommit_ns),
+                    ("timeout_commit", cfg.consensus.timeout_commit_ns)):
+        if v < 0:
+            raise ValueError(f"consensus.{name} cannot be negative")
+    if cfg.mempool.size <= 0:
+        raise ValueError("mempool.size must be positive")
+    if cfg.p2p.max_num_inbound_peers < 0 or \
+            cfg.p2p.max_num_outbound_peers < 0:
+        raise ValueError("p2p peer limits cannot be negative")
+    if cfg.state_sync.enable:
+        if not cfg.state_sync.rpc_servers:
+            raise ValueError("state_sync requires rpc_servers")
+        if cfg.state_sync.trust_height <= 0:
+            raise ValueError("state_sync requires trust_height > 0")
+        if not cfg.state_sync.trust_hash:
+            raise ValueError("state_sync requires trust_hash")

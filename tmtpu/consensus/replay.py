@@ -101,6 +101,14 @@ class Handshaker:
             raise HandshakeError(
                 f"state height {state_height} ahead of store {store_height}")
 
+        if store_height == state_height + 1 and app_height == store_height:
+            # The app committed the latest block but state didn't persist
+            # (crash between app Commit and state save, or an operator
+            # `rollback`). Replay state-only from the saved ABCI responses
+            # — re-executing the block would double-apply it to the app
+            # (replay.go:284's mockProxyApp branch).
+            return self._replay_state_only(store_height, app_hash)
+
         # replay stored blocks the app hasn't seen
         exec_ = BlockExecutor(self.state_store, proxy_app.consensus,
                               event_bus=None)
@@ -129,6 +137,33 @@ class Handshaker:
                 f"app hash mismatch after replay: app "
                 f"{app_hash.hex().upper()} != state "
                 f"{self.state.app_hash.hex().upper()}")
+        return app_hash
+
+    def _replay_state_only(self, height: int, app_hash: bytes) -> bytes:
+        """The app committed block ``height`` but state wasn't saved (crash
+        after app Commit, or operator rollback): rebuild state from the
+        SAVED ABCI responses — re-executing would double-apply the block
+        to the app (replay.go's mockProxyApp branch)."""
+        block = self.block_store.load_block(height)
+        meta = self.block_store.load_block_meta(height)
+        if block is None or meta is None:
+            raise HandshakeError(f"missing block {height} for state replay")
+        responses = self.state_store.load_abci_responses(height)
+        if responses is None:
+            raise HandshakeError(
+                f"no saved ABCI responses for height {height}; cannot "
+                f"replay state without re-executing the app")
+        from tmtpu.crypto.encoding import pubkey_from_proto
+
+        val_updates = [
+            Validator(pubkey_from_proto(vu.pub_key), vu.power)
+            for vu in responses.end_block.validator_updates
+        ]
+        new_state = update_state(self.state, meta.block_id, block.header,
+                                 responses, val_updates)
+        new_state.app_hash = app_hash
+        self.state_store.save(new_state)
+        self.state = new_state
         return app_hash
 
 

@@ -711,7 +711,10 @@ class ConsensusState(BaseService):
         self._finalize_commit(height)
 
     def _finalize_commit(self, height: int) -> None:
-        """state.go:1567."""
+        """state.go:1567 — fail points mirror the reference's crash
+        injection sites around commit (state.go:1605-1685)."""
+        from tmtpu.libs import fail
+
         rs = self.rs
         if rs.height != height or rs.step != STEP_COMMIT:
             return
@@ -719,24 +722,30 @@ class ConsensusState(BaseService):
         block_id, _ = precommits.two_thirds_majority()
         block, parts = rs.proposal_block, rs.proposal_block_parts
         self.block_exec.validate_block(self.state, block)
+        fail.fail_point()  # 0: before saving the block
         seen_commit = precommits.make_commit()
         if self.block_store.height() < block.header.height:
             self.block_store.save_block(block, parts, seen_commit)
+        fail.fail_point()  # 1: block saved, WAL has no ENDHEIGHT yet
         if self.wal is not None:
             self.wal.write_end_height(height)
+        fail.fail_point()  # 2: ENDHEIGHT written, app not yet committed
         new_state, retain_height = self.block_exec.apply_block(
             self.state, block_id, block)
+        fail.fail_point()  # 3: app committed, state saved
         if retain_height > 0:
             try:
                 self.block_store.prune_blocks(retain_height)
             except Exception:
                 pass
-        self._record_metrics(block, rs.commit_round, new_state)
+        self._record_metrics(block, rs.proposal_block_parts,
+                             rs.commit_round, new_state)
         self.update_to_state(new_state)
         self._schedule_round0()
         self._done_first_block.set()
 
-    def _record_metrics(self, block, commit_round: int, new_state) -> None:
+    def _record_metrics(self, block, parts, commit_round: int,
+                        new_state) -> None:
         """consensus/metrics.go:18 metric set, updated per commit."""
         from tmtpu.libs import metrics as m
 
@@ -744,7 +753,8 @@ class ConsensusState(BaseService):
         m.consensus_rounds.set(commit_round)
         m.consensus_num_txs.set(len(block.txs))
         m.consensus_total_txs.inc(len(block.txs))
-        m.consensus_block_size.set(len(block.encode()))
+        if parts is not None:  # avoid a second full block encode
+            m.consensus_block_size.set(parts.byte_size())
         if new_state.validators is not None:
             m.consensus_validators.set(new_state.validators.size())
             m.consensus_validators_power.set(

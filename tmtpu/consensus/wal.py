@@ -67,13 +67,65 @@ class CorruptedWALError(Exception):
 
 class WAL:
     """consensus/wal.go:58 WAL interface: Write / WriteSync /
-    FlushAndSync / SearchForEndHeight."""
+    FlushAndSync / SearchForEndHeight.
 
-    def __init__(self, path: str):
+    Rotation (libs/autofile/group.go): when the head file exceeds
+    ``head_size_limit`` it is renamed to ``<path>.NNN`` and a fresh head
+    opened; at most ``max_group_files`` rotated files are kept (oldest
+    pruned), bounding disk usage for long-running nodes. Readers iterate
+    the rotated files in order, then the head.
+    """
+
+    # autofile/group.go defaultHeadSizeLimit = 10MB; we keep ~1GB total
+    DEFAULT_HEAD_SIZE_LIMIT = 10 * 1024 * 1024
+    DEFAULT_MAX_GROUP_FILES = 100
+
+    def __init__(self, path: str,
+                 head_size_limit: int = DEFAULT_HEAD_SIZE_LIMIT,
+                 max_group_files: int = DEFAULT_MAX_GROUP_FILES):
         self.path = path
+        self.head_size_limit = head_size_limit
+        self.max_group_files = max_group_files
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._f = open(path, "ab")
         self._lock = threading.Lock()
+
+    @staticmethod
+    def _group_files(path: str):
+        """Rotated files (sorted by index) for a WAL path."""
+        d = os.path.dirname(path) or "."
+        base = os.path.basename(path)
+        out = []
+        try:
+            names = os.listdir(d)
+        except FileNotFoundError:
+            return []
+        for name in names:
+            if name.startswith(base + "."):
+                suffix = name[len(base) + 1:]
+                if suffix.isdigit():
+                    out.append((int(suffix), os.path.join(d, name)))
+        return [p for _, p in sorted(out)]
+
+    def _maybe_rotate_locked(self) -> None:
+        if self._f.tell() < self.head_size_limit:
+            return
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
+        group = self._group_files(self.path)
+        next_idx = 0
+        if group:
+            next_idx = int(group[-1].rsplit(".", 1)[1]) + 1
+        os.replace(self.path, f"{self.path}.{next_idx:03d}")
+        # prune oldest beyond the cap
+        group = self._group_files(self.path)
+        for p in group[:max(0, len(group) - self.max_group_files)]:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        self._f = open(self.path, "ab")
 
     def write(self, msg: WALMessagePB) -> None:
         payload = msg.encode()
@@ -81,6 +133,7 @@ class WAL:
             protoio.encode_uvarint(len(payload)) + payload
         with self._lock:
             self._f.write(rec)
+            self._maybe_rotate_locked()
 
     def write_sync(self, msg: WALMessagePB) -> None:
         self.write(msg)
@@ -112,14 +165,30 @@ class WAL:
 
     # -- reading ------------------------------------------------------------
 
-    @staticmethod
-    def iter_messages(path: str, strict: bool = False
+    @classmethod
+    def iter_messages(cls, path: str, strict: bool = False
                       ) -> Iterator[WALMessagePB]:
-        """Decode records; a torn tail record terminates iteration (crash
-        tolerance), a mid-file corruption raises in strict mode."""
+        """Decode records across the whole group (rotated files in order,
+        then the head). A torn record in the HEAD terminates iteration
+        (crash tolerance); a torn record in a ROTATED file stops the whole
+        group there — yielding later files would hand replay a stream with
+        a silent gap."""
+        for p in cls._group_files(path):
+            status = {}
+            yield from cls._iter_one(p, strict, status)
+            if not status.get("clean"):
+                return
+        yield from cls._iter_one(path, strict)
+
+    @staticmethod
+    def _iter_one(path: str, strict: bool = False, status: dict = None
+                  ) -> Iterator[WALMessagePB]:
+        if status is None:
+            status = {}
         try:
             f = open(path, "rb")
         except FileNotFoundError:
+            status["clean"] = True  # absent file: nothing to miss
             return
         with f:
             data = f.read()
@@ -151,6 +220,7 @@ class WAL:
                 if strict:
                     raise CorruptedWALError(str(e)) from e
                 return
+        status["clean"] = True
 
     @classmethod
     def search_for_end_height(cls, path: str, height: int

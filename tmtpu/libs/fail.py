@@ -1,0 +1,44 @@
+"""Deterministic crash injection (reference: libs/fail/fail.go:28).
+
+``fail_point()`` kills the process at the Nth call when
+``FAIL_TEST_INDEX=N`` is set — the crash/replay tests kill a node at every
+point around commit (consensus/state.go:1605-1685 has 9 such points) and
+assert WAL+handshake recovery converges.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_lock = threading.Lock()
+_call_index = -1
+_env_index = None
+
+
+def _target() -> int:
+    global _env_index
+    if _env_index is None:
+        raw = os.environ.get("FAIL_TEST_INDEX", "")
+        _env_index = int(raw) if raw else -1
+    return _env_index
+
+
+def reset() -> None:
+    """Testing hook: re-read the env and restart the counter."""
+    global _call_index, _env_index
+    with _lock:
+        _call_index = -1
+        _env_index = None
+
+
+def fail_point() -> None:
+    """fail.go Fail — exits the process hard (no cleanup, like a crash)
+    when the call counter reaches FAIL_TEST_INDEX."""
+    global _call_index
+    if _target() < 0:
+        return
+    with _lock:
+        _call_index += 1
+        if _call_index == _target():
+            os._exit(88)

@@ -111,29 +111,28 @@ class Registry:
         self._metrics: Dict[str, Tuple[str, _Metric]] = {}
         self._lock = threading.Lock()
 
-    def counter(self, subsystem: str, name: str, help_: str = "",
-                labels: Tuple[str, ...] = ()) -> Counter:
-        return self._get(subsystem, name, help_, labels, Counter, "counter")
-
-    def gauge(self, subsystem: str, name: str, help_: str = "",
-              labels: Tuple[str, ...] = ()) -> Gauge:
-        return self._get(subsystem, name, help_, labels, Gauge, "gauge")
-
     def histogram(self, subsystem: str, name: str, help_: str = "",
                   labels: Tuple[str, ...] = (),
                   buckets=(0.1, 0.5, 1, 2, 5, 10, 30)) -> Histogram:
-        full = f"{_NAMESPACE}_{subsystem}_{name}"
-        with self._lock:
-            if full not in self._metrics:
-                self._metrics[full] = (
-                    "histogram", Histogram(full, help_, labels, buckets))
-            return self._metrics[full][1]
+        return self._get(
+            subsystem, name, "histogram",
+            lambda full: Histogram(full, help_, tuple(labels), buckets))
 
-    def _get(self, subsystem, name, help_, labels, cls, kind):
+    def counter(self, subsystem: str, name: str, help_: str = "",
+                labels: Tuple[str, ...] = ()) -> Counter:
+        return self._get(subsystem, name, "counter",
+                         lambda full: Counter(full, help_, tuple(labels)))
+
+    def gauge(self, subsystem: str, name: str, help_: str = "",
+              labels: Tuple[str, ...] = ()) -> Gauge:
+        return self._get(subsystem, name, "gauge",
+                         lambda full: Gauge(full, help_, tuple(labels)))
+
+    def _get(self, subsystem, name, kind, make):
         full = f"{_NAMESPACE}_{subsystem}_{name}"
         with self._lock:
             if full not in self._metrics:
-                self._metrics[full] = (kind, cls(full, help_, tuple(labels)))
+                self._metrics[full] = (kind, make(full))
             return self._metrics[full][1]
 
     def render(self) -> str:

@@ -36,12 +36,18 @@ def _parse_operand(raw: str):
     if raw.startswith("TIME "):
         t = raw[5:].strip()
         base, _, frac = t.rstrip("Z").partition(".")
-        secs = calendar.timegm(time.strptime(base, "%Y-%m-%dT%H:%M:%S"))
-        ns = int((frac or "0").ljust(9, "0")[:9])
+        try:
+            secs = calendar.timegm(time.strptime(base, "%Y-%m-%dT%H:%M:%S"))
+            ns = int((frac or "0").ljust(9, "0")[:9])
+        except ValueError as e:
+            raise QueryError(f"invalid TIME operand {t!r}: {e}") from e
         return ("time", secs * 1_000_000_000 + ns)
     if raw.startswith("DATE "):
         d = raw[5:].strip()
-        secs = calendar.timegm(time.strptime(d, "%Y-%m-%d"))
+        try:
+            secs = calendar.timegm(time.strptime(d, "%Y-%m-%d"))
+        except ValueError as e:
+            raise QueryError(f"invalid DATE operand {d!r}: {e}") from e
         return ("time", secs * 1_000_000_000)
     try:
         if "." in raw:
@@ -129,23 +135,17 @@ class Query:
 
 
 def _split_and(s: str) -> List[str]:
-    """Split on AND outside single quotes."""
-    parts, buf, in_q = [], [], False
-    i = 0
-    while i < len(s):
-        c = s[i]
-        if c == "'":
-            in_q = not in_q
-            buf.append(c)
-            i += 1
-        elif not in_q and s[i:i + 5].upper() == " AND " :
-            parts.append("".join(buf))
-            buf = []
-            i += 5
-        else:
-            buf.append(c)
-            i += 1
-    parts.append("".join(buf))
+    """Split on whitespace-delimited AND outside single quotes (any
+    whitespace counts — '\\tAND\\n' is still a separator)."""
+    parts = []
+    last = 0
+    for m in re.finditer(r"\s+AND\s+", s):
+        # inside quotes iff an odd number of quotes precede the match
+        if s.count("'", 0, m.start()) % 2 == 1:
+            continue
+        parts.append(s[last:m.start()])
+        last = m.end()
+    parts.append(s[last:])
     return [p.strip() for p in parts if p.strip()]
 
 

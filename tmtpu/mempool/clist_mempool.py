@@ -173,6 +173,9 @@ class CListMempool:
         with self._lock:
             self._txs.clear()
             self._txs_bytes = 0
+        from tmtpu.libs import metrics as _m
+
+        _m.mempool_size.set(0)
 
     def flush_app_conn(self) -> None:
         self.proxy_app.flush_sync()

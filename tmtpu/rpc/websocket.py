@@ -75,9 +75,12 @@ def _read_raw_frame(rfile):
     return fin, opcode, data
 
 
-def read_frame(rfile):
+def read_frame(rfile, on_control=None):
     """Returns a complete (opcode, payload) message, reassembling
-    RFC 6455 fragmentation (FIN=0 + continuation frames); None on EOF."""
+    RFC 6455 fragmentation (FIN=0 + continuation frames); None on EOF.
+    Control frames interleaved mid-fragmentation are dispatched to
+    ``on_control`` (pings must be answered without dropping fragments);
+    an interleaved CLOSE aborts."""
     first = _read_raw_frame(rfile)
     if first is None:
         return None
@@ -87,9 +90,16 @@ def read_frame(rfile):
         nxt = _read_raw_frame(rfile)
         if nxt is None:
             return None
-        fin, cont_op, chunk = nxt
-        if cont_op != 0x0:  # interleaved control frame: handle solo
-            return cont_op, chunk
+        nfin, cont_op, chunk = nxt
+        if cont_op >= 0x8:  # control frame interleaved in the fragments
+            if cont_op == OP_CLOSE:
+                return cont_op, chunk
+            if on_control is not None:
+                on_control(cont_op, chunk)
+            continue
+        if cont_op != 0x0:
+            return None  # protocol violation: new data frame mid-message
+        fin = nfin
         parts.append(chunk)
     return opcode, b"".join(parts)
 
@@ -131,9 +141,14 @@ class WSSession:
 
     def serve(self) -> None:
         """ws_handler.go readRoutine — blocks until the client leaves."""
+        def on_control(opcode, payload):
+            if opcode == OP_PING:
+                with self._write_lock:
+                    write_frame(self.sock, OP_PONG, payload)
+
         try:
             while not self._closed.is_set():
-                frame = read_frame(self.rfile)
+                frame = read_frame(self.rfile, on_control)
                 if frame is None:
                     break
                 opcode, payload = frame

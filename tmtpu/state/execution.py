@@ -81,8 +81,11 @@ class BlockExecutor:
     def apply_block(self, state: State, block_id: BlockID, block: Block
                     ) -> Tuple[State, int]:
         """execution.go:131 ApplyBlock. Returns (new_state, retain_height)."""
+        from tmtpu.libs import fail
+
         self.validate_block(state, block)
         abci_responses = self._exec_block_on_proxy_app(state, block)
+        fail.fail_point()  # execution.go:149 — after exec, before saving
         self.store.save_abci_responses(block.header.height, abci_responses)
 
         # validate validator updates per consensus params
@@ -101,9 +104,11 @@ class BlockExecutor:
         new_state = update_state(state, block_id, block.header,
                                  abci_responses, val_updates)
 
+        fail.fail_point()  # execution.go:180 — before app Commit
         # Commit: lock mempool, flush, app Commit, update mempool
         app_hash, retain_height = self._commit(new_state, block,
                                                abci_responses.deliver_txs)
+        fail.fail_point()  # execution.go:196 — app committed, state unsaved
         if self.evidence_pool:
             self.evidence_pool.update(new_state, block.evidence)
         new_state.app_hash = app_hash

@@ -1,0 +1,70 @@
+"""State rollback (reference: state/rollback.go) — overwrite state at
+height n with the reconstructed state at n-1. Application state is NOT
+touched (the app must roll back itself, or replay re-executes block n)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from tmtpu.state.state import State
+from tmtpu.version import BlockProtocol
+
+
+class RollbackError(Exception):
+    pass
+
+
+def rollback(block_store, state_store) -> Tuple[int, bytes]:
+    """Returns (new_height, app_hash)."""
+    invalid = state_store.load()
+    if invalid is None or invalid.is_empty():
+        raise RollbackError("no state found")
+    height = block_store.height()
+    # state and blocks don't persist atomically: a block ahead of state
+    # needs no state rollback (rollback.go:29)
+    if height == invalid.last_block_height + 1:
+        return invalid.last_block_height, invalid.app_hash
+    if height != invalid.last_block_height:
+        raise RollbackError(
+            f"statestore height ({invalid.last_block_height}) is not one "
+            f"below or equal to blockstore height ({height})")
+    rollback_height = invalid.last_block_height - 1
+    rollback_meta = block_store.load_block_meta(rollback_height)
+    if rollback_meta is None:
+        raise RollbackError(f"block at height {rollback_height} not found")
+    latest_meta = block_store.load_block_meta(invalid.last_block_height)
+    if latest_meta is None:
+        raise RollbackError(
+            f"block at height {invalid.last_block_height} not found")
+    prev_last_vals = state_store.load_validators(rollback_height)
+    if prev_last_vals is None:
+        raise RollbackError(
+            f"no validators stored for height {rollback_height}")
+    prev_params = state_store.load_consensus_params(rollback_height + 1) \
+        or invalid.consensus_params
+
+    val_change = invalid.last_height_validators_changed
+    if val_change > rollback_height:
+        val_change = rollback_height + 1
+    params_change = invalid.last_height_consensus_params_changed
+    if params_change > rollback_height:
+        params_change = rollback_height + 1
+
+    rolled = State(
+        chain_id=invalid.chain_id,
+        initial_height=invalid.initial_height,
+        last_block_height=rollback_meta.header.height,
+        last_block_id=rollback_meta.block_id,
+        last_block_time=rollback_meta.header.time,
+        next_validators=invalid.validators,
+        validators=invalid.last_validators,
+        last_validators=prev_last_vals,
+        last_height_validators_changed=val_change,
+        consensus_params=prev_params,
+        last_height_consensus_params_changed=params_change,
+        last_results_hash=latest_meta.header.last_results_hash,
+        app_hash=latest_meta.header.app_hash,
+        app_version=prev_params.app_version,
+    )
+    state_store.save(rolled)
+    return rolled.last_block_height, rolled.app_hash
