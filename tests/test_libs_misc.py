@@ -1,0 +1,265 @@
+"""Tests for the remaining inventory batch: priority mempool (v1),
+MConnection flow limiting + pong deadline, RPC client library, structured
+logger, counter app, FuzzedConnection, SecretConnection transcript
+challenge."""
+
+import io
+import threading
+import time
+
+import pytest
+
+from tmtpu.abci import types as abci
+from tmtpu.abci.example.counter import CounterApplication
+from tmtpu.libs.log import (
+    DEBUG, ERROR, INFO, Logger, parse_log_level,
+)
+from tmtpu.mempool.priority_mempool import PriorityMempool
+from tmtpu.mempool.clist_mempool import MempoolFullError
+from tmtpu.p2p.fuzz import FuzzConnConfig, FuzzedConnection
+
+
+class _PriorityApp:
+    """check_tx priority = first byte of the tx."""
+
+    def check_tx_sync(self, req):
+        return abci.ResponseCheckTx(code=0, priority=req.tx[0],
+                                    gas_wanted=1)
+
+    def flush_sync(self):
+        pass
+
+
+def test_priority_mempool_ordering_and_eviction():
+    mp = PriorityMempool(_PriorityApp(), max_txs=3)
+    mp.check_tx(bytes([5]) + b"a")
+    mp.check_tx(bytes([1]) + b"b")
+    mp.check_tx(bytes([9]) + b"c")
+    # reap: highest priority first
+    assert [t[0] for t in mp.reap_max_txs(-1)] == [9, 5, 1]
+    # full + higher priority evicts the lowest
+    mp.check_tx(bytes([7]) + b"d")
+    assert mp.size() == 3
+    assert [t[0] for t in mp.reap_max_txs(-1)] == [9, 7, 5]
+    # full + lower priority than everything resident: rejected
+    with pytest.raises(MempoolFullError):
+        mp.check_tx(bytes([0]) + b"e")
+    # update removes committed
+    mp.update(1, [bytes([9]) + b"c"], [abci.ResponseDeliverTx(code=0)])
+    assert [t[0] for t in mp.reap_max_txs(-1)] == [7, 5]
+
+
+def test_priority_mempool_fifo_within_level():
+    mp = PriorityMempool(_PriorityApp())
+    for suffix in b"abc":
+        mp.check_tx(bytes([4, suffix]))
+    assert mp.reap_max_txs(-1) == [bytes([4, s]) for s in b"abc"]
+
+
+# --- counter app -------------------------------------------------------------
+
+
+def test_counter_app_serial_nonce():
+    app = CounterApplication(serial=True)
+    assert app.deliver_tx(abci.RequestDeliverTx(tx=b"\x00")).code == 0
+    assert app.deliver_tx(abci.RequestDeliverTx(tx=b"\x01")).code == 0
+    # replay of an old nonce fails
+    assert app.deliver_tx(abci.RequestDeliverTx(tx=b"\x01")).code == 2
+    assert app.check_tx(abci.RequestCheckTx(tx=b"\x00")).code == 2
+    res = app.commit()
+    assert res.data == (2).to_bytes(8, "big")
+    q = app.query(abci.RequestQuery(path="tx"))
+    assert q.value == b"2"
+
+
+# --- logger ------------------------------------------------------------------
+
+
+def test_logger_levels_and_fields():
+    assert parse_log_level("consensus:debug,*:error") == {
+        "consensus": DEBUG, "*": ERROR}
+    buf = io.StringIO()
+    lg = Logger(out=buf, levels=parse_log_level("consensus:debug,*:error"))
+    lg.with_fields(module="p2p").info("hidden")
+    lg.with_fields(module="consensus").debug("shown", height=5)
+    out = buf.getvalue()
+    assert "hidden" not in out
+    assert "shown" in out and "height=5" in out
+
+
+def test_logger_json_format():
+    import json as _json
+
+    buf = io.StringIO()
+    lg = Logger(out=buf, fmt="json", levels={"*": INFO})
+    lg.info("committed", height=7, hash=b"\xab\xcd")
+    rec = _json.loads(buf.getvalue())
+    assert rec["msg"] == "committed" and rec["height"] == 7
+
+
+# --- fuzzed connection -------------------------------------------------------
+
+
+class _MemConn:
+    def __init__(self):
+        self.written = []
+
+    def write(self, data):
+        self.written.append(data)
+        return len(data)
+
+    def read_exact(self, n):
+        return b"\x00" * n
+
+    def close(self):
+        pass
+
+
+def test_fuzzed_connection_drops_writes_deterministically():
+    conn = _MemConn()
+    fz = FuzzedConnection(conn, FuzzConnConfig(prob_drop_rw=0.5, seed=42))
+    sent = 0
+    for _ in range(100):
+        fz.write(b"x")
+        sent += 1
+    # roughly half swallowed, none raised
+    assert 20 < len(conn.written) < 80
+    assert sent == 100
+    # delay mode never drops
+    conn2 = _MemConn()
+    fz2 = FuzzedConnection(conn2, FuzzConnConfig(
+        mode=FuzzConnConfig.MODE_DELAY, max_delay_s=0.0, seed=1))
+    for _ in range(50):
+        fz2.write(b"y")
+    assert len(conn2.written) == 50
+
+
+# --- mconnection: rate limit + pong deadline ---------------------------------
+
+
+def test_rate_limiter_throttles():
+    from tmtpu.p2p.conn.connection import _RateLimiter
+
+    rl = _RateLimiter(100_000)  # 100 kB/s, 1s burst
+    t0 = time.monotonic()
+    rl.consume(100_000)  # burst: immediate
+    assert time.monotonic() - t0 < 0.2
+    t0 = time.monotonic()
+    rl.consume(50_000)   # must wait ~0.5s for refill
+    assert time.monotonic() - t0 > 0.3
+
+
+def test_pong_timeout_disconnects():
+    from tmtpu.p2p.conn.connection import (
+        ChannelDescriptor, MConnection, Packet, PacketPing,
+    )
+
+    class _SilentConn:
+        """Accepts writes, never answers — a peer that went dark."""
+
+        def __init__(self):
+            self.ev = threading.Event()
+
+        def write(self, data):
+            return len(data)
+
+        def read_exact(self, n):
+            self.ev.wait(10)  # block forever (until closed)
+            raise ConnectionError("closed")
+
+        def close(self):
+            self.ev.set()
+
+    errors = []
+    m = MConnection(_SilentConn(), [ChannelDescriptor(0x01)],
+                    lambda ch, msg: None, lambda e: errors.append(e))
+    m.PING_INTERVAL = 0.05
+    m.PONG_TIMEOUT = 0.2
+    m.start()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and not errors:
+        time.sleep(0.05)
+    assert errors and "pong timeout" in str(errors[0])
+    assert not m.is_running()
+
+
+# --- secret connection transcript -------------------------------------------
+
+
+def test_secret_connection_transcript_challenge():
+    """The challenge must bind the sorted ephemeral keys via the merlin
+    transcript (secret_connection.go:111-135), not just the DH secret."""
+    import socket as socketlib
+
+    from tmtpu.crypto import ed25519
+    from tmtpu.p2p.conn.secret_connection import SecretConnection
+
+    a, b = socketlib.socketpair()
+    k1, k2 = ed25519.gen_priv_key(), ed25519.gen_priv_key()
+    out = {}
+
+    def server():
+        out["s"] = SecretConnection(b, k2)
+
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+    c = SecretConnection(a, k1)
+    t.join(timeout=10)
+    s = out["s"]
+    assert c.remote_pub_key.bytes() == k2.pub_key().bytes()
+    assert s.remote_pub_key.bytes() == k1.pub_key().bytes()
+    # both sides computed the identical transcript challenge
+    assert c._challenge == s._challenge
+    c.write(b"hello across the transcript")
+    assert s.read_exact(27) == b"hello across the transcript"
+
+
+# --- rpc client library (against a live node) --------------------------------
+
+
+def test_rpc_client_lib(tmp_path):
+    from tests.test_node_rpc import node  # noqa: F401
+
+    # build a one-off node rather than the fixture (module scoping)
+    import tests.test_node_rpc as tnr
+    from tmtpu.config.config import Config
+    from tmtpu.node.node import Node
+    from tmtpu.privval.file_pv import FilePV
+    from tmtpu.rpc.client import HTTPClient, WSClient
+    from tmtpu.types.genesis import GenesisDoc, GenesisValidator
+
+    home = tmp_path / "cli-node"
+    (home / "config").mkdir(parents=True)
+    (home / "data").mkdir(parents=True)
+    cfg = Config.test_config()
+    cfg.base.home = str(home)
+    cfg.base.crypto_backend = "cpu"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    pv = FilePV.load_or_generate(
+        cfg.rooted(cfg.base.priv_validator_key_file),
+        cfg.rooted(cfg.base.priv_validator_state_file))
+    gen = GenesisDoc(chain_id="cli-chain", genesis_time=time.time_ns(),
+                     validators=[GenesisValidator(pv.get_pub_key(), 10)])
+    gen.save_as(cfg.genesis_path)
+    n = Node(cfg)
+    n.start()
+    try:
+        c = HTTPClient(f"http://127.0.0.1:{n.rpc_server.port}")
+        assert c.status()["node_info"]["network"] == "cli-chain"
+        r = c.broadcast_tx_commit(b"clientkey=clientval")
+        assert r["deliver_tx"]["code"] == 0
+        h = int(r["height"])
+        assert int(c.block(h)["block"]["header"]["height"]) == h
+        assert c.validators()["total"] == "1"
+        q = c.abci_query(data="clientkey")
+        import base64 as b64
+
+        assert b64.b64decode(q["response"]["value"]) == b"clientval"
+        # ws subscription via the client lib
+        ws = WSClient(f"http://127.0.0.1:{n.rpc_server.port}")
+        ws.subscribe("tm.event='NewBlock'")
+        ev = next(ws.events(timeout=30))
+        assert ev["data"]["type"] == "tendermint/event/NewBlock"
+        ws.close()
+    finally:
+        n.stop()

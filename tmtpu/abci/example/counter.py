@@ -1,0 +1,73 @@
+"""Counter example app (reference: abci/example/counter/counter.go) —
+txs must be the big-endian encoding of the next counter value when
+``serial`` is on; AppHash is the count."""
+
+from __future__ import annotations
+
+import struct
+
+from tmtpu.abci import types as abci
+
+
+class CounterApplication(abci.Application):
+    def __init__(self, serial: bool = False):
+        self.serial = serial
+        self.hash_count = 0
+        self.tx_count = 0
+
+    def info(self, req: abci.RequestInfo) -> abci.ResponseInfo:
+        return abci.ResponseInfo(
+            data=f"{{\"hashes\":{self.hash_count},\"txs\":{self.tx_count}}}")
+
+    def set_option(self, req: abci.RequestSetOption
+                   ) -> abci.ResponseSetOption:
+        if req.key == "serial":
+            self.serial = req.value == "on"
+        return abci.ResponseSetOption()
+
+    def _tx_value(self, tx: bytes) -> int:
+        if len(tx) > 8:
+            raise ValueError(f"max tx size is 8 bytes, got {len(tx)}")
+        return int.from_bytes(tx, "big")
+
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        if self.serial:
+            try:
+                v = self._tx_value(bytes(req.tx))
+            except ValueError as e:
+                return abci.ResponseCheckTx(code=1, log=str(e))
+            if v < self.tx_count:
+                return abci.ResponseCheckTx(
+                    code=2, log=f"invalid nonce: got {v}, expected >= "
+                                f"{self.tx_count}")
+        return abci.ResponseCheckTx(code=abci.CODE_TYPE_OK)
+
+    def deliver_tx(self, req: abci.RequestDeliverTx
+                   ) -> abci.ResponseDeliverTx:
+        if self.serial:
+            try:
+                v = self._tx_value(bytes(req.tx))
+            except ValueError as e:
+                return abci.ResponseDeliverTx(code=1, log=str(e))
+            if v != self.tx_count:
+                return abci.ResponseDeliverTx(
+                    code=2, log=f"invalid nonce: got {v}, expected "
+                                f"{self.tx_count}")
+        self.tx_count += 1
+        return abci.ResponseDeliverTx(code=abci.CODE_TYPE_OK)
+
+    def commit(self) -> abci.ResponseCommit:
+        self.hash_count += 1
+        if self.tx_count == 0:
+            return abci.ResponseCommit()
+        return abci.ResponseCommit(data=struct.pack(">q", self.tx_count))
+
+    def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
+        if req.path == "hash":
+            value = str(self.hash_count).encode()
+        elif req.path == "tx":
+            value = str(self.tx_count).encode()
+        else:
+            return abci.ResponseQuery(
+                code=1, log=f"invalid query path: {req.path!r}")
+        return abci.ResponseQuery(code=abci.CODE_TYPE_OK, value=value)

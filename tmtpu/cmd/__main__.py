@@ -44,6 +44,7 @@ def _load_config(home: str) -> Config:
             for k, v in vals.items():
                 if hasattr(obj, k):
                     setattr(obj, k, v)
+    cfg_toml._apply_env_overrides(cfg)  # env wins on every config path
     return cfg
 
 
@@ -166,7 +167,6 @@ def cmd_show_node_id(args) -> int:
 
 def cmd_rollback(args) -> int:
     """rollback — state back one height (commands/rollback.go)."""
-    from tmtpu.cmd.__main__ import _load_config  # self-import safe
     from tmtpu.state.rollback import RollbackError, rollback
     from tmtpu.state.store import StateStore
     from tmtpu.store.block_store import BlockStore

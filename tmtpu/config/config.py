@@ -51,6 +51,7 @@ class ConsensusConfig:
 class MempoolConfig:
     """config/config.go:686."""
 
+    version: str = "v0"  # "v0" (FIFO) | "v1" (priority)
     size: int = 5000
     max_txs_bytes: int = 1 << 30
     cache_size: int = 10000

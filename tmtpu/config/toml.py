@@ -29,8 +29,10 @@ def _toml_value(v: Any) -> str:
         return str(v)
     if isinstance(v, list):
         return "[" + ", ".join(_toml_value(x) for x in v) + "]"
-    s = str(v).replace("\\", "\\\\").replace('"', '\\"')
-    return f'"{s}"'
+    import json as _json
+
+    # JSON string escaping (incl. \n and control chars) is TOML-compatible
+    return _json.dumps(str(v))
 
 
 def render_config(cfg: Config) -> str:
@@ -127,6 +129,8 @@ def validate(cfg: Config) -> None:
             raise ValueError(f"consensus.{name} cannot be negative")
     if cfg.mempool.size <= 0:
         raise ValueError("mempool.size must be positive")
+    if cfg.mempool.version not in ("v0", "v1"):
+        raise ValueError(f"unknown mempool.version {cfg.mempool.version!r}")
     if cfg.p2p.max_num_inbound_peers < 0 or \
             cfg.p2p.max_num_outbound_peers < 0:
         raise ValueError("p2p peer limits cannot be negative")

@@ -1,0 +1,115 @@
+"""Structured leveled logger (reference: libs/log — go-kit style).
+
+Key-value structured logging with per-module levels, plain or JSON
+output (``log_format`` config), and ``with_fields`` child loggers:
+
+    log = logger.with_fields(module="consensus")
+    log.info("committed block", height=42, hash=h)
+
+Levels parse from the reference's ``ParseLogLevel`` syntax:
+``"consensus:debug,p2p:info,*:error"``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from typing import Dict, Optional, TextIO
+
+DEBUG, INFO, ERROR, NONE = 0, 1, 2, 3
+_NAMES = {DEBUG: "debug", INFO: "info", ERROR: "error", "none": NONE}
+_BY_NAME = {"debug": DEBUG, "info": INFO, "error": ERROR, "none": NONE}
+
+
+def parse_log_level(spec: str, default: int = INFO) -> Dict[str, int]:
+    """log/filter.go ParseLogLevel: "module:level,..." with '*' default."""
+    out = {"*": default}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            mod, _, lvl = part.partition(":")
+        else:
+            mod, lvl = "*", part
+        if lvl not in _BY_NAME:
+            raise ValueError(f"unknown log level {lvl!r}")
+        out[mod] = _BY_NAME[lvl]
+    return out
+
+
+class Logger:
+    def __init__(self, out: Optional[TextIO] = None, fmt: str = "plain",
+                 levels: Optional[Dict[str, int]] = None, **fields):
+        self.out = out or sys.stderr
+        self.fmt = fmt
+        self.levels = levels or {"*": INFO}
+        self.fields = fields
+        self._lock = threading.Lock()
+
+    def with_fields(self, **fields) -> "Logger":
+        merged = dict(self.fields)
+        merged.update(fields)
+        lg = Logger(self.out, self.fmt, self.levels, **merged)
+        lg._lock = self._lock  # share the write lock
+        return lg
+
+    def _enabled(self, level: int) -> bool:
+        mod = self.fields.get("module", "*")
+        return level >= self.levels.get(mod, self.levels.get("*", INFO))
+
+    def _emit(self, level: int, msg: str, kv: dict) -> None:
+        if not self._enabled(level):
+            return
+        record = dict(self.fields)
+        record.update(kv)
+        ts = time.strftime("%Y-%m-%dT%H:%M:%S")
+        if self.fmt == "json":
+            record.update(level=_NAMES.get(level, "?"), ts=ts, msg=msg)
+            line = json.dumps(record, default=str)
+        else:
+            pairs = " ".join(f"{k}={_fmt_v(v)}" for k, v in record.items())
+            line = f"{ts[-8:]} {_NAMES.get(level, '?').upper():5s} " \
+                   f"{msg:40s} {pairs}".rstrip()
+        with self._lock:
+            self.out.write(line + "\n")
+
+    def debug(self, msg: str, **kv) -> None:
+        self._emit(DEBUG, msg, kv)
+
+    def info(self, msg: str, **kv) -> None:
+        self._emit(INFO, msg, kv)
+
+    def error(self, msg: str, **kv) -> None:
+        self._emit(ERROR, msg, kv)
+
+
+def _fmt_v(v) -> str:
+    if isinstance(v, bytes):
+        return v.hex().upper()[:16]
+    s = str(v)
+    return f'"{s}"' if " " in s else s
+
+
+class NopLogger(Logger):
+    def __init__(self):
+        super().__init__(levels={"*": NONE})
+
+    def _emit(self, level, msg, kv):
+        pass
+
+
+_default = Logger()
+
+
+def default_logger() -> Logger:
+    return _default
+
+
+def configure(level_spec: str = "", fmt: str = "plain",
+              out: Optional[TextIO] = None) -> Logger:
+    global _default
+    _default = Logger(out, fmt, parse_log_level(level_spec))
+    return _default

@@ -139,7 +139,7 @@ def _split_and(s: str) -> List[str]:
     whitespace counts — '\\tAND\\n' is still a separator)."""
     parts = []
     last = 0
-    for m in re.finditer(r"\s+AND\s+", s):
+    for m in re.finditer(r"\s+AND\s+", s, re.IGNORECASE):
         # inside quotes iff an odd number of quotes precede the match
         if s.count("'", 0, m.start()) % 2 == 1:
             continue

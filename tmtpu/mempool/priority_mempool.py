@@ -1,0 +1,199 @@
+"""Priority mempool — v1 (reference: mempool/v1/mempool.go).
+
+Same Mempool interface as CListMempool, but ordered by the per-tx
+priority the app returns from CheckTx (ResponseCheckTx.priority):
+
+- ``reap_max_bytes_max_gas`` serves highest-priority first (FIFO within
+  a priority level);
+- when full, a new higher-priority tx EVICTS the lowest-priority
+  resident txs to make room (mempool.go:  TryAddNewTx eviction loop) —
+  a full v0 mempool just rejects.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Callable, List, Optional
+
+from tmtpu.abci import types as abci
+from tmtpu.crypto import tmhash
+from tmtpu.mempool.clist_mempool import (
+    MempoolFullError, TxCache, TxInMempoolError,
+)
+
+
+class PriorityMempool:
+    def __init__(self, proxy_app, max_txs: int = 5000,
+                 max_txs_bytes: int = 1 << 30, cache_size: int = 10000,
+                 keep_invalid_txs_in_cache: bool = False,
+                 pre_check: Optional[Callable] = None):
+        self.proxy_app = proxy_app
+        self.max_txs = max_txs
+        self.max_txs_bytes = max_txs_bytes
+        self.keep_invalid_txs_in_cache = keep_invalid_txs_in_cache
+        self.pre_check = pre_check
+        self.cache = TxCache(cache_size)
+        self._txs: dict = {}  # hash -> info
+        self._txs_bytes = 0
+        self._height = 0
+        self._seq = itertools.count()  # FIFO tiebreak within a priority
+        self._lock = threading.RLock()
+        self._update_lock = threading.RLock()
+        self._notify: List[Callable] = []
+
+    # -- Mempool interface ---------------------------------------------------
+
+    def check_tx(self, tx: bytes, cb: Optional[Callable] = None,
+                 tx_info: Optional[dict] = None) -> None:
+        tx = bytes(tx)
+        if not self.cache.push(tx):
+            raise TxInMempoolError("tx already exists in cache")
+        if self.pre_check is not None:
+            err = self.pre_check(tx)
+            if err is not None:
+                self.cache.remove(tx)
+                raise ValueError(f"pre-check failed: {err}")
+        res = self.proxy_app.check_tx_sync(abci.RequestCheckTx(
+            tx=tx, type=abci.CHECK_TX_TYPE_NEW))
+        if res.is_ok():
+            self._add(tx, res, tx_info or {})
+        elif not self.keep_invalid_txs_in_cache:
+            self.cache.remove(tx)
+        if cb is not None:
+            cb(res)
+
+    def _add(self, tx: bytes, res: abci.ResponseCheckTx,
+             tx_info: dict) -> None:
+        key = tmhash.sum(tx)
+        with self._lock:
+            if key in self._txs:
+                return
+            # eviction (v1): make room by dropping strictly-lower-priority
+            # residents; refuse if the newcomer can't fit even then
+            while (len(self._txs) >= self.max_txs or
+                   self._txs_bytes + len(tx) > self.max_txs_bytes):
+                victim_key = None
+                victim = None
+                for k, info in self._txs.items():
+                    if info["priority"] < res.priority and (
+                            victim is None
+                            or (info["priority"], -info["seq"])
+                            < (victim["priority"], -victim["seq"])):
+                        victim_key, victim = k, info
+                if victim_key is None:
+                    self.cache.remove(tx)
+                    raise MempoolFullError(
+                        f"mempool is full: {len(self._txs)} txs and no "
+                        f"lower-priority tx to evict")
+                del self._txs[victim_key]
+                self._txs_bytes -= len(victim["tx"])
+            self._txs[key] = {
+                "tx": tx, "priority": res.priority,
+                "gas_wanted": res.gas_wanted, "seq": next(self._seq),
+                "height": self._height,
+                "senders": set(filter(None, [tx_info.get("sender")])),
+            }
+            self._txs_bytes += len(tx)
+            for fn in self._notify:
+                fn()
+        from tmtpu.libs import metrics as _m
+
+        _m.mempool_size.set(self.size())
+
+    def _ordered(self) -> List[dict]:
+        return sorted(self._txs.values(),
+                      key=lambda i: (-i["priority"], i["seq"]))
+
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int
+                               ) -> List[bytes]:
+        with self._lock:
+            out, total_b, total_g = [], 0, 0
+            for info in self._ordered():
+                nb = total_b + len(info["tx"]) + 20
+                ng = total_g + max(info["gas_wanted"], 0)
+                if max_bytes > -1 and nb > max_bytes:
+                    break
+                if max_gas > -1 and ng > max_gas:
+                    break
+                total_b, total_g = nb, ng
+                out.append(info["tx"])
+            return out
+
+    def reap_max_txs(self, n: int) -> List[bytes]:
+        with self._lock:
+            txs = [i["tx"] for i in self._ordered()]
+            return txs if n < 0 else txs[:n]
+
+    def lock(self) -> None:
+        self._update_lock.acquire()
+
+    def unlock(self) -> None:
+        self._update_lock.release()
+
+    def update(self, height: int, txs: List[bytes],
+               deliver_tx_responses) -> None:
+        with self._lock:
+            self._height = height
+            for tx, res in zip(txs, deliver_tx_responses):
+                if res.is_ok():
+                    self.cache.push(tx)
+                elif not self.keep_invalid_txs_in_cache:
+                    self.cache.remove(tx)
+                info = self._txs.pop(tmhash.sum(tx), None)
+                if info is not None:
+                    self._txs_bytes -= len(info["tx"])
+            remaining = [i["tx"] for i in self._txs.values()]
+        for tx in remaining:
+            res = self.proxy_app.check_tx_sync(abci.RequestCheckTx(
+                tx=tx, type=abci.CHECK_TX_TYPE_RECHECK))
+            with self._lock:
+                info = self._txs.get(tmhash.sum(tx))
+                if info is None:
+                    continue
+                if not res.is_ok():
+                    del self._txs[tmhash.sum(tx)]
+                    self._txs_bytes -= len(info["tx"])
+                    if not self.keep_invalid_txs_in_cache:
+                        self.cache.remove(tx)
+                else:
+                    info["priority"] = res.priority  # may change on recheck
+        from tmtpu.libs import metrics as _m
+
+        _m.mempool_size.set(self.size())
+
+    def flush(self) -> None:
+        with self._lock:
+            self._txs.clear()
+            self._txs_bytes = 0
+        from tmtpu.libs import metrics as _m
+
+        _m.mempool_size.set(0)
+
+    def flush_app_conn(self) -> None:
+        self.proxy_app.flush_sync()
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._txs)
+
+    def size_bytes(self) -> int:
+        with self._lock:
+            return self._txs_bytes
+
+    def is_empty(self) -> bool:
+        return self.size() == 0
+
+    def txs_available(self, fn: Callable) -> None:
+        self._notify.append(fn)
+
+    def mark_sender(self, tx: bytes, sender) -> None:
+        with self._lock:
+            info = self._txs.get(tmhash.sum(tx))
+            if info is not None:
+                info["senders"].add(sender)
+
+    def senders(self, tx: bytes) -> set:
+        with self._lock:
+            info = self._txs.get(tmhash.sum(tx))
+            return set(info["senders"]) if info else set()

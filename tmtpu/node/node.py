@@ -121,8 +121,14 @@ class Node(BaseService):
         hs.handshake(self.proxy_app)
         self.state = hs.state
 
-        # --- mempool ---
-        self.mempool = CListMempool(
+        # --- mempool (node.go:368; version per config, like FastSync) ---
+        if config.mempool.version == "v1":
+            from tmtpu.mempool.priority_mempool import PriorityMempool
+
+            mempool_cls = PriorityMempool
+        else:
+            mempool_cls = CListMempool
+        self.mempool = mempool_cls(
             self.proxy_app.mempool,
             max_txs=config.mempool.size,
             max_txs_bytes=config.mempool.max_txs_bytes,
@@ -196,7 +202,9 @@ class Node(BaseService):
                     f":{transport.listen_port}"
             self.switch = Switch(transport,
                                  max_inbound=config.p2p.max_num_inbound_peers,
-                                 max_outbound=config.p2p.max_num_outbound_peers)
+                                 max_outbound=config.p2p.max_num_outbound_peers,
+                                 send_rate=config.p2p.send_rate,
+                                 recv_rate=config.p2p.recv_rate)
             # fast sync only makes sense when someone else has blocks
             # (node.go:450 createBlockchainReactor + onlyValidatorIsUs)
             self.fast_sync = (config.block_sync.enable

@@ -9,6 +9,11 @@ proto/tendermint/p2p/conn.proto). Messages are chunked into
 One send thread drains per-channel queues by priority; one recv thread
 reassembles packets and hands complete messages to the owner's
 ``on_receive(channel_id, msg_bytes)``.
+
+Flow control (connection.go flowrate/sendRate/recvRate): both directions
+are token-bucket limited so a slow or malicious peer can't monopolize the
+node's bandwidth; missing pongs within ``PONG_TIMEOUT`` disconnect the
+peer (connection.go pongTimeoutCh).
 """
 
 from __future__ import annotations
@@ -63,14 +68,49 @@ class _Channel:
         self.recently_sent = 0
 
 
+class _RateLimiter:
+    """Token bucket (the reference's flowrate.Monitor Limit())."""
+
+    def __init__(self, rate_bytes_per_s: int):
+        self.rate = rate_bytes_per_s
+        self._tokens = float(rate_bytes_per_s)  # 1s of burst
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def consume(self, n: int) -> None:
+        """Block until ``n`` bytes of budget are available. Amounts larger
+        than the bucket (1s of rate) are consumed in capacity-sized chunks
+        — a single oversized request must never exceed what the bucket can
+        ever hold, or it would spin forever."""
+        if self.rate <= 0:
+            return
+        while n > 0:
+            chunk = min(n, self.rate)
+            while True:
+                with self._lock:
+                    now = time.monotonic()
+                    self._tokens = min(
+                        float(self.rate),
+                        self._tokens + (now - self._last) * self.rate)
+                    self._last = now
+                    if self._tokens >= chunk:
+                        self._tokens -= chunk
+                        break
+                    wait = (chunk - self._tokens) / self.rate
+                time.sleep(min(wait, 0.1))
+            n -= chunk
+
+
 class MConnection:
     PING_INTERVAL = 30.0
+    PONG_TIMEOUT = 45.0   # connection.go defaultPongTimeout (we allow 1.5x)
     FLUSH_INTERVAL = 0.01
 
     def __init__(self, conn, channel_descs: List[ChannelDescriptor],
                  on_receive: Callable[[int, bytes], None],
                  on_error: Callable[[Exception], None],
-                 max_packet_payload: int = 1024):
+                 max_packet_payload: int = 1024,
+                 send_rate: int = 5_120_000, recv_rate: int = 5_120_000):
         self._conn = conn  # SecretConnection or raw socket-like
         self._channels: Dict[int, _Channel] = {
             d.channel_id: _Channel(d) for d in channel_descs
@@ -78,8 +118,11 @@ class MConnection:
         self._on_receive = on_receive
         self._on_error = on_error
         self._max_payload = max_packet_payload
+        self._send_limiter = _RateLimiter(send_rate)
+        self._recv_limiter = _RateLimiter(recv_rate)
         self._send_event = threading.Event()
         self._pong_pending = False
+        self._ping_sent_at = 0.0    # nonzero while awaiting a pong
         self._stopped = threading.Event()
         self._threads: List[threading.Thread] = []
 
@@ -145,6 +188,12 @@ class MConnection:
                 if now - last_ping > self.PING_INTERVAL:
                     self._write_packet(Packet(ping=PacketPing()))
                     last_ping = now
+                    if not self._ping_sent_at:
+                        self._ping_sent_at = now
+                if self._ping_sent_at and \
+                        now - self._ping_sent_at > self.PONG_TIMEOUT:
+                    raise ConnectionError(
+                        "pong timeout: peer unresponsive")
                 # drain by priority until all queues empty
                 while self._send_some():
                     pass
@@ -173,6 +222,7 @@ class MConnection:
         chunk = best.sending[:self._max_payload]
         rest = best.sending[self._max_payload:]
         eof = not rest
+        self._send_limiter.consume(len(chunk))
         self._write_packet(Packet(msg=PacketMsg(
             channel_id=best.desc.channel_id, eof=eof, data=chunk)))
         best.sending = rest
@@ -216,12 +266,13 @@ class MConnection:
                 n = self._read_uvarint()
                 if n > 30 * 1024 * 1024:
                     raise ConnectionError(f"packet too big: {n}")
+                self._recv_limiter.consume(n)
                 pkt = Packet.decode(self._read_exact(n))
                 if pkt.ping is not None:
                     self._pong_pending = True
                     self._send_event.set()
                 elif pkt.pong is not None:
-                    pass
+                    self._ping_sent_at = 0.0
                 elif pkt.msg is not None:
                     ch = self._channels.get(pkt.msg.channel_id)
                     if ch is None:

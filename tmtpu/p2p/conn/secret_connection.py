@@ -2,8 +2,10 @@
 Station-to-Station authenticated encryption for peer links:
 
 1. exchange ephemeral X25519 pubkeys (:289-335);
-2. HKDF-SHA256 over the DH secret → two ChaCha20-Poly1305 keys + a
-   challenge (:337 deriveSecrets);
+2. HKDF-SHA256 over the DH secret → two ChaCha20-Poly1305 keys
+   (:337 deriveSecrets); the CHALLENGE comes from a merlin transcript
+   over the sorted ephemeral keys + DH secret (:111-135), binding the
+   authentication to the key ordering;
 3. sign the challenge with the node's ed25519 key and exchange
    AuthSigMessages over the now-encrypted link (MakeSecretConnection :92).
 
@@ -67,10 +69,20 @@ class SecretConnection:
             raise SecretConnectionError("ephemeral key reflected")
 
         # 2. derive secrets; key assignment depends on sort order
-        # (secret_connection.go deriveSecrets: low sorted key gets recvKey
-        # first)
+        # (secret_connection.go:111-135): the CHALLENGE comes from a merlin
+        # transcript over the sorted ephemeral keys + DH secret — binding
+        # the authentication to the key ordering — while the two AEAD keys
+        # come from HKDF over the DH secret (deriveSecrets :337).
+        from tmtpu.crypto.merlin import Transcript
+
+        lo, hi = sorted((eph_pub, remote_eph_pub))
+        transcript = Transcript(
+            b"TENDERMINT_SECRET_CONNECTION_TRANSCRIPT_HASH")
+        transcript.append_message(b"EPHEMERAL_LOWER_PUBLIC_KEY", lo)
+        transcript.append_message(b"EPHEMERAL_UPPER_PUBLIC_KEY", hi)
         shared = eph_priv.exchange(X25519PublicKey.from_public_bytes(
             remote_eph_pub))
+        transcript.append_message(b"DH_SECRET", shared)
         okm = HKDF(algorithm=hashes.SHA256(), length=96, salt=None,
                    info=b"TENDERMINT_SECRET_CONNECTION_KEY_AND_CHALLENGE_GEN"
                    ).derive(shared)
@@ -79,7 +91,8 @@ class SecretConnection:
             recv_key, send_key = okm[:32], okm[32:64]
         else:
             send_key, recv_key = okm[:32], okm[32:64]
-        self._challenge = okm[64:96]
+        self._challenge = transcript.challenge_bytes(
+            b"SECRET_CONNECTION_MAC", 32)
         self._send_aead = ChaCha20Poly1305(send_key)
         self._recv_aead = ChaCha20Poly1305(recv_key)
 

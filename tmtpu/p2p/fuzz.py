@@ -1,0 +1,82 @@
+"""FuzzedConnection (reference: p2p/fuzz.go) — wraps a connection-like
+object and probabilistically delays or drops reads/writes, driven by
+FuzzConnConfig (config/config.go:663). Used by network fault-injection
+tests to shake out ordering and partial-delivery assumptions."""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Optional
+
+
+class FuzzConnConfig:
+    """config/config.go FuzzConnConfig defaults."""
+
+    MODE_DROP = "drop"
+    MODE_DELAY = "delay"
+
+    def __init__(self, mode: str = MODE_DROP,
+                 max_delay_s: float = 3.0,
+                 prob_drop_rw: float = 0.2,
+                 prob_drop_conn: float = 0.0,
+                 prob_sleep: float = 0.0,
+                 seed: Optional[int] = None):
+        self.mode = mode
+        self.max_delay_s = max_delay_s
+        self.prob_drop_rw = prob_drop_rw
+        self.prob_drop_conn = prob_drop_conn
+        self.prob_sleep = prob_sleep
+        self.rng = random.Random(seed)
+
+
+class FuzzedConnection:
+    """Duck-types the SecretConnection surface (write / read_exact /
+    close) the MConnection drives."""
+
+    def __init__(self, conn, config: Optional[FuzzConnConfig] = None):
+        self.conn = conn
+        self.config = config or FuzzConnConfig()
+        self._dead = False
+
+    def _fuzz(self) -> bool:
+        """Returns True if the operation should be swallowed."""
+        cfg = self.config
+        if self._dead:
+            raise ConnectionError("fuzz: connection dropped")
+        if cfg.mode == FuzzConnConfig.MODE_DELAY:
+            if cfg.rng.random() < cfg.prob_sleep:
+                time.sleep(cfg.rng.random() * cfg.max_delay_s)
+            return False
+        # drop mode
+        if cfg.prob_drop_conn and cfg.rng.random() < cfg.prob_drop_conn:
+            self._dead = True
+            self.close()
+            raise ConnectionError("fuzz: connection dropped")
+        if cfg.rng.random() < cfg.prob_sleep:
+            time.sleep(cfg.rng.random() * cfg.max_delay_s)
+        return cfg.rng.random() < cfg.prob_drop_rw
+
+    def write(self, data: bytes) -> int:
+        if self._fuzz():
+            return len(data)  # silently swallowed
+        return self.conn.write(data)
+
+    def read_exact(self, n: int) -> bytes:
+        # reads can't be "dropped" without desyncing the stream; only
+        # delay/kill apply (fuzz.go fuzzes reads by delaying)
+        cfg = self.config
+        if self._dead:
+            raise ConnectionError("fuzz: connection dropped")
+        if cfg.rng.random() < cfg.prob_sleep:
+            time.sleep(cfg.rng.random() * cfg.max_delay_s)
+        return self.conn.read_exact(n)
+
+    def close(self) -> None:
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+
+    def __getattr__(self, name):
+        return getattr(self.conn, name)

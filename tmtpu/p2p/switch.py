@@ -47,13 +47,15 @@ class Peer:
     """p2p/peer.go — a connected peer wrapping its MConnection."""
 
     def __init__(self, conn, node_info: NodeInfo, remote_ip: str,
-                 outbound: bool, channel_descs, on_receive, on_error):
+                 outbound: bool, channel_descs, on_receive, on_error,
+                 send_rate: int = 5_120_000, recv_rate: int = 5_120_000):
         self.node_info = node_info
         self.remote_ip = remote_ip
         self.outbound = outbound
         self.mconn = MConnection(conn, channel_descs,
                                  lambda ch, msg: on_receive(self, ch, msg),
-                                 lambda err: on_error(self, err))
+                                 lambda err: on_error(self, err),
+                                 send_rate=send_rate, recv_rate=recv_rate)
         self._data: Dict[str, object] = {}
         self._data_lock = threading.Lock()
 
@@ -107,9 +109,12 @@ class Switch(BaseService):
     RECONNECT_MAX_TRIES = 20
 
     def __init__(self, transport: Transport,
-                 max_inbound: int = 40, max_outbound: int = 10):
+                 max_inbound: int = 40, max_outbound: int = 10,
+                 send_rate: int = 5_120_000, recv_rate: int = 5_120_000):
         super().__init__("Switch")
         self.transport = transport
+        self.send_rate = send_rate
+        self.recv_rate = recv_rate
         self.reactors: Dict[str, Reactor] = {}
         self._channel_descs: List[ChannelDescriptor] = []
         self._reactor_by_channel: Dict[int, Reactor] = {}
@@ -230,7 +235,8 @@ class Switch(BaseService):
                 sc.close()
                 return None
             peer = Peer(sc, ni, ip, outbound, self._channel_descs,
-                        self._on_peer_receive, self._on_peer_error)
+                        self._on_peer_receive, self._on_peer_error,
+                        send_rate=self.send_rate, recv_rate=self.recv_rate)
             self.peers[ni.node_id] = peer
             from tmtpu.libs import metrics as _m
 

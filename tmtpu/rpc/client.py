@@ -1,0 +1,241 @@
+"""RPC client library (reference: rpc/client/http) — typed access to a
+node's JSON-RPC over HTTP, plus a WebSocket subscription client. The
+reference's Client interface (rpc/client/interface.go) maps to methods
+here; values come back as the parsed JSON result objects."""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import socket
+import struct
+import threading
+import urllib.request
+from typing import Callable, Dict, Iterator, Optional
+
+
+class RPCClientError(Exception):
+    def __init__(self, code: int, message: str, data: str = ""):
+        super().__init__(f"RPC error {code}: {message} {data}".strip())
+        self.code = code
+        self.message = message
+        self.data = data
+
+
+class HTTPClient:
+    """rpc/client/http — one method per core route."""
+
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self._id = 0
+
+    def call(self, method: str, **params):
+        self._id += 1
+        req = urllib.request.Request(
+            self.base_url + "/",
+            data=json.dumps({"jsonrpc": "2.0", "id": self._id,
+                             "method": method, "params": params}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            body = json.loads(r.read())
+        if body.get("error"):
+            e = body["error"]
+            raise RPCClientError(e.get("code", -1), e.get("message", ""),
+                                 str(e.get("data", "")))
+        return body["result"]
+
+    # -- info ---------------------------------------------------------------
+
+    def status(self):
+        return self.call("status")
+
+    def health(self):
+        return self.call("health")
+
+    def genesis(self):
+        return self.call("genesis")
+
+    def net_info(self):
+        return self.call("net_info")
+
+    def abci_info(self):
+        return self.call("abci_info")
+
+    def consensus_state(self):
+        return self.call("consensus_state")
+
+    # -- chain data ---------------------------------------------------------
+
+    def block(self, height: Optional[int] = None):
+        p = {} if height is None else {"height": str(height)}
+        return self.call("block", **p)
+
+    def block_by_hash(self, hash_hex: str):
+        return self.call("block_by_hash", hash=hash_hex)
+
+    def block_results(self, height: Optional[int] = None):
+        p = {} if height is None else {"height": str(height)}
+        return self.call("block_results", **p)
+
+    def blockchain(self, min_height: int = 0, max_height: int = 0):
+        return self.call("blockchain", minHeight=str(min_height),
+                         maxHeight=str(max_height))
+
+    def commit(self, height: Optional[int] = None):
+        p = {} if height is None else {"height": str(height)}
+        return self.call("commit", **p)
+
+    def validators(self, height: Optional[int] = None, page: int = 1,
+                   per_page: int = 30):
+        p = {"page": str(page), "per_page": str(per_page)}
+        if height is not None:
+            p["height"] = str(height)
+        return self.call("validators", **p)
+
+    def consensus_params(self, height: Optional[int] = None):
+        p = {} if height is None else {"height": str(height)}
+        return self.call("consensus_params", **p)
+
+    # -- txs ----------------------------------------------------------------
+
+    def broadcast_tx_sync(self, tx: bytes):
+        return self.call("broadcast_tx_sync", tx=tx.decode("latin-1"))
+
+    def broadcast_tx_async(self, tx: bytes):
+        return self.call("broadcast_tx_async", tx=tx.decode("latin-1"))
+
+    def broadcast_tx_commit(self, tx: bytes):
+        return self.call("broadcast_tx_commit", tx=tx.decode("latin-1"))
+
+    def tx(self, hash_hex: str, prove: bool = False):
+        return self.call("tx", hash=hash_hex, prove=prove)
+
+    def tx_search(self, query: str, page: int = 1, per_page: int = 30,
+                  order_by: str = "asc"):
+        return self.call("tx_search", query=query, page=str(page),
+                         per_page=str(per_page), order_by=order_by)
+
+    def block_search(self, query: str, page: int = 1, per_page: int = 30):
+        return self.call("block_search", query=query, page=str(page),
+                         per_page=str(per_page))
+
+    def abci_query(self, path: str = "", data: str = "", height: int = 0,
+                   prove: bool = False):
+        return self.call("abci_query", path=path, data=data,
+                         height=str(height), prove=prove)
+
+    def unconfirmed_txs(self, limit: int = 30):
+        return self.call("unconfirmed_txs", limit=str(limit))
+
+    def broadcast_evidence(self, ev) -> dict:
+        from tmtpu.types.evidence import evidence_to_proto
+
+        return self.call("broadcast_evidence", evidence=base64.b64encode(
+            evidence_to_proto(ev).encode()).decode())
+
+
+class WSClient:
+    """rpc/client WSEvents — subscribe over /websocket and iterate
+    matching events."""
+
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        u = base_url.rstrip("/")
+        hostport = u.split("://", 1)[-1]
+        host, _, port = hostport.rpartition(":")
+        self.sock = socket.create_connection((host or "127.0.0.1",
+                                              int(port)), timeout=timeout)
+        key = base64.b64encode(os.urandom(16)).decode()
+        self.sock.sendall(
+            (f"GET /websocket HTTP/1.1\r\nHost: {host}\r\n"
+             f"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+             f"Sec-WebSocket-Key: {key}\r\n"
+             f"Sec-WebSocket-Version: 13\r\n\r\n").encode())
+        resp = b""
+        while b"\r\n\r\n" not in resp:
+            chunk = self.sock.recv(4096)
+            if not chunk:
+                raise ConnectionError("ws handshake failed")
+            resp += chunk
+        if b"101" not in resp.split(b"\r\n", 1)[0]:
+            raise ConnectionError(f"ws upgrade rejected: {resp[:100]!r}")
+        guid = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+        expect = base64.b64encode(
+            hashlib.sha1((key + guid).encode()).digest()).decode()
+        if expect.encode() not in resp:
+            raise ConnectionError("bad Sec-WebSocket-Accept")
+        self._buf = b""
+        self._id = 0
+
+    def _send_json(self, obj) -> None:
+        payload = json.dumps(obj).encode()
+        mask = os.urandom(4)
+        n = len(payload)
+        hdr = bytearray([0x81])
+        if n < 126:
+            hdr.append(0x80 | n)
+        elif n < 1 << 16:
+            hdr.append(0x80 | 126)
+            hdr += struct.pack(">H", n)
+        else:
+            hdr.append(0x80 | 127)
+            hdr += struct.pack(">Q", n)
+        masked = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        self.sock.sendall(bytes(hdr) + mask + masked)
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("ws closed")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def recv_json(self, timeout: Optional[float] = None):
+        self.sock.settimeout(timeout)
+        while True:
+            b0, b1 = self._read_exact(2)
+            n = b1 & 0x7F
+            if n == 126:
+                n = struct.unpack(">H", self._read_exact(2))[0]
+            elif n == 127:
+                n = struct.unpack(">Q", self._read_exact(8))[0]
+            payload = self._read_exact(n)
+            op = b0 & 0x0F
+            if op == 0x9:  # ping → pong
+                self._send_pong(payload)
+                continue
+            if op != 0x1:
+                continue
+            return json.loads(payload)
+
+    def _send_pong(self, payload: bytes) -> None:
+        mask = os.urandom(4)
+        hdr = bytearray([0x8A, 0x80 | len(payload)])
+        self.sock.sendall(bytes(hdr) + mask + bytes(
+            b ^ mask[i % 4] for i, b in enumerate(payload)))
+
+    def subscribe(self, query: str) -> int:
+        self._id += 1
+        self._send_json({"jsonrpc": "2.0", "id": self._id,
+                         "method": "subscribe", "params": {"query": query}})
+        ack = self.recv_json(timeout=15)
+        if "error" in ack:
+            raise RPCClientError(ack["error"].get("code", -1),
+                                 ack["error"].get("message", ""))
+        return self._id
+
+    def events(self, timeout: Optional[float] = None) -> Iterator[dict]:
+        while True:
+            msg = self.recv_json(timeout=timeout)
+            if "result" in msg and "data" in msg.get("result", {}):
+                yield msg["result"]
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
