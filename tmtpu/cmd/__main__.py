@@ -82,11 +82,41 @@ def cmd_init(args) -> int:
     return 0
 
 
+def cmd_debug_dump(args) -> int:
+    """debug dump — capture a node's state via RPC (commands/debug):
+    status, consensus state, net info into a timestamped dir."""
+    import urllib.request
+
+    out = os.path.join(os.path.expanduser(args.output_dir),
+                       time.strftime("%Y%m%d-%H%M%S"))
+    os.makedirs(out, exist_ok=True)
+    base = args.rpc_laddr.replace("tcp://", "http://")
+    for name in ("status", "consensus_state", "dump_consensus_state",
+                 "net_info", "num_unconfirmed_txs"):
+        try:
+            with urllib.request.urlopen(f"{base}/{name}", timeout=10) as r:
+                body = r.read()
+            with open(os.path.join(out, f"{name}.json"), "wb") as f:
+                f.write(body)
+        except Exception as e:  # noqa: BLE001
+            print(f"  {name}: {e}", file=sys.stderr)
+    print(f"Wrote debug dump to {out}")
+    return 0
+
+
 def cmd_start(args) -> int:
     """start — run the node (commands/run_node.go:100)."""
+    import faulthandler
+
     from tmtpu.node.node import Node
 
     cfg = _load_config(args.home)
+    # deadlock observability (the reference's deadlock build tag + debug
+    # kill): SIGUSR1 dumps every thread's stack to stderr
+    try:
+        faulthandler.register(signal.SIGUSR1, all_threads=True)
+    except (AttributeError, ValueError):
+        pass
     if args.proxy_app:
         cfg.base.proxy_app = args.proxy_app
     if args.rpc_laddr:
@@ -288,6 +318,12 @@ def main(argv=None) -> int:
 
     sp = sub.add_parser("replay", help="re-sync the app from the stores")
     sp.set_defaults(fn=cmd_replay)
+
+    sp = sub.add_parser("debug", help="capture a running node's state")
+    sp.add_argument("--rpc-laddr", dest="rpc_laddr",
+                    default="tcp://127.0.0.1:26657")
+    sp.add_argument("--output-dir", dest="output_dir", default="./debug")
+    sp.set_defaults(fn=cmd_debug_dump)
 
     sp = sub.add_parser("testnet", help="generate N validator home dirs")
     sp.add_argument("--validators", type=int, default=4)

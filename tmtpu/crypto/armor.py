@@ -1,0 +1,213 @@
+"""ASCII armor + symmetric key encryption (reference: crypto/armor/,
+crypto/xsalsa20symmetric/).
+
+- ``encode_armor``/``decode_armor``: OpenPGP-style armor blocks (RFC 4880
+  framing with CRC-24 checksum) used for exporting keys as text.
+- ``encrypt_symmetric``/``decrypt_symmetric``: NaCl-secretbox-equivalent
+  XSalsa20-Poly1305 (pure Python salsa core + poly1305 one-time MAC),
+  with an scrypt KDF for passphrase keys (the reference uses bcrypt;
+  scrypt is the stdlib-available memory-hard equivalent — documented
+  deviation, same 32-byte key contract).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import os
+import struct
+from typing import Dict, Optional, Tuple
+
+# --- CRC-24 (RFC 4880 §6.1) --------------------------------------------------
+
+_CRC24_INIT = 0xB704CE
+_CRC24_POLY = 0x1864CFB
+
+
+def _crc24(data: bytes) -> int:
+    crc = _CRC24_INIT
+    for b in data:
+        crc ^= b << 16
+        for _ in range(8):
+            crc <<= 1
+            if crc & 0x1000000:
+                crc ^= _CRC24_POLY
+    return crc & 0xFFFFFF
+
+
+def encode_armor(block_type: str, headers: Dict[str, str],
+                 data: bytes) -> str:
+    lines = [f"-----BEGIN {block_type}-----"]
+    for k in sorted(headers):
+        lines.append(f"{k}: {headers[k]}")
+    lines.append("")
+    b64 = base64.b64encode(data).decode()
+    lines.extend(b64[i:i + 64] for i in range(0, len(b64), 64))
+    lines.append("=" + base64.b64encode(
+        _crc24(data).to_bytes(3, "big")).decode())
+    lines.append(f"-----END {block_type}-----")
+    return "\n".join(lines) + "\n"
+
+
+def decode_armor(armor_str: str) -> Tuple[str, Dict[str, str], bytes]:
+    lines = [ln.rstrip("\r") for ln in armor_str.strip().splitlines()]
+    if not lines or not lines[0].startswith("-----BEGIN ") or \
+            not lines[0].endswith("-----"):
+        raise ValueError("missing armor begin line")
+    block_type = lines[0][len("-----BEGIN "):-len("-----")]
+    if lines[-1] != f"-----END {block_type}-----":
+        raise ValueError("missing or mismatched armor end line")
+    headers: Dict[str, str] = {}
+    i = 1
+    while i < len(lines) - 1 and lines[i]:
+        if ":" not in lines[i]:
+            break
+        k, _, v = lines[i].partition(":")
+        headers[k.strip()] = v.strip()
+        i += 1
+    body = []
+    checksum: Optional[int] = None
+    for ln in lines[i:-1]:
+        if not ln:
+            continue
+        if ln.startswith("="):
+            checksum = int.from_bytes(base64.b64decode(ln[1:]), "big")
+            continue
+        body.append(ln)
+    data = base64.b64decode("".join(body))
+    if checksum is not None and _crc24(data) != checksum:
+        raise ValueError("armor checksum mismatch")
+    return block_type, headers, data
+
+
+# --- salsa20 core ------------------------------------------------------------
+
+
+def _rotl(v: int, n: int) -> int:
+    return ((v << n) | (v >> (32 - n))) & 0xFFFFFFFF
+
+
+def _salsa20_core(inp: list, rounds: int = 20) -> list:
+    x = list(inp)
+    for _ in range(rounds // 2):
+        for a, b, c, d in ((4, 0, 12, 7), (8, 4, 0, 9), (12, 8, 4, 13),
+                           (0, 12, 8, 18), (9, 5, 1, 7), (13, 9, 5, 9),
+                           (1, 13, 9, 13), (5, 1, 13, 18), (14, 10, 6, 7),
+                           (2, 14, 10, 9), (6, 2, 14, 13), (10, 6, 2, 18),
+                           (3, 15, 11, 7), (7, 3, 15, 9), (11, 7, 3, 13),
+                           (15, 11, 7, 18)):
+            x[a] ^= _rotl((x[b] + x[c]) & 0xFFFFFFFF, d)
+        for a, b, c, d in ((1, 0, 3, 7), (2, 1, 0, 9), (3, 2, 1, 13),
+                           (0, 3, 2, 18), (6, 5, 4, 7), (7, 6, 5, 9),
+                           (4, 7, 6, 13), (5, 4, 7, 18), (11, 10, 9, 7),
+                           (8, 11, 10, 9), (9, 8, 11, 13), (10, 9, 8, 18),
+                           (12, 15, 14, 7), (13, 12, 15, 9),
+                           (14, 13, 12, 13), (15, 14, 13, 18)):
+            x[a] ^= _rotl((x[b] + x[c]) & 0xFFFFFFFF, d)
+    return x
+
+
+_SIGMA = struct.unpack("<4I", b"expand 32-byte k")
+
+
+def _hsalsa20(key: bytes, nonce16: bytes) -> bytes:
+    k = struct.unpack("<8I", key)
+    n = struct.unpack("<4I", nonce16)
+    inp = [_SIGMA[0], *k[:4], _SIGMA[1], *n, _SIGMA[2], *k[4:], _SIGMA[3]]
+    x = _salsa20_core(inp)
+    out = [x[0], x[5], x[10], x[15], x[6], x[7], x[8], x[9]]
+    return struct.pack("<8I", *out)
+
+
+def _salsa20_xor(key: bytes, nonce8: bytes, data: bytes,
+                 counter: int = 0) -> bytes:
+    k = struct.unpack("<8I", key)
+    n = struct.unpack("<2I", nonce8)
+    out = bytearray()
+    for block_i in range((len(data) + 63) // 64):
+        ctr = counter + block_i
+        inp = [_SIGMA[0], *k[:4], _SIGMA[1], n[0], n[1],
+               ctr & 0xFFFFFFFF, (ctr >> 32) & 0xFFFFFFFF,
+               _SIGMA[2], *k[4:], _SIGMA[3]]
+        x = _salsa20_core(inp)
+        ks = struct.pack("<16I", *((a + b) & 0xFFFFFFFF
+                                   for a, b in zip(x, inp)))
+        chunk = data[block_i * 64:(block_i + 1) * 64]
+        out.extend(c ^ ks[i] for i, c in enumerate(chunk))
+    return bytes(out)
+
+
+# --- poly1305 ----------------------------------------------------------------
+
+
+def _poly1305(key32: bytes, msg: bytes) -> bytes:
+    r = int.from_bytes(key32[:16], "little") & \
+        0x0FFFFFFC0FFFFFFC0FFFFFFC0FFFFFFF
+    s = int.from_bytes(key32[16:], "little")
+    p = (1 << 130) - 5
+    acc = 0
+    for i in range(0, len(msg), 16):
+        block = msg[i:i + 16]
+        n = int.from_bytes(block + b"\x01", "little")
+        acc = (acc + n) * r % p
+    return ((acc + s) & ((1 << 128) - 1)).to_bytes(16, "little")
+
+
+# --- secretbox (XSalsa20-Poly1305, nacl/secretbox) ---------------------------
+
+
+def secretbox_seal(key: bytes, nonce24: bytes, msg: bytes) -> bytes:
+    subkey = _hsalsa20(key, nonce24[:16])
+    stream = _salsa20_xor(subkey, nonce24[16:], b"\x00" * 32 + msg)
+    mac_key, ct = stream[:32], stream[32:]
+    return _poly1305(mac_key, ct) + ct
+
+
+def secretbox_open(key: bytes, nonce24: bytes, boxed: bytes
+                   ) -> Optional[bytes]:
+    if len(boxed) < 16:
+        return None
+    tag, ct = boxed[:16], boxed[16:]
+    subkey = _hsalsa20(key, nonce24[:16])
+    mac_key = _salsa20_xor(subkey, nonce24[16:], b"\x00" * 32)
+    if _poly1305(mac_key, ct) != tag:
+        return None
+    return _salsa20_xor(subkey, nonce24[16:], b"\x00" * 32 + ct)[32:]
+
+
+# --- symmetric passphrase encryption (xsalsa20symmetric) ---------------------
+
+_NONCE = b"\x00" * 24  # keys are single-use per encryption (fresh salt)
+
+
+def derive_key(passphrase: str, salt: bytes) -> bytes:
+    """32-byte key via scrypt (reference: bcrypt; see module docstring)."""
+    return hashlib.scrypt(passphrase.encode(), salt=salt,
+                          n=1 << 14, r=8, p=1, dklen=32)
+
+
+def encrypt_armor_priv_key(priv_key, passphrase: str) -> str:
+    salt = os.urandom(16)
+    key = derive_key(passphrase, salt)
+    boxed = secretbox_seal(key, _NONCE, priv_key.bytes())
+    return encode_armor("TENDERMINT PRIVATE KEY",
+                        {"kdf": "scrypt", "salt": salt.hex().upper(),
+                         "type": priv_key.type_value()}, boxed)
+
+
+def unarmor_decrypt_priv_key(armor_str: str, passphrase: str):
+    from tmtpu.crypto.keys import KEY_TYPES
+
+    block_type, headers, boxed = decode_armor(armor_str)
+    if block_type != "TENDERMINT PRIVATE KEY":
+        raise ValueError(f"unrecognized armor type {block_type!r}")
+    if headers.get("kdf") != "scrypt":
+        raise ValueError(f"unrecognized KDF {headers.get('kdf')!r}")
+    key = derive_key(passphrase, bytes.fromhex(headers["salt"]))
+    plain = secretbox_open(key, _NONCE, boxed)
+    if plain is None:
+        raise ValueError("invalid passphrase")
+    entry = KEY_TYPES.get(headers.get("type", "ed25519"))
+    if entry is None:
+        raise ValueError(f"unknown key type {headers.get('type')!r}")
+    return entry[1](plain)

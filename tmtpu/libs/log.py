@@ -19,7 +19,7 @@ import time
 from typing import Dict, Optional, TextIO
 
 DEBUG, INFO, ERROR, NONE = 0, 1, 2, 3
-_NAMES = {DEBUG: "debug", INFO: "info", ERROR: "error", "none": NONE}
+_NAMES = {DEBUG: "debug", INFO: "info", ERROR: "error", NONE: "none"}
 _BY_NAME = {"debug": DEBUG, "info": INFO, "error": ERROR, "none": NONE}
 
 

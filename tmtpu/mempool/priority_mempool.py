@@ -88,6 +88,8 @@ class PriorityMempool:
                         f"lower-priority tx to evict")
                 del self._txs[victim_key]
                 self._txs_bytes -= len(victim["tx"])
+                # evicted txs must be re-submittable (they're in no block)
+                self.cache.remove(victim["tx"])
             self._txs[key] = {
                 "tx": tx, "priority": res.priority,
                 "gas_wanted": res.gas_wanted, "seq": next(self._seq),

@@ -194,9 +194,15 @@ class MConnection:
                         now - self._ping_sent_at > self.PONG_TIMEOUT:
                     raise ConnectionError(
                         "pong timeout: peer unresponsive")
-                # drain by priority until all queues empty
-                while self._send_some():
-                    pass
+                # drain by priority — bounded per pass so ping/pong (and
+                # the pong deadline) stay serviced while queues are busy;
+                # the rate limiter can make each packet block, so an
+                # unbounded drain would starve keepalives entirely
+                for _ in range(256):
+                    if not self._send_some():
+                        break
+                else:
+                    self._send_event.set()  # more to drain next pass
         except Exception as e:  # noqa: BLE001
             if not self._stopped.is_set():
                 self._on_error(e)

@@ -83,7 +83,7 @@ class SecretConnection:
         shared = eph_priv.exchange(X25519PublicKey.from_public_bytes(
             remote_eph_pub))
         transcript.append_message(b"DH_SECRET", shared)
-        okm = HKDF(algorithm=hashes.SHA256(), length=96, salt=None,
+        okm = HKDF(algorithm=hashes.SHA256(), length=64, salt=None,
                    info=b"TENDERMINT_SECRET_CONNECTION_KEY_AND_CHALLENGE_GEN"
                    ).derive(shared)
         loc_is_least = eph_pub < remote_eph_pub

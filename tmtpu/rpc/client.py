@@ -101,14 +101,21 @@ class HTTPClient:
 
     # -- txs ----------------------------------------------------------------
 
+    # txs go as base64 — the server's POST contract (_decode_tx tries
+    # base64 first, so a raw string that HAPPENS to be valid base64 would
+    # be mangled)
+
     def broadcast_tx_sync(self, tx: bytes):
-        return self.call("broadcast_tx_sync", tx=tx.decode("latin-1"))
+        return self.call("broadcast_tx_sync",
+                         tx=base64.b64encode(tx).decode())
 
     def broadcast_tx_async(self, tx: bytes):
-        return self.call("broadcast_tx_async", tx=tx.decode("latin-1"))
+        return self.call("broadcast_tx_async",
+                         tx=base64.b64encode(tx).decode())
 
     def broadcast_tx_commit(self, tx: bytes):
-        return self.call("broadcast_tx_commit", tx=tx.decode("latin-1"))
+        return self.call("broadcast_tx_commit",
+                         tx=base64.b64encode(tx).decode())
 
     def tx(self, hash_hex: str, prove: bool = False):
         return self.call("tx", hash=hash_hex, prove=prove)
@@ -143,8 +150,10 @@ class WSClient:
 
     def __init__(self, base_url: str, timeout: float = 30.0):
         u = base_url.rstrip("/")
-        hostport = u.split("://", 1)[-1]
-        host, _, port = hostport.rpartition(":")
+        hostport = u.split("://", 1)[-1].split("/", 1)[0]
+        host, sep, port = hostport.rpartition(":")
+        if not sep:  # no explicit port
+            host, port = hostport, "80"
         self.sock = socket.create_connection((host or "127.0.0.1",
                                               int(port)), timeout=timeout)
         key = base64.b64encode(os.urandom(16)).decode()
