@@ -10,7 +10,8 @@ bytes → validity-mask + power-tally + bitarray pipeline for 10,000 REAL
 distinct votes (distinct keys, distinct canonical vote sign-bytes) —
 host prep (length/canonicality checks, SHA-512 challenge hashing, mod-L
 reduction, digit extraction), H2D transfer, and the device
-verify+tally step (tmtpu.tpu.sharding.verify_tally_step). Steady state is
+verify+tally step (tmtpu.tpu.sharding.verify_tally_step_compact);
+steady state is
 double-buffered: batch k+1 preps on the host while batch k runs on the
 device, exactly how the consensus batching window uses it.
 
@@ -126,10 +127,10 @@ def main():
 
     powers = jnp.asarray(sh.powers_to_limbs([1000] * LANES))
     table = tv.base_table_f32()
-    step = jax.jit(sh.verify_tally_step)
+    step = jax.jit(sh.verify_tally_step_compact)
 
     def prep():
-        args, host_ok = tv.prepare_batch(pks, msgs, sigs)
+        args, host_ok = tv.prepare_batch_compact(pks, msgs, sigs)
         assert host_ok.all()
         return args
 

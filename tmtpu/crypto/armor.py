@@ -75,7 +75,9 @@ def decode_armor(armor_str: str) -> Tuple[str, Dict[str, str], bytes]:
             continue
         body.append(ln)
     data = base64.b64decode("".join(body))
-    if checksum is not None and _crc24(data) != checksum:
+    if checksum is None:
+        raise ValueError("armor missing CRC-24 checksum line")
+    if _crc24(data) != checksum:
         raise ValueError("armor checksum mismatch")
     return block_type, headers, data
 

@@ -135,8 +135,8 @@ class TPUBatchVerifier(BatchVerifier):
     def verify_tally(self) -> Tuple[bool, List[bool], int]:
         """Fused verify + power tally: ed25519 lanes get ONE device dispatch
         that returns both the validity mask and the psum of valid lanes'
-        powers (tmtpu.tpu.sharding.verify_tally_step); other curves fall
-        back to serial verify with host-side summation."""
+        powers (tmtpu.tpu.sharding.verify_tally_step_compact); other
+        curves fall back to serial verify with host-side summation."""
         return self._run(tally=True)
 
     def _run(self, tally: bool) -> Tuple[bool, List[bool], int]:

@@ -64,14 +64,15 @@ def pack_bitarray(mask):
     )
 
 
-def verify_tally_step(pk_y, pk_sign, r_y, r_sign, s_digits, h_digits,
-                      power_limbs, table):
+def verify_tally_step_compact(pk_b, r_b, s_b, h_b, power_limbs, table):
     """The flagship device step: batch-verify all lanes, then reduce the
     valid lanes' voting power and pack the validity bitarray — the fused
-    VoteSet.addVote hot path (types/vote_set.go:233-304) for a whole round's
-    votes at once. Returns (mask [B] bool, power_sums [5] int32,
-    bit_words [B/32] uint32)."""
-    mask = tv.verify_core(pk_y, pk_sign, r_y, r_sign, s_digits, h_digits, table)
+    VoteSet.addVote hot path (types/vote_set.go:233-304) for a whole
+    round's votes at once. Inputs are raw [32, B] byte columns (128 B/lane
+    over the host->device link), unpacked on device
+    (tv.verify_core_compact). Returns (mask [B] bool, power_sums [5]
+    int32, bit_words [B/32] uint32)."""
+    mask = tv.verify_core_compact(pk_b, r_b, s_b, h_b, table)
     power_sums = jnp.sum(power_limbs * mask[None].astype(jnp.int32), axis=1)
     return mask, power_sums, pack_bitarray(mask)
 
@@ -83,16 +84,17 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
     return Mesh(np.asarray(devs), ("sig",))
 
 
-def sharded_verify_tally(mesh: Mesh):
-    """Build the pjit'd multi-chip step for ``mesh``. Lane arrays are sharded
-    on the batch dim; the power reduction crosses devices as an XLA psum.
-    Returns a callable with the same signature as ``verify_tally_step``."""
+def sharded_verify_tally_compact(mesh: Mesh):
+    """Build the pjit'd multi-chip step for ``mesh``: every [32, B] byte
+    column shards on its lane ("sig") dimension, unpack happens
+    shard-locally, and only the power reduction crosses devices as an XLA
+    psum riding ICI."""
     lane = NamedSharding(mesh, P(None, "sig"))
     flat = NamedSharding(mesh, P("sig"))
     repl = NamedSharding(mesh, P())
     return jax.jit(
-        verify_tally_step,
-        in_shardings=(lane, flat, lane, flat, lane, lane, lane, repl),
+        verify_tally_step_compact,
+        in_shardings=(lane, lane, lane, lane, lane, repl),
         out_shardings=(flat, repl, flat),
     )
 
@@ -103,14 +105,15 @@ _fused_jit = None
 def _fused_step():
     global _fused_jit
     if _fused_jit is None:
-        _fused_jit = jax.jit(verify_tally_step)
+        _fused_jit = jax.jit(verify_tally_step_compact)
     return _fused_jit
 
 
 def batch_verify_tally(pks, msgs, sigs, powers):
     """Host-facing fused entry: bytes -> (validity mask [B] bool ndarray,
     summed voting power of valid lanes as a Python int). One device dispatch
-    runs verify + power-psum + bitarray pack (verify_tally_step); this is
+    runs verify + power-psum + bitarray pack (verify_tally_step_compact);
+    this is
     what crypto.batch.TPUBatchVerifier.verify_tally calls.
 
     Lanes failing the host-side checks (bad lengths, s >= L, non-canonical
@@ -119,7 +122,7 @@ def batch_verify_tally(pks, msgs, sigs, powers):
     B = len(sigs)
     if B == 0:
         return np.zeros(0, dtype=bool), 0
-    args, host_ok = tv.prepare_batch(pks, msgs, sigs)
+    args, host_ok = tv.prepare_batch_compact(pks, msgs, sigs)
     p = np.asarray(powers, dtype=np.int64).copy()
     assert p.shape == (B,)
     p[~host_ok] = 0
@@ -140,13 +143,13 @@ def _tile(a, reps):
 
 def example_batch(lanes: int):
     """Deterministic well-formed device args with ``lanes`` lanes (one real
-    signature tiled), for compile checks and benchmarks."""
+    signature tiled), for compile checks and benchmarks (compact form)."""
     from tmtpu.crypto import ed25519_ref as ref
 
     seed = bytes(range(32))
     msg = b"tmtpu-example-vote-sign-bytes" * 4
     pk = ref.public_key(seed)
     sig = ref.sign(seed, msg)
-    args, host_ok = tv.prepare_batch([pk], [msg], [sig])
+    args, host_ok = tv.prepare_batch_compact([pk], [msg], [sig])
     assert host_ok.all()
     return tuple(_tile(a, lanes) for a in args)

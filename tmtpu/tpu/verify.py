@@ -10,11 +10,12 @@ tmtpu.crypto.ed25519_ref.verify):
 Split of labor:
 - **host** (cheap, data-dependent byte work): length checks, ``s < L``,
   canonical-``y`` check on A, SHA-512 (messages are short and distinct),
-  reduction mod L, 4-bit window digit extraction — all vectorized numpy or
-  per-item hashlib;
-- **device** (all the field/curve arithmetic — ~99% of the FLOPs): point
-  decompression (sqrt in GF(p)), the shared-doubling Straus/Shamir ladder
-  [s]B + [h](-A), and the byte-exact compressed comparison.
+  reduction mod L — vectorized numpy / C-backed hashlib;
+- **device**: byte->limb unpacking and 4-bit window extraction (raw
+  32-byte columns ship over the host link — 128 B/lane), then all the
+  field/curve arithmetic (~99% of the FLOPs): point decompression (sqrt
+  in GF(p)), the shared-doubling Straus/Shamir ladder [s]B + [h](-A), and
+  the byte-exact compressed comparison.
 
 Every device op is elementwise over the trailing batch dimension, so the
 whole pipeline shards over a device mesh by splitting lanes (data parallel
@@ -92,37 +93,38 @@ def verify_core(pk_y, pk_sign, r_y, r_sign, s_digits, h_digits, base_table):
     return a_ok & curve.compress_check(r_prime, r_y, r_sign)
 
 
+def digits_msb_device(s_bytes):
+    """DEVICE [32, B] scalar bytes (LE) -> [64, B] int32 4-bit windows,
+    most-significant first (MSB-first because the Straus ladder consumes
+    windows high-to-low)."""
+    s = s_bytes.astype(jnp.int32)
+    lo = s & 0x0F
+    hi = s >> 4
+    # interleave LSB-first: window 2i = lo[i], 2i+1 = hi[i]
+    inter = jnp.stack([lo, hi], axis=1).reshape((64,) + s.shape[1:])
+    return inter[::-1]
+
+
+def verify_core_compact(pk_b, r_b, s_b, h_b, base_table):
+    """Compact-transfer device graph: raw 32-byte columns in, mask out.
+
+    pk_b, r_b, s_b, h_b: [32, B] uint8 — the A and R encodings and the
+    s / h scalars exactly as on the wire (128 B/lane vs 848 B/lane for
+    pre-unpacked limbs+digits; unpacking is a handful of elementwise ops).
+    Host guarantees: s < L, A.y canonical (host_ok covers violators).
+    """
+    pk_sign = (pk_b[31] >> 7).astype(jnp.int32)
+    r_sign = (r_b[31] >> 7).astype(jnp.int32)
+    mask_hi = jnp.asarray(0x7F, dtype=pk_b.dtype)
+    pk_y = fe.pack_bytes_device(pk_b.at[31].set(pk_b[31] & mask_hi))
+    r_y = fe.pack_bytes_device(r_b.at[31].set(r_b[31] & mask_hi))
+    return verify_core(pk_y, pk_sign, r_y, r_sign,
+                       digits_msb_device(s_b), digits_msb_device(h_b),
+                       base_table)
+
+
 # ---------------------------------------------------------------------------
 # Host-side preparation.
-
-
-def _digits_msb_first(scalars_le: np.ndarray) -> np.ndarray:
-    """[B, 32] uint8 little-endian scalars -> [64, B] int32 4-bit windows,
-    most-significant window first (the ladder consumes MSB→LSB)."""
-    lo = (scalars_le & 0x0F).astype(np.int32)
-    hi = (scalars_le >> 4).astype(np.int32)
-    # window index 2i = low nibble of byte i, 2i+1 = high nibble (LSB-first)
-    digits = np.empty((scalars_le.shape[0], 64), dtype=np.int32)
-    digits[:, 0::2] = lo
-    digits[:, 1::2] = hi
-    return np.ascontiguousarray(digits[:, ::-1].T)  # MSB-first, [64, B]
-
-
-def _y_limbs_and_sign(enc: np.ndarray):
-    """[B, 32] uint8 point encodings -> ([20, B] y limbs, [B] sign bits,
-    [B] y-canonical mask)."""
-    sign = (enc[:, 31] >> 7).astype(np.int32)
-    masked = enc.copy()
-    masked[:, 31] &= 0x7F
-    # canonicality (y < p = 2^255 - 19): y is non-canonical iff its low 255
-    # bits are in [p, 2^255), i.e. byte0 >= 0xED and bytes 1..30 all 0xFF and
-    # masked byte31 == 0x7F. Exact and fully vectorized.
-    canonical = ~(
-        (masked[:, 0] >= 0xED)
-        & np.all(masked[:, 1:31] == 0xFF, axis=1)
-        & (masked[:, 31] == 0x7F)
-    )
-    return fe.pack_bytes_le(masked), sign, canonical
 
 
 _L_LE = np.frombuffer(int.to_bytes(L, 32, "little"), dtype=np.uint8)
@@ -142,18 +144,15 @@ def _s_below_l(s_arr: np.ndarray) -> np.ndarray:
     return any_diff & (s_arr[rows, idx] < _L_LE[idx])
 
 
-def prepare_batch(pks, msgs, sigs):
-    """Host prep for a batch. pks/sigs: list of bytes (or [B,32]/[B,64]
-    arrays); msgs: list of bytes. Returns (device_args, host_ok mask).
+def prepare_batch_compact(pks, msgs, sigs):
+    """Compact host prep: returns ([32, B] uint8 x4 (pk, r, s, h), host_ok).
 
-    host_ok covers the checks the device never sees: wrong lengths,
-    non-canonical s (>= L), non-canonical A.y (>= p). Lanes failing host_ok
-    get dummy-but-wellformed device inputs (lane result is ANDed away).
-
-    Fully vectorized except two C-backed comprehensions (SHA-512 and the
-    512-bit mod-L reduction via Python ints) — ~3 µs/lane total, so a 10k
-    VoteSet preps in ~30 ms and pipelines behind the device step.
-    """
+    Host-side checks (the ones the device never sees): wrong lengths,
+    non-canonical s (>= L), non-canonical A.y (>= p); violating lanes get
+    dummy-but-wellformed inputs and are masked via host_ok. No limb/digit
+    expansion here — that runs on device (verify_core_compact) — so the
+    host does only byte shuffling plus SHA-512 challenge hashing and the
+    mod-L reduction."""
     B = len(sigs)
     pks_b = [bytes(p) for p in pks]
     sigs_b = [bytes(s) for s in sigs]
@@ -166,17 +165,12 @@ def prepare_batch(pks, msgs, sigs):
         sigs_b = [s if ok else _ZERO64 for s, ok in zip(sigs_b, len_ok)]
     sig_arr = np.frombuffer(b"".join(sigs_b), dtype=np.uint8).reshape(B, 64)
     pk_arr = np.frombuffer(b"".join(pks_b), dtype=np.uint8).reshape(B, 32)
-    # .copy(), not ascontiguousarray: for B=1 the slice of the frombuffer
-    # view is already contiguous and would stay READ-ONLY, breaking the
-    # invalid-lane zeroing below
     r_arr = sig_arr[:, :32].copy()
     s_arr = sig_arr[:, 32:].copy()
     host_ok = len_ok & _s_below_l(s_arr)
-    # keep the documented invariant: the device never sees s >= L
     if not host_ok.all():
         s_arr[~host_ok] = 0
-    # challenge scalars: h = SHA-512(R || A || M) mod L, per lane
-    h_scalars = np.frombuffer(
+    h_arr = np.frombuffer(
         b"".join(
             int.to_bytes(
                 int.from_bytes(
@@ -188,16 +182,19 @@ def prepare_batch(pks, msgs, sigs):
         ),
         dtype=np.uint8,
     ).reshape(B, 32)
-    pk_y, pk_sign, pk_canon = _y_limbs_and_sign(pk_arr)
-    host_ok &= pk_canon
-    r_y, r_sign, _ = _y_limbs_and_sign(r_arr)  # R canonicality is implicit in
-    # the byte compare: encode(R') is always canonical, so a non-canonical
-    # claimed R simply never matches.
+    # canonicality of A.y (device packs the masked bytes; the check is host's)
+    masked = pk_arr.copy()
+    masked[:, 31] &= 0x7F
+    host_ok &= ~(
+        (masked[:, 0] >= 0xED)
+        & np.all(masked[:, 1:31] == 0xFF, axis=1)
+        & (masked[:, 31] == 0x7F)
+    )
     args = (
-        jnp.asarray(pk_y), jnp.asarray(pk_sign),
-        jnp.asarray(r_y), jnp.asarray(r_sign),
-        jnp.asarray(_digits_msb_first(s_arr)),
-        jnp.asarray(_digits_msb_first(h_scalars)),
+        jnp.asarray(np.ascontiguousarray(pk_arr.T)),
+        jnp.asarray(np.ascontiguousarray(r_arr.T)),
+        jnp.asarray(np.ascontiguousarray(s_arr.T)),
+        jnp.asarray(np.ascontiguousarray(h_arr.T)),
     )
     return args, host_ok
 
@@ -215,8 +212,8 @@ def base_table_f32():
 
 
 @jax.jit
-def _verify_jit(pk_y, pk_sign, r_y, r_sign, s_digits, h_digits, table):
-    return verify_core(pk_y, pk_sign, r_y, r_sign, s_digits, h_digits, table)
+def _verify_compact_jit(pk_b, r_b, s_b, h_b, table):
+    return verify_core_compact(pk_b, r_b, s_b, h_b, table)
 
 
 def _pad_to_bucket(n: int) -> int:
@@ -256,7 +253,7 @@ def batch_verify(pks, msgs, sigs) -> np.ndarray:
     B = len(sigs)
     if B == 0:
         return np.zeros(0, dtype=bool)
-    args, host_ok = prepare_batch(pks, msgs, sigs)
+    args, host_ok = prepare_batch_compact(pks, msgs, sigs)
     args = pad_args_to_bucket(args, B, _pad_to_bucket(B))
-    mask = np.asarray(_verify_jit(*args, base_table_f32()))[:B]
+    mask = np.asarray(_verify_compact_jit(*args, base_table_f32()))[:B]
     return mask & host_ok
