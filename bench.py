@@ -125,13 +125,36 @@ def main():
     print(f"bench: generated {LANES} votes in "
           f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
-    powers = jnp.asarray(sh.powers_to_limbs([1000] * LANES))
-    table = tv.base_table_f32()
-    step = jax.jit(sh.verify_tally_step_compact)
+    use_kernel = tv.use_pallas_kernel()
+    # kernel path: lanes pad to a tile multiple (10000 -> 10240); padded
+    # lanes replicate lane 0's bytes but carry ZERO power, so the tally is
+    # exact. XLA path: exact LANES.
+    if use_kernel:
+        from tmtpu.tpu import kernel as tk
+
+        tile = tk.DEFAULT_TILE
+        pad = ((LANES + tile - 1) // tile) * tile
+    else:
+        pad = LANES
+    power_list = [1000] * LANES + [0] * (pad - LANES)
+    powers = jnp.asarray(sh.powers_to_limbs(power_list))
+    if use_kernel:
+        # production TPU path: the fused Pallas kernel (tmtpu/tpu/kernel.py)
+        # + XLA tally
+        step_kernel = jax.jit(sh.verify_tally_step_kernel)
+        table = None
+        step = lambda *a: step_kernel(*a[:-1])  # drop table arg
+    else:
+        table = tv.base_table_f32()
+        step = jax.jit(sh.verify_tally_step_compact)
+    print(f"bench: device impl = {'pallas' if use_kernel else 'xla'}",
+          file=sys.stderr)
 
     def prep():
         args, host_ok = tv.prepare_batch_compact(pks, msgs, sigs)
         assert host_ok.all()
+        if pad != LANES:
+            args = tv.pad_args_to_bucket(args, LANES, pad)
         return args
 
     # warmup / compile

@@ -1,0 +1,108 @@
+"""Native (C) host-side helpers for the TPU crypto pipeline.
+
+``hostprep`` — batched SHA-512 challenge hashing + mod-L reduction + the
+canonical-s check, the host half of ed25519 batch verification (the device
+half is tmtpu/tpu/kernel.py). Reference semantics:
+crypto/ed25519/ed25519.go:148-155 (h = SHA-512(R||A||M)) and scMinimal
+(s < L); spec oracle tmtpu/crypto/ed25519_ref.py.
+
+The library is built lazily with the system C compiler (cc -O2 -shared
+-pthread) into this directory and loaded over ctypes; when no toolchain is
+available, callers fall back to the vectorized numpy/hashlib path in
+tmtpu/tpu/verify.py — same results, more host CPU.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "hostprep.c")
+_SO = os.path.join(_DIR, "_hostprep.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    for cc in ("cc", "gcc", "g++", "clang"):
+        try:
+            r = subprocess.run(
+                [cc, "-O2", "-shared", "-fPIC", "-pthread", "-o", _SO, _SRC],
+                capture_output=True, timeout=120,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if r.returncode == 0:
+            return True
+    return False
+
+
+def load():
+    """ctypes handle to the hostprep library, or None when unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) or (
+            os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+        ):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.tmtpu_prep_ed25519.argtypes = [
+            ctypes.c_size_t,
+            ctypes.c_void_p,  # pks  n*32
+            ctypes.c_void_p,  # rs   n*32
+            ctypes.c_void_p,  # ss   n*32
+            ctypes.c_void_p,  # msgs concatenated
+            ctypes.c_void_p,  # moff n+1 uint64
+            ctypes.c_void_p,  # h_out n*32
+            ctypes.c_void_p,  # s_ok  n
+            ctypes.c_int,     # nthreads
+        ]
+        lib.tmtpu_prep_ed25519.restype = None
+        _lib = lib
+        return _lib
+
+
+def prep_ed25519(pk_arr: np.ndarray, r_arr: np.ndarray, s_arr: np.ndarray,
+                 msgs, nthreads: int | None = None):
+    """Batched h = SHA-512(R||A||M) mod L and s < L.
+
+    pk_arr/r_arr/s_arr: [B, 32] uint8 C-contiguous; msgs: list of bytes.
+    Returns (h_arr [B, 32] uint8, s_ok bool [B]) or None when the native
+    library is unavailable.
+    """
+    lib = load()
+    if lib is None:
+        return None
+    B = pk_arr.shape[0]
+    if nthreads is None:
+        nthreads = min(8, os.cpu_count() or 1)
+    moff = np.zeros(B + 1, dtype=np.uint64)
+    lens = np.fromiter((len(m) for m in msgs), dtype=np.uint64, count=B)
+    np.cumsum(lens, out=moff[1:])
+    blob = b"".join(bytes(m) for m in msgs)
+    msgs_buf = np.frombuffer(blob, dtype=np.uint8) if blob else \
+        np.zeros(1, dtype=np.uint8)
+    h_out = np.empty((B, 32), dtype=np.uint8)
+    s_ok = np.empty(B, dtype=np.uint8)
+    lib.tmtpu_prep_ed25519(
+        B,
+        pk_arr.ctypes.data, r_arr.ctypes.data, s_arr.ctypes.data,
+        msgs_buf.ctypes.data, moff.ctypes.data,
+        h_out.ctypes.data, s_ok.ctypes.data,
+        int(nthreads),
+    )
+    return h_out, s_ok.astype(bool)

@@ -24,13 +24,10 @@ def force_cpu_backend(n_devices: int = 8) -> None:
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
+    # The config pin (after import, so it wins over sitecustomize's env) is
+    # sufficient: backend init is lazy and only the requested platform is
+    # initialized, so the axon tunnel is never dialed. Do NOT pop the other
+    # backend factories from xla_bridge: their registration is what makes
+    # "tpu" a known lowering platform, and removing it breaks importing
+    # jax.experimental.pallas (checkify registers a tpu lowering rule).
     jax.config.update("jax_platforms", "cpu")
-    try:
-        import jax._src.xla_bridge as xb
-
-        reg = getattr(xb, "_backend_factories", None)
-        if reg:
-            for k in [k for k in list(reg) if k != "cpu"]:
-                reg.pop(k)
-    except Exception:
-        pass  # registry layout changed; jax_platforms=cpu should still win

@@ -32,7 +32,11 @@ D2_LIMBS = fe.limbs_of_int(D2_INT)
 
 def identity(batch_shape):
     z = jnp.zeros((fe.NLIMBS,) + tuple(batch_shape), dtype=jnp.int32)
-    one = z.at[0].add(1)
+    # concat instead of .at[0:1].set: .at lowers to scatter, which Mosaic
+    # (the Pallas TPU kernel reuses this) has no lowering for
+    one = jnp.concatenate(
+        [jnp.ones((1,) + tuple(batch_shape), dtype=jnp.int32), z[1:]], axis=0
+    )
     return (z, one, one, z)
 
 
@@ -81,7 +85,7 @@ def add_cached(p, c):
 
 def to_cached(p):
     X, Y, Z, T = p
-    d2 = jnp.asarray(D2_LIMBS)[:, None]
+    d2 = fe.const_col("D2", D2_LIMBS)
     return (fe.sub(Y, X), fe.add(Y, X), fe.add(Z, Z), fe.mul(T, d2))
 
 

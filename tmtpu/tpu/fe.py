@@ -29,6 +29,7 @@ needs an exact sequential borrow chain, and it runs once per verification.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 
 import jax
@@ -63,6 +64,44 @@ def int_of_limbs(a) -> int:
 
 
 P_LIMBS = limbs_of_int(P_INT)
+
+
+# ---------------------------------------------------------------------------
+# Constant plumbing. Outside Pallas, limb-vector constants are just
+# jnp.asarray'd numpy arrays (XLA embeds them). Inside a Pallas kernel,
+# closed-over arrays are rejected ("captures constants — pass them as
+# inputs"), so tmtpu.tpu.kernel passes one [20, n] constants plane as a
+# kernel input and installs its columns here; every fe/curve routine then
+# picks constants up from the active context.
+
+import contextvars
+
+# ContextVar, not a module global: a kernel trace on one thread must not
+# leak its Ref-slice constants into an XLA-path trace running concurrently
+# on another thread (e.g. consensus compiling the kernel while an RPC
+# thread verifies over the plain graph).
+_CONST_CTX: contextvars.ContextVar[dict | None] = contextvars.ContextVar(
+    "tmtpu_fe_const_ctx", default=None)
+
+
+@contextlib.contextmanager
+def const_context(consts: dict):
+    """Install kernel-provided full-width constant planes (keys: K64P,
+    P_LIMBS, D2, D, SQRT_M1) for the duration of a kernel trace."""
+    token = _CONST_CTX.set(consts)
+    try:
+        yield
+    finally:
+        _CONST_CTX.reset(token)
+
+
+def const_col(name: str, np_vec) -> jnp.ndarray:
+    """Column(s) for a named limb constant — from the kernel context when
+    one is active ([20, T] there), else a plain embedded [20, 1]."""
+    ctx = _CONST_CTX.get()
+    if ctx is not None:
+        return ctx[name]
+    return jnp.asarray(np_vec)[:, None]
 
 
 def pack_bytes_le(b: np.ndarray) -> np.ndarray:
@@ -100,17 +139,44 @@ def pack_bytes_device(b):
     return (limbs * w[None, :, None]).sum(axis=1, dtype=jnp.int32)
 
 
+def at_add(x, lo: int, v):
+    """x.at[lo:lo+v.shape[0]].add(v), in the form the active compiler
+    wants.
+
+    jax lowers ``.at[].add`` to scatter-add even for static slices, and
+    Mosaic (Pallas TPU) has no scatter-add lowering — while
+    dynamic-update-slice + elementwise add are native to it. Outside the
+    kernel the scatter form stays: XLA fuses it well, and the zeros-DUS-add
+    expansion blows up XLA:CPU compile time (the multichip dryrun budget).
+    Kernel traces are detected via the active const_context (installed by
+    tmtpu.tpu.kernel for exactly the duration of the kernel trace); its
+    "_dus" entry is False for interpret-mode kernels, which execute through
+    XLA CPU where the scatter form is both supported and much faster to
+    compile."""
+    ctx = _CONST_CTX.get()
+    if ctx is not None and ctx.get("_dus", True):
+        n = v.shape[0]
+        parts = []
+        if lo:
+            parts.append(x[:lo])
+        parts.append(x[lo : lo + n] + v)
+        if lo + n < x.shape[0]:
+            parts.append(x[lo + n :])
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+    return x.at[lo : lo + v.shape[0]].add(v)
+
+
 def _carry_pass(x, fold):
     """One vectorized carry pass. If ``fold`` is nonzero, the carry out of
     the top limb wraps to limb 0 multiplied by ``fold``; otherwise the top
     limb keeps its excess (caller guarantees no overflow)."""
     c = x >> RADIX
     x = x - (c << RADIX)
-    x = x.at[1:].add(c[:-1])
+    x = at_add(x, 1, c[:-1])
     if fold:
-        x = x.at[0].add(fold * c[-1])
+        x = at_add(x, 0, fold * c[-1:])
     else:
-        x = x.at[-1].add(c[-1] << RADIX)
+        x = at_add(x, x.shape[0] - 1, c[-1:] << RADIX)
     return x
 
 
@@ -156,7 +222,7 @@ def sub(a, b):
     [15168-9500, 16383+2*9500] ⊂ [5668, 35383]; two passes: after pass 1
     carries ≤ 4 so limb0 ≤ 8191+4+608*4 ≤ 10627, after pass 2 carries ≤ 1 so
     limbs ≤ 8191+1+608 = 8800."""
-    return carry(a + jnp.asarray(K64P)[:, None] - b, 2)
+    return carry(a + const_col("K64P", K64P) - b, 2)
 
 
 def neg(a):
@@ -185,9 +251,14 @@ def mul(a, b):
     """Schoolbook product + reduction. Inputs loose (≤ 9500 -> coefficient
     bound 20*9500^2 = 1.805e9 < 2^31-1). Output loose (≤ 8800)."""
     B = jnp.broadcast_shapes(a.shape[1:], b.shape[1:])
+    # broadcast [20, 1] constants up front: per-row slices of an
+    # unbroadcast constant are [1, 1] and their implicit broadcast against
+    # [20, B] is a 2-axis broadcast Mosaic can't lower (XLA: free either way)
+    a = jnp.broadcast_to(a, (NLIMBS,) + B)
+    b = jnp.broadcast_to(b, (NLIMBS,) + B)
     c = jnp.zeros((2 * NLIMBS,) + B, dtype=jnp.int32)
     for i in range(NLIMBS):
-        c = c.at[i : i + NLIMBS].add(a[i][None] * b)
+        c = at_add(c, i, a[i : i + 1] * b)
     return _fold_product(c)
 
 
@@ -199,9 +270,9 @@ def sq(a):
     a2 = a + a  # ≤ 19000; only ever multiplied by a ≤ 9500 below
     c = jnp.zeros((2 * NLIMBS,) + B, dtype=jnp.int32)
     for i in range(NLIMBS):
-        c = c.at[2 * i].add(a[i] * a[i])
+        c = at_add(c, 2 * i, a[i : i + 1] * a[i : i + 1])
         if i + 1 < NLIMBS:
-            c = c.at[2 * i + 1 : i + NLIMBS].add(a2[i][None] * a[i + 1 :])
+            c = at_add(c, 2 * i + 1, a2[i : i + 1] * a[i + 1 :])
     return _fold_product(c)
 
 
@@ -218,22 +289,22 @@ def freeze(x):
     for _ in range(2):
         # value < 2^260: bits ≥ 255 live in limb 19 (weight 2^247) bits ≥ 8.
         # Subtract q*2^255 and add q*19 (2^255 ≡ 19 mod p).
-        q = x[NLIMBS - 1] >> (255 - RADIX * (NLIMBS - 1))
-        x = x.at[NLIMBS - 1].add(-(q << 8))
-        x = x.at[0].add(19 * q)
+        q = x[NLIMBS - 1 :] >> (255 - RADIX * (NLIMBS - 1))
+        x = at_add(x, NLIMBS - 1, -(q << 8))
+        x = at_add(x, 0, 19 * q)
         x = carry(x, 2)
     # Now value < 2^255 + eps; exact sequential carry (no fold can trigger:
     # value < 2^256 << 2^260).
     for i in range(NLIMBS - 1):
-        c = x[i] >> RADIX
-        x = x.at[i].add(-(c << RADIX)).at[i + 1].add(c)
+        c = x[i : i + 1] >> RADIX
+        x = at_add(at_add(x, i, -(c << RADIX)), i + 1, c)
     # x may still be in [p, 2^255): conditionally subtract p with an exact
     # borrow chain.
-    t = x - jnp.asarray(P_LIMBS)[:, None]
+    t = x - const_col("P_LIMBS", P_LIMBS)
     for i in range(NLIMBS - 1):
-        c = t[i] >> RADIX
-        t = t.at[i].add(-(c << RADIX)).at[i + 1].add(c)
-    return jnp.where(t[NLIMBS - 1] < 0, x, t)
+        c = t[i : i + 1] >> RADIX
+        t = at_add(at_add(t, i, -(c << RADIX)), i + 1, c)
+    return jnp.where(t[NLIMBS - 1 :] < 0, x, t)
 
 
 def sqn(a, n: int):

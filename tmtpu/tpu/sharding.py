@@ -77,6 +77,17 @@ def verify_tally_step_compact(pk_b, r_b, s_b, h_b, power_limbs, table):
     return mask, power_sums, pack_bitarray(mask)
 
 
+def verify_tally_step_kernel(pk_b, r_b, s_b, h_b, power_limbs):
+    """verify_tally_step_compact with the verification running as the
+    fused Pallas kernel (tmtpu.tpu.kernel) — the production TPU path; the
+    tally stays a handful of XLA reduction ops on the kernel's mask."""
+    from tmtpu.tpu import kernel as tk
+
+    mask = tk.verify_compact_kernel(pk_b, r_b, s_b, h_b)
+    power_sums = jnp.sum(power_limbs * mask[None].astype(jnp.int32), axis=1)
+    return mask, power_sums, pack_bitarray(mask)
+
+
 def make_mesh(n_devices: int | None = None) -> Mesh:
     devs = jax.devices()
     if n_devices is not None:
@@ -100,6 +111,7 @@ def sharded_verify_tally_compact(mesh: Mesh):
 
 
 _fused_jit = None
+_fused_kernel_jit = None
 
 
 def _fused_step():
@@ -107,6 +119,13 @@ def _fused_step():
     if _fused_jit is None:
         _fused_jit = jax.jit(verify_tally_step_compact)
     return _fused_jit
+
+
+def _fused_kernel_step():
+    global _fused_kernel_jit
+    if _fused_kernel_jit is None:
+        _fused_kernel_jit = jax.jit(verify_tally_step_kernel)
+    return _fused_kernel_jit
 
 
 def batch_verify_tally(pks, msgs, sigs, powers):
@@ -126,13 +145,22 @@ def batch_verify_tally(pks, msgs, sigs, powers):
     p = np.asarray(powers, dtype=np.int64).copy()
     assert p.shape == (B,)
     p[~host_ok] = 0
+    use_kernel = tv.use_pallas_kernel()
     padded = tv._pad_to_bucket(B)
+    if use_kernel:
+        from tmtpu.tpu import kernel as tk
+
+        padded = max(tk.DEFAULT_TILE, padded)
     power_limbs = np.zeros((POWER_LIMBS, padded), dtype=np.int32)
     power_limbs[:, :B] = powers_to_limbs(p)
     args = tv.pad_args_to_bucket(args, B, padded)
-    mask, power_sums, _bits = _fused_step()(
-        *args, jnp.asarray(power_limbs), tv.base_table_f32()
-    )
+    if use_kernel:
+        mask, power_sums, _bits = _fused_kernel_step()(
+            *args, jnp.asarray(power_limbs))
+    else:
+        mask, power_sums, _bits = _fused_step()(
+            *args, jnp.asarray(power_limbs), tv.base_table_f32()
+        )
     mask = np.asarray(mask)[:B] & host_ok
     return mask, limb_sums_to_int(power_sums)
 

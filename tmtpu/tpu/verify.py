@@ -132,6 +132,21 @@ _ZERO32 = bytes(32)
 _ZERO64 = bytes(64)
 
 
+def _native_prep(pk_arr, r_arr, s_arr, msgs):
+    """Batched SHA-512 + mod-L + s<L via the C hostprep library
+    (tmtpu/native); None when no toolchain is available (callers fall back
+    to the numpy/hashlib path below). Disable with TMTPU_NO_NATIVE=1."""
+    import os
+
+    if os.environ.get("TMTPU_NO_NATIVE"):
+        return None
+    try:
+        from tmtpu import native
+    except Exception:
+        return None
+    return native.prep_ed25519(pk_arr, r_arr, s_arr, msgs)
+
+
 def _s_below_l(s_arr: np.ndarray) -> np.ndarray:
     """Vectorized canonical-s check: s < L, lexicographic over little-endian
     bytes from the most significant byte down (Go scMinimal)."""
@@ -167,21 +182,27 @@ def prepare_batch_compact(pks, msgs, sigs):
     pk_arr = np.frombuffer(b"".join(pks_b), dtype=np.uint8).reshape(B, 32)
     r_arr = sig_arr[:, :32].copy()
     s_arr = sig_arr[:, 32:].copy()
-    host_ok = len_ok & _s_below_l(s_arr)
+    native = _native_prep(pk_arr, r_arr, s_arr, msgs)
+    if native is not None:
+        h_arr, s_ok = native
+        host_ok = len_ok & s_ok
+    else:
+        host_ok = len_ok & _s_below_l(s_arr)
+        h_arr = np.frombuffer(
+            b"".join(
+                int.to_bytes(
+                    int.from_bytes(
+                        hashlib.sha512(s[:32] + p + bytes(m)).digest(),
+                        "little",
+                    ) % L,
+                    32, "little",
+                )
+                for s, p, m in zip(sigs_b, pks_b, msgs)
+            ),
+            dtype=np.uint8,
+        ).reshape(B, 32)
     if not host_ok.all():
         s_arr[~host_ok] = 0
-    h_arr = np.frombuffer(
-        b"".join(
-            int.to_bytes(
-                int.from_bytes(
-                    hashlib.sha512(s[:32] + p + bytes(m)).digest(), "little"
-                ) % L,
-                32, "little",
-            )
-            for s, p, m in zip(sigs_b, pks_b, msgs)
-        ),
-        dtype=np.uint8,
-    ).reshape(B, 32)
     # canonicality of A.y (device packs the masked bytes; the check is host's)
     masked = pk_arr.copy()
     masked[:, 31] &= 0x7F
@@ -209,6 +230,29 @@ def base_table_f32():
             curve.fixed_base_niels_table(), dtype=jnp.float32
         )
     return _BASE_TABLE_F32
+
+
+def use_pallas_kernel() -> bool:
+    """Device-graph implementation choice. The fused Pallas kernel
+    (tmtpu.tpu.kernel) is the production path on real TPUs; the plain-XLA
+    graph remains for CPU/virtual-mesh runs (tests, multichip dryrun),
+    where Mosaic isn't in play and XLA:CPU compiles the scatter form much
+    faster. Override with TMTPU_TPU_IMPL=pallas|xla."""
+    import os
+
+    impl = os.environ.get("TMTPU_TPU_IMPL", "")
+    if impl == "pallas":
+        return True
+    if impl == "xla":
+        return False
+    import jax
+
+    # the device platform, not default_backend(): under the axon PJRT
+    # plugin the backend is named "axon" but the devices are real TPUs
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
 
 
 @jax.jit
@@ -254,6 +298,13 @@ def batch_verify(pks, msgs, sigs) -> np.ndarray:
     if B == 0:
         return np.zeros(0, dtype=bool)
     args, host_ok = prepare_batch_compact(pks, msgs, sigs)
-    args = pad_args_to_bucket(args, B, _pad_to_bucket(B))
-    mask = np.asarray(_verify_compact_jit(*args, base_table_f32()))[:B]
+    if use_pallas_kernel():
+        from tmtpu.tpu import kernel as tk
+
+        padded = max(tk.DEFAULT_TILE, _pad_to_bucket(B))
+        args = pad_args_to_bucket(args, B, padded)
+        mask = np.asarray(tk.verify_compact_kernel(*args))[:B]
+    else:
+        args = pad_args_to_bucket(args, B, _pad_to_bucket(B))
+        mask = np.asarray(_verify_compact_jit(*args, base_table_f32()))[:B]
     return mask & host_ok
