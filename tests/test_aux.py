@@ -1,0 +1,182 @@
+"""Aux subsystem tests: pprof server, deadlock-detecting locks, trust
+metric, SQL sink, mock peer, abci-cli, native hostprep."""
+
+import sqlite3
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+
+def test_pprof_server_endpoints():
+    from tmtpu.rpc.pprof import PprofServer
+
+    srv = PprofServer("tcp://127.0.0.1:0")
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}/debug/pprof"
+        stacks = urllib.request.urlopen(base + "/goroutine").read().decode()
+        assert "thread" in stacks and "test_pprof_server_endpoints" in stacks
+        heap = urllib.request.urlopen(base + "/heap").read().decode()
+        assert "tracemalloc" in heap or "heap profile" in heap
+        prof = urllib.request.urlopen(
+            base + "/profile?seconds=0.3").read().decode()
+        assert isinstance(prof, str)
+        cmd = urllib.request.urlopen(base + "/cmdline").read().decode()
+        assert "py" in cmd
+    finally:
+        srv.stop()
+
+
+def test_deadlock_detection_reports(capsys):
+    from tmtpu.libs import sync as tmsync
+
+    lock = tmsync._WatchedLock("test-lock")
+    old_timeout = tmsync._timeout
+    tmsync._timeout = 0.3
+    try:
+        holder_entered = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with lock:
+                holder_entered.set()
+                release.wait(5)
+
+        t = threading.Thread(target=holder, daemon=True)
+        t.start()
+        holder_entered.wait(2)
+        got = []
+
+        def blocked():
+            lock.acquire()
+            got.append(True)
+            lock.release()
+
+        b = threading.Thread(target=blocked, daemon=True)
+        b.start()
+        time.sleep(0.8)  # > timeout: the report must have fired
+        release.set()
+        b.join(5)
+        assert got == [True]
+    finally:
+        tmsync._timeout = old_timeout
+    err = capsys.readouterr().err
+    assert "POSSIBLE DEADLOCK" in err and "test-lock" in err
+
+
+def test_mutex_factory_plain_by_default():
+    from tmtpu.libs import sync as tmsync
+
+    if not tmsync._enabled:
+        m = tmsync.Mutex()
+        assert type(m).__name__ in ("lock", "Lock") or hasattr(m, "acquire")
+
+
+def test_trust_metric_decay_and_store():
+    from tmtpu.libs.db import MemDB
+    from tmtpu.p2p.trust import TrustMetric, TrustMetricStore
+
+    t0 = 1000.0
+    m = TrustMetric(now=t0)
+    assert m.value(now=t0) == pytest.approx(1.0)
+    for _ in range(10):
+        m.bad_event(now=t0 + 1)
+    v_bad = m.value(now=t0 + 15)
+    assert v_bad < 0.6
+    # a full good interval, once closed into history, recovers trust
+    for _ in range(50):
+        m.good_event(now=t0 + 31)
+    assert m.value(now=t0 + 75) > v_bad
+
+    db = MemDB()
+    store = TrustMetricStore(db)
+    store.get("peerA").bad_event()
+    store.save()
+    store2 = TrustMetricStore(db)
+    assert store2.get("peerA") is not None
+
+
+def test_sql_sink_indexes_blocks_txs():
+    from tmtpu.state.sink_sql import SQLSink
+
+    sink = SQLSink(sqlite3.connect(":memory:"), "test-chain")
+    sink.index_block_events(1, 111, [("block_bonus", {"who": "val1"})])
+    sink.index_tx_events(1, 111, 0, "AB" * 32, b"\x01\x02",
+                         [("transfer", {"sender": "alice", "amount": "7"})])
+    sink.index_tx_events(2, 222, 0, "CD" * 32, b"\x03",
+                         [("transfer", {"sender": "bob", "amount": "9"})])
+    assert sink.tx_count() == 2
+    assert sink.find_tx_heights("transfer.sender", "alice") == [1]
+    assert sink.find_tx_heights("transfer.sender", "bob") == [2]
+    assert sink.find_tx_heights("block_bonus.who", "val1") == [1]
+
+
+def test_mock_peer_reactor():
+    from tmtpu.p2p.mock import MockPeer, MockReactor
+
+    p = MockPeer()
+    r = MockReactor([0x20, 0x21])
+    r.add_peer(p)
+    assert p.send(0x20, b"hello")
+    assert p.sent_on(0x20) == [b"hello"]
+    r.receive(0x21, p, b"payload")
+    assert r.received[0][1] == 0x21
+    p.stop()
+    assert not p.send(0x20, b"nope")
+
+
+def test_abci_cli_one_shots(tmp_path, capsys):
+    from tmtpu.abci.cli import main, parse_value
+    from tmtpu.abci.example.kvstore import KVStoreApplication
+    from tmtpu.abci.server import SocketServer
+
+    assert parse_value("0x6162") == b"ab"
+    assert parse_value('"xy"') == b"xy"
+    assert parse_value("plain") == b"plain"
+
+    srv = SocketServer("tcp://127.0.0.1:0", KVStoreApplication())
+    srv.start()
+    addr = f"tcp://127.0.0.1:{srv.listen_port}"
+    try:
+        assert main(["--address", addr, "echo", "hi"]) == 0
+        assert "hi" in capsys.readouterr().out
+        assert main(["--address", addr, "deliver_tx", "k=v"]) == 0
+        assert "OK" in capsys.readouterr().out
+        assert main(["--address", addr, "commit"]) == 0
+        assert "data.hex" in capsys.readouterr().out
+        assert main(["--address", addr, "query", "k"]) == 0
+        out = capsys.readouterr().out
+        assert "OK" in out
+        assert main(["--address", addr, "info"]) == 0
+    finally:
+        srv.stop()
+
+
+def test_native_hostprep_differential():
+    import hashlib
+
+    from tmtpu import native
+
+    if native.load() is None:
+        pytest.skip("no C toolchain")
+    rng = np.random.default_rng(5)
+    B = 300
+    pk = rng.integers(0, 256, (B, 32), dtype=np.uint8)
+    r = rng.integers(0, 256, (B, 32), dtype=np.uint8)
+    s = rng.integers(0, 256, (B, 32), dtype=np.uint8)
+    L = 2**252 + 27742317777372353535851937790883648493
+    # adversarial s lanes: L-1, L, L+1, 2^256-1, 0
+    for j, v in enumerate([L - 1, L, L + 1, 2**256 - 1, 0]):
+        s[j] = np.frombuffer(v.to_bytes(32, "little"), dtype=np.uint8)
+    msgs = [rng.integers(0, 256, int(n), dtype=np.uint8).tobytes()
+            for n in rng.integers(0, 400, B)]
+    msgs[0] = b""  # empty message edge
+    h, sok = native.prep_ed25519(pk, r, s, msgs)
+    for i in range(B):
+        d = hashlib.sha512(r[i].tobytes() + pk[i].tobytes() + msgs[i])
+        want = (int.from_bytes(d.digest(), "little") % L)
+        assert h[i].tobytes() == want.to_bytes(32, "little"), i
+        assert sok[i] == (int.from_bytes(s[i].tobytes(), "little") < L), i

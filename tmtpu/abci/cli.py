@@ -1,0 +1,140 @@
+"""abci-cli — interactive/one-shot console against an ABCI server.
+
+Reference analogue: abci/cmd/abci-cli (console + subcommands echo, info,
+deliver_tx, check_tx, commit, query; plus in-process kvstore/counter server
+modes). Talks to any socket-protocol ABCI app; values accept the reference
+console's 0x-hex and "quoted string" forms.
+
+Usage:
+    python -m tmtpu.abci.cli console --address tcp://127.0.0.1:26658
+    python -m tmtpu.abci.cli echo hello
+    python -m tmtpu.abci.cli deliver_tx "name=satoshi"
+    python -m tmtpu.abci.cli kvstore   # serve the example app
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import sys
+
+from tmtpu.abci import types as abci
+from tmtpu.abci.client import SocketClient
+
+
+def parse_value(s: str) -> bytes:
+    """Console value syntax: 0xDEADBEEF hex or "str" / bare string."""
+    if s.startswith("0x"):
+        return bytes.fromhex(s[2:])
+    if len(s) >= 2 and s[0] == '"' and s[-1] == '"':
+        return s[1:-1].encode()
+    return s.encode()
+
+
+def _print_response(kind: str, res) -> None:
+    code = getattr(res, "code", 0)
+    out = [f"-> code: {'OK' if code == 0 else code}"]
+    for field in ("data", "value", "key"):
+        v = getattr(res, field, b"")
+        if v:
+            out.append(f"-> {field}.hex: 0x{bytes(v).hex().upper()}")
+            try:
+                out.append(f"-> {field}: {bytes(v).decode()}")
+            except UnicodeDecodeError:
+                pass
+    log = getattr(res, "log", "")
+    if log:
+        out.append(f"-> log: {log}")
+    for field in ("height", "gas_used"):
+        v = getattr(res, field, 0)
+        if v:
+            out.append(f"-> {field}: {v}")
+    print("\n".join(out))
+
+
+def run_command(client: SocketClient, cmd: str, args: list[str]) -> bool:
+    if cmd in ("quit", "exit"):
+        return False
+    if cmd == "help":
+        print("commands: echo <msg> | info | deliver_tx <tx> | "
+              "check_tx <tx> | commit | query <data> | quit")
+    elif cmd == "echo":
+        res = client.echo_sync(" ".join(args))
+        print(f"-> data: {res.message}")
+    elif cmd == "info":
+        res = client.info_sync(abci.RequestInfo(version=""))
+        print(f"-> data: {res.data}\n-> last_block_height: "
+              f"{res.last_block_height}\n-> last_block_app_hash: "
+              f"0x{bytes(res.last_block_app_hash).hex().upper()}")
+    elif cmd == "deliver_tx":
+        _print_response(cmd, client.deliver_tx_sync(
+            abci.RequestDeliverTx(tx=parse_value(args[0]))))
+    elif cmd == "check_tx":
+        _print_response(cmd, client.check_tx_sync(
+            abci.RequestCheckTx(tx=parse_value(args[0]))))
+    elif cmd == "commit":
+        res = client.commit_sync()
+        print(f"-> data.hex: 0x{bytes(res.data).hex().upper()}")
+    elif cmd == "query":
+        _print_response(cmd, client.query_sync(
+            abci.RequestQuery(data=parse_value(args[0]))))
+    else:
+        print(f"unknown command {cmd!r} (try: help)", file=sys.stderr)
+    return True
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="abci-cli")
+    ap.add_argument("--address", default="tcp://127.0.0.1:26658")
+    ap.add_argument("command", nargs="?", default="console")
+    ap.add_argument("args", nargs="*")
+    ns = ap.parse_args(argv)
+
+    if ns.command in ("kvstore", "counter"):
+        # serve the example app in-process (abci-cli kvstore mode)
+        from tmtpu.abci.server import SocketServer
+
+        if ns.command == "kvstore":
+            from tmtpu.abci.example.kvstore import KVStoreApplication as App
+        else:
+            from tmtpu.abci.example.counter import CounterApplication as App
+        srv = SocketServer(ns.address, App())
+        srv.start()
+        print(f"ABCI {ns.command} server listening on {ns.address} "
+              f"(port {srv.listen_port})")
+        try:
+            import time
+
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            srv.stop()
+        return 0
+
+    client = SocketClient(ns.address)
+    client.start()
+    try:
+        if ns.command != "console":
+            run_command(client, ns.command, ns.args)
+            return 0
+        print("> type 'help' for commands")
+        while True:
+            try:
+                line = input("> ")
+            except EOFError:
+                break
+            parts = shlex.split(line)
+            if not parts:
+                continue
+            try:
+                if not run_command(client, parts[0], parts[1:]):
+                    break
+            except Exception as e:  # console keeps going on errors
+                print(f"error: {e}", file=sys.stderr)
+    finally:
+        client.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

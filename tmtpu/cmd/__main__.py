@@ -123,6 +123,8 @@ def cmd_start(args) -> int:
         cfg.rpc.laddr = args.rpc_laddr
     if args.crypto_backend:
         cfg.base.crypto_backend = args.crypto_backend
+    if getattr(args, "misbehaviors", ""):
+        cfg.base.misbehaviors = args.misbehaviors
     node = Node(cfg)
     node.start()
     rpc = node.rpc_server
@@ -296,6 +298,9 @@ def main(argv=None) -> int:
     sp.add_argument("--rpc-laddr", dest="rpc_laddr", default="")
     sp.add_argument("--crypto-backend", default="",
                     choices=["", "auto", "cpu", "tpu"])
+    sp.add_argument("--misbehaviors", default="",
+                    help="maverick-style schedule 'double-prevote@3,...' "
+                         "(byzantine test nets only)")
     sp.set_defaults(fn=cmd_start)
 
     sp = sub.add_parser("version")

@@ -152,6 +152,9 @@ class BaseConfig:
     filter_peers: bool = False
     # the new crypto backend switch (BASELINE.json: crypto.backend=tpu)
     crypto_backend: str = "auto"  # "auto" | "cpu" | "tpu"
+    # maverick-style byzantine schedule "name@height,..." (test nets only;
+    # tmtpu/consensus/misbehavior.py)
+    misbehaviors: str = ""
 
 
 @dataclass

@@ -1,0 +1,47 @@
+"""Byzantine misbehavior injection (reference analogue: test/maverick — a
+node whose consensus exposes pluggable per-height Misbehavior hooks, used
+inside e2e networks to prove the evidence pipeline end-to-end).
+
+A misbehavior schedule is ``{height: name}``; supported names:
+
+``double-prevote``
+    At the scheduled height the node signs its honest prevote AND a
+    conflicting nil prevote, gossiping both — an equivocation that honest
+    peers must turn into DuplicateVoteEvidence, gossip, commit in a block,
+    and report to the app as byzantine_validators.
+
+``absent-prevote``
+    The node stays silent in prevote at the scheduled height (liveness
+    fault: forces the round to time out and move on).
+
+The conflicting signature is produced by signing with the raw key,
+bypassing the privval double-sign protection — exactly the maverick
+setup: the *protection* is the honest node's; a byzantine node by
+definition doesn't run it.
+
+Schedule syntax (CLI ``--misbehaviors``): ``name@height[,name@height...]``
+"""
+
+from __future__ import annotations
+
+SUPPORTED = ("double-prevote", "absent-prevote")
+
+
+def parse_schedule(spec: str) -> dict[int, str]:
+    """"double-prevote@3,absent-prevote@7" -> {3: ..., 7: ...}."""
+    out: dict[int, str] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, h = part.partition("@")
+        if name not in SUPPORTED:
+            raise ValueError(f"unknown misbehavior {name!r} "
+                             f"(supported: {', '.join(SUPPORTED)})")
+        out[int(h)] = name
+    return out
+
+
+def unsafe_sign_vote(priv_validator, chain_id: str, vote) -> None:
+    """Sign bypassing HRS double-sign protection (byzantine path only)."""
+    vote.signature = priv_validator.priv_key.sign(vote.sign_bytes(chain_id))

@@ -105,6 +105,9 @@ class ConsensusState(BaseService):
         self.replay_mode = False
         # test/byzantine hook: replaces decide_proposal when set
         self.decide_proposal_override = None
+        # maverick-style misbehavior schedule {height: name}
+        # (tmtpu.consensus.misbehavior; reference test/maverick)
+        self.misbehaviors: dict = {}
         # outbound hooks, wired by the reactor (or in-proc test harnesses):
         # fired for our own signed votes / proposals so they reach peers
         self.on_own_vote = None  # callable(Vote)
@@ -852,6 +855,10 @@ class ConsensusState(BaseService):
             else rs.votes.precommits(rs.round)
         if vs is not None and vs.get_by_index(idx) is not None:
             return
+        misbehavior = self.misbehaviors.get(rs.height) \
+            if self.misbehaviors else None
+        if misbehavior == "absent-prevote" and vote_type == PREVOTE:
+            return
         if block_hash:
             block_id = BlockID(block_hash, parts.total, parts.hash)
         else:
@@ -873,6 +880,25 @@ class ConsensusState(BaseService):
         self._try_add_votes([(vote, "")])
         if self.on_own_vote is not None:
             self.on_own_vote(vote)
+        if (misbehavior == "double-prevote" and vote_type == PREVOTE
+                and block_hash):
+            # equivocate: also sign a conflicting nil prevote and gossip it
+            # (reference maverick's double-prevote; the raw-key sign bypasses
+            # our own HRS protection — byzantine by construction)
+            from tmtpu.consensus import misbehavior as mb
+
+            if getattr(self.priv_validator, "priv_key", None) is None:
+                return  # remote signer: no raw key to equivocate with
+            evil = Vote(
+                type=vote_type, height=rs.height, round=rs.round,
+                block_id=BlockID(), timestamp=vote.timestamp,
+                validator_address=vote.validator_address,
+                validator_index=idx,
+            )
+            mb.unsafe_sign_vote(self.priv_validator,
+                                self.state.chain_id, evil)
+            if self.on_own_vote is not None:
+                self.on_own_vote(evil)
 
     def _vote_time(self) -> int:
         """state.go voteTime: monotonic over last block time."""

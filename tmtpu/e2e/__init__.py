@@ -1,0 +1,12 @@
+"""End-to-end testnet harness (reference analogue: test/e2e/).
+
+The reference drives docker-compose testnets from a TOML manifest through
+stages setup/start/load/perturb/wait/test/stop (test/e2e/README.md:34-58,
+test/e2e/pkg/manifest.go:11). This harness runs the same stages with each
+node as a local subprocess of ``python -m tmtpu.cmd start`` — no Docker in
+the image — talking to the nodes only through their public surfaces: the
+config/home dir, signals, and RPC.
+"""
+
+from tmtpu.e2e.manifest import Manifest, NodeSpec, Perturbation  # noqa: F401
+from tmtpu.e2e.runner import Runner  # noqa: F401

@@ -1,0 +1,81 @@
+"""Testnet manifest (reference: test/e2e/pkg/manifest.go:11).
+
+A manifest describes a testnet declaratively: the nodes (validators and
+full nodes, with per-node start heights for catch-up testing), the tx load
+to apply, and the perturbations to inject while the net runs. Loadable
+from TOML::
+
+    chain_id = "e2e-net"
+    [load]
+    rate = 50.0
+    [[node]]
+    name = "v0"
+    [[node]]
+    name = "late"
+    validator = false
+    start_at = 5
+    [[perturbation]]
+    node = "v1"
+    op = "restart"
+    at_height = 8
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import tomllib
+from dataclasses import dataclass, field
+
+
+@dataclass
+class NodeSpec:
+    name: str
+    validator: bool = True
+    power: int = 100
+    start_at: int = 0          # join once the net reaches this height
+    # extra "section.key" -> value config overrides for this node
+    config: dict = field(default_factory=dict)
+    misbehaviors: dict = field(default_factory=dict)  # height -> name
+
+
+@dataclass
+class Perturbation:
+    node: str
+    op: str                    # kill | restart | pause | disconnect
+    at_height: int = 0         # trigger when any node reaches this height
+    delay_s: float = 1.0       # dwell time before revival (restart/pause)
+
+
+@dataclass
+class LoadSpec:
+    rate: float = 20.0         # tx/s offered
+    size: int = 32             # tx payload bytes
+
+
+@dataclass
+class Manifest:
+    chain_id: str = "e2e-testnet"
+    nodes: list[NodeSpec] = field(default_factory=list)
+    perturbations: list[Perturbation] = field(default_factory=list)
+    load: LoadSpec = field(default_factory=LoadSpec)
+    target_height: int = 12    # run until every node reaches this
+    timeout_s: float = 120.0
+
+    @staticmethod
+    def from_toml(path: str) -> "Manifest":
+        with open(path, "rb") as f:
+            data = tomllib.load(f)
+        m = Manifest(chain_id=data.get("chain_id", "e2e-testnet"),
+                     target_height=data.get("target_height", 12),
+                     timeout_s=data.get("timeout_s", 120.0))
+        for nd in data.get("node", []):
+            m.nodes.append(NodeSpec(**{
+                k: v for k, v in nd.items()
+                if k in {f.name for f in dataclasses.fields(NodeSpec)}}))
+        for pb in data.get("perturbation", []):
+            m.perturbations.append(Perturbation(**pb))
+        if "load" in data:
+            m.load = LoadSpec(**data["load"])
+        if not m.nodes:
+            m.nodes = [NodeSpec(name=f"validator{i:02d}") for i in range(4)]
+        return m

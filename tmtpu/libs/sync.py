@@ -1,0 +1,107 @@
+"""Deadlock-detecting locks (reference analogue: libs/sync — the
+``deadlock`` build tag swaps tmsync.Mutex for sasha-s/go-deadlock,
+libs/sync/deadlock.go:1-18).
+
+``Mutex()`` / ``RWLock()`` return plain threading primitives unless
+deadlock detection is enabled (env ``TMTPU_DEADLOCK=1`` or
+``enable_deadlock_detection()``), in which case every acquisition is
+watched: if a lock cannot be acquired within the timeout (default 30 s,
+``TMTPU_DEADLOCK_TIMEOUT`` seconds), a report with the blocked thread's
+and the holder's stacks goes to stderr — the same observability
+go-deadlock gives — and acquisition then proceeds to block normally.
+Zero overhead when disabled (the factory returns raw threading.Lock).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import traceback
+
+_enabled = os.environ.get("TMTPU_DEADLOCK", "") not in ("", "0")
+_timeout = float(os.environ.get("TMTPU_DEADLOCK_TIMEOUT", "30"))
+
+
+def enable_deadlock_detection(timeout_s: float = 30.0) -> None:
+    global _enabled, _timeout
+    _enabled = True
+    _timeout = timeout_s
+
+
+class DeadlockError(Exception):
+    pass
+
+
+class _WatchedLock:
+    """Lock wrapper that reports (stderr) when acquisition stalls past the
+    timeout, including where the current holder acquired and what every
+    thread is doing — enough to reconstruct lock-order cycles."""
+
+    def __init__(self, name: str = "", reentrant: bool = False):
+        self._lock = threading.RLock() if reentrant else threading.Lock()
+        self.name = name or f"lock@{id(self):x}"
+        self._holder: int | None = None
+        self._holder_stack: str = ""
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if not blocking or timeout >= 0:
+            ok = self._lock.acquire(blocking, timeout)
+            if ok:
+                self._note_acquired()
+            return ok
+        if self._lock.acquire(timeout=_timeout):
+            self._note_acquired()
+            return True
+        self._report()
+        self._lock.acquire()  # proceed to block like a normal lock
+        self._note_acquired()
+        return True
+
+    def _note_acquired(self):
+        self._holder = threading.get_ident()
+        self._holder_stack = "".join(traceback.format_stack(limit=8))
+
+    def release(self):
+        self._holder = None
+        self._lock.release()
+
+    def _report(self):
+        lines = [
+            f"POSSIBLE DEADLOCK: {self.name} not acquired in {_timeout}s",
+            f"blocked thread {threading.current_thread().name}:",
+            "".join(traceback.format_stack(limit=12)),
+            f"held by thread {self._holder}; acquired at:",
+            self._holder_stack or "  <unknown>",
+            "all threads:",
+        ]
+        names = {t.ident: t.name for t in threading.enumerate()}
+        for tid, frame in sys._current_frames().items():
+            lines.append(f"  thread {tid} [{names.get(tid, '?')}]:")
+            lines.append("".join(traceback.format_stack(frame, limit=6)))
+        print("\n".join(lines), file=sys.stderr)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._holder is not None
+
+
+def Mutex(name: str = ""):
+    """threading.Lock, or a watched lock when deadlock detection is on."""
+    if _enabled:
+        return _WatchedLock(name)
+    return threading.Lock()
+
+
+def RMutex(name: str = ""):
+    """threading.RLock, or a watched reentrant lock when detection is on."""
+    if _enabled:
+        return _WatchedLock(name, reentrant=True)
+    return threading.RLock()

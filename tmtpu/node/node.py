@@ -155,6 +155,11 @@ class Node(BaseService):
             self.mempool, self.evidence_pool, self.event_bus,
             self.priv_validator, wal_path,
         )
+        if config.base.misbehaviors:
+            from tmtpu.consensus.misbehavior import parse_schedule
+
+            self.consensus.misbehaviors = parse_schedule(
+                config.base.misbehaviors)
 
         # --- p2p stack (node.go createTransport/createSwitch) ---
         self.node_key = None
@@ -281,6 +286,13 @@ class Node(BaseService):
 
             self.rpc_server = RPCServer(config.rpc.laddr, self)
 
+        # --- pprof (node.go:894-900: gated on RPC.PprofListenAddress) ---
+        self.pprof_server = None
+        if config.rpc.pprof_laddr:
+            from tmtpu.rpc.pprof import PprofServer
+
+            self.pprof_server = PprofServer(config.rpc.pprof_laddr)
+
     def _make_state_provider(self):
         """stateprovider.go:48 — light client over the configured RPC
         servers, anchored at the configured trust height/hash."""
@@ -368,8 +380,12 @@ class Node(BaseService):
             self.consensus.start()
         if self.rpc_server is not None:
             self.rpc_server.start()
+        if self.pprof_server is not None:
+            self.pprof_server.start()
 
     def on_stop(self) -> None:
+        if self.pprof_server is not None:
+            self.pprof_server.stop()
         if self.rpc_server is not None:
             self.rpc_server.stop()
         self.consensus.stop()

@@ -1,0 +1,90 @@
+"""Mock peer/reactor for reactor unit tests (reference analogue:
+p2p/mock/peer.go and the Reactor test doubles in p2p/mocks/).
+
+``MockPeer`` satisfies the surface reactors use (id/send/metadata);
+``MockReactor`` records everything routed to it. Neither opens sockets, so
+reactor logic can be tested without a Switch or TCP.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class MockPeer:
+    """In-memory peer: captures sent messages per channel."""
+
+    def __init__(self, node_id: str = "mockpeer0000000000000000",
+                 outbound: bool = False, persistent: bool = False):
+        self.node_id = node_id
+        self.outbound = outbound
+        self.persistent = persistent
+        self.sent: list[tuple[int, bytes]] = []
+        self._kv: dict = {}
+        self._running = True
+        self._lock = threading.Lock()
+
+    # surface used by reactors / PeerState
+    @property
+    def id(self) -> str:
+        return self.node_id
+
+    def is_running(self) -> bool:
+        return self._running
+
+    def send(self, chan_id: int, payload: bytes) -> bool:
+        if not self._running:
+            return False
+        with self._lock:
+            self.sent.append((chan_id, bytes(payload)))
+        return True
+
+    def try_send(self, chan_id: int, payload: bytes) -> bool:
+        return self.send(chan_id, payload)
+
+    def get(self, key, default=None):
+        return self._kv.get(key, default)
+
+    def set(self, key, value):
+        self._kv[key] = value
+
+    def stop(self):
+        self._running = False
+
+    # test helpers
+    def sent_on(self, chan_id: int) -> list[bytes]:
+        with self._lock:
+            return [p for c, p in self.sent if c == chan_id]
+
+
+class MockReactor:
+    """Records peers added/removed and messages received per channel."""
+
+    def __init__(self, channels: list[int]):
+        self.channels = channels
+        self.peers: list = []
+        self.removed: list = []
+        self.received: list[tuple[str, int, bytes]] = []
+        self.switch = None
+
+    def get_channels(self):
+        return self.channels
+
+    def set_switch(self, sw):
+        self.switch = sw
+
+    def add_peer(self, peer):
+        self.peers.append(peer)
+
+    def remove_peer(self, peer, reason=""):
+        self.removed.append((peer, reason))
+
+    def receive(self, chan_id: int, peer, payload: bytes):
+        self.received.append((getattr(peer, "id", "?"), chan_id,
+                              bytes(payload)))
+
+    def start(self):
+        pass
+
+    def stop(self):
+        pass

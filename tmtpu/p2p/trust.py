@@ -1,0 +1,134 @@
+"""Peer trust metric (reference analogue: p2p/trust/ — metric.go's
+interval-weighted good/bad event history and store.go's per-peer
+persistence).
+
+Design (same model as the reference, re-derived): time is divided into
+fixed intervals; each interval accumulates good/bad event counts and
+closes into a history ring. The metric value combines
+
+    r = current-interval proportion (weight fades in as the interval fills)
+    h = history value: weighted average over past intervals, recent
+        intervals weighted highest
+    d = derivative penalty when the current proportion is falling below
+        the historic trend
+
+giving a score in [0, 1] (new peers start at 1). A TrustMetricStore keys
+metrics by peer id and persists scores across restarts via the node DB.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+
+class TrustMetric:
+    INTERVAL_S = 30.0
+    MAX_HISTORY = 16
+
+    def __init__(self, now: float | None = None):
+        self._lock = threading.Lock()
+        self._good = 0.0
+        self._bad = 0.0
+        self._history: list[float] = []
+        self._start = now if now is not None else time.monotonic()
+
+    # -- event input --------------------------------------------------------
+
+    def good_event(self, weight: float = 1.0, now: float | None = None):
+        with self._lock:
+            self._roll(now)
+            self._good += weight
+
+    def bad_event(self, weight: float = 1.0, now: float | None = None):
+        with self._lock:
+            self._roll(now)
+            self._bad += weight
+
+    # -- internals ----------------------------------------------------------
+
+    def _roll(self, now: float | None):
+        now = now if now is not None else time.monotonic()
+        while now - self._start >= self.INTERVAL_S:
+            self._history.append(self._proportion())
+            if len(self._history) > self.MAX_HISTORY:
+                self._history.pop(0)
+            self._good = self._bad = 0.0
+            self._start += self.INTERVAL_S
+
+    def _proportion(self) -> float:
+        total = self._good + self._bad
+        if total == 0:
+            return 1.0
+        return self._good / total
+
+    def _history_value(self) -> float:
+        if not self._history:
+            return 1.0
+        # recent intervals weigh most: weight k+1 for the k-th oldest
+        num = den = 0.0
+        for k, v in enumerate(self._history):
+            w = float(k + 1)
+            num += w * v
+            den += w
+        return num / den
+
+    # -- output -------------------------------------------------------------
+
+    def value(self, now: float | None = None) -> float:
+        with self._lock:
+            self._roll(now)
+            now = now if now is not None else time.monotonic()
+            r = self._proportion()
+            h = self._history_value()
+            # fade the current interval in as it fills
+            a = min((now - self._start) / self.INTERVAL_S, 1.0) * 0.5
+            v = a * r + (1.0 - a) * h
+            # derivative penalty when behavior is degrading
+            if r < h:
+                v += (r - h) * 0.25
+            return max(0.0, min(1.0, v))
+
+
+class TrustMetricStore:
+    """Per-peer metrics with JSON persistence (store.go)."""
+
+    KEY = b"trust/metrics"
+
+    def __init__(self, db=None):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, TrustMetric] = {}
+        self._db = db
+        self._seed: dict[str, float] = {}
+        if db is not None:
+            raw = db.get(self.KEY)
+            if raw:
+                try:
+                    self._seed = json.loads(raw.decode())
+                except ValueError:
+                    self._seed = {}
+
+    def get(self, peer_id: str) -> TrustMetric:
+        with self._lock:
+            m = self._metrics.get(peer_id)
+            if m is None:
+                m = TrustMetric()
+                # resume from the persisted score as one history interval
+                seed = self._seed.get(peer_id)
+                if seed is not None:
+                    m._history.append(seed)
+                self._metrics[peer_id] = m
+            return m
+
+    def peer_disconnected(self, peer_id: str):
+        self.save()
+
+    def save(self):
+        if self._db is None:
+            return
+        with self._lock:
+            data = {pid: m.value() for pid, m in self._metrics.items()}
+            data.update({k: v for k, v in self._seed.items()
+                         if k not in data})
+        self._db.set(self.KEY, json.dumps(data).encode())

@@ -1,0 +1,135 @@
+"""Runtime profiling endpoints (reference analogue: the net/http/pprof
+server gated by config.RPC.PprofListenAddress, node/node.go:894-900).
+
+Python-native equivalents of the Go pprof profiles:
+
+    /debug/pprof/            index
+    /debug/pprof/goroutine   every thread's stack (goroutine profile)
+    /debug/pprof/heap        tracemalloc top allocations (heap profile)
+    /debug/pprof/profile?seconds=N
+                             statistical CPU profile: samples all thread
+                             stacks at ~100 Hz for N seconds, returns
+                             collapsed stacks (flamegraph.pl format)
+    /debug/pprof/cmdline     process argv
+
+Started by the node when ``rpc.pprof_laddr`` is set; also used by
+`tmtpu debug dump`.
+"""
+
+from __future__ import annotations
+
+import collections
+import sys
+import threading
+import time
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+
+def thread_stacks() -> str:
+    """All live threads with their current stacks (goroutine profile)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for tid, frame in sys._current_frames().items():
+        out.append(f"thread {tid} [{names.get(tid, '?')}]:")
+        out.extend(line.rstrip()
+                   for line in traceback.format_stack(frame))
+        out.append("")
+    return "\n".join(out)
+
+
+def heap_profile(top: int = 50) -> str:
+    """tracemalloc top allocation sites; starts tracing on first call
+    (subsequent calls show growth since then)."""
+    import tracemalloc
+
+    if not tracemalloc.is_tracing():
+        tracemalloc.start()
+        return ("tracemalloc started; call again to see allocations "
+                "since this point\n")
+    snap = tracemalloc.take_snapshot()
+    lines = [f"heap profile: top {top} by size"]
+    for stat in snap.statistics("lineno")[:top]:
+        lines.append(str(stat))
+    return "\n".join(lines) + "\n"
+
+
+def cpu_profile(seconds: float = 5.0, hz: int = 100) -> str:
+    """Statistical CPU profile: collapsed stacks, one line per unique
+    stack with its sample count (flamegraph.pl input format)."""
+    counts: collections.Counter[str] = collections.Counter()
+    interval = 1.0 / hz
+    deadline = time.monotonic() + seconds
+    me = threading.get_ident()
+    while time.monotonic() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            frames = []
+            f = frame
+            while f is not None:
+                frames.append(f"{f.f_code.co_name} "
+                              f"({f.f_code.co_filename.rsplit('/', 1)[-1]}"
+                              f":{f.f_lineno})")
+                f = f.f_back
+            counts[";".join(reversed(frames))] += 1
+        time.sleep(interval)
+    return "\n".join(f"{stack} {n}" for stack, n in counts.most_common())
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *a):  # quiet
+        pass
+
+    def do_GET(self):
+        url = urlparse(self.path)
+        q = parse_qs(url.query)
+        path = url.path.rstrip("/")
+        try:
+            if path in ("", "/debug/pprof"):
+                body = ("pprof endpoints: goroutine, heap, "
+                        "profile?seconds=N, cmdline\n")
+            elif path.endswith("/goroutine"):
+                body = thread_stacks()
+            elif path.endswith("/heap"):
+                body = heap_profile()
+            elif path.endswith("/profile"):
+                secs = float(q.get("seconds", ["5"])[0])
+                body = cpu_profile(min(secs, 60.0))
+            elif path.endswith("/cmdline"):
+                body = "\x00".join(sys.argv)
+            else:
+                self.send_error(404)
+                return
+        except Exception as e:
+            self.send_error(500, str(e))
+            return
+        data = body.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+
+class PprofServer:
+    def __init__(self, laddr: str):
+        host, _, port = laddr.replace("tcp://", "").rpartition(":")
+        self.httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port)),
+                                         _Handler)
+        self.httpd.daemon_threads = True
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="pprof", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()

@@ -1,0 +1,139 @@
+"""SQL event sink (reference analogue: state/indexer/sink/psql — the
+PostgreSQL event sink selected by ``tx_index.indexer = "psql"``).
+
+Schema mirrors the reference's relational layout (blocks, tx_results,
+events, attributes with a view-friendly join key) but is written against
+PEP-249 so it runs on any DB-API driver. In this image psycopg2 is not
+installed, so the sink is exercised against sqlite3 (identical SQL shape,
+`?` placeholders translated from `%s` automatically when the driver
+advertises qmark paramstyle); pointing it at a real PostgreSQL connection
+factory is a config change, not a code change.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_SCHEMA = [
+    """CREATE TABLE IF NOT EXISTS blocks (
+        rowid INTEGER PRIMARY KEY {autoinc},
+        height BIGINT NOT NULL,
+        chain_id TEXT NOT NULL,
+        created_at BIGINT NOT NULL,
+        UNIQUE (height, chain_id)
+    )""",
+    """CREATE TABLE IF NOT EXISTS tx_results (
+        rowid INTEGER PRIMARY KEY {autoinc},
+        block_id BIGINT NOT NULL REFERENCES blocks(rowid),
+        idx INTEGER NOT NULL,
+        created_at BIGINT NOT NULL,
+        tx_hash TEXT NOT NULL,
+        tx_result BLOB NOT NULL,
+        UNIQUE (block_id, idx)
+    )""",
+    """CREATE TABLE IF NOT EXISTS events (
+        rowid INTEGER PRIMARY KEY {autoinc},
+        block_id BIGINT NOT NULL REFERENCES blocks(rowid),
+        tx_id BIGINT REFERENCES tx_results(rowid),
+        type TEXT NOT NULL
+    )""",
+    """CREATE TABLE IF NOT EXISTS attributes (
+        event_id BIGINT NOT NULL REFERENCES events(rowid),
+        key TEXT NOT NULL,
+        composite_key TEXT NOT NULL,
+        value TEXT
+    )""",
+]
+
+
+class SQLSink:
+    """Event sink over a PEP-249 connection (sqlite3, psycopg2, ...)."""
+
+    def __init__(self, conn, chain_id: str):
+        self.conn = conn
+        self.chain_id = chain_id
+        self._lock = threading.Lock()
+        mod = type(conn).__module__.split(".")[0]
+        try:
+            paramstyle = __import__(mod).paramstyle
+        except Exception:
+            paramstyle = "qmark"
+        self._qmark = paramstyle == "qmark"
+        autoinc = "AUTOINCREMENT" if self._qmark else ""
+        cur = self.conn.cursor()
+        for stmt in _SCHEMA:
+            cur.execute(stmt.format(autoinc=autoinc))
+        self.conn.commit()
+
+    def _sql(self, stmt: str) -> str:
+        return stmt.replace("%s", "?") if self._qmark else stmt
+
+    # -- sink interface (indexer/sink/psql/psql.go) -------------------------
+
+    def index_block_events(self, height: int, time_ns: int,
+                           events: list[tuple[str, dict]]) -> int:
+        """Insert the block row + its begin/end-block events. Returns the
+        block rowid."""
+        with self._lock:
+            cur = self.conn.cursor()
+            cur.execute(self._sql(
+                "INSERT INTO blocks (height, chain_id, created_at) "
+                "VALUES (%s, %s, %s)"), (height, self.chain_id, time_ns))
+            block_id = cur.lastrowid
+            self._insert_events(cur, block_id, None, events)
+            self.conn.commit()
+            return block_id
+
+    def index_tx_events(self, height: int, time_ns: int, idx: int,
+                        tx_hash: str, tx_result: bytes,
+                        events: list[tuple[str, dict]]) -> None:
+        with self._lock:
+            cur = self.conn.cursor()
+            cur.execute(self._sql(
+                "SELECT rowid FROM blocks WHERE height = %s AND "
+                "chain_id = %s"), (height, self.chain_id))
+            row = cur.fetchone()
+            if row is None:
+                cur.execute(self._sql(
+                    "INSERT INTO blocks (height, chain_id, created_at) "
+                    "VALUES (%s, %s, %s)"),
+                    (height, self.chain_id, time_ns))
+                block_id = cur.lastrowid
+            else:
+                block_id = row[0]
+            cur.execute(self._sql(
+                "INSERT INTO tx_results (block_id, idx, created_at, "
+                "tx_hash, tx_result) VALUES (%s, %s, %s, %s, %s)"),
+                (block_id, idx, time_ns, tx_hash, tx_result))
+            tx_id = cur.lastrowid
+            self._insert_events(cur, block_id, tx_id, events)
+            self.conn.commit()
+
+    def _insert_events(self, cur, block_id, tx_id, events):
+        for etype, attrs in events:
+            cur.execute(self._sql(
+                "INSERT INTO events (block_id, tx_id, type) "
+                "VALUES (%s, %s, %s)"), (block_id, tx_id, etype))
+            event_id = cur.lastrowid
+            for key, value in attrs.items():
+                cur.execute(self._sql(
+                    "INSERT INTO attributes (event_id, key, composite_key,"
+                    " value) VALUES (%s, %s, %s, %s)"),
+                    (event_id, key, f"{etype}.{key}", str(value)))
+
+    # -- queries used by tests / operators ----------------------------------
+
+    def tx_count(self) -> int:
+        cur = self.conn.cursor()
+        cur.execute("SELECT COUNT(*) FROM tx_results")
+        return cur.fetchone()[0]
+
+    def find_tx_heights(self, composite_key: str, value: str) -> list[int]:
+        cur = self.conn.cursor()
+        cur.execute(self._sql(
+            "SELECT DISTINCT b.height FROM blocks b "
+            "JOIN events e ON e.block_id = b.rowid "
+            "JOIN attributes a ON a.event_id = e.rowid "
+            "WHERE a.composite_key = %s AND a.value = %s ORDER BY b.height"),
+            (composite_key, value))
+        return [r[0] for r in cur.fetchall()]
