@@ -120,9 +120,15 @@ def main():
     from tmtpu.tpu import sharding as sh
     from tmtpu.tpu import verify as tv
 
+    # CPU fallback (wedged/absent TPU): still report a real number, but at
+    # a batch size the host can verify AND compile inside the driver's
+    # budget — the 10k XLA:CPU graph alone costs minutes of compile.
+    lanes = LANES if backend != "cpu" else min(LANES, 2048)
+    n_iters = 5 if backend != "cpu" else 2
+
     t0 = time.perf_counter()
-    pks, msgs, sigs = _make_votes(LANES)
-    print(f"bench: generated {LANES} votes in "
+    pks, msgs, sigs = _make_votes(lanes)
+    print(f"bench: generated {lanes} votes in "
           f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
     use_kernel = tv.use_pallas_kernel()
@@ -133,10 +139,10 @@ def main():
         from tmtpu.tpu import kernel as tk
 
         tile = tk.DEFAULT_TILE
-        pad = ((LANES + tile - 1) // tile) * tile
+        pad = ((lanes + tile - 1) // tile) * tile
     else:
-        pad = LANES
-    power_list = [1000] * LANES + [0] * (pad - LANES)
+        pad = lanes
+    power_list = [1000] * lanes + [0] * (pad - lanes)
     powers = jnp.asarray(sh.powers_to_limbs(power_list))
     if use_kernel:
         # production TPU path: the fused Pallas kernel (tmtpu/tpu/kernel.py)
@@ -153,8 +159,8 @@ def main():
     def prep():
         args, host_ok = tv.prepare_batch_compact(pks, msgs, sigs)
         assert host_ok.all()
-        if pad != LANES:
-            args = tv.pad_args_to_bucket(args, LANES, pad)
+        if pad != lanes:
+            args = tv.pad_args_to_bucket(args, lanes, pad)
         return args
 
     # warmup / compile
@@ -162,12 +168,11 @@ def main():
     args = prep()
     out = jax.block_until_ready(step(*args, powers, table))
     assert bool(jnp.all(out[0])), "bench lanes must verify"
-    assert sh.limb_sums_to_int(out[1]) == 1000 * LANES
+    assert sh.limb_sums_to_int(out[1]) == 1000 * lanes
     print(f"bench: compile+warmup {time.perf_counter() - t0:.1f}s "
           f"on {jax.devices()[0].platform}", file=sys.stderr)
 
     # device-only steady state (pre-staged args), for the breakdown
-    n_iters = 5
     t0 = time.perf_counter()
     for _ in range(n_iters):
         out = jax.block_until_ready(step(*args, powers, table))
@@ -186,16 +191,22 @@ def main():
     jax.block_until_ready(pending)
     e2e_dt = (time.perf_counter() - t0) / n_iters
 
-    sig_s = LANES / e2e_dt
-    print(json.dumps({
+    sig_s = lanes / e2e_dt
+    out = {
         "metric": "ed25519_batch_verify_10k_voteset_e2e",
         "value": round(sig_s, 1),
         "unit": "sig/s",
         "vs_baseline": round(sig_s / GO_SERIAL_SIG_S, 2),
         "backend": backend if backend == "cpu" else jax.devices()[0].platform,
-        "device_only_sig_s": round(LANES / dev_dt, 1),
-        "e2e_ms_per_10k": round(e2e_dt * 1e3, 2),
-    }))
+        "device_only_sig_s": round(lanes / dev_dt, 1),
+        "e2e_ms_per_batch": round(e2e_dt * 1e3, 2),
+        "lanes": lanes,
+    }
+    if lanes == LANES:
+        # only a real 10k measurement earns the headline key — per-dispatch
+        # overhead doesn't scale linearly, so no extrapolation
+        out["e2e_ms_per_10k"] = out["e2e_ms_per_batch"]
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,100 @@
+"""Tests for clist, event switch, and UPnP protocol parsing."""
+
+import threading
+import time
+
+from tmtpu.libs.clist import CList
+from tmtpu.libs.events import EventSwitch
+from tmtpu.p2p import upnp
+
+
+def test_clist_push_iterate_remove():
+    cl = CList()
+    els = [cl.push_back(i) for i in range(5)]
+    assert len(cl) == 5
+    assert list(cl) == [0, 1, 2, 3, 4]
+    cl.remove(els[2])
+    assert list(cl) == [0, 1, 3, 4]
+    assert len(cl) == 4
+    # iterator holding the removed element can continue
+    assert els[2].next is els[3]
+    cl.remove(els[0])
+    assert cl.front().value == 1
+
+
+def test_clist_next_wait_blocks_until_append():
+    cl = CList()
+    first = cl.push_back("a")
+    got = []
+
+    def waiter():
+        got.append(first.next_wait(timeout=5))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.1)
+    second = cl.push_back("b")
+    t.join(5)
+    assert got and got[0] is second
+
+
+def test_clist_wait_chan():
+    cl = CList()
+    got = []
+    t = threading.Thread(target=lambda: got.append(cl.wait_chan(timeout=5)))
+    t.start()
+    time.sleep(0.05)
+    el = cl.push_back(42)
+    t.join(5)
+    assert got and got[0] is el
+
+
+def test_event_switch_routing_and_removal():
+    sw = EventSwitch()
+    seen = []
+    sw.add_listener("l1", "tick", lambda d: seen.append(("l1", d)))
+    sw.add_listener("l2", "tick", lambda d: seen.append(("l2", d)))
+    sw.add_listener("l1", "tock", lambda d: seen.append(("l1-tock", d)))
+    sw.fire_event("tick", 1)
+    assert seen == [("l1", 1), ("l2", 1)]
+    sw.remove_listener("l1")
+    seen.clear()
+    sw.fire_event("tick", 2)
+    sw.fire_event("tock", 3)
+    assert seen == [("l2", 2)]
+
+
+def test_upnp_protocol_parsing():
+    assert b"M-SEARCH" in upnp.build_msearch()
+    resp = (b"HTTP/1.1 200 OK\r\nCACHE-CONTROL: max-age=120\r\n"
+            b"LOCATION: http://192.168.1.1:5000/rootDesc.xml\r\n\r\n")
+    assert upnp.parse_ssdp_response(resp) == \
+        "http://192.168.1.1:5000/rootDesc.xml"
+    assert upnp.parse_ssdp_response(b"HTTP/1.1 404 NF\r\n\r\n") is None
+
+    desc = b"""<?xml version="1.0"?>
+    <root xmlns="urn:schemas-upnp-org:device-1-0">
+      <device><serviceList>
+        <service>
+          <serviceType>urn:schemas-upnp-org:service:WANIPConnection:1</serviceType>
+          <controlURL>/ctl/IPConn</controlURL>
+        </service>
+      </serviceList></device>
+    </root>"""
+    url = upnp.parse_control_url(desc, "http://192.168.1.1:5000/rootDesc.xml")
+    assert url == "http://192.168.1.1:5000/ctl/IPConn"
+
+    body, headers = upnp.build_soap(
+        "GetExternalIPAddress",
+        "urn:schemas-upnp-org:service:WANIPConnection:1", {})
+    assert b"GetExternalIPAddress" in body
+    assert headers["SOAPAction"].endswith('#GetExternalIPAddress"')
+
+    soap_resp = (b'<?xml version="1.0"?><s:Envelope '
+                 b'xmlns:s="http://schemas.xmlsoap.org/soap/envelope/">'
+                 b"<s:Body><u:GetExternalIPAddressResponse "
+                 b'xmlns:u="urn:schemas-upnp-org:service:WANIPConnection:1">'
+                 b"<NewExternalIPAddress>203.0.113.7</NewExternalIPAddress>"
+                 b"</u:GetExternalIPAddressResponse></s:Body></s:Envelope>")
+    assert upnp.parse_soap_value(soap_resp, "NewExternalIPAddress") == \
+        "203.0.113.7"

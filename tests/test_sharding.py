@@ -29,3 +29,39 @@ def test_entry_compiles():
     mask, power_sums, bits = jax.block_until_ready(jax.jit(fn)(*args))
     assert np.asarray(mask).all()
     assert sh.limb_sums_to_int(power_sums) == 1000 * 32
+
+
+@pytest.mark.slow  # Pallas interpret-mode compile dominates (~2 min)
+def test_sharded_kernel_step_cpu_mesh():
+    """The pod-scale fused-kernel path (shard_map + Pallas interpret mode)
+    agrees with the XLA-graph twin on an 8-device CPU mesh."""
+    import jax
+    import jax.numpy as jnp
+
+    n = 8
+    mesh = sh.make_mesh(n)
+    lanes = 32 * n
+    pk_b, r_b, s_b, h_b = sh.example_batch(lanes)
+    # corrupt one lane per shard half to exercise the mask path
+    bad = np.asarray(s_b).copy()
+    bad[0, 5] ^= 1
+    s_bad = jnp.asarray(bad)
+    powers = jnp.asarray(sh.powers_to_limbs([7] * lanes))
+
+    step = sh.sharded_verify_tally_kernel(mesh, tile=32, interpret=True)
+    mask, power_sums, bits = jax.block_until_ready(
+        step(pk_b, r_b, s_bad, h_b, powers))
+
+    ref_step = sh.sharded_verify_tally_compact(mesh)
+    from tmtpu.tpu import verify as tv
+
+    table = tv.base_table_f32()
+    rmask, rsums, rbits = jax.block_until_ready(
+        ref_step(pk_b, r_b, s_bad, h_b, powers, table))
+
+    assert np.array_equal(np.asarray(mask), np.asarray(rmask))
+    assert not np.asarray(mask)[5]
+    assert np.asarray(mask).sum() == lanes - 1
+    assert sh.limb_sums_to_int(power_sums) == 7 * (lanes - 1)
+    assert sh.limb_sums_to_int(rsums) == 7 * (lanes - 1)
+    assert np.array_equal(np.asarray(bits), np.asarray(rbits))

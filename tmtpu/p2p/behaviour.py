@@ -1,0 +1,54 @@
+"""Peer-behaviour reporting (reference analogue: behaviour/ — the
+``Reporter`` abstraction that decouples "this peer did X" from "what to do
+about it"; upstream it is consumed by blockchain/v2).
+
+``SwitchReporter`` translates bad behavior into switch actions
+(stop-for-error) and good behavior into trust-metric credit;
+``MockReporter`` records reports for tests.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class PeerBehaviour:
+    peer_id: str
+    reason: str    # e.g. "bad_block", "bad_message", "consensus_vote"
+    good: bool = False
+
+
+class MockReporter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reports: list[PeerBehaviour] = []
+
+    def report(self, pb: PeerBehaviour) -> None:
+        with self._lock:
+            self.reports.append(pb)
+
+    def of(self, peer_id: str) -> list[PeerBehaviour]:
+        with self._lock:
+            return [r for r in self.reports if r.peer_id == peer_id]
+
+
+class SwitchReporter:
+    """Routes bad behavior to Switch.stop_peer_for_error and feeds the
+    trust metric store when one is attached."""
+
+    def __init__(self, switch, trust_store=None):
+        self.switch = switch
+        self.trust_store = trust_store
+
+    def report(self, pb: PeerBehaviour) -> None:
+        if self.trust_store is not None:
+            metric = self.trust_store.get(pb.peer_id)
+            (metric.good_event if pb.good else metric.bad_event)()
+        if pb.good:
+            return
+        peer = self.switch.peers.get(pb.peer_id) \
+            if hasattr(self.switch, "peers") else None
+        if peer is not None:
+            self.switch.stop_peer_for_error(peer, pb.reason)

