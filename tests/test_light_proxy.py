@@ -1,0 +1,116 @@
+"""Light-client RPC proxy end-to-end (reference: light/proxy,
+light/rpc/client_test.go): a single-validator node serves RPC; the proxy
+verifies every answer against light-verified headers before returning it."""
+
+import base64
+import time
+
+import pytest
+
+from tmtpu.config.config import Config
+from tmtpu.light.client import Client, TrustOptions
+from tmtpu.light.provider import HTTPProvider
+from tmtpu.light.proxy import LightProxy, VerifyError, VerifyingClient
+from tmtpu.node.node import Node
+from tmtpu.privval.file_pv import FilePV
+from tmtpu.rpc.client import HTTPClient, RPCClientError
+from tmtpu.types.genesis import GenesisDoc, GenesisValidator
+
+WEEK_NS = 7 * 24 * 3600 * 1_000_000_000
+CHAIN = "proxy-chain"
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    home = tmp_path_factory.mktemp("tmhome")
+    cfg = Config.test_config()
+    cfg.base.home = str(home)
+    cfg.base.crypto_backend = "cpu"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    (home / "config").mkdir()
+    (home / "data").mkdir()
+    pv = FilePV.load_or_generate(
+        cfg.rooted(cfg.base.priv_validator_key_file),
+        cfg.rooted(cfg.base.priv_validator_state_file))
+    gen = GenesisDoc(chain_id=CHAIN, genesis_time=time.time_ns(),
+                     validators=[GenesisValidator(pv.get_pub_key(), 10)])
+    gen.save_as(cfg.genesis_path)
+    n = Node(cfg)
+    n.start()
+    # a few committed heights for the proxy to verify over
+    direct = HTTPClient(f"http://127.0.0.1:{n.rpc_server.port}")
+    direct.broadcast_tx_commit(b"pk1=pv1")
+    direct.broadcast_tx_commit(b"pk2=pv2")
+    yield n
+    n.stop()
+
+
+@pytest.fixture(scope="module")
+def proxy(node):
+    url = f"http://127.0.0.1:{node.rpc_server.port}"
+    lc = Client(CHAIN,
+                TrustOptions(
+                    WEEK_NS, 1,
+                    HTTPProvider(CHAIN, url).light_block(1).header.hash()),
+                HTTPProvider(CHAIN, url), backend="cpu")
+    p = LightProxy(lc, url, laddr="tcp://127.0.0.1:0")
+    p.start()
+    yield p
+    p.stop()
+
+
+def _client(proxy) -> HTTPClient:
+    return HTTPClient(f"http://127.0.0.1:{proxy.server.port}")
+
+
+def test_proxy_block_commit_validators_verified(node, proxy):
+    c = _client(proxy)
+    h = node.block_store.height()
+    blk = c.block(h)
+    assert int(blk["block"]["header"]["height"]) == h
+    cm = c.commit(h)
+    assert int(cm["signed_header"]["header"]["height"]) == h
+    vals = c.validators(h)
+    assert vals["total"] == "1"
+    # the proxy answered from its OWN verified valset
+    assert proxy.client.lc.last_trusted_height() >= h
+
+
+def test_proxy_tx_proof_verified(node, proxy):
+    c = _client(proxy)
+    res = c.broadcast_tx_commit(b"pk3=pv3")
+    assert res["deliver_tx"]["code"] == 0
+    time.sleep(0.3)  # indexer consumes the event bus asynchronously
+    got = c.tx(res["hash"])
+    assert base64.b64decode(got["tx"]) == b"pk3=pv3"
+    assert got["proof"]["root_hash"]
+
+
+def test_proxy_abci_query_requires_proof(proxy):
+    # kvstore serves no merkle proofs — the proxy must refuse, like the
+    # reference's "no proof ops" error, rather than pass unverified data
+    c = _client(proxy)
+    with pytest.raises(RPCClientError, match="proof"):
+        c.abci_query(data="pk1")
+
+
+def test_proxy_status_passthrough(proxy):
+    s = _client(proxy).status()
+    assert s["node_info"]["network"] == CHAIN
+
+
+def test_proxy_rejects_tampered_block(node, proxy):
+    vc = VerifyingClient(proxy.client.lc,
+                         f"http://127.0.0.1:{node.rpc_server.port}")
+    real_call = vc.http.call
+
+    def lying_call(method, **params):
+        res = real_call(method, **params)
+        if method == "block":
+            res["block"]["header"]["app_hash"] = "00" * 32  # forged state
+        return res
+
+    vc.http.call = lying_call
+    h = node.block_store.height()
+    with pytest.raises(VerifyError, match="does not match"):
+        vc.block(h)

@@ -214,3 +214,57 @@ def test_crash_at_every_fail_point_recovers(tmp_path):
         finally:
             proc.terminate()
             proc.wait(timeout=30)
+
+
+# --- CLI: reindex-event + compact-db -----------------------------------------
+
+
+@pytest.mark.slow
+def test_reindex_event_and_compact_db(tmp_path):
+    """commands/reindex_event.go semantics: wipe the indexes, rebuild them
+    from the stores, and find the tx again; then compact the data dir."""
+    home = str(tmp_path / "home")
+    assert _cli("--home", home, "init").returncode == 0
+    port = 36960
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tmtpu.cmd", "--home", home, "start",
+         "--crypto-backend", "cpu",
+         "--rpc-laddr", f"tcp://127.0.0.1:{port}"],
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        assert _wait_rpc_height(port, 1) >= 1
+        import base64
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/",
+            data=json.dumps({
+                "jsonrpc": "2.0", "id": 1, "method": "broadcast_tx_commit",
+                "params": {"tx": base64.b64encode(b"rk=rv").decode()},
+            }).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            res = json.load(r)["result"]
+        assert res["deliver_tx"]["code"] == 0
+    finally:
+        proc.terminate()
+        proc.wait(timeout=30)
+
+    # wipe the indexes, keep the stores
+    for name in ("txindex", "blockindex"):
+        os.remove(os.path.join(home, "data", f"{name}.sqlite"))
+    r = _cli("--home", home, "reindex-event")
+    assert r.returncode == 0, r.stderr
+    assert "Reindexed" in r.stdout
+
+    from tmtpu.libs.db import SQLiteDB
+    from tmtpu.state.txindex import KVTxIndexer
+    from tmtpu.types.tx import tx_hash
+
+    idx = KVTxIndexer(SQLiteDB(os.path.join(home, "data", "txindex.sqlite")))
+    rec = idx.get(tx_hash(b"rk=rv"))
+    assert rec is not None and rec.tx == b"rk=rv"
+
+    r = _cli("--home", home, "compact-db")
+    assert r.returncode == 0, r.stderr
+    assert "Reclaimed" in r.stdout

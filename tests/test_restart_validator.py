@@ -1,0 +1,54 @@
+"""Regression: a killed-and-restarted validator must rejoin and the net
+must resume (reference: e2e kill perturbation; consensus/reactor.go
+SwitchToConsensus skipWAL).
+
+With 3 equal-power validators, the other two hold exactly 2/3 — not
++2/3 — so nothing commits until the restarted node actually votes again.
+This exercises the full handover chain: blocksync re-sync (mem stores →
+full resync, so blocks_synced > 0 → skip_wal), switch_to_consensus, the
+post-switch NewRoundStep broadcast, and round catch-up via the nil-polka
+/ nil-precommit fast paths."""
+
+import time
+
+import pytest
+
+from tmtpu.node.node import Node
+
+from .test_p2p import _mk_net_nodes
+
+pytestmark = pytest.mark.slow
+
+
+def test_killed_validator_rejoins_and_net_resumes(tmp_path):
+    nodes = _mk_net_nodes(3, tmp_path)
+    cfgs = [nd.config for nd in nodes]
+    try:
+        for nd in nodes:
+            nd.start()
+        for nd in nodes:
+            assert nd.consensus.wait_for_height(5, timeout=60), \
+                nd.consensus.rs.height_round_step()
+        h_kill = nodes[0].block_store.height()
+        nodes[1].stop()
+        time.sleep(1.0)
+        nd1 = Node(cfgs[1])
+        nodes[1] = nd1
+        addrs = [f"{nd.node_id}@127.0.0.1:{nd.p2p_port}" for nd in nodes]
+        nd1.switch.set_persistent_peers(
+            [a for j, a in enumerate(addrs) if j != 1])
+        nd1.start()
+        target = h_kill + 3
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            if all(nd.block_store.height() >= target for nd in nodes):
+                break
+            time.sleep(0.5)
+        heights = [nd.block_store.height() for nd in nodes]
+        assert all(h >= target for h in heights), (
+            f"net did not resume after validator restart: heights {heights}"
+            f" < target {target}; restarted node at "
+            f"{nd1.consensus.rs.height_round_step()}")
+    finally:
+        for nd in nodes:
+            nd.stop()

@@ -283,11 +283,107 @@ def cmd_testnet(args) -> int:
     return 0
 
 
+def cmd_reindex_event(args) -> int:
+    """reindex-event — rebuild tx/block-event indexes from the stores
+    (commands/reindex_event.go)."""
+    from tmtpu.libs.db import SQLiteDB
+    from tmtpu.state.store import StateStore
+    from tmtpu.state.txindex import (
+        KVBlockIndexer, KVTxIndexer, reindex_events,
+    )
+    from tmtpu.store.block_store import BlockStore
+
+    cfg = _load_config(args.home)
+
+    def db(name):
+        return SQLiteDB(cfg.rooted(os.path.join(cfg.base.db_dir,
+                                                f"{name}.sqlite")))
+
+    n = reindex_events(BlockStore(db("blockstore")), StateStore(db("state")),
+                       KVTxIndexer(db("txindex")),
+                       KVBlockIndexer(db("blockindex")),
+                       first=args.start_height, last=args.end_height)
+    print(f"Reindexed {n} heights")
+    return 0
+
+
+def cmd_compact_db(args) -> int:
+    """experimental-compact-goleveldb analogue — VACUUM every sqlite DB in
+    the data dir to reclaim space after pruning."""
+    import sqlite3
+
+    cfg = _load_config(args.home)
+    data = cfg.rooted(cfg.base.db_dir)
+    total = 0
+    for fname in sorted(os.listdir(data) if os.path.isdir(data) else []):
+        if not fname.endswith(".sqlite"):
+            continue
+        path = os.path.join(data, fname)
+        before = os.path.getsize(path)
+        conn = sqlite3.connect(path)
+        conn.execute("VACUUM")
+        conn.close()
+        after = os.path.getsize(path)
+        total += before - after
+        print(f"{fname}: {before} -> {after} bytes")
+    print(f"Reclaimed {total} bytes")
+    return 0
+
+
+def cmd_light(args) -> int:
+    """light — run a light-client-backed RPC proxy daemon
+    (commands/light.go)."""
+    import threading
+
+    from tmtpu.light.client import Client, TrustOptions
+    from tmtpu.light.provider import HTTPProvider
+    from tmtpu.light.proxy import LightProxy
+    from tmtpu.light.store import LightStore
+    from tmtpu.libs.db import SQLiteDB
+
+    primary = args.primary.rstrip("/")
+    witnesses = [w for w in (args.witnesses or "").split(",") if w]
+    home = os.path.expanduser(args.home)
+    os.makedirs(os.path.join(home, "data"), exist_ok=True)
+    store = LightStore(SQLiteDB(os.path.join(home, "data", "light.sqlite")))
+    lc = Client(
+        args.chain_id,
+        TrustOptions(period_ns=int(args.trusting_period * 1e9),
+                     height=args.trusted_height,
+                     hash=bytes.fromhex(args.trusted_hash)),
+        HTTPProvider(args.chain_id, primary),
+        witnesses=[HTTPProvider(args.chain_id, w) for w in witnesses],
+        store=store,
+    )
+    proxy = LightProxy(lc, primary, laddr=args.laddr)
+    proxy.start()
+    print(f"light proxy for {args.chain_id} listening on {proxy.laddr} "
+          f"(primary {primary}, {len(witnesses)} witnesses)")
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        proxy.stop()
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tmtpu",
                                 description="TPU-native BFT consensus node")
     p.add_argument("--home", default=os.environ.get("TMHOME", "~/.tmtpu"))
-    sub = p.add_subparsers(dest="cmd", required=True)
+    _sub = p.add_subparsers(dest="cmd", required=True)
+
+    class _Sub:
+        """--home is accepted before OR after the subcommand, like the
+        reference's cobra persistent flag; SUPPRESS keeps the subparser
+        from clobbering a pre-subcommand --home with its default."""
+
+        @staticmethod
+        def add_parser(*a, **kw):
+            sp = _sub.add_parser(*a, **kw)
+            sp.add_argument("--home", default=argparse.SUPPRESS)
+            return sp
+
+    sub = _Sub()
 
     sp = sub.add_parser("init", help="initialize home dir")
     sp.add_argument("--chain-id", default="")
@@ -329,6 +425,28 @@ def main(argv=None) -> int:
                     default="tcp://127.0.0.1:26657")
     sp.add_argument("--output-dir", dest="output_dir", default="./debug")
     sp.set_defaults(fn=cmd_debug_dump)
+
+    sp = sub.add_parser("reindex-event",
+                        help="rebuild tx/block-event indexes from stores")
+    sp.add_argument("--start-height", type=int, default=0)
+    sp.add_argument("--end-height", type=int, default=0)
+    sp.set_defaults(fn=cmd_reindex_event)
+
+    sp = sub.add_parser("compact-db", help="VACUUM the data dir's DBs")
+    sp.set_defaults(fn=cmd_compact_db)
+
+    sp = sub.add_parser("light", help="light-client RPC proxy daemon")
+    sp.add_argument("chain_id")
+    sp.add_argument("--primary", required=True,
+                    help="primary full node RPC URL")
+    sp.add_argument("--witnesses", default="",
+                    help="comma-separated witness RPC URLs")
+    sp.add_argument("--trusted-height", type=int, required=True)
+    sp.add_argument("--trusted-hash", required=True)
+    sp.add_argument("--trusting-period", type=float,
+                    default=7 * 24 * 3600.0, help="seconds")
+    sp.add_argument("--laddr", default="tcp://127.0.0.1:8888")
+    sp.set_defaults(fn=cmd_light)
 
     sp = sub.add_parser("testnet", help="generate N validator home dirs")
     sp.add_argument("--validators", type=int, default=4)

@@ -12,6 +12,7 @@ from __future__ import annotations
 import random
 import threading
 import time
+import traceback
 from typing import Dict, Optional
 
 from tmtpu.consensus import msgs as cm
@@ -156,16 +157,48 @@ class ConsensusReactor(Reactor):
 
     def switch_to_consensus(self, state, skip_wal: bool = False) -> None:
         """blockchain reactor hands over after catchup
-        (consensus/reactor.go:108 SwitchToConsensus)."""
+        (consensus/reactor.go:108 SwitchToConsensus). skip_wal: blocks were
+        sync'd past the WAL's heights, so WAL catchup must not run — the
+        stale records are for heights consensus already moved past (and a
+        restarted validator's WAL can even hold an #ENDHEIGHT for the new
+        starting height, which catchup treats as corruption)."""
         self.wait_sync = False
         self.cs.update_to_state(state)
-        self.cs.start()
+        if skip_wal:
+            self.cs.do_wal_catchup = False
+        try:
+            self.cs.start()
+        except Exception:
+            # surface the failure — this runs on the blocksync pool thread,
+            # and a silent death here wedges the whole node (state.go would
+            # panic); consensus not running IS fatal
+            traceback.print_exc()
+            raise
+        # peers heard nothing from us while we were syncing (see add_peer);
+        # tell them where we actually are so vote/data gossip starts
+        if self.switch is not None:
+            self.switch.broadcast(STATE_CHANNEL,
+                                  self._new_round_step_msg().encode())
+
+    def init_peer(self, peer: Peer) -> None:
+        # before the conn delivers: receive() needs this immediately
+        peer.set("consensus_peer_state", PeerState())
 
     def add_peer(self, peer: Peer) -> None:
-        ps = PeerState()
-        peer.set("consensus_peer_state", ps)
+        ps = peer.get("consensus_peer_state")
+        if ps is None:  # switch without init_peer support (tests)
+            ps = PeerState()
+            peer.set("consensus_peer_state", ps)
         # announce our current state (reactor.go AddPeer sendNewRoundStep)
-        peer.send(STATE_CHANNEL, self._new_round_step_msg().encode())
+        # — but NOT while block/state sync runs (reactor.go:197
+        # `if !conR.WaitSync()`): advertising a live round while the
+        # wait_sync guard still DROPS incoming votes makes peers gossip
+        # votes to us, optimistically mark them delivered in their
+        # PeerState, and never resend them after we switch — a permanent
+        # vote-gossip wedge (observed: restarted validator stuck one vote
+        # short of every polka)
+        if not self.wait_sync:
+            peer.send(STATE_CHANNEL, self._new_round_step_msg().encode())
         threads = []
         for fn, name in ((self._gossip_data_routine, "gossip-data"),
                          (self._gossip_votes_routine, "gossip-votes")):
@@ -182,7 +215,9 @@ class ConsensusReactor(Reactor):
         m = cm.ConsensusMessagePB.decode(msg_bytes)
         ps: Optional[PeerState] = peer.get("consensus_peer_state")
         if ps is None:
-            return
+            # never drop: a lost one-shot NewRoundStep wedges vote gossip
+            ps = PeerState()
+            peer.set("consensus_peer_state", ps)
         kind = m.which()
         if channel_id == STATE_CHANNEL:
             if kind == "new_round_step":
@@ -291,22 +326,26 @@ class ConsensusReactor(Reactor):
             if prs_h != rs.height:
                 time.sleep(GOSSIP_SLEEP_S)
                 continue
-            # same height: proposal + parts
-            if rs.proposal is not None and not has_proposal:
+            # same height: proposal + parts. Local refs throughout: the
+            # consensus thread may null these fields while we work (the
+            # RoundState snapshot is shallow)
+            proposal = rs.proposal
+            if proposal is not None and not has_proposal:
                 peer.try_send(DATA_CHANNEL, cm.ConsensusMessagePB(
                     proposal=cm.ProposalPB(
-                        proposal=rs.proposal.to_proto())).encode())
+                        proposal=proposal.to_proto())).encode())
                 with ps.lock:
                     ps.proposal = True
-            if rs.proposal_block_parts is not None:
-                ours = rs.proposal_block_parts.bit_array()
-                total = rs.proposal_block_parts.total
+            parts = rs.proposal_block_parts
+            if parts is not None:
+                ours = parts.bit_array()
+                total = parts.total
                 theirs = peer_parts if peer_parts is not None and \
                     peer_parts.size() == total else BitArray(total)
                 missing = ours.sub(theirs)
                 idx = missing.pick_random()
                 if idx is not None:
-                    part = rs.proposal_block_parts.get_part(idx)
+                    part = parts.get_part(idx)
                     if part is not None and peer.try_send(
                             DATA_CHANNEL, cm.ConsensusMessagePB(
                                 block_part=cm.BlockPartPB(

@@ -103,6 +103,9 @@ class ConsensusState(BaseService):
         self._thread: Optional[threading.Thread] = None
         self._done_first_block = threading.Event()
         self.replay_mode = False
+        # cleared by the blocksync/statesync handover (SwitchToConsensus
+        # with skipWAL): the WAL predates the synced blocks
+        self.do_wal_catchup = True
         # test/byzantine hook: replaces decide_proposal when set
         self.decide_proposal_override = None
         # maverick-style misbehavior schedule {height: name}
@@ -125,7 +128,8 @@ class ConsensusState(BaseService):
         # (state.go reconstructLastCommit), then re-feed WAL messages for
         # the in-progress height (replay.go:93 catchupReplay)
         self._reconstruct_last_commit()
-        self.catchup_replay()
+        if self.do_wal_catchup:
+            self.catchup_replay()
         self.ticker.start()
         self._thread = threading.Thread(
             target=self._receive_routine, daemon=True, name="cs-receive")
@@ -995,7 +999,13 @@ class ConsensusState(BaseService):
                         not block_id.is_zero():
                     pass  # will prevote it when we get there
                 if rs.step == STEP_PREVOTE:
-                    if has_polka and not block_id.is_zero():
+                    # nil polka precommits IMMEDIATELY (state.go:2103
+                    # `ok && (HashesTo(...) || blockID.IsZero())`) — without
+                    # this a node replaying a peer's past rounds pays a
+                    # prevote-wait timeout per round and can never catch up
+                    if has_polka and (block_id.is_zero() or (
+                            rs.proposal_block is not None and
+                            rs.proposal_block.hash() == block_id.hash)):
                         self._enter_precommit(height, r)
                     elif prevotes.has_two_thirds_any():
                         self._enter_prevote_wait(height, r)
@@ -1013,15 +1023,17 @@ class ConsensusState(BaseService):
                 continue
             block_id, has_maj = precommits.two_thirds_majority()
             if has_maj:
+                if block_id.is_zero():
+                    # 2/3 precommitted nil: the round is dead — go straight
+                    # to the next one (state.go:2135), no precommit-wait
+                    self._enter_new_round(height, r + 1)
+                    continue
                 self._enter_new_round(height, r)
                 self._enter_precommit(height, r)
-                if not block_id.is_zero():
-                    self._enter_commit(height, r)
-                    if self.config.skip_timeout_commit and \
-                            precommits.has_all():
-                        self._schedule_round0()
-                else:
-                    self._enter_precommit_wait(height, r)
+                self._enter_commit(height, r)
+                if self.config.skip_timeout_commit and \
+                        precommits.has_all():
+                    self._schedule_round0()
             elif r >= rs.round and precommits.has_two_thirds_any():
                 if r > rs.round:
                     self._enter_new_round(height, r)

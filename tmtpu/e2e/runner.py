@@ -278,12 +278,14 @@ class Runner:
 
     def benchmark(self) -> dict:
         """Block-rate statistics over the run (reference: benchmark.go)."""
+        from tmtpu.light.provider import _rfc3339_to_ns
+
         node = self.nodes[0]
         top = node.height()
         times = []
         for h in range(max(2, top - 50), top + 1):
             blk = node.client.block(height=h)["block"]["header"]
-            times.append(int(blk["time"]))
+            times.append(_rfc3339_to_ns(blk["time"]))
         if len(times) < 2:
             return {}
         intervals = [(b - a) / 1e9 for a, b in zip(times, times[1:])]
@@ -310,6 +312,9 @@ class Runner:
             self.wait_for()
             self.stop_load()
             self.test()
-            return self.benchmark()
+            stats = self.benchmark()
+            # nodes are stopped on exit — snapshot heights while they serve
+            self.final_heights = [n.height() for n in self.nodes]
+            return stats
         finally:
             self.stop()

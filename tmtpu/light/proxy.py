@@ -1,0 +1,197 @@
+"""Light-client-backed RPC proxy (reference: light/proxy/proxy.go,
+light/rpc/client.go).
+
+Serves the standard JSON-RPC routes on a local address, forwarding each
+request to the primary full node and **verifying** the parts that can be
+checked against light-client-verified headers before returning them:
+
+- block/commit: the returned header must hash to the light-verified
+  header at that height (light/rpc/client.go Block/Commit);
+- validators: answered from the light client's own verified validator
+  set, never the primary's claim (light/rpc/client.go Validators);
+- tx?prove=true: the tx merkle proof must verify against the verified
+  header's data_hash (light/rpc/client.go Tx);
+- abci_query: requires a merkle proof and checks it against the verified
+  app_hash of the next header (light/rpc/client.go ABCIQueryWithOptions
+  requires resp.ProofOps != nil).
+
+Everything else (status, broadcast_tx_*, net_info, health) passes
+through untouched, as in the reference proxy's route table
+(light/proxy/routes.go).
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Optional
+
+from tmtpu.crypto.merkle import Proof
+from tmtpu.light import provider as prov
+from tmtpu.light.client import Client
+from tmtpu.rpc.client import HTTPClient
+from tmtpu.rpc.server import RPCError, RPCServer
+from tmtpu.types.tx import tx_hash
+
+
+class VerifyError(RPCError):
+    def __init__(self, msg: str):
+        super().__init__(-32603, f"light proxy verification failed: {msg}")
+
+
+def _proof_from_json(d: dict) -> Proof:
+    return Proof(total=int(d["total"]), index=int(d["index"]),
+                 leaf_hash=base64.b64decode(d["leaf_hash"]),
+                 aunts=[base64.b64decode(a) for a in d.get("aunts", [])])
+
+
+class VerifyingClient:
+    """light/rpc/client.go Client — an RPC client whose answers are
+    checked against the light client before being trusted."""
+
+    def __init__(self, light_client: Client, primary_url: str,
+                 timeout: float = 10.0):
+        self.lc = light_client
+        self.http = HTTPClient(primary_url, timeout=timeout)
+
+    # -- verified header plumbing -------------------------------------------
+
+    def _verified(self, height: Optional[int]):
+        """updateLightClientIfNeededTo (light/rpc/client.go:590)."""
+        if height is None:
+            lb = self.lc.update()
+            if lb is None:
+                lb = self.lc.trusted_light_block(
+                    self.lc.last_trusted_height())
+            return lb
+        return self.lc.verify_light_block_at_height(int(height))
+
+    # -- verified routes ----------------------------------------------------
+
+    def block(self, height=None):
+        res = self.http.block(None if height is None else int(height))
+        hdr = prov.header_from_json(res["block"]["header"])
+        lb = self._verified(hdr.height)
+        if hdr.hash() != lb.header.hash():
+            raise VerifyError(
+                f"primary's block header at height {hdr.height} does not "
+                f"match the verified header")
+        claimed = bytes.fromhex(res["block_id"]["hash"])
+        if claimed != lb.header.hash():
+            raise VerifyError("primary's block_id does not hash the header")
+        return res
+
+    def commit(self, height=None):
+        res = self.http.commit(None if height is None else int(height))
+        hdr = prov.header_from_json(res["signed_header"]["header"])
+        lb = self._verified(hdr.height)
+        if hdr.hash() != lb.header.hash():
+            raise VerifyError(
+                f"primary's commit header at height {hdr.height} does not "
+                f"match the verified header")
+        return res
+
+    def validators(self, height=None, page="1", per_page="30"):
+        # answered locally from the verified set — the primary is only the
+        # light-block source (light/rpc/client.go:500)
+        lb = self._verified(None if height is None else int(height))
+        vals = lb.validator_set.validators
+        p, pp = max(1, int(page)), min(100, max(1, int(per_page)))
+        chunk = vals[(p - 1) * pp: p * pp]
+        return {
+            "block_height": str(lb.height()),
+            "validators": [{
+                "address": v.address.hex().upper(),
+                "pub_key": {"type": v.pub_key.type_value(),
+                            "value": base64.b64encode(
+                                v.pub_key.bytes()).decode()},
+                "voting_power": str(v.voting_power),
+                "proposer_priority": str(v.proposer_priority),
+            } for v in chunk],
+            "count": str(len(chunk)),
+            "total": str(len(vals)),
+        }
+
+    def tx(self, hash, prove=True):
+        res = self.http.tx(hash, prove=True)
+        height = int(res["height"])
+        lb = self._verified(height)
+        pr = res.get("proof")
+        if not pr:
+            raise VerifyError("primary returned no tx proof")
+        root = bytes.fromhex(pr["root_hash"])
+        if root != lb.header.data_hash:
+            raise VerifyError("tx proof root != verified data_hash")
+        tx_bytes = base64.b64decode(res["tx"])
+        _proof_from_json(pr["proof"]).verify(root, tx_hash(tx_bytes))
+        return res
+
+    def abci_query(self, path="", data="", height="0", prove=True):
+        res = self.http.abci_query(path=path, data=data,
+                                   height=int(height), prove=True)
+        resp = res["response"]
+        pr = resp.get("proof")
+        if not pr:
+            # the reference refuses unproven query results outright
+            # (light/rpc/client.go:286 "no proof ops")
+            raise VerifyError("app returned no query proof")
+        h = int(resp["height"])
+        lb = self._verified(h + 1)  # value is proven under NEXT app_hash
+        value = base64.b64decode(resp["value"] or "")
+        _proof_from_json(pr).verify(lb.header.app_hash, value)
+        return res
+
+    # -- passthrough routes (light/proxy/routes.go) -------------------------
+
+    def status(self):
+        return self.http.status()
+
+    def health(self):
+        return self.http.health()
+
+    def genesis(self):
+        return self.http.genesis()
+
+    def net_info(self):
+        return self.http.net_info()
+
+    def broadcast_tx_sync(self, tx):
+        return self.http.call("broadcast_tx_sync", tx=tx)
+
+    def broadcast_tx_async(self, tx):
+        return self.http.call("broadcast_tx_async", tx=tx)
+
+    def broadcast_tx_commit(self, tx):
+        return self.http.call("broadcast_tx_commit", tx=tx)
+
+    def unconfirmed_txs(self, limit="30"):
+        return self.http.unconfirmed_txs(int(limit))
+
+    def broadcast_evidence(self, evidence):
+        return self.http.call("broadcast_evidence", evidence=evidence)
+
+
+class LightProxy:
+    """light/proxy/proxy.go Proxy — VerifyingClient behind a local RPC
+    server."""
+
+    def __init__(self, light_client: Client, primary_url: str,
+                 laddr: str = "tcp://127.0.0.1:0", timeout: float = 10.0):
+        self.client = VerifyingClient(light_client, primary_url,
+                                      timeout=timeout)
+        c = self.client
+        routes = {name: getattr(c, name) for name in (
+            "block", "commit", "validators", "tx", "abci_query", "status",
+            "health", "genesis", "net_info", "broadcast_tx_sync",
+            "broadcast_tx_async", "broadcast_tx_commit", "unconfirmed_txs",
+            "broadcast_evidence")}
+        self.server = RPCServer(laddr, routes=routes)
+
+    @property
+    def laddr(self) -> str:
+        return f"tcp://{self.server.host}:{self.server.port}"
+
+    def start(self) -> None:
+        self.server.start()
+
+    def stop(self) -> None:
+        self.server.stop()

@@ -27,6 +27,12 @@ class Reactor:
     def get_channels(self) -> List[ChannelDescriptor]:
         return []
 
+    def init_peer(self, peer: "Peer") -> None:
+        """Attach per-peer state BEFORE the connection's recv routine
+        starts (p2p/base_reactor.go InitPeer). Anything receive() needs
+        must be set here, not in add_peer — a peer's first messages can
+        arrive before add_peer runs."""
+
     def add_peer(self, peer: "Peer") -> None:
         pass
 
@@ -241,6 +247,16 @@ class Switch(BaseService):
             from tmtpu.libs import metrics as _m
 
             _m.p2p_peers.set(len(self.peers))
+        # reference ordering (switch.go addPeer): InitPeer on every reactor
+        # BEFORE the connection starts delivering, then AddPeer — one-shot
+        # messages (e.g. consensus NewRoundStep) sent by the remote right
+        # after its handshake would otherwise race the peer-state setup and
+        # be dropped
+        for r in self.reactors.values():
+            try:
+                r.init_peer(peer)
+            except Exception:
+                pass
         peer.start()
         for r in self.reactors.values():
             try:

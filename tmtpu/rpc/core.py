@@ -333,7 +333,44 @@ def build_routes(env: Environment) -> dict:
         }}
 
     def dump_consensus_state():
-        return consensus_state()
+        """rpc/core/consensus.go DumpConsensusState — round state plus
+        vote-set bitarrays and per-peer gossip state."""
+        out = consensus_state()
+        rs = env.consensus.get_round_state()
+        votes = {}
+        if rs.votes is not None:
+            # include rounds ABOVE the current one too — a lagging node's
+            # higher-round votes are exactly what a stall diagnosis needs
+            for r in range(max(rs.round, rs.votes.round()) + 1):
+                pv, pc = rs.votes.prevotes(r), rs.votes.precommits(r)
+                votes[str(r)] = {
+                    "prevotes": pv.bit_array().true_indices() if pv else [],
+                    "prevotes_sum": str(pv.sum_voting_power()) if pv else "0",
+                    "precommits":
+                        pc.bit_array().true_indices() if pc else [],
+                    "precommits_sum":
+                        str(pc.sum_voting_power()) if pc else "0",
+                }
+        out["round_state"]["height_vote_set"] = votes
+        peers = []
+        switch = getattr(node, "switch", None)
+        if switch is not None:
+            for pid, peer in list(switch.peers.items()):
+                ps = peer.get("consensus_peer_state")
+                if ps is None:
+                    continue
+                with ps.lock:
+                    peers.append({
+                        "node_id": pid,
+                        "height": str(ps.height), "round": ps.round,
+                        "step": ps.step, "proposal": ps.proposal,
+                        "prevotes": {str(r): b.true_indices()
+                                     for r, b in ps.prevotes.items()},
+                        "precommits": {str(r): b.true_indices()
+                                       for r, b in ps.precommits.items()},
+                    })
+        out["peers"] = peers
+        return out
 
     def consensus_params(height=None):
         state = node.latest_state()
@@ -465,13 +502,19 @@ def build_routes(env: Environment) -> dict:
         res = node.proxy_app.query.query_sync(abci.RequestQuery(
             data=raw, path=path, height=int(height),
             prove=prove in (True, "true", "1")))
-        return {"response": {
+        out = {
             "code": res.code, "log": res.log, "info": res.info,
             "index": str(res.index),
             "key": _b64(res.key) if res.key else None,
             "value": _b64(res.value) if res.value else None,
             "height": str(res.height), "codespace": res.codespace,
-        }}
+        }
+        if res.proof_ops is not None and res.proof_ops.total:
+            p = res.proof_ops
+            out["proof"] = {"total": str(p.total), "index": str(p.index),
+                            "leaf_hash": _b64(p.leaf_hash),
+                            "aunts": [_b64(a) for a in p.aunts]}
+        return {"response": out}
 
     def abci_info():
         res = node.proxy_app.query.info_sync(abci.RequestInfo(

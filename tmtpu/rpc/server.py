@@ -29,7 +29,10 @@ class RPCError(Exception):
 
 
 class RPCServer:
-    def __init__(self, laddr: str, node):
+    def __init__(self, laddr: str, node=None, routes=None):
+        """Serve a node's core routes (node=...) or an arbitrary routes
+        dict (routes=..., e.g. the light proxy) — same HTTP/JSON-RPC
+        machinery either way; WebSocket upgrade needs a node's event bus."""
         addr = laddr[len("tcp://"):] if laddr.startswith("tcp://") else laddr
         host, _, port = addr.rpartition(":")
         self.host = host or "127.0.0.1"
@@ -37,12 +40,16 @@ class RPCServer:
             pass
         self.port = int(port)
         self.node = node
+        self.routes = routes
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> None:
-        env = core.Environment(self.node)
-        routes = core.build_routes(env)
+        if self.node is not None:
+            env = core.Environment(self.node)
+            routes = core.build_routes(env)
+        else:
+            env, routes = None, dict(self.routes or {})
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -80,7 +87,7 @@ class RPCServer:
             def do_GET(self):
                 parsed = urllib.parse.urlparse(self.path)
                 method = parsed.path.lstrip("/")
-                if method == "websocket" and \
+                if method == "websocket" and env is not None and \
                         websocket.is_websocket_upgrade(self.headers):
                     self._upgrade_websocket()
                     return
@@ -134,14 +141,25 @@ class RPCServer:
                     self._respond({"jsonrpc": "2.0", "id": -1, "error": {
                         "code": -32700, "message": "Parse error"}})
                     return
+                invalid = {"jsonrpc": "2.0", "id": -1, "error": {
+                    "code": -32600, "message": "Invalid Request"}}
                 if isinstance(req, list):
-                    self._respond([self._run(r.get("method", ""),
-                                             r.get("params") or {},
-                                             r.get("id", -1)) for r in req])
-                else:
+                    # JSON-RPC 2.0: empty batch and non-object entries are
+                    # Invalid Request, not a silently empty response
+                    if not req:
+                        self._respond(invalid)
+                        return
+                    self._respond([
+                        self._run(r.get("method", ""), r.get("params") or {},
+                                  r.get("id", -1))
+                        if isinstance(r, dict) else invalid
+                        for r in req])
+                elif isinstance(req, dict):
                     self._respond(self._run(req.get("method", ""),
                                             req.get("params") or {},
                                             req.get("id", -1)))
+                else:
+                    self._respond(invalid)
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]

@@ -127,6 +127,36 @@ class NullTxIndexer:
         return []
 
 
+def reindex_events(block_store, state_store, tx_indexer,
+                   block_indexer=None, first: int = 0, last: int = 0) -> int:
+    """commands/reindex_event.go — rebuild the tx/block-event indexes from
+    the stored blocks + ABCI responses (no live event bus involved).
+    Returns the number of heights reindexed."""
+    from tmtpu.types.event_bus import (
+        EVENT_NEW_BLOCK, _merge_abci_events,
+    )
+
+    first = first or block_store.base()
+    last = last or block_store.height()
+    n = 0
+    for h in range(first, last + 1):
+        block = block_store.load_block(h)
+        res = state_store.load_abci_responses(h)
+        if block is None or res is None:
+            continue
+        for i, tx in enumerate(block.txs):
+            tx_indexer.index(abci.TxResult(
+                height=h, index=i, tx=tx, result=res.deliver_txs[i]))
+        if block_indexer is not None:
+            events = {"tm.event": [EVENT_NEW_BLOCK],
+                      "block.height": [str(h)]}
+            for r in (res.begin_block, res.end_block):
+                _merge_abci_events(events, getattr(r, "events", None))
+            block_indexer.index(h, events)
+        n += 1
+    return n
+
+
 class IndexerService:
     """state/txindex/indexer_service.go — subscribes to the bus and feeds
     the indexer."""
