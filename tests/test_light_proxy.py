@@ -114,3 +114,21 @@ def test_proxy_rejects_tampered_block(node, proxy):
     h = node.block_store.height()
     with pytest.raises(VerifyError, match="does not match"):
         vc.block(h)
+
+
+def test_mock_client_matches_http(node):
+    """rpc/client/local parity: the in-process client answers the same as
+    the HTTP client for the same node."""
+    from tmtpu.rpc.mock import MockClient
+
+    mc = MockClient(node)
+    hc = HTTPClient(f"http://127.0.0.1:{node.rpc_server.port}")
+    assert mc.status()["node_info"]["network"] == \
+        hc.status()["node_info"]["network"]
+    h = node.block_store.height()
+    assert mc.block(h)["block_id"] == hc.block(h)["block_id"]
+    assert mc.validators(h) == hc.validators(h)
+    res = mc.broadcast_tx_commit(b"mock1=v1")
+    assert res["deliver_tx"]["code"] == 0
+    with pytest.raises(RPCClientError, match="Method not found"):
+        mc.call("bogus_route")

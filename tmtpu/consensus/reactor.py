@@ -72,9 +72,17 @@ class PeerState:
         with self.lock:
             table = self.prevotes if vote_type == PREVOTE else self.precommits
             ba = table.get(round)
-            if ba is None or ba.size() != n:
+            if ba is None:
                 ba = BitArray(n)
                 table[round] = ba
+            elif ba.size() != n:
+                # resize keeping surviving marks — a HasVote that arrived
+                # before we knew the validator count must not be forgotten
+                grown = BitArray(n)
+                for i in ba.true_indices():
+                    if i < n:
+                        grown.set_index(i, True)
+                table[round] = ba = grown
             return ba
 
     def set_has_vote(self, height: int, round: int, vote_type: int,
@@ -129,8 +137,14 @@ class ConsensusReactor(Reactor):
         if cs.event_bus is not None:
             self._step_sub = cs.event_bus.subscribe_type(
                 "reactor-steps", "NewRoundStep")
+            # every ADDED vote (not just our own) is announced as HasVote
+            # so peers skip re-gossiping it to us (reactor.go:390
+            # broadcastHasVoteMessage on the state's Vote event)
+            self._vote_sub = cs.event_bus.subscribe_type(
+                "reactor-hasvote", "Vote")
         else:
             self._step_sub = None
+            self._vote_sub = None
 
     # -- reactor interface --------------------------------------------------
 
@@ -150,6 +164,10 @@ class ConsensusReactor(Reactor):
         if self._step_sub is not None:
             t = threading.Thread(target=self._step_broadcast_routine,
                                  daemon=True, name="cs-step-bcast")
+            t.start()
+        if self._vote_sub is not None:
+            t = threading.Thread(target=self._has_vote_broadcast_routine,
+                                 daemon=True, name="cs-hasvote-bcast")
             t.start()
 
     def on_stop(self) -> None:
@@ -224,7 +242,12 @@ class ConsensusReactor(Reactor):
                 ps.apply_new_round_step(m.new_round_step)
             elif kind == "has_vote":
                 hv = m.has_vote
-                ps.set_has_vote(hv.height, hv.round, hv.type, hv.index)
+                rs = self.cs.get_round_state()
+                n = rs.validators.size() if rs.validators else 0
+                # n sizes the BitArray correctly up front — a default-sized
+                # (index+1) array would be discarded by the gossip loop's
+                # vote_bits(round, type, n) size check, losing the mark
+                ps.set_has_vote(hv.height, hv.round, hv.type, hv.index, n)
             elif kind == "vote_set_maj23":
                 vm = m.vote_set_maj23
                 rs = self.cs.get_round_state()
@@ -283,15 +306,27 @@ class ConsensusReactor(Reactor):
                 self.switch.broadcast(STATE_CHANNEL,
                                       self._new_round_step_msg().encode())
 
+    def _has_vote_broadcast_routine(self) -> None:
+        while not self._stopped.is_set():
+            item = self._vote_sub.next(timeout=0.2)
+            if item is None:
+                continue
+            vote = item.data.get("vote")
+            if vote is None or self.switch is None:
+                continue
+            self.switch.broadcast(STATE_CHANNEL, cm.ConsensusMessagePB(
+                has_vote=cm.HasVotePB(
+                    height=vote.height, round=vote.round, type=vote.type,
+                    index=vote.validator_index)).encode())
+
     def _broadcast_own_vote(self, vote: Vote) -> None:
         if self.switch is None:
             return
         msg = cm.ConsensusMessagePB(vote=cm.VotePB(vote=vote.to_proto()))
         self.switch.broadcast(VOTE_CHANNEL, msg.encode())
-        hv = cm.ConsensusMessagePB(has_vote=cm.HasVotePB(
-            height=vote.height, round=vote.round, type=vote.type,
-            index=vote.validator_index))
-        self.switch.broadcast(STATE_CHANNEL, hv.encode())
+        # HasVote announcement rides the event-driven
+        # _has_vote_broadcast_routine (adding the vote published a Vote
+        # event), matching reactor.go's single broadcastHasVoteMessage
 
     def _broadcast_own_proposal(self, proposal: Proposal, parts) -> None:
         if self.switch is None:

@@ -1,0 +1,32 @@
+"""In-process mock RPC client (reference: rpc/client/mock, rpc/client/local).
+
+Same call surface as ``rpc.client.HTTPClient`` but dispatching straight
+into a node's core route table — no HTTP, no sockets. The reference uses
+this for tests and for the "local" client variant that the light provider
+and load tools can run in-process.
+"""
+
+from __future__ import annotations
+
+from tmtpu.rpc import core
+from tmtpu.rpc.client import HTTPClient, RPCClientError
+from tmtpu.rpc.server import RPCError
+
+
+class MockClient(HTTPClient):
+    """rpc/client/local Local — the full HTTPClient method surface with
+    ``call`` rerouted into the node's route table, so the two clients
+    can never drift apart."""
+
+    def __init__(self, node):
+        super().__init__("http://mock.invalid")
+        self._routes = core.build_routes(core.Environment(node))
+
+    def call(self, method: str, **params):
+        fn = self._routes.get(method)
+        if fn is None:
+            raise RPCClientError(-32601, f"Method not found: {method}")
+        try:
+            return fn(**params)
+        except RPCError as e:
+            raise RPCClientError(e.code, e.message, e.data) from e

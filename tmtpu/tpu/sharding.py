@@ -120,7 +120,15 @@ def sharded_verify_tally_kernel(mesh: Mesh, *, tile: int | None = None,
     This is the production pod-scale path; the XLA-graph twin
     (sharded_verify_tally_compact) remains for CPU meshes and the driver
     dryrun, where Mosaic isn't available."""
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map
+
+        # jax >= 0.8 renamed check_rep -> check_vma
+        rep_kw = {"check_vma": False}
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+        rep_kw = {"check_rep": False}
 
     from tmtpu.tpu import kernel as tk
 
@@ -141,7 +149,7 @@ def sharded_verify_tally_kernel(mesh: Mesh, *, tile: int | None = None,
         mesh=mesh,
         in_specs=(P(None, "sig"),) * 5,
         out_specs=(P("sig"), P(), P("sig")),
-        check_rep=False,
+        **rep_kw,
     ))
 
 
