@@ -141,3 +141,84 @@ def test_event_bus_emits_new_block():
         assert item.data["block"].header.height >= 1
     finally:
         stop_all(nodes)
+
+
+def test_maj23_query_answered_with_vote_set_bits(tmp_path):
+    """reactor.go:310-330 + :849: a VoteSetMaj23 claim is answered on the
+    VoteSetBits channel with our actual vote bits, and an incoming
+    VoteSetBits reconciles the peer's PeerState marks.
+
+    Uses a 2-validator net with only one node running: it prevotes in
+    round 0 and can never commit (1 of 2 is not +2/3), giving a stable
+    round to query."""
+    from tmtpu.consensus import msgs as cm
+    from tmtpu.consensus.reactor import (
+        ConsensusReactor, STATE_CHANNEL, VOTE_SET_BITS_CHANNEL, _decode_bits,
+        _encode_bits,
+    )
+    from tmtpu.p2p.mock import MockPeer
+    from tmtpu.types.vote import PREVOTE
+
+    nodes = make_network(2, wal_dir=str(tmp_path))
+    cs = nodes[0]
+    reactor = ConsensusReactor(cs)
+    try:
+        cs.start()
+        deadline = time.time() + 20
+        vs = None
+        while time.time() < deadline:
+            cur = cs.get_round_state()
+            vs = cur.votes.prevotes(0) if cur.votes else None
+            if vs is not None and vs.bit_array().num_true_bits() > 0:
+                break
+            time.sleep(0.05)
+        assert vs is not None and vs.bit_array().num_true_bits() > 0
+        own = next(vs.get_by_index(i)
+                   for i in vs.bit_array().true_indices())
+        peer = MockPeer()
+        reactor.init_peer(peer)
+
+        # stale-height claim: ignored
+        reactor.receive(STATE_CHANNEL, peer, cm.ConsensusMessagePB(
+            vote_set_maj23=cm.VoteSetMaj23PB(
+                height=cur.height + 7, round=0, type=PREVOTE,
+                block_id=own.block_id.to_proto())).encode())
+        assert not peer.sent_on(VOTE_SET_BITS_CHANNEL)
+
+        # live claim: answered with our actual prevote bits
+        reactor.receive(STATE_CHANNEL, peer, cm.ConsensusMessagePB(
+            vote_set_maj23=cm.VoteSetMaj23PB(
+                height=cur.height, round=0, type=PREVOTE,
+                block_id=own.block_id.to_proto())).encode())
+        replies = peer.sent_on(VOTE_SET_BITS_CHANNEL)
+        assert replies, "no VoteSetBits response"
+        vb = cm.ConsensusMessagePB.decode(replies[-1]).vote_set_bits
+        bits = _decode_bits(bytes(vb.votes))
+        assert bits is not None and bits.num_true_bits() >= 1
+
+        # reconciliation: feeding VoteSetBits marks the peer's known votes
+        ps = peer.get("consensus_peer_state")
+        assert ps.vote_bits(0, PREVOTE, bits.size()).num_true_bits() == 0
+        reactor.receive(VOTE_SET_BITS_CHANNEL, peer, cm.ConsensusMessagePB(
+            vote_set_bits=cm.VoteSetBitsPB(
+                height=cur.height, round=0, type=PREVOTE,
+                block_id=own.block_id.to_proto(),
+                votes=_encode_bits(bits))).encode())
+        after = ps.vote_bits(0, PREVOTE, bits.size()).num_true_bits()
+        assert after == bits.num_true_bits()
+
+        # healing: a stale optimistic mark for a vote WE hold is cleared
+        # when the peer's reply shows it doesn't actually have it
+        # (reactor.go ApplyVoteSetBitsMessage's Sub(ourVotes) semantics)
+        own_idx = own.validator_index
+        ps.set_has_vote(cur.height, 0, PREVOTE, own_idx, bits.size())
+        from tmtpu.libs.bits import BitArray
+        reactor.receive(VOTE_SET_BITS_CHANNEL, peer, cm.ConsensusMessagePB(
+            vote_set_bits=cm.VoteSetBitsPB(
+                height=cur.height, round=0, type=PREVOTE,
+                block_id=own.block_id.to_proto(),
+                votes=_encode_bits(BitArray(bits.size())))).encode())
+        assert not ps.vote_bits(0, PREVOTE, bits.size()).get_index(own_idx), \
+            "stale mark not healed by VoteSetBits"
+    finally:
+        stop_all(nodes)

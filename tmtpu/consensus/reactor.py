@@ -37,6 +37,28 @@ VOTE_SET_BITS_CHANNEL = 0x23
 GOSSIP_SLEEP_S = 0.01  # peerGossipSleepDuration (100ms in ref; faster here)
 
 
+def _encode_bits(ba: BitArray) -> bytes:
+    """BitArray wire form for VoteSetBits: LE uint32 bit-count + packed
+    64-bit words."""
+    import struct
+
+    return struct.pack("<I", ba.size()) + ba.words().tobytes()
+
+
+def _decode_bits(data: bytes):
+    import struct
+
+    import numpy as np
+
+    if len(data) < 4 or (len(data) - 4) % 8 != 0:
+        return None
+    (n,) = struct.unpack("<I", data[:4])
+    words = np.frombuffer(data[4:], dtype=np.uint64)
+    if n > len(words) * 64 or n > (1 << 24):
+        return None
+    return BitArray.from_words(n, words.copy())
+
+
 class PeerState:
     """consensus/reactor.go PeerState — what we know the peer knows."""
 
@@ -219,7 +241,8 @@ class ConsensusReactor(Reactor):
             peer.send(STATE_CHANNEL, self._new_round_step_msg().encode())
         threads = []
         for fn, name in ((self._gossip_data_routine, "gossip-data"),
-                         (self._gossip_votes_routine, "gossip-votes")):
+                         (self._gossip_votes_routine, "gossip-votes"),
+                         (self._query_maj23_routine, "query-maj23")):
             t = threading.Thread(target=fn, args=(peer, ps), daemon=True,
                                  name=f"{name}-{peer.node_id[:8]}")
             t.start()
@@ -258,6 +281,19 @@ class ConsensusReactor(Reactor):
                             BlockID.from_proto(vm.block_id))
                     except Exception:
                         pass
+                    # respond with OUR votes for that set so the peer can
+                    # reconcile its PeerState (reactor.go:310-330)
+                    vs = rs.votes.votes(vm.round, vm.type)
+                    if vs is not None:
+                        ours = vs.bit_array_by_block_id(
+                            BlockID.from_proto(vm.block_id)) \
+                            or BitArray(vs.size())
+                        peer.try_send(
+                            VOTE_SET_BITS_CHANNEL, cm.ConsensusMessagePB(
+                                vote_set_bits=cm.VoteSetBitsPB(
+                                    height=vm.height, round=vm.round,
+                                    type=vm.type, block_id=vm.block_id,
+                                    votes=_encode_bits(ours))).encode())
         elif channel_id == DATA_CHANNEL:
             if self.wait_sync:
                 return
@@ -282,6 +318,29 @@ class ConsensusReactor(Reactor):
                 ps.set_has_vote(vote.height, vote.round, vote.type,
                                 vote.validator_index, n)
                 self.cs.add_vote_msg(vote, peer.node_id)
+        elif channel_id == VOTE_SET_BITS_CHANNEL:
+            if kind == "vote_set_bits":
+                vb = m.vote_set_bits
+                rs = self.cs.get_round_state()
+                if rs.height != vb.height or rs.validators is None:
+                    return
+                n = rs.validators.size()
+                bits = _decode_bits(bytes(vb.votes))
+                if bits is None or bits.size() != n:
+                    return  # size is OUR valset's, never peer-controlled
+                # reactor.go ApplyVoteSetBitsMessage: where WE hold the vote
+                # (could resend it), the peer's reply is authoritative —
+                # clearing stale optimistic marks; outside our own set we
+                # keep whatever we knew
+                vs = rs.votes.votes(vb.round, vb.type) if rs.votes else None
+                ours = vs.bit_array_by_block_id(
+                    BlockID.from_proto(vb.block_id)) if vs else None
+                with ps.lock:
+                    known = ps.vote_bits(vb.round, vb.type, n)
+                    if ours is None:
+                        known.update(bits)
+                    else:
+                        known.update(known.sub(ours).or_(bits))
 
     # -- outbound -----------------------------------------------------------
 
@@ -422,9 +481,9 @@ class ConsensusReactor(Reactor):
             if prs_h == rs.height and rs.votes is not None:
                 # current-round prevotes then precommits
                 for vote_type in (PREVOTE, PRECOMMIT):
-                    vs = rs.votes.prevotes(prs_r) if vote_type == PREVOTE \
-                        else rs.votes.precommits(prs_r)
-                    if vs is None or prs_r < 0:
+                    vs = rs.votes.votes(prs_r, vote_type) if prs_r >= 0 \
+                        else None
+                    if vs is None:
                         continue
                     theirs = ps.vote_bits(prs_r, vote_type, vs.size())
                     missing = vs.bit_array().sub(theirs)
@@ -465,6 +524,32 @@ class ConsensusReactor(Reactor):
                         break
             if not sent:
                 time.sleep(GOSSIP_SLEEP_S)
+
+    QUERY_MAJ23_SLEEP_S = 2.0  # reactor.go:849 queryMaj23Routine cadence
+
+    def _query_maj23_routine(self, peer: Peer, ps: PeerState) -> None:
+        """Periodically tell the peer about 2/3-majorities we've seen so it
+        replies with its actual vote bits (VoteSetBits) — the reconciliation
+        path that heals any divergence between a peer's real vote set and
+        our optimistic PeerState bookkeeping."""
+        while peer.is_running() and not self._stopped.is_set():
+            time.sleep(self.QUERY_MAJ23_SLEEP_S)
+            rs = self.cs.get_round_state()
+            with ps.lock:
+                prs_h, prs_r = ps.height, ps.round
+            if prs_h != rs.height or rs.votes is None or prs_r < 0:
+                continue
+            for vote_type in (PREVOTE, PRECOMMIT):
+                vs = rs.votes.votes(prs_r, vote_type)
+                if vs is None:
+                    continue
+                block_id, has_maj = vs.two_thirds_majority()
+                if not has_maj:
+                    continue
+                peer.try_send(STATE_CHANNEL, cm.ConsensusMessagePB(
+                    vote_set_maj23=cm.VoteSetMaj23PB(
+                        height=rs.height, round=prs_r, type=vote_type,
+                        block_id=block_id.to_proto())).encode())
 
     def _send_vote(self, peer: Peer, ps: PeerState, vote: Vote) -> bool:
         ok = peer.try_send(VOTE_CHANNEL, cm.ConsensusMessagePB(

@@ -163,6 +163,11 @@ class HeightVoteSet:
     def precommits(self, round: int) -> Optional[VoteSet]:
         return self._get(round, PRECOMMIT)
 
+    def votes(self, round: int, typ: int) -> Optional[VoteSet]:
+        """The (round, type) vote set — public form of _get for callers
+        dispatching on a wire vote type."""
+        return self._get(round, typ)
+
     def _get(self, round: int, typ: int) -> Optional[VoteSet]:
         with self._lock:
             rvs = self._round_vote_sets.get(round)
