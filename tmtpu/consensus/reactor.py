@@ -265,15 +265,15 @@ class ConsensusReactor(Reactor):
                 ps.apply_new_round_step(m.new_round_step)
             elif kind == "has_vote":
                 hv = m.has_vote
-                rs = self.cs.get_round_state()
-                n = rs.validators.size() if rs.validators else 0
+                vals = self.cs.round_state_nolock().validators
+                n = vals.size() if vals else 0
                 # n sizes the BitArray correctly up front — a default-sized
                 # (index+1) array would be discarded by the gossip loop's
                 # vote_bits(round, type, n) size check, losing the mark
                 ps.set_has_vote(hv.height, hv.round, hv.type, hv.index, n)
             elif kind == "vote_set_maj23":
                 vm = m.vote_set_maj23
-                rs = self.cs.get_round_state()
+                rs = self.cs.round_state_nolock()
                 if rs.height == vm.height and rs.votes is not None:
                     try:
                         rs.votes.set_peer_maj23(
@@ -313,18 +313,19 @@ class ConsensusReactor(Reactor):
                 return
             if kind == "vote":
                 vote = Vote.from_proto(m.vote.vote)
-                rs = self.cs.get_round_state()
-                n = rs.validators.size() if rs.validators else 0
+                vals = self.cs.round_state_nolock().validators
+                n = vals.size() if vals else 0
                 ps.set_has_vote(vote.height, vote.round, vote.type,
                                 vote.validator_index, n)
                 self.cs.add_vote_msg(vote, peer.node_id)
         elif channel_id == VOTE_SET_BITS_CHANNEL:
             if kind == "vote_set_bits":
                 vb = m.vote_set_bits
-                rs = self.cs.get_round_state()
-                if rs.height != vb.height or rs.validators is None:
+                rs = self.cs.round_state_nolock()
+                vals = rs.validators
+                if rs.height != vb.height or vals is None:
                     return
-                n = rs.validators.size()
+                n = vals.size()
                 bits = _decode_bits(bytes(vb.votes))
                 if bits is None or bits.size() != n:
                     return  # size is OUR valset's, never peer-controlled
@@ -345,10 +346,11 @@ class ConsensusReactor(Reactor):
     # -- outbound -----------------------------------------------------------
 
     def _new_round_step_msg(self) -> cm.ConsensusMessagePB:
-        rs = self.cs.get_round_state()
+        rs = self.cs.round_state_nolock()
         lc_round = -1
-        if rs.last_commit is not None:
-            lc_round = rs.last_commit.round
+        lc = rs.last_commit
+        if lc is not None:
+            lc_round = lc.round
         return cm.ConsensusMessagePB(new_round_step=cm.NewRoundStepPB(
             height=rs.height, round=rs.round, step=rs.step,
             seconds_since_start_time=max(
@@ -403,7 +405,7 @@ class ConsensusReactor(Reactor):
 
     def _gossip_data_routine(self, peer: Peer, ps: PeerState) -> None:
         while peer.is_running() and not self._stopped.is_set():
-            rs = self.cs.get_round_state()
+            rs = self.cs.round_state_nolock()
             with ps.lock:
                 prs_h, prs_r = ps.height, ps.round
                 has_proposal = ps.proposal
@@ -474,7 +476,7 @@ class ConsensusReactor(Reactor):
 
     def _gossip_votes_routine(self, peer: Peer, ps: PeerState) -> None:
         while peer.is_running() and not self._stopped.is_set():
-            rs = self.cs.get_round_state()
+            rs = self.cs.round_state_nolock()
             with ps.lock:
                 prs_h, prs_r = ps.height, ps.round
             sent = False
@@ -534,7 +536,7 @@ class ConsensusReactor(Reactor):
         our optimistic PeerState bookkeeping."""
         while peer.is_running() and not self._stopped.is_set():
             time.sleep(self.QUERY_MAJ23_SLEEP_S)
-            rs = self.cs.get_round_state()
+            rs = self.cs.round_state_nolock()
             with ps.lock:
                 prs_h, prs_r = ps.height, ps.round
             if prs_h != rs.height or rs.votes is None or prs_r < 0:

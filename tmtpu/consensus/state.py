@@ -106,6 +106,10 @@ class ConsensusState(BaseService):
         # cleared by the blocksync/statesync handover (SwitchToConsensus
         # with skipWAL): the WAL predates the synced blocks
         self.do_wal_catchup = True
+        # block parts that arrived before their parts header was known —
+        # replayed by _flush_pending_parts once it is (see
+        # _add_proposal_block_part)
+        self._pending_parts: dict = {}
         # test/byzantine hook: replaces decide_proposal when set
         self.decide_proposal_override = None
         # maverick-style misbehavior schedule {height: name}
@@ -218,6 +222,19 @@ class ConsensusState(BaseService):
         with self._mtx:
             return self.rs
 
+    def round_state_nolock(self) -> RoundState:
+        """The live RoundState WITHOUT taking the consensus mutex — for
+        gossip/query threads (reference reactor.go:403
+        updateRoundStateRoutine keeps a lock-free snapshot for exactly
+        this). ``self.rs`` is a single object mutated in place, so the
+        locked getter returns the same reference anyway; all it adds is
+        blocking — during finalize-commit (ABCI + stores, held under the
+        mutex for the whole block) every gossip thread would stall, peers
+        would miss parts/votes, and under tx load the net livelocks on
+        failed rounds. Readers must tolerate field-level races (take
+        local refs; fields may flip to None)."""
+        return self.rs
+
     def wait_for_height(self, height: int, timeout: float = 30.0) -> bool:
         deadline = time.monotonic() + timeout
         with self._height_cv:
@@ -328,6 +345,7 @@ class ConsensusState(BaseService):
                                     height=ti.height, round=ti.round,
                                     step=ti.step)))
                         self._handle_timeout(ti)
+                    self._flush_pending_parts()
             except Exception:
                 # consensus failures halt the node by design
                 # (state.go:722-735); keep the WAL so the operator can replay
@@ -808,6 +826,24 @@ class ConsensusState(BaseService):
             rs.proposal_block_parts = PartSet(
                 proposal.block_id.parts_total, proposal.block_id.parts_hash)
 
+    def _flush_pending_parts(self) -> None:
+        """Re-feed parts buffered before their header existed; called at
+        the end of every receive cycle (any step in the cycle may have
+        created rs.proposal_block_parts). Stale heights are dropped;
+        still-unanchored parts re-buffer via _add_proposal_block_part."""
+        if not self._pending_parts:
+            return
+        rs = self.rs
+        pend = self._pending_parts
+        self._pending_parts = {}
+        for (h, _idx), msg in pend.items():
+            if h != rs.height:
+                continue
+            if rs.proposal_block_parts is None:
+                self._pending_parts[(h, _idx)] = msg  # keep waiting
+            else:
+                self._add_proposal_block_part(msg, "")
+
     def _add_proposal_block_part(self, msg: BlockPartMessage, peer_id: str
                                  ) -> None:
         """state.go:1890 addProposalBlockPart."""
@@ -817,6 +853,15 @@ class ConsensusState(BaseService):
         if msg.height != rs.height:
             return
         if rs.proposal_block_parts is None:
+            # No parts header yet (no proposal seen / commit not entered):
+            # we can't verify the part — but DON'T lose it. Gossip peers
+            # mark parts delivered on send and never resend, so a part
+            # arriving before its header (catchup to a just-restarted
+            # node, out-of-order delivery) would otherwise be gone for
+            # good and the commit wedges one part short. Buffer and
+            # replay once the header is known.
+            if len(self._pending_parts) < 128:
+                self._pending_parts[(msg.height, msg.part.index)] = msg
             return
         try:
             added = rs.proposal_block_parts.add_part(msg.part)

@@ -60,6 +60,12 @@ class Manifest:
     load: LoadSpec = field(default_factory=LoadSpec)
     target_height: int = 12    # run until every node reaches this
     timeout_s: float = 120.0
+    # e2e nets run the FAST consensus profile (~7x shorter timeouts than
+    # production), so the genesis block-size cap scales down with them —
+    # the reference pairs 21 MiB blocks with a 3 s propose timeout; an
+    # uncapped block at a 400 ms timeout can't reach peers in time and
+    # every round fails until load stops (observed livelock)
+    block_max_bytes: int = 262144
 
     @staticmethod
     def from_toml(path: str) -> "Manifest":
@@ -67,7 +73,8 @@ class Manifest:
             data = tomllib.load(f)
         m = Manifest(chain_id=data.get("chain_id", "e2e-testnet"),
                      target_height=data.get("target_height", 12),
-                     timeout_s=data.get("timeout_s", 120.0))
+                     timeout_s=data.get("timeout_s", 120.0),
+                     block_max_bytes=data.get("block_max_bytes", 262144))
         for nd in data.get("node", []):
             m.nodes.append(NodeSpec(**{
                 k: v for k, v in nd.items()

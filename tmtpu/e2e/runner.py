@@ -118,6 +118,8 @@ class Runner:
             node.node_id = NodeKey.load_or_gen(
                 cfg.rooted(cfg.base.node_key_file)).node_id
             self.nodes.append(node)
+        from tmtpu.types.params import ConsensusParams
+
         gen = GenesisDoc(
             chain_id=self.m.chain_id,
             genesis_time=time.time_ns(),
@@ -125,6 +127,8 @@ class Runner:
                 GenesisValidator(pvs[s.name].get_pub_key(), s.power)
                 for s in self.m.nodes if s.validator
             ],
+            consensus_params=ConsensusParams(
+                block_max_bytes=self.m.block_max_bytes),
         )
         peers = {n.spec.name: f"{n.node_id}@127.0.0.1:{n.p2p_port}"
                  for n in self.nodes}

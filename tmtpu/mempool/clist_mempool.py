@@ -2,9 +2,11 @@
 
 CheckTx goes through the mempool ABCI connection; committed txs are removed
 and the remainder re-checked on update (:435), exactly the reference's
-lifecycle. The concurrent-linked-list becomes an OrderedDict under one lock
-(Python's list/dict are already thread-safe under the GIL for our access
-pattern; the lock covers compound ops).
+lifecycle. Storage is the wait-chan concurrent list (``libs/clist.py``), exactly the
+reference's core structure: broadcast routines hold a CElement cursor and
+block on ``next_wait`` — no rescans, no mempool-lock contention with
+CheckTx/reap on the hot path. A hash→element map provides O(1) dedup and
+removal.
 """
 
 from __future__ import annotations
@@ -15,6 +17,7 @@ from typing import Callable, List, Optional
 
 from tmtpu.abci import types as abci
 from tmtpu.crypto import tmhash
+from tmtpu.libs.clist import CElement, CList
 
 
 class TxInMempoolError(Exception):
@@ -49,7 +52,50 @@ class TxCache:
             self._map.pop(tmhash.sum(tx), None)
 
 
-class CListMempool:
+class AsyncRecheckMixin:
+    """Shared async-recheck machinery (clist_mempool.go:435 recheckTxs
+    fires async CheckTx requests — a synchronous loop would hold the
+    consensus thread for mempool-size ABCI round-trips per commit).
+    Subclasses implement ``_recheck_pass()``. The running/dirty flags are
+    decided under one mutex so a scheduling racing a worker's exit can't
+    be lost."""
+
+    def _init_recheck(self) -> None:
+        self._recheck_dirty = False
+        self._recheck_running = False
+        self._recheck_mtx = threading.Lock()
+
+    def _schedule_recheck(self) -> None:
+        with self._recheck_mtx:
+            self._recheck_dirty = True
+            if self._recheck_running:
+                return
+            self._recheck_running = True
+        threading.Thread(target=self._recheck_worker, daemon=True,
+                         name="mempool-recheck").start()
+
+    def _recheck_worker(self) -> None:
+        while True:
+            with self._recheck_mtx:
+                if not self._recheck_dirty:
+                    self._recheck_running = False
+                    return
+                self._recheck_dirty = False
+            try:
+                self._recheck_pass()
+            except Exception:
+                with self._recheck_mtx:
+                    self._recheck_running = False
+                return  # app conn gone (shutdown)
+            from tmtpu.libs import metrics as _m
+
+            _m.mempool_size.set(self.size())
+
+    def _recheck_pass(self) -> None:
+        raise NotImplementedError
+
+
+class CListMempool(AsyncRecheckMixin):
     def __init__(self, proxy_app, max_txs: int = 5000,
                  max_txs_bytes: int = 1 << 30, cache_size: int = 10000,
                  keep_invalid_txs_in_cache: bool = False,
@@ -60,8 +106,10 @@ class CListMempool:
         self.keep_invalid_txs_in_cache = keep_invalid_txs_in_cache
         self.pre_check = pre_check
         self.cache = TxCache(cache_size)
-        self._txs: "OrderedDict[bytes, dict]" = OrderedDict()  # hash -> info
+        self._list = CList()  # of info dicts, FIFO
+        self._txs: "OrderedDict[bytes, CElement]" = OrderedDict()
         self._txs_bytes = 0
+        self._init_recheck()
         self._height = 0
         self._lock = threading.RLock()
         self._update_lock = threading.RLock()  # Lock()/Unlock() surface
@@ -96,11 +144,12 @@ class CListMempool:
         with self._lock:
             if res.is_ok():
                 if key not in self._txs:
-                    self._txs[key] = {
+                    info = {
                         "tx": tx, "gas_wanted": res.gas_wanted,
                         "height": self._height,
                         "senders": set(filter(None, [tx_info.get("sender")])),
                     }
+                    self._txs[key] = self._list.push_back(info)
                     self._txs_bytes += len(tx)
                     for fn in self._notify:
                         fn()
@@ -115,7 +164,7 @@ class CListMempool:
                                ) -> List[bytes]:
         with self._lock:
             out, total_b, total_g = [], 0, 0
-            for info in self._txs.values():
+            for info in self._list:
                 # amino/proto overhead bound per tx, as the reference reaps
                 nb = total_b + len(info["tx"]) + 20
                 ng = total_g + max(info["gas_wanted"], 0)
@@ -129,8 +178,16 @@ class CListMempool:
 
     def reap_max_txs(self, n: int) -> List[bytes]:
         with self._lock:
-            txs = [i["tx"] for i in self._txs.values()]
+            txs = [i["tx"] for i in self._list]
             return txs if n < 0 else txs[:n]
+
+    def front(self) -> Optional[CElement]:
+        """Front element for cursor-based gossip (TxsFront)."""
+        return self._list.front()
+
+    def wait_front(self, timeout: float | None = None) -> Optional[CElement]:
+        """Block until the mempool is non-empty (TxsWaitChan)."""
+        return self._list.wait_chan(timeout)
 
     def lock(self) -> None:
         self._update_lock.acquire()
@@ -150,27 +207,39 @@ class CListMempool:
                 elif not self.keep_invalid_txs_in_cache:
                     self.cache.remove(tx)
                 key = tmhash.sum(tx)
-                info = self._txs.pop(key, None)
-                if info is not None:
-                    self._txs_bytes -= len(info["tx"])
-            remaining = [i["tx"] for i in self._txs.values()]
-        # recheck outside the map lock (sync for simplicity; small mempools)
+                el = self._txs.pop(key, None)
+                if el is not None:
+                    self._list.remove(el)
+                    self._txs_bytes -= len(el.value["tx"])
+        # recheck runs on a background worker (clist_mempool.go:435
+        # recheckTxs fires ASYNC CheckTx requests): a synchronous loop here
+        # would hold the consensus thread — and the shared app mutex — for
+        # mempool-size ABCI round-trips per commit, which under tx load
+        # starves vote/proposal processing and livelocks rounds
+        self._schedule_recheck()
+        from tmtpu.libs import metrics as _m
+
+        _m.mempool_size.set(self.size())
+
+    def _recheck_pass(self) -> None:
+        with self._lock:
+            remaining = [i["tx"] for i in self._list]
         for tx in remaining:
             res = self.proxy_app.check_tx_sync(abci.RequestCheckTx(
                 tx=tx, type=abci.CHECK_TX_TYPE_RECHECK))
             if not res.is_ok():
                 with self._lock:
-                    info = self._txs.pop(tmhash.sum(tx), None)
-                    if info is not None:
-                        self._txs_bytes -= len(info["tx"])
+                    el = self._txs.pop(tmhash.sum(tx), None)
+                    if el is not None:
+                        self._list.remove(el)
+                        self._txs_bytes -= len(el.value["tx"])
                 if not self.keep_invalid_txs_in_cache:
                     self.cache.remove(tx)
-        from tmtpu.libs import metrics as _m
-
-        _m.mempool_size.set(self.size())
 
     def flush(self) -> None:
         with self._lock:
+            for el in list(self._txs.values()):
+                self._list.remove(el)
             self._txs.clear()
             self._txs_bytes = 0
         from tmtpu.libs import metrics as _m
@@ -197,11 +266,11 @@ class CListMempool:
 
     def mark_sender(self, tx: bytes, sender) -> None:
         with self._lock:
-            info = self._txs.get(tmhash.sum(tx))
-            if info is not None:
-                info["senders"].add(sender)
+            el = self._txs.get(tmhash.sum(tx))
+            if el is not None:
+                el.value["senders"].add(sender)
 
     def senders(self, tx: bytes) -> set:
         with self._lock:
-            info = self._txs.get(tmhash.sum(tx))
-            return set(info["senders"]) if info else set()
+            el = self._txs.get(tmhash.sum(tx))
+            return set(el.value["senders"]) if el else set()

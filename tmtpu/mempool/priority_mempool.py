@@ -18,12 +18,13 @@ from typing import Callable, List, Optional
 
 from tmtpu.abci import types as abci
 from tmtpu.crypto import tmhash
+from tmtpu.libs.clist import CElement, CList
 from tmtpu.mempool.clist_mempool import (
-    MempoolFullError, TxCache, TxInMempoolError,
+    AsyncRecheckMixin, MempoolFullError, TxCache, TxInMempoolError,
 )
 
 
-class PriorityMempool:
+class PriorityMempool(AsyncRecheckMixin):
     def __init__(self, proxy_app, max_txs: int = 5000,
                  max_txs_bytes: int = 1 << 30, cache_size: int = 10000,
                  keep_invalid_txs_in_cache: bool = False,
@@ -35,9 +36,11 @@ class PriorityMempool:
         self.pre_check = pre_check
         self.cache = TxCache(cache_size)
         self._txs: dict = {}  # hash -> info
+        self._list = CList()  # arrival order, for cursor-based gossip
         self._txs_bytes = 0
         self._height = 0
         self._seq = itertools.count()  # FIFO tiebreak within a priority
+        self._init_recheck()
         self._lock = threading.RLock()
         self._update_lock = threading.RLock()
         self._notify: List[Callable] = []
@@ -87,15 +90,18 @@ class PriorityMempool:
                         f"mempool is full: {len(self._txs)} txs and no "
                         f"lower-priority tx to evict")
                 del self._txs[victim_key]
+                self._list.remove(victim["_el"])
                 self._txs_bytes -= len(victim["tx"])
                 # evicted txs must be re-submittable (they're in no block)
                 self.cache.remove(victim["tx"])
-            self._txs[key] = {
+            info = {
                 "tx": tx, "priority": res.priority,
                 "gas_wanted": res.gas_wanted, "seq": next(self._seq),
                 "height": self._height,
                 "senders": set(filter(None, [tx_info.get("sender")])),
             }
+            info["_el"] = self._list.push_back(info)
+            self._txs[key] = info
             self._txs_bytes += len(tx)
             for fn in self._notify:
                 fn()
@@ -144,7 +150,16 @@ class PriorityMempool:
                     self.cache.remove(tx)
                 info = self._txs.pop(tmhash.sum(tx), None)
                 if info is not None:
+                    self._list.remove(info["_el"])
                     self._txs_bytes -= len(info["tx"])
+        # async recheck, same rationale as CListMempool._schedule_recheck
+        self._schedule_recheck()
+        from tmtpu.libs import metrics as _m
+
+        _m.mempool_size.set(self.size())
+
+    def _recheck_pass(self) -> None:
+        with self._lock:
             remaining = [i["tx"] for i in self._txs.values()]
         for tx in remaining:
             res = self.proxy_app.check_tx_sync(abci.RequestCheckTx(
@@ -155,17 +170,17 @@ class PriorityMempool:
                     continue
                 if not res.is_ok():
                     del self._txs[tmhash.sum(tx)]
+                    self._list.remove(info["_el"])
                     self._txs_bytes -= len(info["tx"])
                     if not self.keep_invalid_txs_in_cache:
                         self.cache.remove(tx)
                 else:
-                    info["priority"] = res.priority  # may change on recheck
-        from tmtpu.libs import metrics as _m
-
-        _m.mempool_size.set(self.size())
+                    info["priority"] = res.priority
 
     def flush(self) -> None:
         with self._lock:
+            for info in self._txs.values():
+                self._list.remove(info["_el"])
             self._txs.clear()
             self._txs_bytes = 0
         from tmtpu.libs import metrics as _m
@@ -188,6 +203,14 @@ class PriorityMempool:
 
     def txs_available(self, fn: Callable) -> None:
         self._notify.append(fn)
+
+    def front(self) -> Optional[CElement]:
+        """Arrival-order front, for the reactor's gossip cursor (gossip
+        runs in arrival order; priority governs reaping only)."""
+        return self._list.front()
+
+    def wait_front(self, timeout: float | None = None) -> Optional[CElement]:
+        return self._list.wait_chan(timeout)
 
     def mark_sender(self, tx: bytes, sender) -> None:
         with self._lock:

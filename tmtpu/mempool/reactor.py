@@ -4,6 +4,7 @@ CheckTx with the sender recorded so they aren't echoed back."""
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 from typing import Dict
@@ -29,6 +30,18 @@ class MempoolReactor(Reactor):
         self.mempool = mempool
         self.broadcast = broadcast
         self._stopped = threading.Event()
+        # received txs are admitted on a dedicated worker, NOT the p2p recv
+        # thread (the reference uses CheckTxAsync for the same reason): a
+        # CheckTx ABCI round-trip per tx on the recv thread makes every
+        # consensus vote/proposal on that connection queue behind the tx
+        # flood — under load the consensus thread starves and rounds fail
+        self._rx_q: "queue.Queue[tuple]" = queue.Queue(maxsize=10000)
+        self._rx_thread: threading.Thread | None = None
+
+    def on_start(self) -> None:
+        self._rx_thread = threading.Thread(target=self._admit_routine,
+                                           daemon=True, name="mempool-admit")
+        self._rx_thread.start()
 
     def get_channels(self):
         return [ChannelDescriptor(MEMPOOL_CHANNEL, priority=5,
@@ -49,35 +62,54 @@ class MempoolReactor(Reactor):
         m = TxsPB.decode(msg_bytes)
         for tx in m.txs:
             try:
-                self.mempool.check_tx(bytes(tx),
-                                      tx_info={"sender": peer.node_id})
+                self._rx_q.put_nowait((bytes(tx), peer.node_id))
+            except queue.Full:
+                # backpressure: drop — the peer's broadcast routine will
+                # offer it again via another peer or a later batch
+                return
+
+    def _admit_routine(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                tx, sender = self._rx_q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                self.mempool.check_tx(tx, tx_info={"sender": sender})
             except (TxInMempoolError, MempoolFullError):
-                self.mempool.mark_sender(bytes(tx), peer.node_id)
+                self.mempool.mark_sender(tx, sender)
             except Exception:
                 pass
 
     def _broadcast_routine(self, peer: Peer) -> None:
-        """mempool/v0/reactor.go:148 broadcastTxRoutine — iterate the
-        mempool, send txs the peer hasn't seen."""
-        sent: set = set()
+        """mempool/v0/reactor.go:148 broadcastTxRoutine — hold a CElement
+        cursor into the mempool's concurrent list and block on wait-chans.
+        Never rescans: O(1) per new tx regardless of mempool depth (the
+        old full-reap-per-iteration loop went quadratic under load and
+        starved CheckTx/reap of the mempool lock)."""
+        el = None
         while peer.is_running() and not self._stopped.is_set():
-            batch = []
-            for tx in self.mempool.reap_max_txs(-1):
-                key = hash(tx)
-                if key in sent:
+            if el is None:
+                el = self.mempool.wait_front(timeout=0.2)
+                if el is None:
                     continue
-                if peer.node_id in self.mempool.senders(tx):
-                    sent.add(key)
-                    continue
-                batch.append(tx)
-                sent.add(key)
-                if len(batch) >= 100:
-                    break
-            if batch:
-                if not peer.send(MEMPOOL_CHANNEL, TxsPB(txs=batch).encode()):
-                    for tx in batch:
-                        sent.discard(hash(tx))
+            # collect a batch from the cursor forward, without waiting
+            batch, cur, last = [], el, el
+            while cur is not None and len(batch) < 100:
+                v = cur.value
+                if not cur.removed and peer.node_id not in v["senders"]:
+                    batch.append(v["tx"])
+                last = cur
+                cur = cur.next
+            if batch and not peer.send(MEMPOOL_CHANNEL,
+                                       TxsPB(txs=batch).encode()):
+                time.sleep(0.05)  # send queue full: retry same position
+                continue
+            # advance: block until `last` gains a successor or is removed
+            nxt = last.next_wait(timeout=0.2)
+            if nxt is not None:
+                el = nxt
+            elif last.removed:
+                el = None  # tail removed: restart from the current front
             else:
-                time.sleep(0.02)
-            if len(sent) > 100_000:
-                sent.clear()
+                el = last  # timeout: re-wait (also re-checks peer liveness)

@@ -25,22 +25,73 @@ class RPCClientError(Exception):
 
 
 class HTTPClient:
-    """rpc/client/http — one method per core route."""
+    """rpc/client/http — one method per core route.
+
+    Uses ONE persistent keep-alive connection per client (guarded by a
+    lock for thread safety): a fresh TCP connect + server thread per call
+    caps throughput and churns the node under load."""
 
     def __init__(self, base_url: str, timeout: float = 30.0):
+        import http.client
+        import threading
+        import urllib.parse
+
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self._id = 0
+        u = urllib.parse.urlsplit(self.base_url)
+        self._https = u.scheme == "https"
+        self._host = u.hostname or "127.0.0.1"
+        self._port = u.port or (443 if self._https else 80)
+        self._path = (u.path or "") + "/"
+        self._http = http.client
+        self._conn = None
+        self._lock = threading.Lock()
+
+    def _request(self, payload: bytes) -> bytes:
+        with self._lock:
+            for attempt in (0, 1):
+                if self._conn is None:
+                    cls = self._http.HTTPSConnection if self._https \
+                        else self._http.HTTPConnection
+                    self._conn = cls(self._host, self._port,
+                                     timeout=self.timeout)
+                sent = False
+                try:
+                    self._conn.request(
+                        "POST", self._path, body=payload,
+                        headers={"Content-Type": "application/json"})
+                    sent = True
+                    resp = self._conn.getresponse()
+                    return resp.read()
+                except (OSError, self._http.HTTPException):
+                    try:
+                        self._conn.close()
+                    except OSError:
+                        pass
+                    self._conn = None
+                    # retry ONLY when the request never went out (stale
+                    # keep-alive rejected at send) — once sent, the server
+                    # may have executed it and a resend would duplicate a
+                    # non-idempotent call (e.g. broadcast_tx)
+                    if sent or attempt:
+                        raise
+        raise ConnectionError("unreachable")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._conn is not None:
+                try:
+                    self._conn.close()
+                except OSError:
+                    pass
+                self._conn = None
 
     def call(self, method: str, **params):
         self._id += 1
-        req = urllib.request.Request(
-            self.base_url + "/",
-            data=json.dumps({"jsonrpc": "2.0", "id": self._id,
-                             "method": method, "params": params}).encode(),
-            headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(req, timeout=self.timeout) as r:
-            body = json.loads(r.read())
+        body = json.loads(self._request(json.dumps(
+            {"jsonrpc": "2.0", "id": self._id,
+             "method": method, "params": params}).encode()))
         if body.get("error"):
             e = body["error"]
             raise RPCClientError(e.get("code", -1), e.get("message", ""),
