@@ -75,3 +75,42 @@ at_height = 5
     assert not m.nodes[1].validator and m.nodes[1].start_at == 3
     assert m.perturbations[0].op == "restart"
     assert m.load.rate == 10.0
+
+
+def test_e2e_sustained_load_commits():
+    """Regression for the tx-load livelock (PERF.md): under steady load a
+    4-node subprocess testnet must keep committing blocks and drain the
+    offered txs, not cycle failed rounds at one height."""
+    import time
+
+    m = Manifest(
+        chain_id="e2e-load",
+        target_height=5,
+        timeout_s=60.0,
+        nodes=[NodeSpec(name=f"v{i}") for i in range(4)],
+    )
+    m.load.rate = 130.0
+    m.load.size = 160
+    out = tempfile.mkdtemp(prefix="tmtpu-e2e-load-")
+    r = Runner(m, out)
+    try:
+        r.setup()
+        r.start()
+        r.wait_for(3)
+        h0 = r.nodes[0].height()
+        r.start_load()
+        time.sleep(15)
+        r.stop_load()
+        time.sleep(2)
+        h1 = r.nodes[0].height()
+        cli = r.nodes[0].client
+        n_txs = sum(len(cli.block(h)["block"]["data"].get("txs") or [])
+                    for h in range(h0 + 1, h1 + 1))
+        offered = len(r.txs_sent)
+        blocks = h1 - h0
+        assert blocks >= 10, f"only {blocks} blocks in 15s under load"
+        assert offered > 250, f"load generator managed only {offered}"
+        assert n_txs >= offered * 0.8, (
+            f"committed {n_txs}/{offered} offered txs — backlog growing")
+    finally:
+        r.stop()

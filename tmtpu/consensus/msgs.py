@@ -22,7 +22,7 @@ class NewValidBlockPB(ProtoMessage):
         (1, "height", "int64"),
         (2, "round", "int32"),
         (3, "block_part_set_header", ("msg!", pb.PartSetHeader)),
-        (4, "block_parts", "bytes"),  # bitarray json form
+        (4, "block_parts", "bytes"),  # LE u32 bit-count + packed u64 words
         (5, "is_commit", "bool"),
     ]
 

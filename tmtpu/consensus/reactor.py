@@ -127,6 +127,20 @@ class PeerState:
                 table[round] = ba = grown
             ba.set_index(index, True)
 
+    def apply_new_valid_block(self, height: int, round: int, total: int,
+                              bits: BitArray, is_commit: bool) -> None:
+        """reactor.go ApplyNewValidBlockMessage — the peer's OWN statement
+        of which parts it holds; overwrites our optimistic send marks."""
+        with self.lock:
+            if height != self.height:
+                return
+            if round != self.round and not is_commit:
+                return
+            if bits.size() != total:
+                return
+            self.proposal_parts_total = total
+            self.proposal_block_parts = bits
+
     def set_has_part(self, height: int, index: int, total: int) -> None:
         with self.lock:
             if height != self.height:
@@ -164,9 +178,15 @@ class ConsensusReactor(Reactor):
             # broadcastHasVoteMessage on the state's Vote event)
             self._vote_sub = cs.event_bus.subscribe_type(
                 "reactor-hasvote", "Vote")
+            # valid-block / commit-entry announcements carry the parts
+            # header + our ACTUAL parts bitarray, overwriting peers' stale
+            # optimistic marks (reactor.go:364 broadcastNewValidBlock)
+            self._valid_sub = cs.event_bus.subscribe_type(
+                "reactor-validblock", "ValidBlock")
         else:
             self._step_sub = None
             self._vote_sub = None
+            self._valid_sub = None
 
     # -- reactor interface --------------------------------------------------
 
@@ -190,6 +210,10 @@ class ConsensusReactor(Reactor):
         if self._vote_sub is not None:
             t = threading.Thread(target=self._has_vote_broadcast_routine,
                                  daemon=True, name="cs-hasvote-bcast")
+            t.start()
+        if self._valid_sub is not None:
+            t = threading.Thread(target=self._valid_block_broadcast_routine,
+                                 daemon=True, name="cs-validblock-bcast")
             t.start()
 
     def on_stop(self) -> None:
@@ -263,6 +287,13 @@ class ConsensusReactor(Reactor):
         if channel_id == STATE_CHANNEL:
             if kind == "new_round_step":
                 ps.apply_new_round_step(m.new_round_step)
+            elif kind == "new_valid_block":
+                nv = m.new_valid_block
+                bits = _decode_bits(bytes(nv.block_parts))
+                if bits is not None:
+                    ps.apply_new_valid_block(
+                        nv.height, nv.round,
+                        nv.block_part_set_header.total, bits, nv.is_commit)
             elif kind == "has_vote":
                 hv = m.has_vote
                 vals = self.cs.round_state_nolock().validators
@@ -379,6 +410,23 @@ class ConsensusReactor(Reactor):
                 has_vote=cm.HasVotePB(
                     height=vote.height, round=vote.round, type=vote.type,
                     index=vote.validator_index)).encode())
+
+    def _valid_block_broadcast_routine(self) -> None:
+        while not self._stopped.is_set():
+            item = self._valid_sub.next(timeout=0.2)
+            if item is None or self.switch is None:
+                continue
+            rs = self.cs.round_state_nolock()
+            parts = rs.proposal_block_parts
+            if parts is None:
+                continue
+            self.switch.broadcast(STATE_CHANNEL, cm.ConsensusMessagePB(
+                new_valid_block=cm.NewValidBlockPB(
+                    height=rs.height, round=rs.round,
+                    block_part_set_header=pb.PartSetHeader(
+                        total=parts.total, hash=parts.hash),
+                    block_parts=_encode_bits(parts.bit_array()),
+                    is_commit=rs.step >= STEP_COMMIT)).encode())
 
     def _broadcast_own_vote(self, vote: Vote) -> None:
         if self.switch is None:

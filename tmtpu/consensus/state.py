@@ -717,6 +717,11 @@ class ConsensusState(BaseService):
                 rs.proposal_block = None
                 rs.proposal_block_parts = PartSet(block_id.parts_total,
                                                   block_id.parts_hash)
+            # tell peers which parts we actually hold (none, typically) so
+            # their gossip serves us the committed block
+            # (state.go:1521 PublishEventValidBlock -> NewValidBlockMessage)
+            if self.event_bus:
+                self.event_bus.publish_valid_block(rs)
             return  # wait for block parts
         self._try_finalize_commit(height)
 
