@@ -113,6 +113,47 @@ def test_node_with_remote_signer(tmp_path):
         server.stop()
 
 
+def test_remote_signer_connection_break_recovers(tmp_path):
+    """Regression: a dropped signer connection mid-run must not wedge the
+    validator — the signer re-dials, the endpoint re-accepts (surviving
+    failed handshakes), and the missed own-vote is retried
+    (RetrySignMessage) so the chain resumes."""
+    from tmtpu.config.config import Config
+    from tmtpu.node.node import Node
+    from tmtpu.types.genesis import GenesisDoc, GenesisValidator
+
+    home = tmp_path / "node"
+    (home / "config").mkdir(parents=True)
+    (home / "data").mkdir(parents=True)
+    cfg = Config.test_config()
+    cfg.base.home = str(home)
+    cfg.base.crypto_backend = "cpu"
+    cfg.rpc.laddr = ""
+    cfg.p2p.laddr = ""
+    sock = f"unix://{tmp_path}/breaksigner.sock"
+    cfg.base.priv_validator_laddr = sock
+
+    pv = FilePV.generate(str(tmp_path / "sk.json"), str(tmp_path / "ss.json"))
+    gen = GenesisDoc(chain_id="rb-chain", genesis_time=time.time_ns(),
+                     validators=[GenesisValidator(pv.get_pub_key(), 10)])
+    gen.save_as(cfg.genesis_path)
+
+    server = SignerServer(sock, "rb-chain", pv)
+    server.start()
+    node = Node(cfg)
+    try:
+        node.start()
+        assert node.consensus.wait_for_height(3, timeout=60)
+        h1 = node.block_store.height()
+        node.signer_endpoint._conn.close()  # hard break mid-run
+        assert node.consensus.wait_for_height(h1 + 2, timeout=60), (
+            f"wedged after signer connection break at "
+            f"{node.consensus.rs.height_round_step()}")
+    finally:
+        node.stop()
+        server.stop()
+
+
 # --- sr25519 -----------------------------------------------------------------
 
 

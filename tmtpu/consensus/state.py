@@ -73,6 +73,21 @@ class VoteMessage:
         self.vote = vote
 
 
+class RetrySignMessage:
+    """Internal: re-attempt our own vote after a transient signing failure
+    (remote signer reconnecting). Never hits the WAL or the wire."""
+
+    __slots__ = ("height", "round", "vote_type", "block_hash", "parts")
+
+    def __init__(self, height: int, round: int, vote_type: int,
+                 block_hash: bytes, parts):
+        self.height = height
+        self.round = round
+        self.vote_type = vote_type
+        self.block_hash = block_hash
+        self.parts = parts
+
+
 class ConsensusState(BaseService):
     def __init__(self, config: ConsensusConfig, state, block_exec,
                  block_store, mempool=None, evidence_pool=None,
@@ -370,6 +385,15 @@ class ConsensusState(BaseService):
             except queue.Empty:
                 pass
             try:
+                mi = self.internal_msg_queue.get_nowait()
+                if mi is None:
+                    return None
+                msgs.append(mi)
+                got = True
+                break
+            except queue.Empty:
+                pass
+            try:
                 mi = self.peer_msg_queue.get(timeout=0.02)
                 if mi is None:
                     return None
@@ -431,6 +455,13 @@ class ConsensusState(BaseService):
                     self._set_proposal_safe(mi.msg.proposal)
                 elif isinstance(mi.msg, BlockPartMessage):
                     self._add_proposal_block_part(mi.msg, mi.peer_id)
+                elif isinstance(mi.msg, RetrySignMessage):
+                    m = mi.msg
+                    # only while the round that wanted the vote is current
+                    if self.rs.height == m.height and \
+                            self.rs.round == m.round:
+                        self._sign_add_vote(m.vote_type, m.block_hash,
+                                            m.parts)
         if vote_batch:
             self._try_add_votes(vote_batch)
 
@@ -928,6 +959,19 @@ class ConsensusState(BaseService):
         except (RecursionError, MemoryError):
             raise  # never mask interpreter-level failures as "can't sign"
         except Exception:
+            # transient failure (remote signer mid-reconnect): retry while
+            # this round lasts — the reference just logs and loses the
+            # vote, which permanently wedges any net where this validator
+            # is pivotal. Idempotence above + the signer's HRS protection
+            # make re-attempts safe; stale retries are dropped by the
+            # height/round check in _handle_msgs. Capture height/round NOW
+            # (default args): rs mutates in place, and a late-bound read
+            # would stamp the old block onto a new round.
+            threading.Timer(
+                0.5,
+                lambda h=rs.height, r=rs.round: self.internal_msg_queue.put(
+                    MsgInfo(RetrySignMessage(h, r, vote_type, block_hash,
+                                             parts), ""))).start()
             return
         mi = MsgInfo(VoteMessage(vote), "")
         self._wal_write_msg(mi)

@@ -106,6 +106,7 @@ class Node(BaseService):
                     config.base.priv_validator_laddr)
                 self.signer_endpoint.accept(timeout=60.0)
                 self.signer_endpoint.start_accept_loop()
+                self.signer_endpoint.start_ping_loop()
                 priv_validator = SignerClient(self.signer_endpoint,
                                               self.genesis_doc.chain_id)
             else:

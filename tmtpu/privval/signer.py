@@ -158,6 +158,7 @@ class SignerListenerEndpoint:
         self.timeout_read_s = timeout_read_s
         self._conn: Optional[_Conn] = None
         self._lock = threading.Lock()
+        self._req_lock = threading.Lock()  # serializes send+recv exchanges
         kind, target = _parse_addr(addr)
         if kind == "unix":
             if os.path.exists(target):
@@ -201,13 +202,51 @@ class SignerListenerEndpoint:
             while True:
                 try:
                     self.accept(timeout=None)
-                except OSError:
-                    return  # listener closed
+                except Exception:  # noqa: BLE001
+                    # a failed handshake (e.g. the signer gave up mid-way,
+                    # or a stray connection) must NOT kill the accept loop
+                    # — only a closed listener ends it; otherwise the
+                    # signer could never reconnect and the validator would
+                    # stop signing forever
+                    try:
+                        self._listener.fileno()
+                    except OSError:
+                        return  # listener closed
+                    time.sleep(0.1)  # bound a persistently failing accept
+                    continue
 
         threading.Thread(target=loop, daemon=True,
                          name="signer-accept").start()
 
+    def start_ping_loop(self, interval_s: float = 5.0) -> None:
+        """Periodic pings keep an idle signer connection alive
+        (signer_listener_endpoint.go pingLoop) — without them the signer's
+        read timeout tears down perfectly good connections whenever
+        consensus goes quiet."""
+        def loop():
+            while True:
+                time.sleep(interval_s)
+                try:
+                    self._listener.fileno()
+                except OSError:
+                    return  # endpoint closed
+                try:
+                    self.request(SignerMessagePB(
+                        ping_request=PingRequestPB()))
+                except Exception:  # noqa: BLE001
+                    pass  # no conn right now; accept loop will fix it
+
+        threading.Thread(target=loop, daemon=True,
+                         name="signer-ping").start()
+
     def request(self, m: SignerMessagePB) -> SignerMessagePB:
+        # one exchange at a time: a concurrent caller (ping loop vs the
+        # consensus sign path) would otherwise recv the OTHER caller's
+        # response or interleave reads mid-frame
+        with self._req_lock:
+            return self._request_locked(m)
+
+    def _request_locked(self, m: SignerMessagePB) -> SignerMessagePB:
         with self._lock:
             conn = self._conn
         if conn is None:
@@ -341,16 +380,30 @@ class SignerServer:
         raise ConnectionError(f"cannot reach node: {last_err}")
 
     def _serve_loop(self) -> None:
+        from tmtpu.libs.log import default_logger
+
+        log = default_logger().with_fields(module="privval-signer")
         while not self._stopped.is_set():
             try:
                 conn = self._dial()
-            except ConnectionError:
-                return
+            except ConnectionError as e:
+                if self._stopped.is_set():
+                    return
+                # keep dialing until stopped (signer_dialer_endpoint.go's
+                # retry loop) — a node outage must never permanently kill
+                # the signer; _dial's `retries` bounds one burst only
+                log.error("cannot reach node, will keep retrying", err=e)
+                self._stopped.wait(self.retry_wait_s * 2)
+                continue
             try:
                 while not self._stopped.is_set():
                     req = conn.recv_msg()
                     conn.send_msg(self._handle(req))
-            except (ConnectionError, OSError, ValueError):
+            except Exception as e:  # noqa: BLE001
+                # ANY failure (node restarting mid-frame, decode error,
+                # socket teardown) = disconnect: log it, close, re-dial
+                if not self._stopped.is_set():
+                    log.error("serve error, reconnecting", err=repr(e))
                 conn.close()
                 time.sleep(self.retry_wait_s)
 
