@@ -102,15 +102,17 @@ def test_late_node_fast_syncs_and_joins_consensus(tmp_path):
         joiner.start()
 
         # catches up over TCP: batched commit verification per run of blocks
+        # poll the synced counter (not store height: save_block lands a tick
+        # before blocks_synced increments, so polling height races the count)
         deadline = time.monotonic() + 120
         while time.monotonic() < deadline and \
-                joiner.block_store.height() < 20:
+                joiner.blocksync_reactor.blocks_synced < 20:
             time.sleep(0.25)
-        assert joiner.block_store.height() >= 20, (
+        assert joiner.blocksync_reactor.blocks_synced >= 20, (
             f"joiner only reached {joiner.block_store.height()} "
             f"(pool h={joiner.blocksync_reactor.pool.height}, "
             f"maxpeer={joiner.blocksync_reactor.pool.max_peer_height()})")
-        assert joiner.blocksync_reactor.blocks_synced >= 20
+        assert joiner.block_store.height() >= 20
 
         # blocks match the source chain byte-for-byte
         b10 = joiner.block_store.load_block(10)

@@ -88,6 +88,20 @@ def verify_tally_step_kernel(pk_b, r_b, s_b, h_b, power_limbs):
     return mask, power_sums, pack_bitarray(mask)
 
 
+def verify_tally_packed_kernel(packed, power_limbs):
+    """Packed-input twin of verify_tally_step_kernel: ONE [128, B] uint8
+    plane (pk | r | s | h) so the host->device hop is a single transfer —
+    the tunnel link's per-RPC latency dominates bandwidth (see
+    tv.prepare_batch_packed)."""
+    return verify_tally_step_kernel(*tv.split_packed(packed), power_limbs)
+
+
+def verify_tally_packed_compact(packed, power_limbs, table):
+    """Packed-input twin of verify_tally_step_compact (XLA-graph path)."""
+    return verify_tally_step_compact(
+        *tv.split_packed(packed), power_limbs, table)
+
+
 def make_mesh(n_devices: int | None = None) -> Mesh:
     devs = jax.devices()
     if n_devices is not None:
@@ -160,14 +174,14 @@ _fused_kernel_jit = None
 def _fused_step():
     global _fused_jit
     if _fused_jit is None:
-        _fused_jit = jax.jit(verify_tally_step_compact)
+        _fused_jit = jax.jit(verify_tally_packed_compact)
     return _fused_jit
 
 
 def _fused_kernel_step():
     global _fused_kernel_jit
     if _fused_kernel_jit is None:
-        _fused_kernel_jit = jax.jit(verify_tally_step_kernel)
+        _fused_kernel_jit = jax.jit(verify_tally_packed_kernel)
     return _fused_kernel_jit
 
 
@@ -184,7 +198,7 @@ def batch_verify_tally(pks, msgs, sigs, powers):
     B = len(sigs)
     if B == 0:
         return np.zeros(0, dtype=bool), 0
-    args, host_ok = tv.prepare_batch_compact(pks, msgs, sigs)
+    packed, host_ok = tv.prepare_batch_packed(pks, msgs, sigs)
     p = np.asarray(powers, dtype=np.int64).copy()
     assert p.shape == (B,)
     p[~host_ok] = 0
@@ -196,13 +210,13 @@ def batch_verify_tally(pks, msgs, sigs, powers):
         padded = max(tk.DEFAULT_TILE, padded)
     power_limbs = np.zeros((POWER_LIMBS, padded), dtype=np.int32)
     power_limbs[:, :B] = powers_to_limbs(p)
-    args = tv.pad_args_to_bucket(args, B, padded)
+    packed = jnp.asarray(tv.pad_packed(packed, padded))  # ONE transfer
     if use_kernel:
         mask, power_sums, _bits = _fused_kernel_step()(
-            *args, jnp.asarray(power_limbs))
+            packed, jnp.asarray(power_limbs))
     else:
         mask, power_sums, _bits = _fused_step()(
-            *args, jnp.asarray(power_limbs), tv.base_table_f32()
+            packed, jnp.asarray(power_limbs), tv.base_table_f32()
         )
     mask = np.asarray(mask)[:B] & host_ok
     return mask, limb_sums_to_int(power_sums)

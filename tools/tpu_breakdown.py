@@ -1,0 +1,85 @@
+"""Diagnostic: break the 10k-lane verify pipeline into stages and time each
+on the real chip — host prep, H2D, dispatch latency, device compute —
+so tunnel overhead is distinguishable from kernel time."""
+
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from tmtpu.tpu import kernel as tk
+    from tmtpu.tpu import sharding as sh
+    from tmtpu.tpu import verify as tv
+
+    print("devices:", jax.devices(), file=sys.stderr)
+
+    sys.path.insert(0, ".")
+    from bench import _make_votes
+
+    lanes = 10_000
+    t0 = time.perf_counter()
+    pks, msgs, sigs = _make_votes(lanes)
+    print(f"gen: {time.perf_counter()-t0:.1f}s")
+
+    tile = tk.DEFAULT_TILE
+    pad = ((lanes + tile - 1) // tile) * tile
+    powers = jnp.asarray(sh.powers_to_limbs([1000] * lanes + [0] * (pad - lanes)))
+
+    # 1. host prep alone (numpy outputs, no device involvement)
+    import tmtpu.tpu.verify as tvmod
+    for it in range(3):
+        t0 = time.perf_counter()
+        args, host_ok = tv.prepare_batch_compact(pks, msgs, sigs)
+        for a in args:
+            np.asarray(a)  # ensure materialized
+        print(f"prep[{it}]: {(time.perf_counter()-t0)*1e3:.1f}ms")
+
+    # prep produces jnp arrays; grab numpy copies for the H2D test
+    np_args = [np.asarray(a) for a in args]
+
+    # 2. H2D: device_put of the four [32, pad] uint8 arrays
+    padded = tv.pad_args_to_bucket(tuple(jnp.asarray(a) for a in np_args), lanes, pad)
+    np_padded = [np.asarray(a) for a in padded]
+    for it in range(3):
+        t0 = time.perf_counter()
+        staged = [jax.block_until_ready(jax.device_put(a)) for a in np_padded]
+        print(f"h2d[{it}]: {(time.perf_counter()-t0)*1e3:.1f}ms "
+              f"({sum(a.nbytes for a in np_padded)/1e6:.2f} MB)")
+
+    # 3. dispatch latency: trivial jitted fn round trip
+    f = jax.jit(lambda x: x + 1)
+    x = jax.device_put(np.zeros(8, np.int32))
+    jax.block_until_ready(f(x))
+    for it in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(x))
+        print(f"dispatch[{it}]: {(time.perf_counter()-t0)*1e3:.1f}ms")
+
+    # 4. device compute: kernel with pre-staged device args
+    step_kernel = jax.jit(sh.verify_tally_step_kernel)
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(step_kernel(*staged, powers))
+    print(f"compile+first: {time.perf_counter()-t0:.1f}s")
+    assert bool(np.asarray(out[0]).all())
+    for it in range(5):
+        t0 = time.perf_counter()
+        jax.block_until_ready(step_kernel(*staged, powers))
+        print(f"device[{it}]: {(time.perf_counter()-t0)*1e3:.1f}ms")
+
+    # 5. kernel only (no tally) for comparison
+    t0 = time.perf_counter()
+    m = jax.block_until_ready(tk.verify_compact_kernel(*staged))
+    print(f"kernel-only compile+first: {time.perf_counter()-t0:.1f}s")
+    for it in range(5):
+        t0 = time.perf_counter()
+        jax.block_until_ready(tk.verify_compact_kernel(*staged))
+        print(f"kernel-only[{it}]: {(time.perf_counter()-t0)*1e3:.1f}ms")
+
+
+if __name__ == "__main__":
+    main()

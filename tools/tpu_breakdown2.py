@@ -1,0 +1,104 @@
+"""Second-pass diagnostics: separate host work from tunnel latency/bandwidth.
+
+- pure host prep (numpy end, no jnp conversion)
+- native hostprep availability
+- H2D: one packed [128, B] array vs four [32, B] arrays, plus a 4x larger
+  one (latency vs bandwidth)
+- deep-pipelined kernel throughput: enqueue K batches, then drain
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from tmtpu import native
+    from tmtpu.tpu import kernel as tk
+    from tmtpu.tpu import sharding as sh
+    from tmtpu.tpu import verify as tv
+
+    print("devices:", jax.devices())
+    print("native hostprep loaded:", native.load() is not None)
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from bench import _make_votes
+
+    lanes = 10_000
+    pks, msgs, sigs = _make_votes(lanes)
+    tile = tk.DEFAULT_TILE
+    pad = ((lanes + tile - 1) // tile) * tile
+    powers = jnp.asarray(sh.powers_to_limbs([1000] * lanes + [0] * (pad - lanes)))
+
+    # pure host prep: monkeypatch jnp.asarray out of the path
+    import tmtpu.tpu.verify as tvmod
+    real_asarray = tvmod.jnp.asarray
+    try:
+        tvmod.jnp.asarray = lambda x: x  # numpy passthrough
+        for it in range(3):
+            t0 = time.perf_counter()
+            args_np, host_ok = tv.prepare_batch_compact(pks, msgs, sigs)
+            dt = (time.perf_counter() - t0) * 1e3
+            print(f"host-prep-only[{it}]: {dt:.1f}ms")
+    finally:
+        tvmod.jnp.asarray = real_asarray
+
+    # pad on host (numpy) and pack four planes into one array
+    def pad_np(a):
+        return np.concatenate([a, np.repeat(a[:, :1], pad - lanes, axis=1)], axis=1)
+
+    planes = [pad_np(a) for a in args_np]
+    packed = np.ascontiguousarray(np.concatenate(planes, axis=0))  # [128, pad]
+    print("packed:", packed.shape, packed.nbytes / 1e6, "MB")
+
+    for it in range(3):
+        t0 = time.perf_counter()
+        d = jax.block_until_ready(jax.device_put(packed))
+        print(f"h2d-packed[{it}]: {(time.perf_counter()-t0)*1e3:.1f}ms")
+
+    big = np.ascontiguousarray(np.tile(packed, (1, 4)))
+    for it in range(2):
+        t0 = time.perf_counter()
+        jax.block_until_ready(jax.device_put(big))
+        print(f"h2d-4x[{it}]: {(time.perf_counter()-t0)*1e3:.1f}ms "
+              f"({big.nbytes/1e6:.1f} MB)")
+
+    # kernel fed from the packed plane (slice inside jit)
+    @jax.jit
+    def step_packed(pkd, pw):
+        pk_b, r_b, s_b, h_b = (pkd[:32], pkd[32:64], pkd[64:96], pkd[96:128])
+        return sh.verify_tally_step_kernel(pk_b, r_b, s_b, h_b, pw)
+
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(step_packed(d, powers))
+    print(f"compile+first: {time.perf_counter()-t0:.1f}s")
+    assert bool(np.asarray(out[0]).all())
+
+    for it in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(step_packed(d, powers))
+        print(f"step-sync[{it}]: {(time.perf_counter()-t0)*1e3:.1f}ms")
+
+    # deep pipeline: enqueue K iterations with fresh H2D each, drain at end
+    for K in (4, 8):
+        t0 = time.perf_counter()
+        outs = []
+        for _ in range(K):
+            dk = jax.device_put(packed)  # async
+            outs.append(step_packed(dk, powers))
+        for o in outs:
+            jax.block_until_ready(o)
+        dt = (time.perf_counter() - t0) / K
+        print(f"pipelined-K{K}: {dt*1e3:.1f}ms/batch "
+              f"-> {lanes/dt:.0f} sig/s")
+
+
+if __name__ == "__main__":
+    main()

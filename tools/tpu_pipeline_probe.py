@@ -1,0 +1,139 @@
+"""Probe pipeline structures against the tunnel's per-RPC latency:
+A) sync loop @10240; B) sync loop @40960; C) 2/3 threads @10240;
+D) 2 threads @40960. Each iteration does FULL prep (fresh numpy) +
+one packed device_put + dispatch + drain, on rotating distinct data."""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from tmtpu.tpu import kernel as tk
+    from tmtpu.tpu import sharding as sh
+    from tmtpu.tpu import verify as tv
+    import tmtpu.tpu.verify as tvmod
+
+    from bench import _make_votes
+
+    lanes = 10_000
+    t0 = time.perf_counter()
+    sets = []
+    base = _make_votes(lanes)
+    sets.append(base)
+    # 3 more distinct sets: permute sigs/msgs cheaply? must stay valid ->
+    # rotate the same votes (content differs per set via slicing offset)
+    for k in range(1, 4):
+        pks, msgs, sigs = base
+        sets.append((pks[k:] + pks[:k], msgs[k:] + msgs[:k],
+                     sigs[k:] + sigs[:k]))
+    print(f"gen: {time.perf_counter()-t0:.1f}s")
+
+    tile = tk.DEFAULT_TILE
+    pad1 = ((lanes + tile - 1) // tile) * tile
+
+    powers1 = jnp.asarray(sh.powers_to_limbs(
+        [1000] * lanes + [0] * (pad1 - lanes)))
+
+    real_asarray = tvmod.jnp.asarray
+
+    def prep_np(s):
+        tvmod.jnp.asarray = lambda x: x
+        try:
+            args, ok = tv.prepare_batch_compact(*s)
+        finally:
+            tvmod.jnp.asarray = real_asarray
+        planes = [
+            np.concatenate(
+                [a, np.repeat(a[:, :1], pad1 - lanes, axis=1)], axis=1)
+            for a in args
+        ]
+        return np.ascontiguousarray(np.concatenate(planes, axis=0))
+
+    @jax.jit
+    def step_packed(pkd, pw):
+        return sh.verify_tally_step_kernel(
+            pkd[:32], pkd[32:64], pkd[64:96], pkd[96:128], pw)
+
+    # warmup/compile
+    d = jax.device_put(prep_np(sets[0]))
+    out = jax.block_until_ready(step_packed(d, powers1))
+    assert bool(np.asarray(out[0]).all())
+    print("compiled")
+
+    def run_sync(n_iters, nset=4):
+        t0 = time.perf_counter()
+        for i in range(n_iters):
+            pkd = jax.device_put(prep_np(sets[i % nset]))
+            jax.block_until_ready(step_packed(pkd, powers1))
+        dt = (time.perf_counter() - t0) / n_iters
+        return dt
+
+    dt = run_sync(6)
+    print(f"A sync@10240: {dt*1e3:.1f}ms/batch -> {lanes/dt:.0f} sig/s")
+
+    # B: 4 VoteSets fused in one 40960-lane dispatch
+    pad4 = 4 * pad1
+    powers4 = jnp.asarray(sh.powers_to_limbs(
+        ([1000] * lanes + [0] * (pad1 - lanes)) * 4))
+
+    @jax.jit
+    def step_packed4(pkd, pw):
+        return sh.verify_tally_step_kernel(
+            pkd[:32], pkd[32:64], pkd[64:96], pkd[96:128], pw)
+
+    def prep4():
+        return np.ascontiguousarray(
+            np.concatenate([prep_np(s) for s in sets], axis=1))
+
+    d4 = jax.device_put(prep4())
+    out = jax.block_until_ready(step_packed4(d4, powers4))
+    assert bool(np.asarray(out[0][:lanes]).all())
+    t0 = time.perf_counter()
+    n4 = 4
+    for i in range(n4):
+        pkd = jax.device_put(prep4())
+        jax.block_until_ready(step_packed4(pkd, powers4))
+    dt = (time.perf_counter() - t0) / n4
+    print(f"B sync@40960: {dt*1e3:.1f}ms/batch -> {4*lanes/dt:.0f} sig/s")
+
+    # C: N threads, each full sync loop @10240
+    def run_threads(nthreads, iters_each, step, prep_fn, pw, lanes_per):
+        done = []
+        t0 = time.perf_counter()
+
+        def work(tid):
+            for i in range(iters_each):
+                pkd = jax.device_put(prep_fn((tid + i) % 4))
+                jax.block_until_ready(step(pkd, pw))
+                done.append(1)
+
+        ts = [threading.Thread(target=work, args=(t,))
+              for t in range(nthreads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        dt = (time.perf_counter() - t0) / len(done)
+        return dt
+
+    for nt in (2, 3):
+        dt = run_threads(nt, 4, step_packed,
+                         lambda i: prep_np(sets[i]), powers1, lanes)
+        print(f"C {nt}threads@10240: {dt*1e3:.1f}ms/batch -> "
+              f"{lanes/dt:.0f} sig/s")
+
+    dt = run_threads(2, 3, step_packed4, lambda i: prep4(), powers4, 4 * lanes)
+    print(f"D 2threads@40960: {dt*1e3:.1f}ms/batch -> {4*lanes/dt:.0f} sig/s")
+
+
+if __name__ == "__main__":
+    main()
