@@ -12,10 +12,13 @@ against (VoteSet, VerifyCommit*, light client, evidence):
 Backends:
 - ``cpu``: serial per-signature verify through the PubKey objects (OpenSSL
   under the hood) — the fallback and the small-batch fast path;
-- ``tpu``: groups ed25519 items into one device batch
-  (tmtpu.tpu.verify.batch_verify) and routes other curves to CPU. Per-lane
-  semantics are identical to serial verification (no probabilistic batch
-  equation), so the returned mask is exact for mixed valid/invalid batches.
+- ``tpu``: groups items per curve into device batches — ed25519
+  (tmtpu.tpu.verify.batch_verify), sr25519
+  (tmtpu.tpu.sr_verify.batch_verify_sr), secp256k1
+  (tmtpu.tpu.k1_verify.batch_verify_k1) — so mixed-curve sets get one
+  device dispatch per curve present. Per-lane semantics are identical to
+  serial verification (no probabilistic batch equation), so the returned
+  mask is exact for mixed valid/invalid batches.
 
 Backend selection: ``set_default_backend`` / config ``crypto.backend``;
 ``auto`` probes for a usable jax device once and caches the answer.
@@ -31,6 +34,8 @@ from tmtpu.crypto import keys
 from tmtpu.crypto.keys import PubKey
 
 ED25519 = "ed25519"
+SR25519 = "sr25519"
+SECP256K1 = "secp256k1"
 
 # below this, device dispatch overhead beats CPU serial (env-overridable so
 # small-validator integration tests can force the device path)
@@ -114,9 +119,10 @@ class CPUBatchVerifier(BatchVerifier):
 
 class TPUBatchVerifier(BatchVerifier):
     def _split(self):
-        """Partition items into device-eligible ed25519 lanes and CPU lanes."""
+        """Partition items into per-curve device-eligible lanes and CPU
+        lanes (mixed-curve valsets dispatch one device batch per curve)."""
         ed_idx, ed_pks, ed_msgs, ed_sigs, ed_powers = [], [], [], [], []
-        cpu_idx = []
+        sr_idx, k1_idx, cpu_idx = [], [], []
         for i, (pk, msg, sig, power) in enumerate(self._items):
             if pk.type_value() == ED25519 and len(sig) == 64:
                 ed_idx.append(i)
@@ -124,9 +130,14 @@ class TPUBatchVerifier(BatchVerifier):
                 ed_msgs.append(msg)
                 ed_sigs.append(sig)
                 ed_powers.append(power)
+            elif pk.type_value() == SR25519 and len(sig) == 64:
+                sr_idx.append(i)
+            elif pk.type_value() == SECP256K1 and len(sig) == 64:
+                k1_idx.append(i)
             else:
                 cpu_idx.append(i)
-        return ed_idx, ed_pks, ed_msgs, ed_sigs, ed_powers, cpu_idx
+        return (ed_idx, ed_pks, ed_msgs, ed_sigs, ed_powers,
+                sr_idx, k1_idx, cpu_idx)
 
     def verify(self) -> Tuple[bool, List[bool]]:
         all_ok, mask, _ = self._run(tally=False)
@@ -135,12 +146,20 @@ class TPUBatchVerifier(BatchVerifier):
     def verify_tally(self) -> Tuple[bool, List[bool], int]:
         """Fused verify + power tally: ed25519 lanes get ONE device dispatch
         that returns both the validity mask and the psum of valid lanes'
-        powers (tmtpu.tpu.sharding.verify_tally_step_compact); other
-        curves fall back to serial verify with host-side summation."""
+        powers (tmtpu.tpu.sharding.verify_tally_step_compact); sr25519 and
+        secp256k1 lanes get their own device dispatches (mask only —
+        powers summed on host); sub-threshold groups verify serially."""
         return self._run(tally=True)
 
     def _run(self, tally: bool) -> Tuple[bool, List[bool], int]:
-        ed_idx, ed_pks, ed_msgs, ed_sigs, ed_powers, cpu_idx = self._split()
+        (ed_idx, ed_pks, ed_msgs, ed_sigs, ed_powers,
+         sr_idx, k1_idx, cpu_idx) = self._split()
+        if sr_idx and len(sr_idx) < _TPU_MIN_BATCH:
+            cpu_idx += sr_idx  # below dispatch threshold: serial path
+            sr_idx = []
+        if k1_idx and len(k1_idx) < _TPU_MIN_BATCH:
+            cpu_idx += k1_idx
+            k1_idx = []
         mask: List[bool] = [False] * len(self._items)
         tallied = 0
         for i in cpu_idx:
@@ -148,6 +167,28 @@ class TPUBatchVerifier(BatchVerifier):
             mask[i] = pk.verify_signature(msg, sig)
             if mask[i]:
                 tallied += power
+        def _sr_fn():
+            from tmtpu.tpu.sr_verify import batch_verify_sr
+
+            return batch_verify_sr
+
+        def _k1_fn():
+            from tmtpu.tpu.k1_verify import batch_verify_k1
+
+            return batch_verify_k1
+
+        for idx, get_fn in ((sr_idx, _sr_fn), (k1_idx, _k1_fn)):
+            if not idx:
+                continue
+            dev_mask = get_fn()(
+                [self._items[i][0].bytes() for i in idx],
+                [self._items[i][1] for i in idx],
+                [self._items[i][2] for i in idx],
+            )
+            for j, i in enumerate(idx):
+                mask[i] = bool(dev_mask[j])
+                if mask[i]:
+                    tallied += self._items[i][3]
         if ed_idx:
             if len(ed_idx) < _TPU_MIN_BATCH:
                 for j, i in enumerate(ed_idx):

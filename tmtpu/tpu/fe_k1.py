@@ -1,0 +1,314 @@
+"""GF(2^256 - 2^32 - 977) field arithmetic for TPU (secp256k1's base field),
+batch-vectorized in JAX — the secp256k1 twin of tmtpu.tpu.fe.
+
+The reference verifies secp256k1 serially on CPU via btcec
+(crypto/secp256k1/secp256k1.go:195-197); BASELINE.md lists secp256k1
+batches among the north-star curves. Layout matches tmtpu.tpu.fe: a field
+element is 20 radix-2^13 int32 limbs, limbs-first ([20, B], batch on the
+TPU vector lanes).
+
+Reduction identities (everything below follows from these):
+
+    2^260 ≡ 2^36 + 15632                      (mod p)   [ = 2^4 (2^32+977) ]
+    2^520 ≡ 256 + 29829*2^13 + 3908*2^39 + 128*2^72     [ = (2^36+15632)^2 ]
+    2^256 ≡ 2^32 + 977                        (mod p)   [ used by freeze ]
+
+Unlike ed25519 (fold constant 608), the 2^260 fold constant 15632 is
+nearly two limbs wide: a carry c out of limb 19 folds back as
+``limb0 += 7440 c; limb1 += c; limb2 += 1024 c`` (15632 = 8192 + 7440,
+2^36 = 2^10 * 2^26). The 7440 multiplier means limb 0's resting bound is
+one fold above the mask, so the "loose" invariant here is NON-UNIFORM:
+
+    limb 0      in [0, 15700]
+    limbs 1..19 in [0, 9300]
+
+Product coefficients then satisfy
+``2*15700*9300 + 18*9300^2 = 1.85e9 < 2^31 - 1`` (pair (0,k) plus at most
+18 inner terms), so schoolbook accumulation stays in int32 — checked
+per-op below, as in tmtpu.tpu.fe these are static bounds, not
+probabilistic ones.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tmtpu.tpu.fe import at_add
+
+RADIX = 13
+NLIMBS = 20
+MASK = (1 << RADIX) - 1
+
+P_INT = 2**256 - 2**32 - 977
+
+# 2^260 mod p decomposition (see module doc)
+F0, F1, F2 = 7440, 1, 1024
+# 2^520 mod p decomposition: positions 0, 1, 3, 5
+G0, G1, G3, G5 = 256, 29829, 3908, 128
+
+LOOSE0 = 15700  # resting bound for limb 0
+LOOSEK = 9300   # resting bound for limbs 1..19
+
+
+def limbs_of_int(v: int) -> np.ndarray:
+    out = np.zeros(NLIMBS, dtype=np.int32)
+    for i in range(NLIMBS):
+        out[i] = v & MASK
+        v >>= RADIX
+    assert v == 0
+    return out
+
+
+def int_of_limbs(a) -> int:
+    a = np.asarray(a)
+    return sum(int(a[i]) << (RADIX * i) for i in range(a.shape[0]))
+
+
+P_LIMBS = limbs_of_int(P_INT)
+
+assert (2**260) % P_INT == F2 * 2**26 + F1 * 2**13 + F0
+assert (2**520) % P_INT == G0 + G1 * 2**13 + G3 * 2**39 + G5 * 2**65
+
+
+def _carry_pass(x, fold: bool):
+    """One vectorized carry pass. ``fold`` wraps the top-limb carry through
+    2^260 ≡ 2^36 + 15632; otherwise the top limb keeps its excess."""
+    c = x >> RADIX
+    x = x - (c << RADIX)
+    x = at_add(x, 1, c[:-1])
+    top = c[-1:]
+    if fold:
+        x = at_add(
+            x, 0, jnp.concatenate([F0 * top, F1 * top, F2 * top], axis=0)
+        )
+    else:
+        x = at_add(x, x.shape[0] - 1, top << RADIX)
+    return x
+
+
+def carry(x, passes: int, fold: bool = True):
+    for _ in range(passes):
+        x = _carry_pass(x, fold)
+    return x
+
+
+def add(a, b):
+    """a + b. Loose inputs -> pre-carry limb0 <= 31400, others <= 18600.
+    Pass 1: carries <= 3, top carry c19 <= 2 -> limb0 <= 8191+14880+3.
+    Pass 2: c19 <= 1 -> limb0 <= 8191+7440 = 15631 <= LOOSE0; limb1 <=
+    8191+3+1; limb2 <= 8191+1+1024 = 9216 <= LOOSEK."""
+    return carry(a + b, 2)
+
+
+def _ksub() -> np.ndarray:
+    """64p as 20 int32 limbs with every limb >= the loose bound of the
+    corresponding position (so limbwise ``ksub - b`` is non-negative for
+    any loose b), built by borrowing 2^13 units downward from the top.
+    Limbs stay <= 41000, so ``a + ksub - b`` coefficients are < 66000 —
+    far inside int32."""
+    m = np.zeros(NLIMBS + 1, dtype=np.int64)
+    v = 64 * P_INT
+    for i in range(NLIMBS + 1):
+        m[i] = v & MASK
+        v >>= RADIX
+    k = m[:NLIMBS].copy()
+    k[NLIMBS - 1] += m[NLIMBS] << RADIX  # fold limb 20 into limb 19
+    need = np.full(NLIMBS, LOOSEK + 100, dtype=np.int64)
+    need[0] = LOOSE0 + 100
+    for i in range(NLIMBS - 2, -1, -1):
+        while k[i] < need[i]:
+            k[i] += 1 << RADIX
+            k[i + 1] -= 1
+    assert (k[:-1] >= need[:-1]).all() and k[NLIMBS - 1] >= need[NLIMBS - 1]
+    assert k.max() < 41000
+    out = k.astype(np.int32)
+    assert int_of_limbs(out) == 64 * P_INT
+    return out
+
+
+KSUB = _ksub()
+
+
+def sub(a, b):
+    """a - b + 64p (limbwise non-negative; see _ksub). Pre-carry limbs
+    <= 15700+41000 = 56700 -> pass 1 carries <= 6, c19 <= 6 -> limb0 <=
+    8191+6+44640; pass 2: c19 <= 1, limb0 <= 8191+7440+6 <= LOOSE0,
+    limb2 <= 8191+1+1024 <= LOOSEK."""
+    return carry(a + jnp.asarray(KSUB)[:, None] - b, 2)
+
+
+def neg(a):
+    return sub(jnp.zeros_like(a), a)
+
+
+def _fold_product(c):
+    """[40, B] schoolbook coefficients (<= 1.95e9) -> [20, B] loose limbs.
+
+    Stage 0: extend to 42 coefficients (two zero tops) and run two no-fold
+    passes: pass 1 carries <= 238k -> limbs <= 8191+238k, c[40] <= 238k,
+    c[41] = 0; pass 2 carries <= 30 -> limbs <= 8221, c[40] <= 238k+30,
+    c[41] <= 29. Then split c[40] = hi*2^13 + lo so every coefficient
+    that the fold multiplies is <= 8221 (lo) or <= 30 (hi, joins c[41]).
+
+    Stage 1 (analytic fold of positions 20..41 into 0..19):
+    - pos 20+j, j=0..17:  ``j += 7440c, j+1 += c, j+2 += 1024c``;
+    - pos 38's j+2-spill lands on pos 20 -> refold analytically:
+      1024*2^260 = 1954*2^13 + 128*2^39  (exact)  -> pos1 += 1954c,
+      pos3 += 128c;
+    - pos 39: 7440c at pos 19; its +c spill at pos 20 -> pos0 += 7440c,
+      pos1 += c, pos2 += 1024c; its 1024c spill at pos 21 ->
+      1024*2^273 = 1954*2^26 + 128*2^52 -> pos2 += 1954c, pos4 += 128c;
+    - pos 40 (= 2^520, c = lo <= 8221): pos0 += 256c, pos1 += 29829c,
+      pos3 += 3908c, pos5 += 128c;
+    - pos 41 (= 2^533): same shifted up one limb, c <= 30.
+    Worst-case accumulated limb (pos1): 8221 + 69.7e6 + 1954*8221 +
+    8221 + 29829*8221 + 256*30 ≈ 0.33e9 < 2^31.
+
+    Stage 2: four folding carry passes: pass 1 carries <= 41k (limb0 <=
+    8191+41k+7440*41k ≈ 0.31e9); pass 2 limb0 <= 53k; pass 3 limbs near
+    rest; pass 4 -> limb0 <= 15631, limb2 <= 9216 (loose)."""
+    B = c.shape[1:]
+    z = jnp.zeros((2,) + B, dtype=jnp.int32)
+    c = jnp.concatenate([c, z], axis=0)  # [42, B]
+    c = carry(c, 2, fold=False)
+    lo40 = c[40:41] & MASK
+    hi40 = c[40:41] >> RADIX
+    low = c[:NLIMBS]
+    h = c[NLIMBS:]  # [22, B]; h[20] = c[40] (replaced by lo40/hi40), h[21]
+
+    def acc(x, pos, v):
+        return at_add(x, pos, v)
+
+    # standard rule for positions 20..37 (j = 0..17)
+    low = acc(low, 0, F0 * h[0:18])
+    low = acc(low, 1, F1 * h[0:18])
+    low = acc(low, 2, F2 * h[0:18])
+    # pos 38: spill at j+2 == 20 refolds to pos1/pos3
+    c38 = h[18:19]
+    low = acc(low, 18, F0 * c38)
+    low = acc(low, 19, F1 * c38)
+    low = acc(low, 1, 1954 * c38)
+    low = acc(low, 3, 128 * c38)
+    # pos 39: 7440 at pos19; +c spill at 20; +1024c spill at 21
+    c39 = h[19:20]
+    low = acc(low, 19, F0 * c39)
+    low = acc(low, 0, F0 * c39)
+    low = acc(low, 1, F1 * c39)
+    low = acc(low, 2, F2 * c39)
+    low = acc(low, 2, 1954 * c39)
+    low = acc(low, 4, 128 * c39)
+    # pos 40 = 2^520 (lo part)
+    low = acc(low, 0, G0 * lo40)
+    low = acc(low, 1, G1 * lo40)
+    low = acc(low, 3, G3 * lo40)
+    low = acc(low, 5, G5 * lo40)
+    # pos 41 = 2^533 (hi part of c[40] plus c[41])
+    c41 = hi40 + h[21:22]
+    low = acc(low, 1, G0 * c41)
+    low = acc(low, 2, G1 * c41)
+    low = acc(low, 4, G3 * c41)
+    low = acc(low, 6, G5 * c41)
+    return carry(low, 4)
+
+
+def mul(a, b):
+    """Schoolbook product + reduction. Loose inputs: coefficient bound
+    2*15700*9300 + 18*9300^2 = 1.85e9 < 2^31. Output loose."""
+    B = jnp.broadcast_shapes(a.shape[1:], b.shape[1:])
+    a = jnp.broadcast_to(a, (NLIMBS,) + B)
+    b = jnp.broadcast_to(b, (NLIMBS,) + B)
+    c = jnp.zeros((2 * NLIMBS,) + B, dtype=jnp.int32)
+    for i in range(NLIMBS):
+        c = at_add(c, i, a[i : i + 1] * b)
+    return _fold_product(c)
+
+
+def sq(a):
+    """Square via symmetry. Doubled-pair terms: pair (0,k) contributes
+    2*15700*9300 = 0.29e9, at most 9 inner pairs 2*9300^2 plus the
+    diagonal 9300^2 -> <= 1.94e9 < 2^31."""
+    B = a.shape[1:]
+    a2 = a + a
+    c = jnp.zeros((2 * NLIMBS,) + B, dtype=jnp.int32)
+    for i in range(NLIMBS):
+        c = at_add(c, 2 * i, a[i : i + 1] * a[i : i + 1])
+        if i + 1 < NLIMBS:
+            c = at_add(c, 2 * i + 1, a2[i : i + 1] * a[i + 1 :])
+    return _fold_product(c)
+
+
+def mul_small(a, k: int):
+    """a * k for a small constant k (k <= 21 here: b3 = 3b = 21 in the
+    complete addition formulas). Coefficients <= 21*15700 = 330k; two
+    folding passes restore loose bounds (pass 1 carries <= 41, limb0 <=
+    8191+41+7440*41 = 0.31e6; pass 2 -> loose)."""
+    assert 0 < k < 64
+    return carry(a * k, 3)
+
+
+def freeze(x):
+    """Canonical form: value in [0, p), limbs in [0, 2^13). Mirrors
+    tmtpu.tpu.fe.freeze: bring the value under 2^256+eps via
+    2^256 ≡ 2^32 + 977 (limb 19 holds bits >= 256 at weight 2^9:
+    q = x19 >> 9; x0 += 977q; x2 += 64q since 2^32 = 64*2^26), then an
+    exact sequential carry and one conditional subtract of p."""
+    x = carry(x, 3)
+    for _ in range(2):
+        q = x[NLIMBS - 1 :] >> (256 - RADIX * (NLIMBS - 1))
+        x = at_add(x, NLIMBS - 1, -(q << (256 - RADIX * (NLIMBS - 1))))
+        x = at_add(x, 0, 977 * q)
+        x = at_add(x, 2, 64 * q)
+        x = carry(x, 2)
+    for i in range(NLIMBS - 1):
+        c = x[i : i + 1] >> RADIX
+        x = at_add(at_add(x, i, -(c << RADIX)), i + 1, c)
+    t = x - jnp.asarray(P_LIMBS)[:, None]
+    for i in range(NLIMBS - 1):
+        c = t[i : i + 1] >> RADIX
+        t = at_add(at_add(t, i, -(c << RADIX)), i + 1, c)
+    return jnp.where(t[NLIMBS - 1 :] < 0, x, t)
+
+
+def sqn(a, n: int):
+    if n <= 4:
+        for _ in range(n):
+            a = sq(a)
+        return a
+    return jax.lax.fori_loop(0, n, lambda _, x: sq(x), a)
+
+
+def sqrt_candidate(a):
+    """a^((p+1)/4) — since p ≡ 3 (mod 4) this is a square root of a
+    whenever one exists (callers must check sq(result) == a). Uses the
+    libsecp256k1 addition chain (253 squarings + 13 multiplies),
+    validated against pow(a, (p+1)//4, p) in tests."""
+    x2 = mul(sqn(a, 1), a)
+    x3 = mul(sqn(x2, 1), a)
+    x6 = mul(sqn(x3, 3), x3)
+    x9 = mul(sqn(x6, 3), x3)
+    x11 = mul(sqn(x9, 2), x2)
+    x22 = mul(sqn(x11, 11), x11)
+    x44 = mul(sqn(x22, 22), x22)
+    x88 = mul(sqn(x44, 44), x44)
+    x176 = mul(sqn(x88, 88), x88)
+    x220 = mul(sqn(x176, 44), x44)
+    x223 = mul(sqn(x220, 3), x3)
+    t1 = mul(sqn(x223, 23), x22)
+    t1 = mul(sqn(t1, 6), x2)
+    return sqn(t1, 2)
+
+
+def pack_bytes_device(b):
+    """DEVICE-side [32, B] big-endian byte strings -> [20, B] int32 limbs.
+    secp256k1 wire encodings are big-endian (SEC1), unlike ed25519 —
+    reverse, then pack LSB-first like tmtpu.tpu.fe.pack_bytes_device."""
+    b = b[::-1].astype(jnp.int32)  # now little-endian [32, B]
+    bits = (b[:, None, :] >> jnp.arange(8, dtype=jnp.int32)[None, :, None]) & 1
+    bits = bits.reshape((256,) + b.shape[1:])
+    pad = jnp.zeros((NLIMBS * RADIX - 256,) + b.shape[1:], dtype=jnp.int32)
+    bits = jnp.concatenate([bits, pad], axis=0)
+    w = (1 << jnp.arange(RADIX, dtype=jnp.int32))
+    limbs = bits.reshape((NLIMBS, RADIX) + b.shape[1:])
+    return (limbs * w[None, :, None]).sum(axis=1, dtype=jnp.int32)
