@@ -167,20 +167,17 @@ class TPUBatchVerifier(BatchVerifier):
             mask[i] = pk.verify_signature(msg, sig)
             if mask[i]:
                 tallied += power
-        def _sr_fn():
+        curve_batches = []
+        if sr_idx:
             from tmtpu.tpu.sr_verify import batch_verify_sr
 
-            return batch_verify_sr
-
-        def _k1_fn():
+            curve_batches.append((sr_idx, batch_verify_sr))
+        if k1_idx:
             from tmtpu.tpu.k1_verify import batch_verify_k1
 
-            return batch_verify_k1
-
-        for idx, get_fn in ((sr_idx, _sr_fn), (k1_idx, _k1_fn)):
-            if not idx:
-                continue
-            dev_mask = get_fn()(
+            curve_batches.append((k1_idx, batch_verify_k1))
+        for idx, fn in curve_batches:
+            dev_mask = fn(
                 [self._items[i][0].bytes() for i in idx],
                 [self._items[i][1] for i in idx],
                 [self._items[i][2] for i in idx],

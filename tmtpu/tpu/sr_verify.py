@@ -41,7 +41,6 @@ from tmtpu.crypto import ristretto
 from tmtpu.crypto.merlin import Transcript
 from tmtpu.tpu import curve, fe
 from tmtpu.tpu.verify import (
-    _pad_to_bucket,
     base_table_f32,
     digits_msb_device,
     lt_le,
@@ -230,7 +229,11 @@ def batch_verify_sr(pks, msgs, sigs) -> np.ndarray:
     B = len(sigs)
     if B == 0:
         return np.zeros(0, dtype=bool)
+    from tmtpu.tpu import verify as tv
+
     args, host_ok = prepare_sr_batch(pks, msgs, sigs)
-    args = pad_args_to_bucket(args, B, _pad_to_bucket(B))
+    # attribute lookup (not an import-time binding) so tests can pin one
+    # bucket via monkeypatch, same as the ed25519/secp256k1 paths
+    args = pad_args_to_bucket(args, B, tv._pad_to_bucket(B))
     mask = np.asarray(_sr_verify_compact_jit(*args, base_table_f32()))[:B]
     return mask & host_ok
