@@ -250,6 +250,7 @@ def main():
         return (lanes * k * n_iters_each * nthreads) / dt
 
     structures = {}
+    failed = []
 
     def measure(name, fn, *a):
         """A structure that dies (flaky tunnel RPC, thread error) must not
@@ -259,6 +260,7 @@ def main():
             print(f"bench: {name}: {structures[name]:,.0f} sig/s",
                   file=sys.stderr)
         except Exception as e:  # noqa: BLE001
+            failed.append(name)
             print(f"bench: {name} FAILED: {e!r}", file=sys.stderr)
 
     if backend == "cpu":
@@ -280,6 +282,7 @@ def main():
             measure("ahead4", run_ahead, 3, 4, step4, powers4)
             measure("threads2_4x", run_threads, 2, 2, 4, step4, powers4)
         except Exception as e:  # noqa: BLE001
+            failed.append("4x-shape")
             print(f"bench: 4x shape FAILED: {e!r}", file=sys.stderr)
         measure("threads3", run_threads, 2, 3, 1, step1, powers1)
     if not structures:
@@ -298,6 +301,10 @@ def main():
         "structures": {k: round(v, 1) for k, v in structures.items()},
         "lanes": lanes,
     }
+    if failed:
+        # machine-readable degradation marker: the headline was picked
+        # from a reduced structure set
+        out["failed"] = failed
     if lanes == LANES and "sync" in structures:
         # per-batch LATENCY of one 10k VoteSet (prep -> put -> step ->
         # drain), from the measured sync structure — deliberately NOT the

@@ -1,0 +1,105 @@
+"""Per-curve device batch-verify throughput on the real chip (the BASELINE
+"Curves" row: ed25519, sr25519, secp256k1 batches). ed25519's headline is
+bench.py; this tool measures the other two curves' device paths end-to-end
+(host prep + H2D + device) and their serial-CPU baselines, printing one
+JSON line per curve.
+
+Usage: python tools/curve_bench.py [--lanes-sr 512] [--lanes-k1 2048]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _measure(name, lanes, gen, batch_fn, serial_fn, iters=3,
+             backend="device"):
+    t0 = time.perf_counter()
+    pks, msgs, sigs = gen(lanes)
+    gen_s = time.perf_counter() - t0
+    print(f"{name}: generated {lanes} sigs in {gen_s:.1f}s", file=sys.stderr)
+
+    # serial CPU baseline over a sample
+    sample = min(lanes, 50)
+    t0 = time.perf_counter()
+    ok = [serial_fn(pks[i], msgs[i], sigs[i]) for i in range(sample)]
+    serial_rate = sample / (time.perf_counter() - t0)
+    assert all(ok)
+
+    # compile + warm
+    t0 = time.perf_counter()
+    mask = batch_fn(pks, msgs, sigs)
+    assert mask.all()
+    print(f"{name}: compile+first {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        mask = batch_fn(pks, msgs, sigs)
+    rate = lanes * iters / (time.perf_counter() - t0)
+    print(json.dumps({
+        "metric": f"{name}_batch_verify_e2e",
+        "value": round(rate, 1), "unit": "sig/s",
+        "lanes": lanes,
+        "serial_cpu_sig_s": round(serial_rate, 1),
+        "speedup_vs_serial": round(rate / serial_rate, 2),
+        "backend": backend,
+    }))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--lanes-sr", type=int, default=512)
+    ap.add_argument("--lanes-k1", type=int, default=2048)
+    ap.add_argument("--backend", default="auto", choices=("auto", "cpu"))
+    args = ap.parse_args()
+
+    # the axon tunnel can wedge backend init indefinitely — reuse
+    # bench.py's hardened init (subprocess probe with hard timeout,
+    # 2-attempt retry for transient tunnel failures, CPU-backend fallback)
+    if args.backend == "cpu":
+        from tmtpu.tpu.compat import force_cpu_backend
+
+        force_cpu_backend(1)
+        device = False
+    else:
+        from bench import _init_backend
+
+        device = _init_backend() == "device"
+    if not device:
+        print("curve_bench: CPU backend — reduced lanes", file=sys.stderr)
+        args.lanes_sr = min(args.lanes_sr, 64)
+        args.lanes_k1 = min(args.lanes_k1, 64)
+
+    from tmtpu.crypto import secp256k1 as k1
+    from tmtpu.crypto import sr25519 as sr
+    from tmtpu.tpu import k1_verify as kv
+    from tmtpu.tpu import sr_verify as srv
+
+    def gen_sr(n):
+        keys = [sr.gen_priv_key_from_secret(b"cb%d" % i) for i in range(n)]
+        msgs = [b"curve-bench-sr-%d" % i for i in range(n)]
+        return ([k.pub_key().bytes() for k in keys], msgs,
+                [k.sign(m) for k, m in zip(keys, msgs)])
+
+    def gen_k1(n):
+        keys = [k1.gen_priv_key() for _ in range(n)]
+        msgs = [b"curve-bench-k1-%d" % i for i in range(n)]
+        return ([k.pub_key().bytes() for k in keys], msgs,
+                [k.sign(m) for k, m in zip(keys, msgs)])
+
+    backend = "device" if device else "cpu"
+    _measure("sr25519", args.lanes_sr, gen_sr, srv.batch_verify_sr,
+             lambda p, m, s: sr.PubKeySr25519(p).verify_signature(m, s),
+             backend=backend)
+    _measure("secp256k1", args.lanes_k1, gen_k1, kv.batch_verify_k1,
+             lambda p, m, s: k1.PubKeySecp256k1(p).verify_signature(m, s),
+             backend=backend)
+
+
+if __name__ == "__main__":
+    main()
