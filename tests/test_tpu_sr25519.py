@@ -118,6 +118,29 @@ def test_mixed_curve_batch_verifier_dispatch(monkeypatch):
     assert tallied == sum(powers)
 
 
+def test_native_merlin_challenges_match_python():
+    """The C STROBE/merlin transcript walk (tmtpu/native/hostprep.c
+    tmtpu_sr_challenges) must agree byte-for-byte with the KAT-verified
+    pure-Python merlin across message lengths spanning keccak block
+    boundaries."""
+    from tmtpu import native
+    from tmtpu.tpu.sr_verify import _challenge_k
+
+    if native.load() is None:
+        pytest.skip("no C toolchain")
+    rng = np.random.default_rng(9)
+    lens = [0, 1, 100, 143, 144, 145, 163, 164, 165, 166, 167, 200, 331,
+            332, 500]
+    B = len(lens)
+    pks = rng.integers(0, 256, (B, 32), dtype=np.uint8)
+    rs = rng.integers(0, 256, (B, 32), dtype=np.uint8)
+    msgs = [rng.integers(0, 256, l, dtype=np.uint8).tobytes() for l in lens]
+    got = native.sr_challenges(pks, rs, msgs)
+    for i in range(B):
+        want = _challenge_k(pks[i].tobytes(), msgs[i], rs[i].tobytes())
+        assert got[i].tobytes() == want, f"msg len {lens[i]}"
+
+
 def test_ristretto_decode_oracle_roundtrip():
     """Device decompression matches the host oracle point-for-point on the
     first 32 small multiples of B (covers torsion-free canonical points)."""

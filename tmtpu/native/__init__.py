@@ -72,8 +72,30 @@ def load():
             ctypes.c_int,     # nthreads
         ]
         lib.tmtpu_prep_ed25519.restype = None
+        lib.tmtpu_sr_challenges.argtypes = [
+            ctypes.c_size_t,
+            ctypes.c_void_p,  # pks  n*32
+            ctypes.c_void_p,  # rs   n*32
+            ctypes.c_void_p,  # msgs concatenated
+            ctypes.c_void_p,  # moff n+1 uint64
+            ctypes.c_void_p,  # k_out n*32
+            ctypes.c_int,     # nthreads
+        ]
+        lib.tmtpu_sr_challenges.restype = None
         _lib = lib
         return _lib
+
+
+def _pack_msgs(msgs, B):
+    """(offsets [B+1] uint64, concatenated uint8 buffer) for a message list
+    — the shared wire layout both batch entry points hand to C."""
+    moff = np.zeros(B + 1, dtype=np.uint64)
+    lens = np.fromiter((len(m) for m in msgs), dtype=np.uint64, count=B)
+    np.cumsum(lens, out=moff[1:])
+    blob = b"".join(bytes(m) for m in msgs)
+    msgs_buf = np.frombuffer(blob, dtype=np.uint8) if blob else \
+        np.zeros(1, dtype=np.uint8)
+    return moff, msgs_buf
 
 
 def prep_ed25519(pk_arr: np.ndarray, r_arr: np.ndarray, s_arr: np.ndarray,
@@ -90,12 +112,7 @@ def prep_ed25519(pk_arr: np.ndarray, r_arr: np.ndarray, s_arr: np.ndarray,
     B = pk_arr.shape[0]
     if nthreads is None:
         nthreads = min(8, os.cpu_count() or 1)
-    moff = np.zeros(B + 1, dtype=np.uint64)
-    lens = np.fromiter((len(m) for m in msgs), dtype=np.uint64, count=B)
-    np.cumsum(lens, out=moff[1:])
-    blob = b"".join(bytes(m) for m in msgs)
-    msgs_buf = np.frombuffer(blob, dtype=np.uint8) if blob else \
-        np.zeros(1, dtype=np.uint8)
+    moff, msgs_buf = _pack_msgs(msgs, B)
     h_out = np.empty((B, 32), dtype=np.uint8)
     s_ok = np.empty(B, dtype=np.uint8)
     lib.tmtpu_prep_ed25519(
@@ -106,3 +123,26 @@ def prep_ed25519(pk_arr: np.ndarray, r_arr: np.ndarray, s_arr: np.ndarray,
         int(nthreads),
     )
     return h_out, s_ok.astype(bool)
+
+
+def sr_challenges(pk_arr: np.ndarray, r_arr: np.ndarray, msgs,
+                  nthreads: int | None = None):
+    """Batched sr25519 verify challenges: the merlin transcript walk of
+    PubKeySr25519.verify_signature producing k = challenge mod L per lane
+    (32 bytes LE). pk_arr/r_arr: [B, 32] uint8 C-contiguous; msgs: list of
+    bytes. Returns k_arr [B, 32] uint8, or None when the native library is
+    unavailable. ~50x the pure-Python merlin (tmtpu/crypto/merlin.py)."""
+    lib = load()
+    if lib is None:
+        return None
+    B = pk_arr.shape[0]
+    if nthreads is None:
+        nthreads = min(8, os.cpu_count() or 1)
+    moff, msgs_buf = _pack_msgs(msgs, B)
+    k_out = np.empty((B, 32), dtype=np.uint8)
+    lib.tmtpu_sr_challenges(
+        B, pk_arr.ctypes.data, r_arr.ctypes.data,
+        msgs_buf.ctypes.data, moff.ctypes.data, k_out.ctypes.data,
+        int(nthreads),
+    )
+    return k_out

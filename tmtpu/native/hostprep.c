@@ -258,6 +258,226 @@ static void *worker(void *arg) {
     return NULL;
 }
 
+/* ------------------------------------------------------------------ */
+/* keccak-f[1600] + STROBE-128 + merlin transcript — the sr25519
+ * challenge-scalar host prep. The Python merlin (tmtpu/crypto/merlin.py,
+ * KAT-verified) costs ~1.3 ms per transcript; at 10k-lane batches that is
+ * ~13 s of host work dwarfing the device step, so the verify transcript
+ * walk (sr25519.PubKeySr25519.verify_signature) runs here instead.
+ * Lane layout assumption: little-endian host (x86-64/aarch64 — the lane
+ * bytes at offset 8*(x+5y) are the uint64 lane value LE, so the state can
+ * be permuted in place as uint64[25]). */
+
+static const uint64_t KRC[24] = {
+    0x0000000000000001ULL, 0x0000000000008082ULL, 0x800000000000808aULL,
+    0x8000000080008000ULL, 0x000000000000808bULL, 0x0000000080000001ULL,
+    0x8000000080008081ULL, 0x8000000000008009ULL, 0x000000000000008aULL,
+    0x0000000000000088ULL, 0x0000000080008009ULL, 0x000000008000000aULL,
+    0x000000008000808bULL, 0x800000000000008bULL, 0x8000000000008089ULL,
+    0x8000000000008003ULL, 0x8000000000008002ULL, 0x8000000000000080ULL,
+    0x000000000000800aULL, 0x800000008000000aULL, 0x8000000080008081ULL,
+    0x8000000000008080ULL, 0x0000000080000001ULL, 0x8000000080008008ULL};
+
+#define ROL64(x, n) (((x) << (n)) | ((x) >> (64 - (n))))
+
+static void keccakf(uint64_t st[25]) {
+    static const int rotc[24] = {1, 3, 6, 10, 15, 21, 28, 36, 45, 55, 2,
+                                 14, 27, 41, 56, 8, 25, 43, 62, 18, 39,
+                                 61, 20, 44};
+    static const int piln[24] = {10, 7, 11, 17, 18, 3, 5, 16, 8, 21, 24,
+                                 4, 15, 23, 19, 13, 12, 2, 20, 14, 22, 9,
+                                 6, 1};
+    uint64_t bc[5], t;
+    for (int round = 0; round < 24; round++) {
+        for (int i = 0; i < 5; i++)
+            bc[i] = st[i] ^ st[i + 5] ^ st[i + 10] ^ st[i + 15] ^ st[i + 20];
+        for (int i = 0; i < 5; i++) {
+            t = bc[(i + 4) % 5] ^ ROL64(bc[(i + 1) % 5], 1);
+            for (int j = 0; j < 25; j += 5) st[j + i] ^= t;
+        }
+        t = st[1];
+        for (int i = 0; i < 24; i++) {
+            int j = piln[i];
+            bc[0] = st[j];
+            st[j] = ROL64(t, rotc[i]);
+            t = bc[0];
+        }
+        for (int j = 0; j < 25; j += 5) {
+            for (int i = 0; i < 5; i++) bc[i] = st[j + i];
+            for (int i = 0; i < 5; i++)
+                st[j + i] = bc[i] ^ ((~bc[(i + 1) % 5]) & bc[(i + 2) % 5]);
+        }
+        st[0] ^= KRC[round];
+    }
+}
+
+#define STROBE_R 166
+#define SFLAG_I 1
+#define SFLAG_A (1 << 1)
+#define SFLAG_C (1 << 2)
+#define SFLAG_K (1 << 5)
+#define SFLAG_M (1 << 4)
+
+typedef struct {
+    union {
+        uint8_t b[200];
+        uint64_t w[25]; /* LE lanes at 8*(x+5y) — alignment via union */
+    } st;
+    uint8_t pos, pos_begin, cur_flags;
+} strobe_t;
+
+static void strobe_run_f(strobe_t *s) {
+    s->st.b[s->pos] ^= s->pos_begin;
+    s->st.b[s->pos + 1] ^= 0x04;
+    s->st.b[STROBE_R + 1] ^= 0x80;
+    keccakf(s->st.w);
+    s->pos = 0;
+    s->pos_begin = 0;
+}
+
+static void strobe_absorb(strobe_t *s, const uint8_t *d, size_t n) {
+    for (size_t i = 0; i < n; i++) {
+        s->st.b[s->pos++] ^= d[i];
+        if (s->pos == STROBE_R) strobe_run_f(s);
+    }
+}
+
+static void strobe_squeeze(strobe_t *s, uint8_t *out, size_t n) {
+    for (size_t i = 0; i < n; i++) {
+        out[i] = s->st.b[s->pos];
+        s->st.b[s->pos] = 0;
+        s->pos++;
+        if (s->pos == STROBE_R) strobe_run_f(s);
+    }
+}
+
+static void strobe_begin_op(strobe_t *s, uint8_t flags) { /* more=false */
+    uint8_t hdr[2];
+    hdr[0] = s->pos_begin;
+    hdr[1] = flags;
+    s->pos_begin = s->pos + 1;
+    s->cur_flags = flags;
+    strobe_absorb(s, hdr, 2);
+    if ((flags & (SFLAG_C | SFLAG_K)) && s->pos != 0) strobe_run_f(s);
+}
+
+static void strobe_meta_ad(strobe_t *s, const uint8_t *d, size_t n) {
+    strobe_begin_op(s, SFLAG_M | SFLAG_A);
+    strobe_absorb(s, d, n);
+}
+
+static void strobe_ad(strobe_t *s, const uint8_t *d, size_t n) {
+    strobe_begin_op(s, SFLAG_A);
+    strobe_absorb(s, d, n);
+}
+
+static void strobe_prf(strobe_t *s, uint8_t *out, size_t n) {
+    strobe_begin_op(s, SFLAG_I | SFLAG_A | SFLAG_C);
+    strobe_squeeze(s, out, n);
+}
+
+static void strobe_init(strobe_t *s, const uint8_t *label, size_t n) {
+    memset(s->st.b, 0, 200);
+    const uint8_t hdr[6] = {1, STROBE_R + 2, 1, 0, 1, 96};
+    memcpy(s->st.b, hdr, 6);
+    memcpy(s->st.b + 6, "STROBEv1.0.2", 12);
+    keccakf(s->st.w);
+    s->pos = 0;
+    s->pos_begin = 0;
+    s->cur_flags = 0;
+    strobe_meta_ad(s, label, n);
+}
+
+/* merlin Transcript.append_message: meta_ad(label || le32(len)); ad(msg) */
+static void tr_append(strobe_t *s, const char *label, const uint8_t *msg,
+                      size_t mlen) {
+    uint8_t meta[64];
+    size_t ll = strlen(label);
+    memcpy(meta, label, ll);
+    meta[ll] = (uint8_t)mlen;
+    meta[ll + 1] = (uint8_t)(mlen >> 8);
+    meta[ll + 2] = (uint8_t)(mlen >> 16);
+    meta[ll + 3] = (uint8_t)(mlen >> 24);
+    strobe_meta_ad(s, meta, ll + 4);
+    strobe_ad(s, msg, mlen);
+}
+
+typedef struct {
+    size_t lo, hi;
+    const strobe_t *base;
+    const uint8_t *pks, *rs, *msgs;
+    const uint64_t *moff;
+    uint8_t *k_out;
+} srjob_t;
+
+static void sr_run_range(srjob_t *j) {
+    for (size_t i = j->lo; i < j->hi; i++) {
+        strobe_t s = *j->base; /* after SigningContext + empty-ctx append */
+        tr_append(&s, "sign-bytes", j->msgs + j->moff[i],
+                  (size_t)(j->moff[i + 1] - j->moff[i]));
+        tr_append(&s, "proto-name", (const uint8_t *)"Schnorr-sig", 11);
+        tr_append(&s, "sign:pk", j->pks + 32 * i, 32);
+        tr_append(&s, "sign:R", j->rs + 32 * i, 32);
+        /* challenge_bytes("sign:c", 64) */
+        uint8_t meta[16] = {'s', 'i', 'g', 'n', ':', 'c', 64, 0, 0, 0};
+        strobe_meta_ad(&s, meta, 10);
+        uint8_t wide[64];
+        strobe_prf(&s, wide, 64);
+        uint64_t limbs[8], red[4];
+        for (int k = 0; k < 8; k++) {
+            uint64_t v = 0;
+            for (int b = 7; b >= 0; b--) v = (v << 8) | wide[8 * k + b];
+            limbs[k] = v;
+        }
+        mod_l(limbs, red);
+        for (int k = 0; k < 4; k++)
+            for (int b = 0; b < 8; b++)
+                j->k_out[32 * i + 8 * k + b] = (uint8_t)(red[k] >> (8 * b));
+    }
+}
+
+static void *sr_worker(void *arg) {
+    sr_run_range((srjob_t *)arg);
+    return NULL;
+}
+
+/* Batched sr25519 (schnorrkel) verify challenges: per lane
+ *   t = merlin("SigningContext"); t.append("", ""); t.append("sign-bytes",
+ *   msg); t.append("proto-name", "Schnorr-sig"); t.append("sign:pk", pk);
+ *   t.append("sign:R", r); k = challenge_bytes("sign:c", 64) mod L.
+ * k_out: n*32 bytes little-endian. */
+void tmtpu_sr_challenges(size_t n, const uint8_t *pks, const uint8_t *rs,
+                         const uint8_t *msgs, const uint64_t *moff,
+                         uint8_t *k_out, int nthreads) {
+    strobe_t base;
+    strobe_init(&base, (const uint8_t *)"Merlin v1.0", 11);
+    tr_append(&base, "dom-sep", (const uint8_t *)"SigningContext", 14);
+    tr_append(&base, "", (const uint8_t *)"", 0);
+    if (nthreads < 1) nthreads = 1;
+    if (nthreads > 16) nthreads = 16;
+    if ((size_t)nthreads > n) nthreads = n ? (int)n : 1;
+    pthread_t tids[16];
+    srjob_t jobs[16];
+    size_t chunk = (n + nthreads - 1) / nthreads;
+    int started = 0;
+    for (int t = 0; t < nthreads; t++) {
+        size_t lo = (size_t)t * chunk;
+        if (lo >= n) break;
+        size_t hi = lo + chunk < n ? lo + chunk : n;
+        jobs[t] = (srjob_t){lo, hi, &base, pks, rs, msgs, moff, k_out};
+        if (t == nthreads - 1 || hi == n) {
+            sr_run_range(&jobs[t]);
+            break;
+        }
+        if (pthread_create(&tids[started], NULL, sr_worker, &jobs[t]) != 0) {
+            sr_run_range(&jobs[t]); /* EAGAIN etc: run the chunk inline */
+            continue;
+        }
+        started++;
+    }
+    for (int t = 0; t < started; t++) pthread_join(tids[t], NULL);
+}
+
 /* Entry point. msgs: concatenated message bytes; moff: n+1 offsets.
  * h_out: n*32 bytes (row-major); s_ok: n bytes. nthreads <= 16. */
 void tmtpu_prep_ed25519(size_t n, const uint8_t *pks, const uint8_t *rs,
@@ -280,7 +500,10 @@ void tmtpu_prep_ed25519(size_t n, const uint8_t *pks, const uint8_t *rs,
             run_range(&jobs[t]); /* run last chunk inline */
             break;
         }
-        pthread_create(&tids[t], NULL, worker, &jobs[t]);
+        if (pthread_create(&tids[started], NULL, worker, &jobs[t]) != 0) {
+            run_range(&jobs[t]); /* EAGAIN etc: run the chunk inline */
+            continue;
+        }
         started++;
     }
     for (int t = 0; t < started; t++) pthread_join(tids[t], NULL);
