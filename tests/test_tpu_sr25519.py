@@ -118,6 +118,26 @@ def test_mixed_curve_batch_verifier_dispatch(monkeypatch):
     assert tallied == sum(powers)
 
 
+@pytest.mark.slow
+def test_sr_pallas_kernel_interpret_matches_graph():
+    """The fused sr25519 Pallas kernel (interpret mode — the same program
+    Mosaic compiles on a real TPU) must agree lane-for-lane with the XLA
+    graph and the serial oracle on valid + adversarial lanes."""
+    from tmtpu.tpu import kernel as tk
+
+    pks, msgs, sigs = _mk(8, seed=b"sr-kern")
+    pks, sigs = list(pks), list(sigs)
+    s2 = bytearray(sigs[2]); s2[7] ^= 0x10; sigs[2] = bytes(s2)  # bad R
+    pks[5] = pks[6]  # wrong key
+    args, host_ok = srv.prepare_sr_batch(pks, msgs, sigs)
+    want = srv.batch_verify_sr(pks, msgs, sigs)
+    got = np.asarray(
+        tk.sr_verify_compact_kernel(*args, tile=8, interpret=True))
+    assert (got & host_ok).tolist() == want.tolist()
+    assert want.tolist() == _serial(pks, msgs, sigs)
+    assert not want[2] and not want[5] and want[0]
+
+
 def test_native_merlin_challenges_match_python():
     """The C STROBE/merlin transcript walk (tmtpu/native/hostprep.c
     tmtpu_sr_challenges) must agree byte-for-byte with the KAT-verified

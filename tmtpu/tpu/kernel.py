@@ -186,40 +186,25 @@ def _verify_kernel(consts_ref, fc_ref, pk_ref, r_ref, s_ref, h_ref, out_ref,
                      ym_ref, yp_ref, z2_ref, t2_ref, sd_ref, hd_ref)
 
 
-def _verify_body(consts, pk_ref, r_ref, s_ref, h_ref, out_ref,
-                 ym_ref, yp_ref, z2_ref, t2_ref, sd_ref, hd_ref):
-    T = pk_ref.shape[1]
-
-    pk_b = pk_ref[:].astype(jnp.int32)
-    r_b = r_ref[:].astype(jnp.int32)
-
-    pk_y = _unpack_limbs_255(pk_b)
-    r_y = _unpack_limbs_255(r_b)
-    pk_sign = pk_b[31:32] >> 7
-    r_sign = r_b[31:32] >> 7
-
-    for w, row in enumerate(_digit_rows_msb(s_ref[:].astype(jnp.int32))):
-        sd_ref[w : w + 1] = row
-    for w, row in enumerate(_digit_rows_msb(h_ref[:].astype(jnp.int32))):
-        hd_ref[w : w + 1] = row
-
-    a_point, a_ok = _decompress(pk_y, pk_sign)
-    neg_a = curve.negate(a_point)
-
-    # Cached window table for -A: entry 0 = identity, entry 1 = -A, then 14
-    # sequential adds. Unrolled: each add is ~8 field muls.
+def _shamir_ladder(consts, neg_a, tab_refs, d1_ref, d2_ref, T):
+    """Shared kernel core: build the per-lane cached window table for -A
+    in scratch (entry 0 = identity, entry 1 = -A, then 14 sequential
+    adds — each ~8 field muls, unrolled), then run the 64-window
+    Straus/Shamir ladder [scalar1]B + [scalar2](-A) with select-chain
+    lookups (fixed-base niels from the constants plane; per-lane cached
+    from scratch). Returns the extended result."""
+    ym_ref, yp_ref, z2_ref, t2_ref = tab_refs
     ident = curve.identity((T,))
     ic = curve.to_cached(ident)
     c1 = curve.to_cached(neg_a)
-    for ref_, val in zip((ym_ref, yp_ref, z2_ref, t2_ref), ic):
+    for ref_, val in zip(tab_refs, ic):
         ref_[0:NLIMBS] = val
-    for ref_, val in zip((ym_ref, yp_ref, z2_ref, t2_ref), c1):
+    for ref_, val in zip(tab_refs, c1):
         ref_[NLIMBS : 2 * NLIMBS] = val
     acc = neg_a
     for d in range(2, NTAB):
         acc = curve.add_cached(acc, c1)
-        for ref_, val in zip((ym_ref, yp_ref, z2_ref, t2_ref),
-                             curve.to_cached(acc)):
+        for ref_, val in zip(tab_refs, curve.to_cached(acc)):
             ref_[d * NLIMBS : (d + 1) * NLIMBS] = val
 
     def lookup_base(dig):
@@ -239,24 +224,46 @@ def _verify_body(consts, pk_ref, r_ref, s_ref, h_ref, out_ref,
     def lookup_a(dig):
         """dig [1, T] -> cached tuple of [20, T] from the scratch table."""
         outs = []
-        for ref_ in (ym_ref, yp_ref, z2_ref, t2_ref):
+        for ref_ in tab_refs:
             acc_c = ref_[0:NLIMBS]
             for d in range(1, NTAB):
-                acc_c = jnp.where(dig == d, ref_[d * NLIMBS : (d + 1) * NLIMBS],
-                                  acc_c)
+                acc_c = jnp.where(dig == d,
+                                  ref_[d * NLIMBS : (d + 1) * NLIMBS], acc_c)
             outs.append(acc_c)
         return tuple(outs)
 
     def body(w, p):
         for _ in range(WINDOW):
             p = curve.double(p)
-        ds = sd_ref[pl.ds(w, 1)]
-        dh = hd_ref[pl.ds(w, 1)]
-        p = curve.add_niels(p, lookup_base(ds))
-        p = curve.add_cached(p, lookup_a(dh))
+        d1 = d1_ref[pl.ds(w, 1)]
+        d2 = d2_ref[pl.ds(w, 1)]
+        p = curve.add_niels(p, lookup_base(d1))
+        p = curve.add_cached(p, lookup_a(d2))
         return p
 
-    rp = jax.lax.fori_loop(0, NDIGITS, body, ident)
+    return jax.lax.fori_loop(0, NDIGITS, body, ident)
+
+
+def _verify_body(consts, pk_ref, r_ref, s_ref, h_ref, out_ref,
+                 ym_ref, yp_ref, z2_ref, t2_ref, sd_ref, hd_ref):
+    T = pk_ref.shape[1]
+
+    pk_b = pk_ref[:].astype(jnp.int32)
+    r_b = r_ref[:].astype(jnp.int32)
+
+    pk_y = _unpack_limbs_255(pk_b)
+    r_y = _unpack_limbs_255(r_b)
+    pk_sign = pk_b[31:32] >> 7
+    r_sign = r_b[31:32] >> 7
+
+    for w, row in enumerate(_digit_rows_msb(s_ref[:].astype(jnp.int32))):
+        sd_ref[w : w + 1] = row
+    for w, row in enumerate(_digit_rows_msb(h_ref[:].astype(jnp.int32))):
+        hd_ref[w : w + 1] = row
+
+    a_point, a_ok = _decompress(pk_y, pk_sign)
+    rp = _shamir_ladder(consts, curve.negate(a_point),
+                        (ym_ref, yp_ref, z2_ref, t2_ref), sd_ref, hd_ref, T)
 
     ok = a_ok & _compress_check(rp, r_y, r_sign)
     out_ref[:] = jnp.broadcast_to(ok.astype(jnp.int32), (8, T))
@@ -297,17 +304,191 @@ def _verify_pallas_jit(pk_b, r_b, s_b, h_b, tile: int, interpret: bool):
     return out[0]
 
 
+def _default_interpret() -> bool:
+    # device platform, not default_backend(): under the axon PJRT plugin
+    # the backend name is "axon" but the devices are real TPUs (same check
+    # as verify.use_pallas_kernel)
+    try:
+        return jax.devices()[0].platform != "tpu"
+    except Exception:
+        return True
+
+
 def verify_compact_kernel(pk_b, r_b, s_b, h_b, *, tile: int = 256,
                           interpret: bool | None = None):
     """Drop-in twin of verify.verify_core_compact running as one fused
     Pallas kernel. pk_b/r_b/s_b/h_b: [32, B] uint8 device arrays (B a
     multiple of ``tile``; verify.batch_verify pads). Returns bool [B]."""
     if interpret is None:
-        # device platform, not default_backend(): under the axon PJRT
-        # plugin the backend name is "axon" but the devices are real TPUs
-        # (same check as verify.use_pallas_kernel)
-        try:
-            interpret = jax.devices()[0].platform != "tpu"
-        except Exception:
-            interpret = True
+        interpret = _default_interpret()
     return _verify_pallas_jit(pk_b, r_b, s_b, h_b, tile, interpret) != 0
+
+
+# ---------------------------------------------------------------------------
+# sr25519 fused kernel. Same skeleton as the ed25519 kernel — unpack,
+# decompress, per-lane window table, the 64-window Straus/Shamir ladder —
+# with ristretto255 decompression (SQRT_RATIO_M1, run for BOTH the pubkey
+# A and the signature's R) and projective coset equality replacing the
+# Edwards decompress/compress-compare. Semantics twin:
+# tmtpu.tpu.sr_verify.sr_verify_core_compact (oracle
+# tmtpu.crypto.sr25519.PubKeySr25519.verify_signature).
+
+# fc plane columns for the sr kernel (full tile width; see _verify_kernel
+# docstring for why narrow constants can't live inside the kernel):
+# K64P, P_LIMBS, D2, D, SQRT_M1, NEG_ONE, NEG_SQRT_M1.
+_SR_FC_N = 7
+
+_SR_FCOLS = None
+
+
+def _sr_fcols() -> np.ndarray:
+    global _SR_FCOLS
+    if _SR_FCOLS is None:
+        P = curve.ref.P
+        plane = _consts_plane()  # columns 0-4 are the five fe constants
+        _SR_FCOLS = np.concatenate(
+            [plane[:, j] for j in range(5)]
+            + [fe.limbs_of_int(P - 1), fe.limbs_of_int(P - curve.ref.SQRT_M1)]
+        )  # [7*20]
+    return _SR_FCOLS
+
+
+def _abs_fe_k(x):
+    """CT_ABS with a [1, T] mask: negate iff the canonical form is odd."""
+    xf = fe.freeze(x)
+    return jnp.where((xf[0:1] & 1) == 1, fe.neg(xf), xf)
+
+
+def _ristretto_decompress_k(s):
+    """Kernel twin of sr_verify.ristretto_decompress: s [20, T] canonical
+    limbs (host-checked < p and even). Returns (extended point, valid
+    [1, T])."""
+    one = _row0_one(s)
+    ss = fe.sq(s)
+    u1 = fe.sub(one, ss)
+    u2 = fe.add(one, ss)
+    u2_sqr = fe.sq(u2)
+    d = fe.const_col("D", fe.limbs_of_int(curve.ref.D))
+    v = fe.sub(fe.neg(fe.mul(d, fe.sq(u1))), u2_sqr)
+    # SQRT_RATIO_M1(1, w) with w = v*u2^2
+    w = fe.mul(v, u2_sqr)
+    w3 = fe.mul(fe.sq(w), w)
+    w7 = fe.mul(fe.sq(w3), w)
+    r = fe.mul(w3, fe.pow_p58(w7))
+    check = fe.freeze(fe.mul(w, fe.sq(r)))
+    correct = _eq_all(check, one)
+    flipped = _eq_all(
+        check, fe.const_col("NEG_ONE", fe.limbs_of_int(curve.ref.P - 1)))
+    flipped_i = _eq_all(
+        check,
+        fe.const_col("NEG_SQRT_M1",
+                     fe.limbs_of_int(curve.ref.P - curve.ref.SQRT_M1)))
+    sqrt_m1 = fe.const_col("SQRT_M1", fe.limbs_of_int(curve.ref.SQRT_M1))
+    r = jnp.where(flipped | flipped_i, fe.mul(r, sqrt_m1), r)
+    ok = correct | flipped
+    invsqrt = _abs_fe_k(r)
+    den_x = fe.mul(invsqrt, u2)
+    den_y = fe.mul(fe.mul(invsqrt, den_x), v)
+    x = _abs_fe_k(fe.mul(fe.add(s, s), den_x))
+    y = fe.mul(u1, den_y)
+    t = fe.mul(x, y)
+    yf = fe.freeze(y)
+    y_zero = jnp.sum(yf, axis=0, keepdims=True) == 0
+    valid = ok & ((fe.freeze(t)[0:1] & 1) == 0) & ~y_zero
+    return (x, y, one, t), valid
+
+
+def _coset_eq_k(p, q):
+    """Kernel twin of sr_verify.ristretto_equal -> bool [1, T] (canonical
+    limbs are non-negative, so sum == 0 means every limb is zero)."""
+    x1, y1 = p[0], p[1]
+    x2, y2 = q[0], q[1]
+    a = fe.freeze(fe.sub(fe.mul(x1, y2), fe.mul(y1, x2)))
+    b = fe.freeze(fe.sub(fe.mul(x1, x2), fe.mul(y1, y2)))
+    za = jnp.sum(a, axis=0, keepdims=True) == 0
+    zb = jnp.sum(b, axis=0, keepdims=True) == 0
+    return za | zb
+
+
+def _sr_verify_kernel(consts_ref, fc_ref, pk_ref, r_ref, s_ref, k_ref,
+                      out_ref, ym_ref, yp_ref, z2_ref, t2_ref, sd_ref,
+                      kd_ref, use_dus: bool = True):
+    consts = consts_ref[:]
+    ctx = {
+        "K64P": fc_ref[0 * NLIMBS : 1 * NLIMBS],
+        "P_LIMBS": fc_ref[1 * NLIMBS : 2 * NLIMBS],
+        "D2": fc_ref[2 * NLIMBS : 3 * NLIMBS],
+        "D": fc_ref[3 * NLIMBS : 4 * NLIMBS],
+        "SQRT_M1": fc_ref[4 * NLIMBS : 5 * NLIMBS],
+        "NEG_ONE": fc_ref[5 * NLIMBS : 6 * NLIMBS],
+        "NEG_SQRT_M1": fc_ref[6 * NLIMBS : 7 * NLIMBS],
+        "_dus": use_dus,
+    }
+    with fe.const_context(ctx):
+        _sr_verify_body(consts, pk_ref, r_ref, s_ref, k_ref, out_ref,
+                        ym_ref, yp_ref, z2_ref, t2_ref, sd_ref, kd_ref)
+
+
+def _sr_verify_body(consts, pk_ref, r_ref, s_ref, k_ref, out_ref,
+                    ym_ref, yp_ref, z2_ref, t2_ref, sd_ref, kd_ref):
+    T = pk_ref.shape[1]
+
+    # canonical ristretto encodings have bit 255 clear (value < p,
+    # host-checked), so the 255-bit unpack captures the full value
+    pk_s = _unpack_limbs_255(pk_ref[:].astype(jnp.int32))
+    r_s = _unpack_limbs_255(r_ref[:].astype(jnp.int32))
+
+    for w, row in enumerate(_digit_rows_msb(s_ref[:].astype(jnp.int32))):
+        sd_ref[w : w + 1] = row
+    for w, row in enumerate(_digit_rows_msb(k_ref[:].astype(jnp.int32))):
+        kd_ref[w : w + 1] = row
+
+    a_point, a_ok = _ristretto_decompress_k(pk_s)
+    r_point, r_ok = _ristretto_decompress_k(r_s)
+    rp = _shamir_ladder(consts, curve.negate(a_point),
+                        (ym_ref, yp_ref, z2_ref, t2_ref), sd_ref, kd_ref, T)
+
+    ok = a_ok & r_ok & _coset_eq_k(rp, r_point)
+    out_ref[:] = jnp.broadcast_to(ok.astype(jnp.int32), (8, T))
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def _sr_verify_pallas_jit(pk_b, r_b, s_b, k_b, tile: int, interpret: bool):
+    B = pk_b.shape[1]
+    grid = (B // tile,)
+    spec_in = pl.BlockSpec((32, tile), lambda i: (0, i),
+                           memory_space=pltpu.VMEM)
+    spec_consts = pl.BlockSpec((NLIMBS, CONST_COLS), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM)
+    fc = jnp.asarray(np.repeat(_sr_fcols()[:, None], tile, axis=1))
+    spec_fc = pl.BlockSpec((_SR_FC_N * NLIMBS, tile), lambda i: (0, 0),
+                           memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        functools.partial(_sr_verify_kernel, use_dus=not interpret),
+        grid=grid,
+        in_specs=[spec_consts, spec_fc] + [spec_in] * 4,
+        out_specs=pl.BlockSpec((8, tile), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((8, B), jnp.int32),
+        scratch_shapes=[
+            pltpu.VMEM((NTAB * NLIMBS, tile), jnp.int32),  # ym
+            pltpu.VMEM((NTAB * NLIMBS, tile), jnp.int32),  # yp
+            pltpu.VMEM((NTAB * NLIMBS, tile), jnp.int32),  # z2
+            pltpu.VMEM((NTAB * NLIMBS, tile), jnp.int32),  # t2d
+            pltpu.VMEM((NDIGITS, tile), jnp.int32),        # s digits
+            pltpu.VMEM((NDIGITS, tile), jnp.int32),        # k digits
+        ],
+        interpret=interpret,
+    )(jnp.asarray(_consts_plane()), fc, pk_b.astype(jnp.int32),
+      r_b.astype(jnp.int32), s_b.astype(jnp.int32), k_b.astype(jnp.int32))
+    return out[0]
+
+
+def sr_verify_compact_kernel(pk_b, r_b, s_b, k_b, *, tile: int = 256,
+                             interpret: bool | None = None):
+    """Fused-kernel twin of sr_verify.sr_verify_core_compact.
+    pk_b/r_b/s_b/k_b: [32, B] uint8 device arrays (B a multiple of
+    ``tile``). Returns bool [B]."""
+    if interpret is None:
+        interpret = _default_interpret()
+    return _sr_verify_pallas_jit(pk_b, r_b, s_b, k_b, tile, interpret) != 0

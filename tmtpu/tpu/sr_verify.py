@@ -244,15 +244,40 @@ def _sr_verify_compact_jit(pk_b, r_b, s_b, k_b, table):
     return sr_verify_core_compact(pk_b, r_b, s_b, k_b, table)
 
 
+# set on the first Pallas failure so later batches go straight to XLA
+_kernel_broken = False
+
+
 def batch_verify_sr(pks, msgs, sigs) -> np.ndarray:
     """sr25519 batch verification: bool [B] per-signature validity, exactly
-    matching serial PubKeySr25519.verify_signature per lane."""
+    matching serial PubKeySr25519.verify_signature per lane. On real TPUs
+    the fused Pallas kernel (tmtpu.tpu.kernel.sr_verify_compact_kernel)
+    runs the whole pipeline in VMEM like the ed25519 path; the plain-XLA
+    graph remains the CPU/virtual-mesh path and the fallback should Mosaic
+    reject the kernel."""
     B = len(sigs)
     if B == 0:
         return np.zeros(0, dtype=bool)
     from tmtpu.tpu import verify as tv
 
     args, host_ok = prepare_sr_batch(pks, msgs, sigs)
+    global _kernel_broken
+    if not _kernel_broken and tv.use_pallas_kernel():
+        from tmtpu.tpu import kernel as tk
+
+        padded = max(tk.DEFAULT_TILE, tv._pad_to_bucket(B))
+        kargs = pad_args_to_bucket(args, B, padded)
+        try:
+            mask = np.asarray(tk.sr_verify_compact_kernel(*kargs))[:B]
+            return mask & host_ok
+        except Exception as e:  # noqa: BLE001 — Mosaic lowering/compile
+            # latch: jit caches nothing on failure, so retrying every call
+            # would pay the full trace+lowering cost per batch
+            _kernel_broken = True
+            import sys
+
+            print(f"sr_verify: Pallas kernel disabled after failure: {e!r}",
+                  file=sys.stderr)
     # attribute lookup (not an import-time binding) so tests can pin one
     # bucket via monkeypatch, same as the ed25519/secp256k1 paths
     args = pad_args_to_bucket(args, B, tv._pad_to_bucket(B))
