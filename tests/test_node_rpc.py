@@ -145,3 +145,32 @@ def test_light_client_over_http_provider(node):
                 HTTPProvider("rpc-chain", base), backend="cpu")
     assert c2.verify_light_block_at_height(h).header.hash() == \
         target.header.hash()
+
+
+def test_genesis_chunked(node):
+    """rpc/core/net.go:104 GenesisChunked — chunked base64 genesis; the
+    single-validator genesis fits in one chunk, and out-of-range chunk ids
+    are errors."""
+    import base64
+
+    res = rpc_get(node, "genesis_chunked", chunk=0)
+    assert res["total"] == 1 and res["chunk"] == 0
+    doc = json.loads(base64.b64decode(res["data"]))
+    assert doc["chain_id"] == "rpc-chain"
+    # matches the unchunked route
+    assert rpc_get(node, "genesis")["genesis"]["chain_id"] == "rpc-chain"
+    # invalid chunk id -> JSON-RPC error
+    url = (f"http://127.0.0.1:{node.rpc_server.port}/genesis_chunked?chunk=9")
+    with urllib.request.urlopen(url, timeout=30) as r:
+        body = json.loads(r.read())
+    assert "error" in body
+
+
+def test_check_tx_route(node):
+    """rpc/core/mempool.go:177 CheckTx — app CheckTx without mempool
+    insertion: the unconfirmed count must not change."""
+    before = int(rpc_get(node, "num_unconfirmed_txs")["n_txs"])
+    res = rpc_get(node, "check_tx", tx='"checkonly=1"')
+    assert res["code"] == 0
+    after = int(rpc_get(node, "num_unconfirmed_txs")["n_txs"])
+    assert after == before

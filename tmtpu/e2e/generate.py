@@ -1,0 +1,115 @@
+"""Random testnet manifest generator (reference:
+test/e2e/generator/generate.go:1 + random.go — uniformChoice /
+probSetChoice over topology, node options, and perturbations).
+
+Differences from the reference, by design:
+
+- Scaled to this box: the reference caps "large" nets for CPU reasons
+  (generate.go:88 FIXME); on a single core we cap harder (<=6 nodes).
+- Curve mix is a first-class axis: each validator's key draws from
+  ed25519/sr25519/secp256k1 (the reference's codec handles only two
+  curves; BASELINE.md "mixed-curve valsets" row).
+- Statesync is not an axis here: bootstrapping trust hashes requires a
+  live net and is covered by tests/test_statesync.py; late-start nodes
+  exercise the blocksync catch-up path instead (generate.go nextStartAt).
+
+Deterministic: same seed -> same manifests, so a failing random net is
+reproducible from the seed recorded in its chain_id.
+"""
+
+from __future__ import annotations
+
+import random
+
+from tmtpu.e2e.manifest import LoadSpec, Manifest, NodeSpec, Perturbation
+
+TOPOLOGIES = ("single", "quad", "large")
+
+# weighted axes (generate.go nodeMempools / nodePerturbations analogues)
+_CURVES = ["ed25519", "ed25519", "sr25519", "secp256k1"]
+_MEMPOOLS = ["v0", "v1"]
+_PERTURBATIONS = {"kill": 0.1, "restart": 0.1, "pause": 0.1}
+
+
+def generate_manifest(rng: random.Random, topology: str | None = None,
+                      seed_tag: str = "") -> Manifest:
+    """One random testnet manifest."""
+    topology = topology or rng.choice(TOPOLOGIES)
+    if topology == "single":
+        n_validators, n_fulls = 1, 0
+    elif topology == "quad":
+        n_validators, n_fulls = 4, 0
+    else:  # large (bounded: 1 CPU core runs every node as a subprocess)
+        n_validators, n_fulls = 4 + rng.randrange(2), rng.randrange(2)
+
+    m = Manifest(chain_id=f"gen-{seed_tag or topology}",
+                 target_height=8 + rng.randrange(4),
+                 timeout_s=240.0)
+
+    # BFT quorum starts at genesis; the rest join late and blocksync in
+    # (generate.go:106-118 nextStartAt). Unlike the reference — which adds
+    # late validators via ValidatorUpdates — late validators here are in
+    # the genesis valset from the start, so genesis-started validators
+    # must hold a POWER supermajority by construction or the net could
+    # never reach the late joiners' start heights: genesis powers are an
+    # order of magnitude above late powers.
+    quorum = n_validators * 2 // 3 + 1
+    next_start = 5
+    for i in range(n_validators):
+        start_at, power = 0, 100 + rng.randrange(71)
+        if i >= quorum:
+            start_at, next_start = next_start, next_start + 2
+            power = 10 + rng.randrange(20)
+        m.nodes.append(NodeSpec(
+            name=f"validator{i:02d}",
+            power=power,
+            start_at=start_at,
+            key_type=rng.choice(_CURVES),
+            config=_node_config(rng),
+        ))
+    for i in range(n_fulls):
+        m.nodes.append(NodeSpec(
+            name=f"full{i:02d}", validator=False,
+            start_at=rng.choice([0, next_start]),
+            config=_node_config(rng),
+        ))
+
+    # perturbation schedule: each started-at-genesis node may draw each op
+    # with probability 0.1 (generate.go nodePerturbations probSetChoice).
+    # Single-node nets skip kill/pause: with no peers to catch up from, a
+    # one-validator net pausing its only proposer just stalls the clock.
+    if n_validators + n_fulls > 1:
+        for node in m.nodes:
+            if node.start_at:
+                continue
+            for op, prob in _PERTURBATIONS.items():
+                if rng.random() < prob:
+                    m.perturbations.append(Perturbation(
+                        node=node.name, op=op,
+                        at_height=2 + rng.randrange(5),
+                        delay_s=0.5 + rng.random()))
+
+    m.load = LoadSpec(rate=float(10 + rng.randrange(30)),
+                      size=rng.choice([32, 128, 256]))
+    return m
+
+
+def _node_config(rng: random.Random) -> dict:
+    """Random per-node config overrides ("section.key" -> value)."""
+    cfg = {"mempool.version": rng.choice(_MEMPOOLS)}
+    if rng.random() < 0.3:
+        cfg["mempool.recheck"] = False
+    return cfg
+
+
+def generate(seed: int, groups: int = 1) -> list[Manifest]:
+    """`groups` manifests per topology, deterministically from `seed`
+    (generator/main.go writes one TOML per manifest; callers here get the
+    objects and feed them straight to tmtpu.e2e.runner.Runner)."""
+    rng = random.Random(seed)
+    out = []
+    for g in range(groups):
+        for topo in TOPOLOGIES:
+            out.append(generate_manifest(
+                rng, topo, seed_tag=f"{topo}-s{seed}g{g}"))
+    return out

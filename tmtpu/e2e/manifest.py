@@ -33,6 +33,7 @@ class NodeSpec:
     validator: bool = True
     power: int = 100
     start_at: int = 0          # join once the net reaches this height
+    key_type: str = "ed25519"  # ed25519 | sr25519 | secp256k1
     # extra "section.key" -> value config overrides for this node
     config: dict = field(default_factory=dict)
     misbehaviors: dict = field(default_factory=dict)  # height -> name

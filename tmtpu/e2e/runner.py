@@ -112,7 +112,8 @@ class Runner:
             cfg = self._node_config(node)
             pv = FilePV.load_or_generate(
                 cfg.rooted(cfg.base.priv_validator_key_file),
-                cfg.rooted(cfg.base.priv_validator_state_file))
+                cfg.rooted(cfg.base.priv_validator_state_file),
+                key_type=spec.key_type)
             if spec.validator:
                 pvs[spec.name] = pv
             node.node_id = NodeKey.load_or_gen(

@@ -31,35 +31,59 @@ _tried = False
 
 
 def _build() -> bool:
+    # Compile to a temp name and rename into place: rewriting _SO in place
+    # keeps its inode, and glibc dlopen caches by dev/ino — a process that
+    # already loaded a stale .so would get the cached stale handle back on
+    # the post-rebuild CDLL instead of the fresh code.
+    tmp = _SO + ".build"
     for cc in ("cc", "gcc", "g++", "clang"):
         try:
             r = subprocess.run(
-                [cc, "-O2", "-shared", "-fPIC", "-pthread", "-o", _SO, _SRC],
+                [cc, "-O2", "-shared", "-fPIC", "-pthread", "-o", tmp, _SRC],
                 capture_output=True, timeout=120,
             )
         except (OSError, subprocess.TimeoutExpired):
             continue
         if r.returncode == 0:
+            os.replace(tmp, _SO)
             return True
+    try:
+        os.unlink(tmp)  # partial output from a failed/timed-out compile
+    except OSError:
+        pass
     return False
 
 
 def load():
-    """ctypes handle to the hostprep library, or None when unavailable."""
+    """ctypes handle to the hostprep library, or None when unavailable.
+
+    A pre-existing .so that fails to load or lacks the expected symbols
+    (stale artifact from an older hostprep.c) triggers ONE rebuild from
+    source before giving up — callers always get either a fully-bound
+    library or None (pure-Python fallback), never a partial binding.
+    """
     global _lib, _tried
     with _lock:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_SO) or (
-            os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+        if os.path.exists(_SO) and (
+            os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
         ):
-            if not _build():
-                return None
-        try:
-            lib = ctypes.CDLL(_SO)
-        except OSError:
+            lib = _load_and_bind()
+            if lib is not None:
+                _lib = lib
+                return _lib
+        if not _build():
             return None
+        _lib = _load_and_bind()
+        return _lib
+
+
+def _load_and_bind():
+    """CDLL + full symbol binding, or None on any load/symbol failure."""
+    try:
+        lib = ctypes.CDLL(_SO)
         lib.tmtpu_prep_ed25519.argtypes = [
             ctypes.c_size_t,
             ctypes.c_void_p,  # pks  n*32
@@ -82,8 +106,20 @@ def load():
             ctypes.c_int,     # nthreads
         ]
         lib.tmtpu_sr_challenges.restype = None
-        _lib = lib
-        return _lib
+        return lib
+    except AttributeError:
+        # stale library missing symbols: dlclose it, else glibc's pathname
+        # cache would hand the same stale handle back after a rebuild
+        try:
+            libc = ctypes.CDLL(None)
+            libc.dlclose.argtypes = [ctypes.c_void_p]
+            libc.dlclose.restype = ctypes.c_int
+            libc.dlclose(ctypes.c_void_p(lib._handle))
+        except (OSError, AttributeError):
+            pass
+        return None
+    except OSError:
+        return None
 
 
 def _pack_msgs(msgs, B):

@@ -393,6 +393,8 @@ static void tr_append(strobe_t *s, const char *label, const uint8_t *msg,
                       size_t mlen) {
     uint8_t meta[64];
     size_t ll = strlen(label);
+    if (ll > sizeof(meta) - 4) /* transcript labels are short constants */
+        ll = sizeof(meta) - 4;
     memcpy(meta, label, ll);
     meta[ll] = (uint8_t)mlen;
     meta[ll + 1] = (uint8_t)(mlen >> 8);

@@ -21,6 +21,21 @@ from tmtpu.libs import protoio
 from tmtpu.types import pb
 from tmtpu.types.priv_validator import PrivValidator
 
+
+def _gen_priv_key(key_type: str):
+    if key_type == "ed25519":
+        return ed25519.gen_priv_key()
+    if key_type == "sr25519":
+        from tmtpu.crypto import sr25519
+
+        return sr25519.gen_priv_key()
+    if key_type == "secp256k1":
+        from tmtpu.crypto import secp256k1
+
+        return secp256k1.gen_priv_key()
+    raise ValueError(f"unknown key type {key_type!r} "
+                     f"(want ed25519|sr25519|secp256k1)")
+
 STEP_PROPOSAL = 1
 STEP_PREVOTE = 2
 STEP_PRECOMMIT = 3
@@ -63,8 +78,12 @@ class FilePV(PrivValidator):
     # -- construction -------------------------------------------------------
 
     @classmethod
-    def generate(cls, key_file: str, state_file: str) -> "FilePV":
-        pv = cls(ed25519.gen_priv_key(), key_file, state_file)
+    def generate(cls, key_file: str, state_file: str,
+                 key_type: str = "ed25519") -> "FilePV":
+        """New validator key on any registered curve (cmd/tendermint init
+        --key analogue; the reference's codec.go:14 handles only
+        ed25519/secp256k1 — sr25519 works here too)."""
+        pv = cls(_gen_priv_key(key_type), key_file, state_file)
         pv.save()
         return pv
 
@@ -96,12 +115,13 @@ class FilePV(PrivValidator):
         return pv
 
     @classmethod
-    def load_or_generate(cls, key_file: str, state_file: str) -> "FilePV":
+    def load_or_generate(cls, key_file: str, state_file: str,
+                         key_type: str = "ed25519") -> "FilePV":
         if os.path.exists(key_file):
             return cls.load(key_file, state_file)
         os.makedirs(os.path.dirname(key_file) or ".", exist_ok=True)
         os.makedirs(os.path.dirname(state_file) or ".", exist_ok=True)
-        return cls.generate(key_file, state_file)
+        return cls.generate(key_file, state_file, key_type)
 
     def save(self) -> None:
         pub = self.priv_key.pub_key()

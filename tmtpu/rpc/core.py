@@ -215,7 +215,35 @@ def build_routes(env: Environment) -> dict:
     def genesis():
         import json as _json
 
+        if len(_gen_chunks()) > 1:
+            raise RPCError(-32603, "genesis response is large, please use "
+                                   "the genesis_chunked API instead")
         return {"genesis": _json.loads(node.genesis_doc.to_json())}
+
+    _gen_chunks_cache: list = []
+
+    def _gen_chunks() -> list:
+        """The genesis doc split into base64 chunks of <=16 MiB, computed
+        once (rpc/core/env.go:142 InitGenesisChunks, chunk size :33)."""
+        if not _gen_chunks_cache:
+            data = node.genesis_doc.to_json().encode()
+            size = 16 * 1024 * 1024
+            # single idempotent publish: concurrent first requests from the
+            # threading HTTP server must not double-extend the cache
+            _gen_chunks_cache[:] = [
+                base64.b64encode(data[i:i + size]).decode()
+                for i in range(0, max(len(data), 1), size)]
+        return _gen_chunks_cache
+
+    def genesis_chunked(chunk="0"):
+        """rpc/core/net.go:104 GenesisChunked — one base64 chunk of the
+        genesis file per call, for genesis docs too large for one frame."""
+        chunks = _gen_chunks()
+        cid = int(chunk)
+        if cid < 0 or cid > len(chunks) - 1:
+            raise RPCError(-32603, f"there are {len(chunks) - 1} chunks, "
+                                   f"{cid} is invalid")
+        return {"total": len(chunks), "chunk": cid, "data": chunks[cid]}
 
     def net_info():
         sw = getattr(node, "switch", None)
@@ -399,6 +427,15 @@ def build_routes(env: Environment) -> dict:
         return {"n_txs": str(env.mempool.size()),
                 "total": str(env.mempool.size()),
                 "total_bytes": str(env.mempool.size_bytes())}
+
+    def check_tx(tx):
+        """rpc/core/mempool.go:177 CheckTx — run a tx through the app's
+        CheckTx on the mempool connection WITHOUT adding it to the mempool
+        or broadcasting it."""
+        raw = _decode_tx(tx)
+        res = node.proxy_app.mempool.check_tx_sync(
+            abci.RequestCheckTx(tx=raw))
+        return _deliver_tx_json(res)
 
     def broadcast_tx_async(tx):
         raw = _decode_tx(tx)
@@ -601,6 +638,7 @@ def build_routes(env: Environment) -> dict:
 
     return {
         "health": health, "status": status, "genesis": genesis,
+        "genesis_chunked": genesis_chunked, "check_tx": check_tx,
         "net_info": net_info, "blockchain": blockchain, "block": block,
         "block_by_hash": block_by_hash, "block_results": block_results,
         "commit": commit, "validators": validators,

@@ -244,8 +244,24 @@ def _sr_verify_compact_jit(pk_b, r_b, s_b, k_b, table):
     return sr_verify_core_compact(pk_b, r_b, s_b, k_b, table)
 
 
-# set on the first Pallas failure so later batches go straight to XLA
+# set on a Pallas compile/lowering failure (or 2 consecutive failures of
+# any kind) so later batches go straight to XLA
 _kernel_broken = False
+_kernel_failures = 0
+
+# substrings that identify a deterministic compile/lowering rejection —
+# retrying those would pay full trace+lowering cost per batch for nothing.
+# Transient runtime faults (device OOM, tunnel RPC hiccup) do NOT match and
+# get one retry before the latch trips.
+_COMPILE_ERR_MARKERS = ("mosaic", "lowering", "unsupported", "unimplemented",
+                        "cannot lower", "pallas")
+
+
+def _is_compile_error(e: Exception) -> bool:
+    if isinstance(e, NotImplementedError):
+        return True
+    s = f"{type(e).__name__}: {e}".lower()
+    return any(m in s for m in _COMPILE_ERR_MARKERS)
 
 
 def batch_verify_sr(pks, msgs, sigs) -> np.ndarray:
@@ -261,7 +277,7 @@ def batch_verify_sr(pks, msgs, sigs) -> np.ndarray:
     from tmtpu.tpu import verify as tv
 
     args, host_ok = prepare_sr_batch(pks, msgs, sigs)
-    global _kernel_broken
+    global _kernel_broken, _kernel_failures
     if not _kernel_broken and tv.use_pallas_kernel():
         from tmtpu.tpu import kernel as tk
 
@@ -269,15 +285,24 @@ def batch_verify_sr(pks, msgs, sigs) -> np.ndarray:
         kargs = pad_args_to_bucket(args, B, padded)
         try:
             mask = np.asarray(tk.sr_verify_compact_kernel(*kargs))[:B]
+            _kernel_failures = 0
             return mask & host_ok
-        except Exception as e:  # noqa: BLE001 — Mosaic lowering/compile
-            # latch: jit caches nothing on failure, so retrying every call
-            # would pay the full trace+lowering cost per batch
-            _kernel_broken = True
+        except Exception as e:  # noqa: BLE001
+            # Latch permanently only on deterministic compile/lowering
+            # rejections; a transient runtime fault (device OOM, RPC
+            # hiccup) gets one retry on the next batch before latching —
+            # ADVICE r2: one hiccup must not silently degrade the process
+            # to the XLA path forever.
+            _kernel_failures += 1
+            if _is_compile_error(e) or _kernel_failures >= 2:
+                _kernel_broken = True
             import sys
 
-            print(f"sr_verify: Pallas kernel disabled after failure: {e!r}",
-                  file=sys.stderr)
+            print(
+                "sr_verify: Pallas kernel "
+                f"{'disabled' if _kernel_broken else 'failed (will retry)'}"
+                f": {e!r}",
+                file=sys.stderr)
     # attribute lookup (not an import-time binding) so tests can pin one
     # bucket via monkeypatch, same as the ed25519/secp256k1 paths
     args = pad_args_to_bucket(args, B, tv._pad_to_bucket(B))
