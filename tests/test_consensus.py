@@ -22,9 +22,10 @@ from tmtpu.types.priv_validator import MockPV
 CHAIN_ID = "cs-test-chain"
 
 
-def make_network(n_vals, wal_dir=None):
-    """N consensus states over one genesis, cross-wired in-proc."""
-    pvs = [MockPV() for _ in range(n_vals)]
+def make_network(n_vals, wal_dir=None, pvs=None):
+    """N consensus states over one genesis, cross-wired in-proc. Pass
+    ``pvs`` to pin validator keys (e.g. a mixed-curve set)."""
+    pvs = pvs if pvs is not None else [MockPV() for _ in range(n_vals)]
     gen = GenesisDoc(
         chain_id=CHAIN_ID,
         genesis_time=time.time_ns(),

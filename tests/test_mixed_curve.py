@@ -1,0 +1,200 @@
+"""Mixed-curve validator sets through the CONSENSUS path (BASELINE.md
+"configs" row: mixed-curve valsets; VERDICT r2 weak #4/#5).
+
+The reference's codec only registers ed25519 + secp256k1
+(crypto/encoding/codec.go:14) and has no batch path at all; here a single
+validator set mixes ed25519, sr25519 and secp256k1 keys and every layer
+above — VoteSet, verify_commit, live consensus, blocksync of a late
+joiner, light-client verification — handles the mix, with the TPU
+BatchVerifier splitting lanes per curve into one device dispatch each
+(tmtpu/crypto/batch.py TPUBatchVerifier._split).
+"""
+
+import hashlib
+import tempfile
+import time
+
+import pytest
+
+from tmtpu.crypto import batch as crypto_batch
+from tmtpu.crypto import secp256k1 as k1
+from tmtpu.crypto import sr25519 as sr
+from tmtpu.types.block import BlockID
+from tmtpu.types.priv_validator import MockPV
+from tmtpu.types.validator import Validator, ValidatorSet
+from tmtpu.types.vote import PRECOMMIT, PREVOTE, Vote
+from tmtpu.types.vote_set import VoteSet
+
+from tests.test_types import CHAIN_ID, mk_vote
+
+pytestmark = pytest.mark.slow
+
+
+def _k1_priv(seed: bytes):
+    v = int.from_bytes(hashlib.sha256(seed).digest(), "big")
+    return k1.PrivKeySecp256k1((v % (k1.N - 1) + 1).to_bytes(32, "big"))
+
+
+def mk_mixed_valset(n_ed, n_sr, n_k1, power=3):
+    """Validator set mixing all three curves; returns (vals, pvs sorted by
+    the set's canonical order)."""
+    pvs = [MockPV() for _ in range(n_ed)]
+    pvs += [MockPV(sr.gen_priv_key_from_secret(b"mix-sr-%d" % i))
+            for i in range(n_sr)]
+    pvs += [MockPV(_k1_priv(b"mix-k1-%d" % i)) for i in range(n_k1)]
+    vals = ValidatorSet([Validator(pv.get_pub_key(), power) for pv in pvs])
+    by_addr = {pv.get_pub_key().address(): pv for pv in pvs}
+    return vals, [by_addr[v.address] for v in vals.validators]
+
+
+def test_commit_verify_10k_mixed_lanes():
+    """10,000-lane VoteSet over a three-curve valset, filled in one
+    add_votes dispatch with corrupted lanes scattered across every curve;
+    the per-curve device batches (ed25519/sr25519/secp256k1) must each
+    reject exactly their corrupt lanes, and the commit built from the set
+    must verify through the batch path."""
+    n_ed, n_sr, n_k1 = 9000, 500, 500
+    n = n_ed + n_sr + n_k1
+    vals, pvs = mk_mixed_valset(n_ed, n_sr, n_k1)
+    curves = {v.address: v.pub_key.type_value() for v in vals.validators}
+    vs = VoteSet(CHAIN_ID, 1, 0, PRECOMMIT, vals, verify_backend="tpu")
+    bid = BlockID(b"\x01" * 32, 1, b"\x02" * 32)
+    votes = [mk_vote(pvs[i], vals, i, block_id=bid) for i in range(n)]
+
+    # corrupt one slice per curve so every device batch sees failures
+    bad = set()
+    seen_curves = set()
+    for i in range(0, n, 701):
+        bad.add(i)
+        seen_curves.add(curves[votes[i].validator_address])
+        sig = bytearray(votes[i].signature)
+        sig[0] ^= 0xFF
+        votes[i].signature = bytes(sig)
+    assert seen_curves == {"ed25519", "sr25519", "secp256k1"}, \
+        "corruption must hit all three curves"
+
+    t0 = time.perf_counter()
+    results = vs.add_votes(votes)
+    dt = time.perf_counter() - t0
+    assert [i for i, ok in enumerate(results) if not ok] == sorted(bad)
+    good = n - len(bad)
+    assert vs.sum_voting_power() == 3 * good
+    assert vs.has_two_thirds_majority()
+    print(f"10k mixed-curve add_votes: {dt:.2f}s")
+
+    commit = vs.make_commit()
+    vals.verify_commit_light(CHAIN_ID, bid, 1, commit, backend="tpu")
+    vals.verify_commit(CHAIN_ID, bid, 1, commit, backend="tpu")
+
+
+def test_4node_net_mixed_curves_commits(monkeypatch):
+    """LIVE in-proc consensus with a validator on each curve (4th ed25519):
+    proposals and votes sign/verify across curves and blocks commit. Every
+    vote burst rides the TPU BatchVerifier so the per-curve split runs
+    inside consensus, not just in unit tests."""
+    from tmtpu.tpu import verify as tv
+
+    from tests.test_consensus import make_network, stop_all
+
+    monkeypatch.setattr(crypto_batch, "_TPU_MIN_BATCH", 1)
+    monkeypatch.setattr(crypto_batch, "_default_backend", "tpu")
+    monkeypatch.setattr(crypto_batch, "_tpu_usable", True)
+    # one jit shape per curve graph: every burst pads to the 8-lane bucket
+    monkeypatch.setattr(tv, "_pad_to_bucket", lambda n: 8)
+
+    pvs = [MockPV(),
+           MockPV(sr.gen_priv_key_from_secret(b"net-sr")),
+           MockPV(_k1_priv(b"net-k1")),
+           MockPV()]
+
+    # pre-warm the three per-curve device graphs at the single bucket so
+    # CPU compiles land before consensus timeouts start ticking
+    for pv in pvs[:3]:
+        vals1 = ValidatorSet([Validator(pv.get_pub_key(), 10)])
+        warm = Vote(type=PREVOTE, height=1, round=0,
+                    block_id=BlockID(b"\x01" * 32, 1, b"\x02" * 32),
+                    timestamp=time.time_ns(),
+                    validator_address=pv.get_pub_key().address(),
+                    validator_index=0)
+        pv.sign_vote(CHAIN_ID, warm)
+        bv = crypto_batch.new_batch_verifier("tpu")
+        for _ in range(2):
+            bv.add(vals1.validators[0].pub_key, warm.sign_bytes(CHAIN_ID),
+                   warm.signature, power=1)
+        all_ok, *_ = bv.verify_tally()
+        assert all_ok
+
+    nodes = make_network(4, pvs=pvs)
+    for cs in nodes:
+        cs.verify_backend = "tpu"
+    try:
+        for cs in nodes:
+            cs.start()
+        for cs in nodes:
+            assert cs.wait_for_height(2, timeout=300), \
+                f"stuck at {cs.rs.height_round_step()}"
+        h1 = [cs.block_store.load_block(1).hash() for cs in nodes]
+        assert len(set(h1)) == 1
+        # all three curves actually signed the height-1 commit
+        commit = nodes[0].block_store.load_seen_commit(1)
+        vals = nodes[0].rs.validators
+        signed_curves = {
+            vals.validators[i].pub_key.type_value()
+            for i, cs_ in enumerate(commit.signatures) if not cs_.is_absent()
+        }
+        assert {"ed25519", "sr25519", "secp256k1"} <= signed_curves
+    finally:
+        stop_all(nodes)
+
+
+def test_e2e_mixed_curve_localnet_blocksync_and_light():
+    """The BASELINE configs row end-to-end: a real-TCP 4-node testnet whose
+    validators sign with ed25519/sr25519/secp256k1, plus a late-joining
+    full node that must BLOCKSYNC the mixed-curve commits; after the run a
+    light client bisection-verifies the chain over public RPC."""
+    from tmtpu.e2e import Manifest, NodeSpec, Runner
+    from tmtpu.light.client import Client, TrustOptions
+    from tmtpu.light.provider import HTTPProvider
+
+    m = Manifest(
+        chain_id="e2e-mixed",
+        target_height=8,
+        timeout_s=150.0,
+        nodes=[
+            NodeSpec(name="v-ed", key_type="ed25519"),
+            NodeSpec(name="v-sr", key_type="sr25519"),
+            NodeSpec(name="v-k1", key_type="secp256k1"),
+            NodeSpec(name="v-ed2", key_type="ed25519"),
+            # joins at height 4: blocksyncs mixed-curve commits
+            NodeSpec(name="late", validator=False, start_at=4),
+        ],
+    )
+    m.load.rate = 10.0
+    out = tempfile.mkdtemp(prefix="tmtpu-e2e-mixed-")
+    r = Runner(m, out)
+    try:
+        r.setup()
+        r.start()
+        r.start_load()
+        r.run_perturbations()  # starts the late joiner
+        r.wait_for()
+        r.stop_load()
+        r.test()
+
+        # light client: trust height 1, bisect to the tip across the
+        # mixed-curve commits
+        url = f"http://127.0.0.1:{r.nodes[0].rpc_port}"
+        week_ns = 7 * 24 * 3600 * 1_000_000_000
+        prov = HTTPProvider(m.chain_id, url)
+        lc = Client(m.chain_id,
+                    TrustOptions(week_ns, 1,
+                                 prov.light_block(1).header.hash()),
+                    prov, backend="cpu")
+        tip = r.nodes[0].height()
+        lb = lc.verify_light_block_at_height(tip, time.time_ns())
+        assert lb.header.height == tip
+        # the late joiner replayed to the tip through blocksync
+        late = next(n for n in r.nodes if n.spec.name == "late")
+        assert late.height() >= m.target_height
+    finally:
+        r.stop()

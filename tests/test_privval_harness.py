@@ -1,0 +1,68 @@
+"""Signer conformance harness (tmtpu/privval/harness.py; reference
+tools/tm-signer-harness): run it against our own SignerServer+FilePV pair
+— which must pass all checks — and against a deliberately unprotected
+signer, which must fail the double-sign check."""
+
+import threading
+
+import pytest
+
+from tmtpu.privval.file_pv import FilePV
+from tmtpu.privval.harness import HarnessFailure, run_harness
+from tmtpu.privval.signer import SignerServer
+from tmtpu.types.priv_validator import MockPV
+
+CHAIN_ID = "harness-chain"
+
+
+def _run(tmp_path, pv, **kw):
+    sock = f"unix://{tmp_path}/harness.sock"
+    server = SignerServer(sock, CHAIN_ID, pv)
+    server.start()  # dial-retry loop tolerates the listener coming up late
+    try:
+        return run_harness(sock, CHAIN_ID, accept_deadline_s=10.0,
+                           log=lambda *a: None, **kw)
+    finally:
+        server.stop()
+
+
+def test_harness_passes_against_file_pv(tmp_path):
+    pv = FilePV.generate(str(tmp_path / "key.json"),
+                         str(tmp_path / "state.json"))
+    assert _run(tmp_path, pv,
+                expect_pubkey=pv.get_pub_key().bytes()) == 0
+
+
+def test_harness_rejects_wrong_pubkey(tmp_path):
+    pv = FilePV.generate(str(tmp_path / "key.json"),
+                         str(tmp_path / "state.json"))
+    with pytest.raises(HarnessFailure) as ei:
+        _run(tmp_path, pv, expect_pubkey=b"\x00" * 32)
+    assert ei.value.check == "pubkey"
+
+
+def test_harness_fails_unprotected_signer(tmp_path):
+    # MockPV signs anything — no last-sign-state: the double-sign-defence
+    # check must be the one that fails
+    with pytest.raises(HarnessFailure) as ei:
+        _run(tmp_path, MockPV())
+    assert ei.value.check == "double-sign-defence"
+
+
+def test_cli_signer_harness(tmp_path):
+    """The operator entry point: `tmtpu signer-harness` against a live
+    external signer process (in-proc thread here; same protocol)."""
+    from tmtpu.cmd.__main__ import main
+
+    pv = FilePV.generate(str(tmp_path / "key.json"),
+                         str(tmp_path / "state.json"))
+    sock = f"unix://{tmp_path}/cli.sock"
+    server = SignerServer(sock, CHAIN_ID, pv)
+    threading.Thread(target=server.start, daemon=True).start()
+    try:
+        rc = main(["signer-harness", CHAIN_ID, "--laddr", sock,
+                   "--accept-deadline", "10",
+                   "--expect-pubkey", pv.get_pub_key().bytes().hex()])
+        assert rc == 0
+    finally:
+        server.stop()

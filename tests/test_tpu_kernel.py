@@ -53,3 +53,50 @@ def test_kernel_matches_oracle_and_xla_graph():
     assert (kernel_mask == oracle).all()
     # sanity: the batch contains both verdicts
     assert kernel_mask.any() and (~kernel_mask).any()
+
+
+def test_k1_kernel_matches_oracle_and_xla_graph():
+    """Fused secp256k1 kernel (tmtpu/tpu/k1_kernel.py) in interpret mode:
+    kernel mask == plain-XLA mask == serial-oracle verdicts over valid and
+    corrupted lanes (reference crypto/secp256k1/secp256k1.go:195)."""
+    from tmtpu.crypto import secp256k1 as k1
+    from tmtpu.tpu import k1_kernel as kk
+    from tmtpu.tpu import k1_verify as kv
+
+    B = 64
+    rng = np.random.default_rng(23)
+    pks, msgs, sigs = [], [], []
+    for i in range(B):
+        import hashlib
+
+        seed = int.from_bytes(
+            hashlib.sha256(b"k1-kernel-%d" % i).digest(), "big")
+        sk = k1.PrivKeySecp256k1((seed % (k1.N - 1) + 1).to_bytes(32, "big"))
+        pk = sk.pub_key().bytes()
+        msg = rng.integers(0, 256, int(rng.integers(40, 150)),
+                           dtype=np.uint8).tobytes()
+        sig = bytearray(sk.sign(msg))
+        k = i % 8
+        if k == 1:
+            sig[0] ^= 1            # corrupt r
+        elif k == 3:
+            sig[35] ^= 1           # corrupt s
+        elif k == 5:
+            msg = msg + b"!"       # corrupt msg
+        elif k == 7:
+            pk = bytes([2]) + bytes(32)  # x = 0: x^3+7 likely non-residue
+        pks.append(bytes(pk))
+        msgs.append(bytes(msg))
+        sigs.append(bytes(sig))
+
+    args, parity, host_ok = kv.prepare_k1_batch(pks, msgs, sigs)
+    kernel_mask = np.asarray(kk.k1_verify_compact_kernel(
+        args[0], parity, *args[1:], tile=B, interpret=True)) & host_ok
+    xla_mask = np.asarray(kv._k1_verify_compact_jit(
+        args[0], parity, *args[1:], kv.base_table_f32())) & host_ok
+    oracle = np.array([
+        k1.PubKeySecp256k1(p).verify_signature(m, s)
+        for p, m, s in zip(pks, msgs, sigs)])
+    assert (kernel_mask == xla_mask).all()
+    assert (kernel_mask == oracle).all()
+    assert kernel_mask.any() and (~kernel_mask).any()

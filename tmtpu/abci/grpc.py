@@ -1,48 +1,306 @@
-"""gRPC ABCI transport (reference analogue: abci/client/grpc_client.go +
-the gRPC server in abci/server).
+"""gRPC ABCI transport (reference: abci/client/grpc_client.go:1 + the
+grpc server in abci/server/grpc_server.go).
 
-The reference offers gRPC as an *alternative* ABCI transport next to the
-default socket protocol; this deployment image has no ``grpcio`` (and no
-way to install it), so the gRPC transport is a guarded optional: when
-``grpcio`` is importable the client/server constructors work against the
-same ``tmtpu.abci.types`` request/response messages (serialized with this
-package's wire-compatible codec); otherwise they raise a clear error
-directing users to the socket transport, which is feature-complete.
+The reference offers gRPC as an alternative ABCI transport next to the
+default socket protocol. This image has no ``grpcio`` (and nothing may be
+installed), so the transport speaks the real gRPC wire protocol — h2c
+HTTP/2 framing, HPACK, length-prefixed messages, ``grpc-status``
+trailers, ``/tendermint.abci.ABCIApplication/<Method>`` paths — through
+the from-scratch stack in tmtpu.libs.h2. The tmtpu client and server
+fully interoperate with each other; the documented protocol limits
+(no Huffman HPACK strings, h2c only) live in tmtpu/libs/h2.py. The
+socket transport remains the production default, as in the reference.
 """
 
 from __future__ import annotations
 
+import queue
+import socket
+import struct
+import threading
+from typing import Dict, Optional
 
-def _require_grpc():
-    try:
-        import grpc  # noqa: F401
+from tmtpu.abci import types as abci
+from tmtpu.abci.client import Client, ClientError, ReqRes
+from tmtpu.libs import h2
+from tmtpu.libs.h2 import (
+    DATA, FLAG_ACK, FLAG_END_STREAM, GOAWAY, H2Conn, H2Error, HEADERS,
+    PING, PREFACE, RST_STREAM, SETTINGS, WINDOW_UPDATE, grpc_frame,
+    grpc_unframe, read_frame,
+)
 
-        return grpc
-    except ImportError as e:
-        raise RuntimeError(
-            "gRPC ABCI transport requires the 'grpcio' package, which is "
-            "not available in this deployment. Use the socket transport "
-            "(abci.client.SocketClient / abci.server.SocketServer) — it is "
-            "the default and feature-complete transport."
-        ) from e
+SERVICE = "tendermint.abci.ABCIApplication"
+
+# oneof field name <-> gRPC method name (types.proto service definition)
+_METHOD_OF = {
+    "echo": "Echo", "flush": "Flush", "info": "Info",
+    "set_option": "SetOption", "init_chain": "InitChain", "query": "Query",
+    "begin_block": "BeginBlock", "check_tx": "CheckTx",
+    "deliver_tx": "DeliverTx", "end_block": "EndBlock", "commit": "Commit",
+    "list_snapshots": "ListSnapshots", "offer_snapshot": "OfferSnapshot",
+    "load_snapshot_chunk": "LoadSnapshotChunk",
+    "apply_snapshot_chunk": "ApplySnapshotChunk",
+}
+_FIELD_OF = {m: f for f, m in _METHOD_OF.items()}
+_REQ_CLS = {name: spec[1] for _, name, spec in abci.Request.FIELDS}
+_RES_CLS = {name: spec[1] for _, name, spec in abci.Response.FIELDS}
 
 
-class GRPCClient:
-    """ABCI client over gRPC. Requires grpcio."""
+def _parse_addr(addr: str):
+    addr = addr.replace("tcp://", "")
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+class GRPCClient(Client):
+    """ABCI client over gRPC (grpc_client.go semantics: unary call per
+    request, one connection, calls serialized — the reference client also
+    forces ordered delivery via grpc.WithBlock + per-call sync). Drop-in
+    for SocketClient."""
 
     def __init__(self, addr: str):
-        self._grpc = _require_grpc()
         self.addr = addr
-        self.channel = self._grpc.insecure_channel(addr)
+        self._sock: Optional[socket.socket] = None
+        self._conn: Optional[H2Conn] = None
+        self._next_stream = 1
+        self._call_lock = threading.Lock()
+        self._async_q: "queue.Queue" = queue.Queue()
+        self._worker: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+        self._global_cb = None
 
-    def close(self):
-        self.channel.close()
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        host, port = _parse_addr(self.addr)
+        self._sock = socket.create_connection((host, port), timeout=30)
+        # blocking reads from here on: a per-recv timeout firing mid-frame
+        # would desynchronize the HTTP/2 byte stream (read_exact's partial
+        # bytes are lost); stop() closing the socket unblocks the reader
+        self._sock.settimeout(None)
+        rfile = self._sock.makefile("rb")
+        wfile = self._sock.makefile("wb")
+        wfile.write(PREFACE)
+        wfile.flush()
+        self._conn = H2Conn(rfile, wfile)
+        self._conn.send_settings_and_window()
+        # absorb the server's handshake (SETTINGS + connection
+        # WINDOW_UPDATE) before the first call: send_data would otherwise
+        # block on the default 64 KiB window with nobody reading the
+        # window grants (frames after this point are read inside _unary)
+        seen_settings = seen_window = False
+        while not (seen_settings and seen_window):
+            ftype, flags, _sid, payload = read_frame(self._conn.rfile)
+            if ftype == SETTINGS and not flags & FLAG_ACK:
+                self._conn.apply_peer_settings(payload)
+                self._conn.send_frame(SETTINGS, FLAG_ACK, 0)
+                seen_settings = True
+            elif ftype == WINDOW_UPDATE:
+                self._conn.grow_send_window(
+                    struct.unpack(">I", payload)[0] & 0x7FFFFFFF)
+                seen_window = True
+        self._worker = threading.Thread(target=self._async_loop,
+                                        daemon=True, name="abci-grpc-async")
+        self._worker.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._async_q.put(None)
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    # -- calls --------------------------------------------------------------
+
+    def _unary(self, method: str, req_bytes: bytes) -> bytes:
+        """One gRPC unary exchange; absorbs connection-level frames."""
+        conn = self._conn
+        with self._call_lock:
+            stream_id = self._next_stream
+            self._next_stream += 2
+            conn.send_headers(stream_id, [
+                (":method", "POST"), (":scheme", "http"),
+                (":path", f"/{SERVICE}/{method}"),
+                (":authority", self.addr),
+                ("content-type", "application/grpc"),
+                ("te", "trailers"),
+            ], end_stream=False)
+            conn.send_data(stream_id, grpc_frame(req_bytes), end_stream=True)
+            body = b""
+            status = None
+            while True:
+                ftype, flags, sid, payload = read_frame(conn.rfile)
+                if ftype == SETTINGS:
+                    if not flags & FLAG_ACK:
+                        conn.apply_peer_settings(payload)
+                        conn.send_frame(SETTINGS, FLAG_ACK, 0)
+                elif ftype == PING:
+                    if not flags & FLAG_ACK:
+                        conn.send_frame(PING, FLAG_ACK, 0, payload)
+                elif ftype == WINDOW_UPDATE:
+                    conn.grow_send_window(
+                        struct.unpack(">I", payload)[0] & 0x7FFFFFFF)
+                elif ftype == GOAWAY:
+                    raise ClientError("server sent GOAWAY")
+                elif ftype == RST_STREAM and sid == stream_id:
+                    raise ClientError("stream reset by server")
+                elif ftype == HEADERS and sid == stream_id:
+                    block = conn.read_headers_payload(flags, payload)
+                    hdrs = dict(conn.decoder.decode(block))
+                    if "grpc-status" in hdrs:
+                        status = hdrs
+                    if flags & FLAG_END_STREAM:
+                        break
+                elif ftype == DATA and sid == stream_id:
+                    body += payload
+                    conn.replenish_recv_window(len(payload))
+                    if flags & FLAG_END_STREAM:
+                        break
+            if status is not None and status.get("grpc-status", "0") != "0":
+                raise ClientError(
+                    f"grpc-status {status.get('grpc-status')}: "
+                    f"{status.get('grpc-message', '')}")
+            return grpc_unframe(body)
+
+    def _call(self, req: abci.Request) -> abci.Response:
+        which = req.which()
+        method = _METHOD_OF[which]
+        inner = getattr(req, which)
+        res_bytes = self._unary(method, inner.encode())
+        inner_res = _RES_CLS[which].decode(res_bytes)
+        return abci.Response(**{which: inner_res})
+
+    def _call_async(self, req: abci.Request) -> ReqRes:
+        rr = ReqRes(req)
+        self._async_q.put(rr)
+        return rr
+
+    def _async_loop(self):
+        while not self._stopped.is_set():
+            rr = self._async_q.get()
+            if rr is None:
+                return
+            try:
+                res = self._call(rr.request)
+            except Exception as e:  # noqa: BLE001 — connection died
+                rr.set_response(abci.Response(
+                    exception=abci.ResponseException(error=str(e))))
+                if self._stopped.is_set():
+                    return
+                continue
+            rr.set_response(res)
+            if self._global_cb is not None and \
+                    res.which() not in ("flush", "exception"):
+                self._global_cb(rr.request, res)
 
 
 class GRPCServer:
-    """ABCI server over gRPC. Requires grpcio."""
+    """ABCI application served over gRPC (grpc_server.go). One thread per
+    connection; requests on a connection dispatch sequentially under the
+    app mutex, matching the socket server's ordering guarantee."""
 
     def __init__(self, addr: str, app):
-        self._grpc = _require_grpc()
         self.addr = addr
         self.app = app
+        self._listener: Optional[socket.socket] = None
+        self._stopped = threading.Event()
+        self._mtx = threading.Lock()
+        self._threads = []
+
+    def start(self) -> None:
+        host, port = _parse_addr(self.addr)
+        self._listener = socket.socket()
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(8)
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="abci-grpc-accept")
+        t.start()
+        self._threads.append(t)
+
+    @property
+    def listen_port(self) -> int:
+        return self._listener.getsockname()[1]
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, sock: socket.socket) -> None:
+        rfile = sock.makefile("rb")
+        wfile = sock.makefile("wb")
+        try:
+            if h2.read_exact(rfile, len(PREFACE)) != PREFACE:
+                return
+            conn = H2Conn(rfile, wfile)
+            conn.send_settings_and_window()
+            streams: Dict[int, dict] = {}
+            while not self._stopped.is_set():
+                ftype, flags, sid, payload = read_frame(rfile)
+                if ftype == SETTINGS:
+                    if not flags & FLAG_ACK:
+                        conn.apply_peer_settings(payload)
+                        conn.send_frame(SETTINGS, FLAG_ACK, 0)
+                elif ftype == PING:
+                    if not flags & FLAG_ACK:
+                        conn.send_frame(PING, FLAG_ACK, 0, payload)
+                elif ftype == WINDOW_UPDATE:
+                    conn.grow_send_window(
+                        struct.unpack(">I", payload)[0] & 0x7FFFFFFF)
+                elif ftype == GOAWAY:
+                    return
+                elif ftype == HEADERS:
+                    block = conn.read_headers_payload(flags, payload)
+                    streams[sid] = {
+                        "headers": dict(conn.decoder.decode(block)),
+                        "data": b"",
+                    }
+                    if flags & FLAG_END_STREAM:
+                        self._respond(conn, sid, streams.pop(sid))
+                elif ftype == DATA and sid in streams:
+                    streams[sid]["data"] += payload
+                    conn.replenish_recv_window(len(payload))
+                    if flags & FLAG_END_STREAM:
+                        self._respond(conn, sid, streams.pop(sid))
+        except (OSError, EOFError, H2Error):
+            pass
+        finally:
+            sock.close()
+
+    def _respond(self, conn: H2Conn, sid: int, stream: dict) -> None:
+        path = stream["headers"].get(":path", "")
+        method = path.rsplit("/", 1)[-1]
+        field = _FIELD_OF.get(method)
+        if field is None:
+            conn.send_headers(sid, [
+                (":status", "200"), ("content-type", "application/grpc"),
+                ("grpc-status", "12"),  # UNIMPLEMENTED
+                ("grpc-message", f"unknown method {method!r}"),
+            ], end_stream=True)
+            return
+        inner = _REQ_CLS[field].decode(grpc_unframe(stream["data"]))
+        with self._mtx:
+            res = abci.dispatch(self.app, abci.Request(**{field: inner}))
+        body = grpc_frame(getattr(res, field).encode())
+        conn.send_headers(sid, [
+            (":status", "200"), ("content-type", "application/grpc"),
+        ], end_stream=False)
+        conn.send_data(sid, body, end_stream=False)
+        conn.send_headers(sid, [("grpc-status", "0")], end_stream=True)

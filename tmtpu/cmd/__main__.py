@@ -366,6 +366,21 @@ def cmd_light(args) -> int:
     return 0
 
 
+def cmd_signer_harness(args) -> int:
+    """signer-harness — remote-signer conformance checks
+    (tools/tm-signer-harness/main.go)."""
+    from tmtpu.privval.harness import HarnessFailure, run_harness
+
+    expect = bytes.fromhex(args.expect_pubkey) if args.expect_pubkey else None
+    try:
+        return run_harness(args.laddr, args.chain_id,
+                           accept_deadline_s=args.accept_deadline,
+                           expect_pubkey=expect)
+    except HarnessFailure as e:
+        print(f"FAIL {e}")
+        return 1
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tmtpu",
                                 description="TPU-native BFT consensus node")
@@ -447,6 +462,18 @@ def main(argv=None) -> int:
                     default=7 * 24 * 3600.0, help="seconds")
     sp.add_argument("--laddr", default="tcp://127.0.0.1:8888")
     sp.set_defaults(fn=cmd_light)
+
+    sp = sub.add_parser("signer-harness",
+                        help="remote-signer conformance checks")
+    sp.add_argument("chain_id")
+    sp.add_argument("--laddr", default="tcp://127.0.0.1:0",
+                    help="address the external signer dials "
+                         "(tcp:// or unix://)")
+    sp.add_argument("--accept-deadline", type=float, default=30.0,
+                    help="seconds to wait for the signer to connect")
+    sp.add_argument("--expect-pubkey", default="",
+                    help="hex pubkey the signer must serve")
+    sp.set_defaults(fn=cmd_signer_harness)
 
     sp = sub.add_parser("testnet", help="generate N validator home dirs")
     sp.add_argument("--validators", type=int, default=4)

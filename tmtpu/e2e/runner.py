@@ -96,6 +96,8 @@ class Runner:
         self._stop_load = threading.Event()
         self._load_thread: threading.Thread | None = None
         self.txs_sent: list[bytes] = []
+        # tx -> perf_counter_ns at broadcast, for the latency report
+        self.tx_send_ns: dict[bytes, int] = {}
 
     # -- stages -------------------------------------------------------------
 
@@ -174,20 +176,36 @@ class Runner:
                 time.sleep(0.2)
 
     def start_load(self):
+        """Offer ``load.rate`` tx/s round-robin over the validators. Above
+        ~40 tx/s, txs go in JSON-RPC batch requests on a ~50 ms cadence
+        (one HTTP round-trip per ~rate/20 txs): per-request overhead — not
+        bandwidth — is what bounds single-host ingest, the same reason the
+        reference's loadtime generator batches
+        (test/loadtime/load/main.go)."""
+
         def loop():
             i = 0
             validators = [n for n in self.nodes if n.spec.start_at == 0]
-            interval = 1.0 / max(self.m.load.rate, 0.1)
+            chunk = max(1, int(self.m.load.rate * 0.05))
+            interval = chunk / max(self.m.load.rate, 0.1)
             while not self._stop_load.is_set():
-                node = validators[i % len(validators)]
-                tx = (b"load-%06d=" % i) + os.urandom(
-                    self.m.load.size // 2).hex().encode()
+                node = validators[(i // chunk) % len(validators)]
+                txs = []
+                for _ in range(chunk):
+                    txs.append((b"load-%06d=" % i) + os.urandom(
+                        self.m.load.size // 2).hex().encode())
+                    i += 1
                 try:
-                    node.client.broadcast_tx_async(tx)
-                    self.txs_sent.append(tx)
+                    sent_ns = time.time_ns()
+                    if chunk == 1:
+                        node.client.broadcast_tx_async(txs[0])
+                    else:
+                        node.client.broadcast_tx_async_batch(txs)
+                    for tx in txs:
+                        self.txs_sent.append(tx)
+                        self.tx_send_ns[tx] = sent_ns
                 except Exception:
                     pass  # node may be mid-perturbation
-                i += 1
                 time.sleep(interval)
 
         self._load_thread = threading.Thread(target=loop, daemon=True)
@@ -282,23 +300,66 @@ class Runner:
                 f"only {found}/{len(sample)} sampled txs committed")
 
     def benchmark(self) -> dict:
-        """Block-rate statistics over the run (reference: benchmark.go)."""
+        """Block-rate statistics over the run (reference: benchmark.go),
+        plus the per-tx latency distribution when load was applied
+        (reference: test/loadtime/report — there, latency = block time
+        minus the timestamp embedded in each tx; here the runner already
+        holds every tx's send time, so no payload format is needed)."""
         from tmtpu.light.provider import _rfc3339_to_ns
 
         node = self.nodes[0]
         top = node.height()
-        times = []
-        for h in range(max(2, top - 50), top + 1):
-            blk = node.client.block(height=h)["block"]["header"]
-            times.append(_rfc3339_to_ns(blk["time"]))
+        times = {}
+        block_txs = {}
+        for h in range(2, top + 1):
+            blk = node.client.block(height=h)["block"]
+            times[h] = _rfc3339_to_ns(blk["header"]["time"])
+            block_txs[h] = blk["data"].get("txs") or []
         if len(times) < 2:
             return {}
-        intervals = [(b - a) / 1e9 for a, b in zip(times, times[1:])]
-        return {
+        ts = [times[h] for h in sorted(times)][-51:]
+        intervals = [(b - a) / 1e9 for a, b in zip(ts, ts[1:])]
+        out = {
             "blocks": len(intervals),
             "avg_interval_s": sum(intervals) / len(intervals),
             "max_interval_s": max(intervals),
             "blocks_per_min": 60.0 / (sum(intervals) / len(intervals)),
+        }
+        out.update(self.latency_report(times, block_txs))
+        return out
+
+    def latency_report(self, block_time_ns: dict, block_txs: dict) -> dict:
+        """p50/p95/max broadcast→commit latency over every load tx found
+        in a block (tx latency = committing block's timestamp - send
+        time). Txs still uncommitted at report time are counted, not
+        silently dropped."""
+        import base64
+
+        if not self.tx_send_ns:
+            return {}
+        lat_s = []
+        committed = set()
+        for h, txs in block_txs.items():
+            for b64 in txs:
+                tx = base64.b64decode(b64)
+                sent = self.tx_send_ns.get(tx)
+                if sent is not None:
+                    committed.add(tx)
+                    lat_s.append((block_time_ns[h] - sent) / 1e9)
+        if not lat_s:
+            return {"txs_committed": 0,
+                    "txs_uncommitted": len(self.tx_send_ns)}
+        lat_s.sort()
+
+        def pct(p):
+            return lat_s[min(len(lat_s) - 1, int(p * len(lat_s)))]
+
+        return {
+            "txs_committed": len(lat_s),
+            "txs_uncommitted": len(self.tx_send_ns) - len(committed),
+            "latency_p50_s": round(pct(0.50), 3),
+            "latency_p95_s": round(pct(0.95), 3),
+            "latency_max_s": round(lat_s[-1], 3),
         }
 
     def stop(self):

@@ -1,0 +1,336 @@
+"""Minimal HTTP/2 (h2c) framing + HPACK codec — just enough protocol for
+the gRPC ABCI transport (tmtpu/abci/grpc.py).
+
+The deployment image has no ``grpcio`` and nothing may be installed, so
+the gRPC transport speaks the real wire protocol through this
+from-scratch implementation (reference counterpart: the grpc-go stack
+under abci/client/grpc_client.go). Scope — documented, not hidden:
+
+- h2c only (prior-knowledge cleartext, what insecure gRPC channels use);
+- frames: DATA, HEADERS(+CONTINUATION), RST_STREAM, SETTINGS, PING,
+  GOAWAY, WINDOW_UPDATE; others are ignored per RFC 7540 §4.1;
+- HPACK: full static table, dynamic-table *decoding* (incremental
+  indexing + size updates), encoding as literal-never-indexed (always
+  valid, stateless); Huffman-coded strings are rejected with a clear
+  error — this codec's own encoder never emits them, so the tmtpu
+  client/server pair round-trips; foreign clients that Huffman-encode
+  (most do by default) need the socket transport, which remains the
+  production ABCI path as in the reference;
+- flow control: both sides advertise large windows up front
+  (SETTINGS_INITIAL_WINDOW_SIZE + a connection WINDOW_UPDATE) and the
+  sender chunks DATA to 16 KiB frames while honoring the peer's
+  connection window.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+
+# frame types (RFC 7540 §6)
+DATA = 0x0
+HEADERS = 0x1
+RST_STREAM = 0x3
+SETTINGS = 0x4
+PING = 0x6
+GOAWAY = 0x7
+WINDOW_UPDATE = 0x8
+CONTINUATION = 0x9
+
+# flags
+FLAG_END_STREAM = 0x1
+FLAG_END_HEADERS = 0x4
+FLAG_ACK = 0x1
+FLAG_PADDED = 0x8
+FLAG_PRIORITY = 0x20
+
+PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+MAX_FRAME = 16384
+# window both sides advertise (fits snapshot-chunk-sized gRPC messages
+# without per-message WINDOW_UPDATE chatter)
+BIG_WINDOW = 1 << 30
+DEFAULT_WINDOW = 65535
+
+SETTINGS_INITIAL_WINDOW_SIZE = 0x4
+SETTINGS_MAX_FRAME_SIZE = 0x5
+
+
+class H2Error(Exception):
+    pass
+
+
+def pack_frame(ftype: int, flags: int, stream_id: int,
+               payload: bytes = b"") -> bytes:
+    n = len(payload)
+    return (struct.pack(">I", n)[1:] + bytes((ftype, flags))
+            + struct.pack(">I", stream_id & 0x7FFFFFFF) + payload)
+
+
+def read_exact(rfile, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = rfile.read(n - len(buf))
+        if not chunk:
+            raise EOFError("connection closed")
+        buf += chunk
+    return buf
+
+
+def read_frame(rfile):
+    hdr = read_exact(rfile, 9)
+    n = struct.unpack(">I", b"\x00" + hdr[:3])[0]
+    ftype, flags = hdr[3], hdr[4]
+    stream_id = struct.unpack(">I", hdr[5:9])[0] & 0x7FFFFFFF
+    payload = read_exact(rfile, n) if n else b""
+    return ftype, flags, stream_id, payload
+
+
+# ---------------------------------------------------------------------------
+# HPACK (RFC 7541). Encoding: literal-never-indexed only (stateless,
+# always valid). Decoding: static + dynamic tables, no Huffman.
+
+_STATIC_TABLE = [
+    (":authority", ""), (":method", "GET"), (":method", "POST"),
+    (":path", "/"), (":path", "/index.html"), (":scheme", "http"),
+    (":scheme", "https"), (":status", "200"), (":status", "204"),
+    (":status", "206"), (":status", "304"), (":status", "400"),
+    (":status", "404"), (":status", "500"), ("accept-charset", ""),
+    ("accept-encoding", "gzip, deflate"), ("accept-language", ""),
+    ("accept-ranges", ""), ("accept", ""), ("access-control-allow-origin",
+    ""), ("age", ""), ("allow", ""), ("authorization", ""),
+    ("cache-control", ""), ("content-disposition", ""),
+    ("content-encoding", ""), ("content-language", ""),
+    ("content-length", ""), ("content-location", ""), ("content-range", ""),
+    ("content-type", ""), ("cookie", ""), ("date", ""), ("etag", ""),
+    ("expect", ""), ("expires", ""), ("from", ""), ("host", ""),
+    ("if-match", ""), ("if-modified-since", ""), ("if-none-match", ""),
+    ("if-range", ""), ("if-unmodified-since", ""), ("last-modified", ""),
+    ("link", ""), ("location", ""), ("max-forwards", ""),
+    ("proxy-authenticate", ""), ("proxy-authorization", ""), ("range", ""),
+    ("referer", ""), ("refresh", ""), ("retry-after", ""), ("server", ""),
+    ("set-cookie", ""), ("strict-transport-security", ""),
+    ("transfer-encoding", ""), ("user-agent", ""), ("vary", ""),
+    ("via", ""), ("www-authenticate", ""),
+]
+
+
+def _encode_int(value: int, prefix_bits: int, first_byte: int) -> bytes:
+    limit = (1 << prefix_bits) - 1
+    if value < limit:
+        return bytes((first_byte | value,))
+    out = [first_byte | limit]
+    value -= limit
+    while value >= 128:
+        out.append((value & 0x7F) | 0x80)
+        value >>= 7
+    out.append(value)
+    return bytes(out)
+
+
+def _decode_int(data: bytes, pos: int, prefix_bits: int):
+    limit = (1 << prefix_bits) - 1
+    value = data[pos] & limit
+    pos += 1
+    if value < limit:
+        return value, pos
+    shift = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        value += (b & 0x7F) << shift
+        shift += 7
+        if not (b & 0x80):
+            return value, pos
+
+
+def hpack_encode(headers) -> bytes:
+    """[(name, value)] -> HPACK block, every field literal-never-indexed
+    (0x10 prefix), names/values as raw (non-Huffman) strings."""
+    out = bytearray()
+    for name, value in headers:
+        nb = name.encode() if isinstance(name, str) else name
+        vb = value.encode() if isinstance(value, str) else value
+        out.append(0x10)
+        out += _encode_int(len(nb), 7, 0x00)
+        out += nb
+        out += _encode_int(len(vb), 7, 0x00)
+        out += vb
+    return bytes(out)
+
+
+class HpackDecoder:
+    """Per-connection HPACK decoding state (dynamic table)."""
+
+    def __init__(self):
+        self._dyn: list[tuple[str, str]] = []
+        self._dyn_size = 0
+        self._max_size = 4096
+
+    def _entry(self, idx: int):
+        if idx <= 0:
+            raise H2Error("hpack index 0")
+        if idx <= len(_STATIC_TABLE):
+            return _STATIC_TABLE[idx - 1]
+        d = idx - len(_STATIC_TABLE) - 1
+        if d >= len(self._dyn):
+            raise H2Error(f"hpack index {idx} out of range")
+        return self._dyn[d]
+
+    def _add(self, name: str, value: str):
+        self._dyn.insert(0, (name, value))
+        self._dyn_size += len(name) + len(value) + 32
+        while self._dyn_size > self._max_size and self._dyn:
+            n, v = self._dyn.pop()
+            self._dyn_size -= len(n) + len(v) + 32
+
+    def _string(self, data: bytes, pos: int):
+        huffman = bool(data[pos] & 0x80)
+        length, pos = _decode_int(data, pos, 7)
+        raw = data[pos : pos + length]
+        pos += length
+        if huffman:
+            raise H2Error(
+                "HPACK Huffman-coded string: not supported by this "
+                "minimal codec — use the socket ABCI transport for "
+                "foreign gRPC clients")
+        return raw.decode("utf-8", "surrogateescape"), pos
+
+    def decode(self, data: bytes):
+        headers = []
+        pos = 0
+        while pos < len(data):
+            b = data[pos]
+            if b & 0x80:  # indexed
+                idx, pos = _decode_int(data, pos, 7)
+                headers.append(self._entry(idx))
+            elif b & 0x40:  # literal with incremental indexing
+                idx, pos = _decode_int(data, pos, 6)
+                name = self._entry(idx)[0] if idx else None
+                if name is None:
+                    name, pos = self._string(data, pos)
+                value, pos = self._string(data, pos)
+                self._add(name, value)
+                headers.append((name, value))
+            elif b & 0x20:  # dynamic table size update
+                self._max_size, pos = _decode_int(data, pos, 5)
+                while self._dyn_size > self._max_size and self._dyn:
+                    n, v = self._dyn.pop()
+                    self._dyn_size -= len(n) + len(v) + 32
+            else:  # literal without indexing / never indexed (0x00 / 0x10)
+                idx, pos = _decode_int(data, pos, 4)
+                name = self._entry(idx)[0] if idx else None
+                if name is None:
+                    name, pos = self._string(data, pos)
+                value, pos = self._string(data, pos)
+                headers.append((name, value))
+        return headers
+
+
+# ---------------------------------------------------------------------------
+# Connection plumbing shared by the gRPC client and server.
+
+
+class H2Conn:
+    """Frame pump over a socket file pair: writes are locked (multiple
+    application threads), reads belong to one reader loop. Tracks the
+    peer's connection-level send window."""
+
+    def __init__(self, rfile, wfile):
+        self.rfile = rfile
+        self.wfile = wfile
+        self.decoder = HpackDecoder()
+        self._wlock = threading.Lock()
+        self._send_window = DEFAULT_WINDOW
+        self._window_cv = threading.Condition()
+
+    def send_frame(self, ftype, flags, stream_id, payload=b""):
+        with self._wlock:
+            self.wfile.write(pack_frame(ftype, flags, stream_id, payload))
+            self.wfile.flush()
+
+    def send_settings_and_window(self):
+        settings = struct.pack(">HI", SETTINGS_INITIAL_WINDOW_SIZE,
+                               BIG_WINDOW)
+        settings += struct.pack(">HI", SETTINGS_MAX_FRAME_SIZE, MAX_FRAME)
+        self.send_frame(SETTINGS, 0, 0, settings)
+        self.send_frame(WINDOW_UPDATE, 0, 0,
+                        struct.pack(">I", BIG_WINDOW - DEFAULT_WINDOW))
+
+    def grow_send_window(self, n: int):
+        with self._window_cv:
+            self._send_window += n
+            self._window_cv.notify_all()
+
+    def replenish_recv_window(self, n: int):
+        """Hand back connection-window credit for ``n`` consumed DATA
+        bytes. Without this the one-shot handshake grant is a finite
+        lifetime: after ~2 GiB of cumulative DATA the peer's send window
+        hits zero and the connection stalls dead."""
+        if n > 0:
+            self.send_frame(WINDOW_UPDATE, 0, 0, struct.pack(">I", n))
+
+    def apply_peer_settings(self, payload: bytes):
+        for off in range(0, len(payload) - 5, 6):
+            ident, value = struct.unpack(">HI",
+                                         payload[off : off + 6])
+            if ident == SETTINGS_INITIAL_WINDOW_SIZE:
+                # applies to stream windows; our unary streams send whole
+                # messages against the connection window, treat it as such
+                self.grow_send_window(value - DEFAULT_WINDOW)
+
+    def send_data(self, stream_id: int, data: bytes, end_stream: bool):
+        """Chunked DATA respecting the connection send window."""
+        off = 0
+        total = len(data)
+        while off < total or (total == 0 and end_stream):
+            n = min(MAX_FRAME, total - off)
+            with self._window_cv:
+                while self._send_window < n:
+                    if not self._window_cv.wait(timeout=30):
+                        raise H2Error("flow-control window stalled")
+                self._send_window -= n
+            last = off + n >= total
+            self.send_frame(DATA, FLAG_END_STREAM if (last and end_stream)
+                            else 0, stream_id, data[off : off + n])
+            off += n
+            if total == 0:
+                break
+
+    def send_headers(self, stream_id: int, headers, end_stream: bool):
+        block = hpack_encode(headers)
+        flags = FLAG_END_HEADERS | (FLAG_END_STREAM if end_stream else 0)
+        self.send_frame(HEADERS, flags, stream_id, block)
+
+    def read_headers_payload(self, flags: int, payload: bytes) -> bytes:
+        """HEADERS payload -> raw HPACK block (strips padding/priority,
+        absorbs CONTINUATION frames until END_HEADERS)."""
+        pos = 0
+        if flags & FLAG_PADDED:
+            pad = payload[0]
+            payload = payload[1:]
+            payload = payload[: len(payload) - pad]
+        if flags & FLAG_PRIORITY:
+            pos = 5
+        block = payload[pos:]
+        while not (flags & FLAG_END_HEADERS):
+            ftype, flags, _sid, payload = read_frame(self.rfile)
+            if ftype != CONTINUATION:
+                raise H2Error("expected CONTINUATION")
+            block += payload
+        return block
+
+
+def grpc_frame(msg: bytes) -> bytes:
+    """gRPC length-prefixed message (uncompressed)."""
+    return b"\x00" + struct.pack(">I", len(msg)) + msg
+
+
+def grpc_unframe(buf: bytes) -> bytes:
+    if len(buf) < 5:
+        raise H2Error("short gRPC frame")
+    if buf[0] != 0:
+        raise H2Error("compressed gRPC messages not supported")
+    n = struct.unpack(">I", buf[1:5])[0]
+    if len(buf) < 5 + n:
+        raise H2Error("truncated gRPC message")
+    return buf[5 : 5 + n]

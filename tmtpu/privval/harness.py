@@ -1,0 +1,111 @@
+"""Remote-signer conformance harness (reference:
+tools/tm-signer-harness/main.go + internal/test_harness.go).
+
+An operator points an EXTERNAL remote signer (anything speaking the
+privval protocol — our SignerServer, tmkms, ...) at the harness; the
+harness plays the node side (listener endpoint) and runs the reference's
+acceptance checks:
+
+1. connectivity — the signer dials in before the accept deadline;
+2. public key — the signer serves its pubkey (optionally matched against
+   an expected key, e.g. from genesis);
+3. sign proposal — a height-1 proposal signature that verifies;
+4. sign vote — prevote + precommit signatures that verify;
+5. double-sign defence — re-signing the same HRS with a DIFFERENT block
+   id must be refused (the reference's TestSignProposal/TestSignVote
+   failure cases; a signer without last-sign-state tracking fails here).
+
+Each check prints PASS/FAIL; the run exits non-zero on the first failure
+so CI can gate on it. Used by ``tmtpu signer-harness`` (cmd/__main__.py)
+and tests/test_privval_harness.py.
+"""
+
+from __future__ import annotations
+
+import time
+
+from tmtpu.privval.signer import (
+    RemoteSignerError, SignerClient, SignerListenerEndpoint,
+)
+from tmtpu.types.block import BlockID
+from tmtpu.types.vote import PRECOMMIT, PREVOTE, Proposal, Vote
+
+
+class HarnessFailure(Exception):
+    def __init__(self, check: str, detail: str):
+        super().__init__(f"{check}: {detail}")
+        self.check = check
+
+
+def _bid(tag: bytes) -> BlockID:
+    return BlockID((tag * 32)[:32], 1, (b"\xaa" * 32)[:32])
+
+
+def run_harness(laddr: str, chain_id: str, *, accept_deadline_s: float = 30.0,
+                expect_pubkey: bytes | None = None, log=print) -> int:
+    """Run every check against the signer dialing ``laddr``. Returns 0 on
+    full conformance; raises HarnessFailure on the first failed check."""
+    ep = SignerListenerEndpoint(laddr)
+    try:
+        log(f"signer-harness: listening on {laddr}, waiting up to "
+            f"{accept_deadline_s:.0f}s for the signer to dial in...")
+        try:
+            ep.accept(timeout=accept_deadline_s)
+        except Exception as e:  # noqa: BLE001
+            raise HarnessFailure("connect", f"signer never dialed in: {e!r}")
+        log("PASS connect")
+
+        client = SignerClient(ep, chain_id)
+        try:
+            pk = client.get_pub_key()
+        except RemoteSignerError as e:
+            raise HarnessFailure("pubkey", str(e))
+        if expect_pubkey is not None and pk.bytes() != expect_pubkey:
+            raise HarnessFailure(
+                "pubkey", f"got {pk.bytes().hex()}, "
+                f"expected {expect_pubkey.hex()}")
+        log(f"PASS pubkey ({pk.type_value()} {pk.bytes().hex()[:16]}...)")
+
+        now = time.time_ns()
+        prop = Proposal(height=1, round=0, pol_round=-1,
+                        block_id=_bid(b"\x01"), timestamp=now)
+        try:
+            client.sign_proposal(chain_id, prop)
+        except RemoteSignerError as e:
+            raise HarnessFailure("sign-proposal", str(e))
+        if not pk.verify_signature(prop.sign_bytes(chain_id),
+                                   prop.signature):
+            raise HarnessFailure("sign-proposal",
+                                 "signature does not verify")
+        log("PASS sign-proposal")
+
+        for vtype, name in ((PREVOTE, "prevote"), (PRECOMMIT, "precommit")):
+            v = Vote(type=vtype, height=1, round=0, block_id=_bid(b"\x02"),
+                     timestamp=now, validator_address=pk.address(),
+                     validator_index=0)
+            try:
+                client.sign_vote(chain_id, v)
+            except RemoteSignerError as e:
+                raise HarnessFailure(f"sign-{name}", str(e))
+            if not pk.verify_signature(v.sign_bytes(chain_id), v.signature):
+                raise HarnessFailure(f"sign-{name}",
+                                     "signature does not verify")
+            log(f"PASS sign-{name}")
+
+        # double-sign defence: same H/R/S, conflicting block id
+        evil = Vote(type=PRECOMMIT, height=1, round=0, block_id=_bid(b"\x03"),
+                    timestamp=now + 1, validator_address=pk.address(),
+                    validator_index=0)
+        try:
+            client.sign_vote(chain_id, evil)
+        except RemoteSignerError:
+            log("PASS double-sign-defence (conflicting precommit refused)")
+        else:
+            raise HarnessFailure(
+                "double-sign-defence",
+                "signer signed a conflicting precommit at the same HRS")
+
+        log("signer-harness: ALL CHECKS PASSED")
+        return 0
+    finally:
+        ep.close()

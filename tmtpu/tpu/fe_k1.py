@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tmtpu.tpu.fe import at_add
+from tmtpu.tpu.fe import at_add, const_col
 
 RADIX = 13
 NLIMBS = 20
@@ -136,7 +136,7 @@ def sub(a, b):
     <= 15700+41000 = 56700 -> pass 1 carries <= 6, c19 <= 6 -> limb0 <=
     8191+6+44640; pass 2: c19 <= 1, limb0 <= 8191+7440+6 <= LOOSE0,
     limb2 <= 8191+1+1024 <= LOOSEK."""
-    return carry(a + jnp.asarray(KSUB)[:, None] - b, 2)
+    return carry(a + const_col("K1_KSUB", KSUB) - b, 2)
 
 
 def neg(a):
@@ -264,7 +264,7 @@ def freeze(x):
     for i in range(NLIMBS - 1):
         c = x[i : i + 1] >> RADIX
         x = at_add(at_add(x, i, -(c << RADIX)), i + 1, c)
-    t = x - jnp.asarray(P_LIMBS)[:, None]
+    t = x - const_col("K1_P", P_LIMBS)
     for i in range(NLIMBS - 1):
         c = t[i : i + 1] >> RADIX
         t = at_add(at_add(t, i, -(c << RADIX)), i + 1, c)

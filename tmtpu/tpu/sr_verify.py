@@ -245,23 +245,10 @@ def _sr_verify_compact_jit(pk_b, r_b, s_b, k_b, table):
 
 
 # set on a Pallas compile/lowering failure (or 2 consecutive failures of
-# any kind) so later batches go straight to XLA
+# any kind) so later batches go straight to XLA; the shared policy lives
+# in tmtpu.tpu.verify.is_compile_error (k1_verify uses the same one)
 _kernel_broken = False
 _kernel_failures = 0
-
-# substrings that identify a deterministic compile/lowering rejection —
-# retrying those would pay full trace+lowering cost per batch for nothing.
-# Transient runtime faults (device OOM, tunnel RPC hiccup) do NOT match and
-# get one retry before the latch trips.
-_COMPILE_ERR_MARKERS = ("mosaic", "lowering", "unsupported", "unimplemented",
-                        "cannot lower", "pallas")
-
-
-def _is_compile_error(e: Exception) -> bool:
-    if isinstance(e, NotImplementedError):
-        return True
-    s = f"{type(e).__name__}: {e}".lower()
-    return any(m in s for m in _COMPILE_ERR_MARKERS)
 
 
 def batch_verify_sr(pks, msgs, sigs) -> np.ndarray:
@@ -294,7 +281,7 @@ def batch_verify_sr(pks, msgs, sigs) -> np.ndarray:
             # ADVICE r2: one hiccup must not silently degrade the process
             # to the XLA path forever.
             _kernel_failures += 1
-            if _is_compile_error(e) or _kernel_failures >= 2:
+            if tv.is_compile_error(e) or _kernel_failures >= 2:
                 _kernel_broken = True
             import sys
 

@@ -291,6 +291,22 @@ def use_pallas_kernel() -> bool:
         return False
 
 
+# Shared Pallas-fallback latch policy (sr25519 + secp256k1 batch paths):
+# substrings identifying a deterministic compile/lowering rejection —
+# retrying those pays full trace+lowering cost per batch for nothing,
+# while transient runtime faults (device OOM, tunnel RPC hiccup) deserve
+# one retry before the per-module latch trips.
+_COMPILE_ERR_MARKERS = ("mosaic", "lowering", "unsupported", "unimplemented",
+                        "cannot lower", "pallas")
+
+
+def is_compile_error(e: Exception) -> bool:
+    if isinstance(e, NotImplementedError):
+        return True
+    s = f"{type(e).__name__}: {e}".lower()
+    return any(m in s for m in _COMPILE_ERR_MARKERS)
+
+
 @jax.jit
 def _verify_compact_jit(pk_b, r_b, s_b, h_b, table):
     return verify_core_compact(pk_b, r_b, s_b, h_b, table)
