@@ -362,6 +362,17 @@ def main():
         # inverse of the pipelined-throughput headline above, which
         # overlaps batches
         out["e2e_ms_per_10k"] = round(1e3 * LANES / structures["sync"], 2)
+    if backend != "cpu":
+        # the BASELINE "Curves" row in the same driver artifact: sr25519 +
+        # secp256k1 device rates (ed25519 is the headline above). Bounded
+        # lanes keep the add-on to a few minutes; any failure is recorded
+        # per curve without touching the headline.
+        try:
+            from tools.curve_bench import curve_measurements
+
+            out["curves"] = curve_measurements(1024, 1024, "device")
+        except Exception as e:  # noqa: BLE001
+            out["curves"] = {"error": repr(e)}
     print(json.dumps(out))
 
 

@@ -238,10 +238,10 @@ class GRPCServer:
                 conn, _ = self._listener.accept()
             except OSError:
                 return
-            t = threading.Thread(target=self._serve_conn, args=(conn,),
-                                 daemon=True)
-            t.start()
-            self._threads.append(t)
+            # daemon threads, not retained: accumulating one dead Thread
+            # per short-lived connection would grow without bound
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
 
     def _serve_conn(self, sock: socket.socket) -> None:
         rfile = sock.makefile("rb")
@@ -295,10 +295,22 @@ class GRPCServer:
                 ("grpc-message", f"unknown method {method!r}"),
             ], end_stream=True)
             return
-        inner = _REQ_CLS[field].decode(grpc_unframe(stream["data"]))
-        with self._mtx:
-            res = abci.dispatch(self.app, abci.Request(**{field: inner}))
-        body = grpc_frame(getattr(res, field).encode())
+        try:
+            inner = _REQ_CLS[field].decode(grpc_unframe(stream["data"]))
+            with self._mtx:
+                res = abci.dispatch(self.app,
+                                    abci.Request(**{field: inner}))
+            body = grpc_frame(getattr(res, field).encode())
+        except Exception as e:  # noqa: BLE001 — bad payload or app error:
+            # answer INTERNAL on this stream, keep the connection alive
+            # (the reference server does the same; only transport-level
+            # failures may kill the connection)
+            conn.send_headers(sid, [
+                (":status", "200"), ("content-type", "application/grpc"),
+                ("grpc-status", "13"),  # INTERNAL
+                ("grpc-message", repr(e)),
+            ], end_stream=True)
+            return
         conn.send_headers(sid, [
             (":status", "200"), ("content-type", "application/grpc"),
         ], end_stream=False)

@@ -94,9 +94,10 @@ class Runner:
         self.outdir = outdir
         self.nodes: list[_Node] = []
         self._stop_load = threading.Event()
-        self._load_thread: threading.Thread | None = None
+        self._load_threads: list[threading.Thread] = []
         self.txs_sent: list[bytes] = []
-        # tx -> perf_counter_ns at broadcast, for the latency report
+        # tx -> wall-clock time_ns at broadcast, for the latency report
+        # (compared against block header timestamps, also wall-clock)
         self.tx_send_ns: dict[bytes, int] = {}
 
     # -- stages -------------------------------------------------------------
@@ -183,33 +184,67 @@ class Runner:
         reference's loadtime generator batches
         (test/loadtime/load/main.go)."""
 
-        def loop():
-            i = 0
+        # one worker per ~120 tx/s: a single thread's HTTP round-trips cap
+        # out near 200 tx/s regardless of node capacity (the generator,
+        # not the net, becomes the bottleneck — seen in knee sweeps)
+        n_workers = max(1, round(self.m.load.rate / 120.0))
+        rate_each = self.m.load.rate / n_workers
+        lock = threading.Lock()
+
+        def loop(worker: int):
+            from tmtpu.rpc.client import HTTPClient
+
             validators = [n for n in self.nodes if n.spec.start_at == 0]
-            chunk = max(1, int(self.m.load.rate * 0.05))
-            interval = chunk / max(self.m.load.rate, 0.1)
+            # own keep-alive client per worker: HTTPClient serializes on
+            # one connection, sharing would re-serialize the workers
+            clients = [HTTPClient(f"http://127.0.0.1:{n.rpc_port}",
+                                  timeout=5.0) for n in validators]
+            chunk = max(1, int(rate_each * 0.05))
+            interval = chunk / max(rate_each, 0.1)
+            i = 0
+            next_at = time.monotonic()
             while not self._stop_load.is_set():
-                node = validators[(i // chunk) % len(validators)]
+                cli = clients[(i // chunk) % len(clients)]
                 txs = []
                 for _ in range(chunk):
-                    txs.append((b"load-%06d=" % i) + os.urandom(
+                    txs.append((b"load-%d-%06d=" % (worker, i)) + os.urandom(
                         self.m.load.size // 2).hex().encode())
                     i += 1
                 try:
                     sent_ns = time.time_ns()
                     if chunk == 1:
-                        node.client.broadcast_tx_async(txs[0])
+                        cli.broadcast_tx_async(txs[0])
+                        accepted = txs
                     else:
-                        node.client.broadcast_tx_async_batch(txs)
-                    for tx in txs:
-                        self.txs_sent.append(tx)
-                        self.tx_send_ns[tx] = sent_ns
+                        # call_batch returns per-entry results — an
+                        # RPCClientError entry (mempool full, rejection)
+                        # means that tx was never accepted; recording it
+                        # as sent would poison the committed-tx invariant
+                        # and the latency report
+                        results = cli.broadcast_tx_async_batch(txs)
+                        accepted = [tx for tx, r in zip(txs, results)
+                                    if not isinstance(r, Exception)]
+                    with lock:
+                        for tx in accepted:
+                            self.txs_sent.append(tx)
+                            self.tx_send_ns[tx] = sent_ns
                 except Exception:
                     pass  # node may be mid-perturbation
-                time.sleep(interval)
+                # elapsed-compensating pacing: sleep to the schedule, not
+                # a full interval after each (slow) request
+                next_at += interval
+                delay = next_at - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                else:
+                    next_at = time.monotonic()  # fell behind: reset
 
-        self._load_thread = threading.Thread(target=loop, daemon=True)
-        self._load_thread.start()
+        self._load_threads = [
+            threading.Thread(target=loop, args=(w,), daemon=True)
+            for w in range(n_workers)
+        ]
+        for t in self._load_threads:
+            t.start()
 
     def max_height(self) -> int:
         return max((n.height() for n in self.nodes if n.running),
@@ -264,8 +299,8 @@ class Runner:
 
     def stop_load(self):
         self._stop_load.set()
-        if self._load_thread:
-            self._load_thread.join(5)
+        for t in self._load_threads:
+            t.join(5)
 
     def test(self):
         """Invariants over RPC (reference: test/e2e/tests/): app hash and
@@ -331,8 +366,12 @@ class Runner:
     def latency_report(self, block_time_ns: dict, block_txs: dict) -> dict:
         """p50/p95/max broadcast→commit latency over every load tx found
         in a block (tx latency = committing block's timestamp - send
-        time). Txs still uncommitted at report time are counted, not
-        silently dropped."""
+        time, the reference loadtime/report definition). Header time is
+        BFT time — the median of the PREVIOUS height's precommit
+        timestamps — so it lags real commit time by ~one block interval;
+        at sub-second block rates a tx committed within one block can
+        therefore report small NEGATIVE latency. Txs still uncommitted at
+        report time are counted, not silently dropped."""
         import base64
 
         if not self.tx_send_ns:

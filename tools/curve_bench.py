@@ -16,8 +16,10 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _measure(name, lanes, gen, batch_fn, serial_fn, iters=3,
-             backend="device"):
+def measure_curve(name, lanes, gen, batch_fn, serial_fn, iters=3,
+                  backend="device") -> dict:
+    """One curve's end-to-end batch rate + serial baseline, as a dict
+    (bench.py embeds these in its single JSON line; main() prints them)."""
     t0 = time.perf_counter()
     pks, msgs, sigs = gen(lanes)
     gen_s = time.perf_counter() - t0
@@ -41,14 +43,107 @@ def _measure(name, lanes, gen, batch_fn, serial_fn, iters=3,
     for _ in range(iters):
         mask = batch_fn(pks, msgs, sigs)
     rate = lanes * iters / (time.perf_counter() - t0)
-    print(json.dumps({
+    return {
         "metric": f"{name}_batch_verify_e2e",
         "value": round(rate, 1), "unit": "sig/s",
         "lanes": lanes,
         "serial_cpu_sig_s": round(serial_rate, 1),
         "speedup_vs_serial": round(rate / serial_rate, 2),
         "backend": backend,
-    }))
+    }
+
+
+def gen_sr(n):
+    from tmtpu.crypto import sr25519 as sr
+
+    keys = [sr.gen_priv_key_from_secret(b"cb%d" % i) for i in range(n)]
+    msgs = [b"curve-bench-sr-%d" % i for i in range(n)]
+    return ([k.pub_key().bytes() for k in keys], msgs,
+            [k.sign(m) for k, m in zip(keys, msgs)])
+
+
+def gen_k1(n):
+    from tmtpu.crypto import secp256k1 as k1
+
+    keys = [k1.gen_priv_key() for _ in range(n)]
+    msgs = [b"curve-bench-k1-%d" % i for i in range(n)]
+    return ([k.pub_key().bytes() for k in keys], msgs,
+            [k.sign(m) for k, m in zip(keys, msgs)])
+
+
+def gen_mixed(n):
+    """Round-robin ed25519/sr25519/secp256k1 lanes (a mixed-curve valset's
+    commit, the BASELINE 'mixed sets' config)."""
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+
+    from tmtpu.crypto import secp256k1 as k1
+    from tmtpu.crypto import sr25519 as sr
+    from tmtpu.crypto.ed25519 import PubKeyEd25519
+
+    raw = serialization.Encoding.Raw, serialization.PublicFormat.Raw
+    msgs, sigs, pk_objs = [], [], []
+    for i in range(n):
+        msg = b"curve-bench-mixed-%d" % i
+        if i % 3 == 0:
+            sk = Ed25519PrivateKey.from_private_bytes(
+                (b"%032d" % i)[:32])
+            sigs.append(sk.sign(msg))
+            pk_objs.append(PubKeyEd25519(sk.public_key().public_bytes(*raw)))
+        elif i % 3 == 1:
+            sk = sr.gen_priv_key_from_secret(b"mx%d" % i)
+            sigs.append(sk.sign(msg))
+            pk_objs.append(sk.pub_key())
+        else:
+            sk = k1.gen_priv_key()
+            sigs.append(sk.sign(msg))
+            pk_objs.append(sk.pub_key())
+        msgs.append(msg)
+    return pk_objs, msgs, sigs
+
+
+def _batch_verify_mixed(pk_objs, msgs, sigs):
+    """One TPUBatchVerifier pass over the mixed set (per-curve device
+    dispatch under the hood — tmtpu/crypto/batch.py _split)."""
+    import numpy as np
+
+    from tmtpu.crypto import batch as crypto_batch
+
+    bv = crypto_batch.TPUBatchVerifier()
+    for pk, m, s in zip(pk_objs, msgs, sigs):
+        bv.add(pk, m, s)
+    _all_ok, mask = bv.verify()
+    return np.asarray(mask)
+
+
+def curve_measurements(lanes_sr: int, lanes_k1: int, backend: str) -> dict:
+    """sr25519 + secp256k1 + mixed-set device-path rates keyed by curve;
+    failures are recorded per curve (a flaky tunnel RPC during one curve's
+    pass must not lose the others' numbers)."""
+    from tmtpu.crypto import secp256k1 as k1
+    from tmtpu.crypto import sr25519 as sr
+    from tmtpu.tpu import k1_verify as kv
+    from tmtpu.tpu import sr_verify as srv
+
+    out = {}
+    for name, lanes, gen, batch_fn, serial_fn in (
+        ("sr25519", lanes_sr, gen_sr, srv.batch_verify_sr,
+         lambda p, m, s: sr.PubKeySr25519(p).verify_signature(m, s)),
+        ("secp256k1", lanes_k1, gen_k1, kv.batch_verify_k1,
+         lambda p, m, s: k1.PubKeySecp256k1(p).verify_signature(m, s)),
+        ("mixed", min(lanes_sr, lanes_k1) * 3, gen_mixed,
+         _batch_verify_mixed,
+         lambda pk, m, s: pk.verify_signature(m, s)),
+    ):
+        try:
+            out[name] = measure_curve(name, lanes, gen, batch_fn,
+                                      serial_fn, backend=backend)
+        except Exception as e:  # noqa: BLE001
+            out[name] = {"error": repr(e)}
+            print(f"curve_bench: {name} FAILED: {e!r}", file=sys.stderr)
+    return out
 
 
 def main():
@@ -75,39 +170,11 @@ def main():
         args.lanes_sr = min(args.lanes_sr, 64)
         args.lanes_k1 = min(args.lanes_k1, 64)
 
-    from tmtpu.crypto import secp256k1 as k1
-    from tmtpu.crypto import sr25519 as sr
-    from tmtpu.tpu import k1_verify as kv
-    from tmtpu.tpu import sr_verify as srv
-
-    def gen_sr(n):
-        keys = [sr.gen_priv_key_from_secret(b"cb%d" % i) for i in range(n)]
-        msgs = [b"curve-bench-sr-%d" % i for i in range(n)]
-        return ([k.pub_key().bytes() for k in keys], msgs,
-                [k.sign(m) for k, m in zip(keys, msgs)])
-
-    def gen_k1(n):
-        keys = [k1.gen_priv_key() for _ in range(n)]
-        msgs = [b"curve-bench-k1-%d" % i for i in range(n)]
-        return ([k.pub_key().bytes() for k in keys], msgs,
-                [k.sign(m) for k, m in zip(keys, msgs)])
-
     backend = "device" if device else "cpu"
-    ok = True
-    # per-curve isolation: a flaky tunnel RPC during one curve's pass must
-    # not lose the other curve's number
-    for m_args in (
-        ("sr25519", args.lanes_sr, gen_sr, srv.batch_verify_sr,
-         lambda p, m, s: sr.PubKeySr25519(p).verify_signature(m, s)),
-        ("secp256k1", args.lanes_k1, gen_k1, kv.batch_verify_k1,
-         lambda p, m, s: k1.PubKeySecp256k1(p).verify_signature(m, s)),
-    ):
-        try:
-            _measure(*m_args, backend=backend)
-        except Exception as e:  # noqa: BLE001
-            ok = False
-            print(f"curve_bench: {m_args[0]} FAILED: {e!r}", file=sys.stderr)
-    sys.exit(0 if ok else 1)
+    results = curve_measurements(args.lanes_sr, args.lanes_k1, backend)
+    for res in results.values():
+        print(json.dumps(res))
+    sys.exit(0 if all("error" not in r for r in results.values()) else 1)
 
 
 if __name__ == "__main__":
