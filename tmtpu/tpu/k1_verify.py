@@ -295,11 +295,11 @@ def _lt_be(arr: np.ndarray, bound_be: np.ndarray) -> np.ndarray:
     return lt_le(arr[:, ::-1], bound_be[::-1].copy())
 
 
-def prepare_k1_batch(pks, msgs, sigs):
-    """Host prep: ((pkx, u1, u2, r, rpn) [32, B] uint8 + parity [B] int32,
-    host_ok). Host rejects wrong lengths, bad SEC1 prefixes, r/s out of
-    [1, n-1], and non-low-S (s > n/2) — matching the serial path's checks
-    before any curve math."""
+def prepare_k1_batch_packed(pks, msgs, sigs):
+    """Host prep, packed form: (numpy [168, B] uint8, host_ok). Host
+    rejects wrong lengths, bad SEC1 prefixes, r/s out of [1, n-1], and
+    non-low-S (s > n/2) — matching the serial path's checks before any
+    curve math."""
     B = len(sigs)
     pks_b = [bytes(p) for p in pks]
     sigs_b = [bytes(s) for s in sigs]
@@ -348,12 +348,31 @@ def prepare_k1_batch(pks, msgs, sigs):
     u1_arr = np.frombuffer(b"".join(u1_list), dtype=np.uint8).reshape(B, 32)
     u2_arr = np.frombuffer(b"".join(u2_list), dtype=np.uint8).reshape(B, 32)
     rpn_arr = np.frombuffer(b"".join(rpn_list), dtype=np.uint8).reshape(B, 32)
-    parity = (pk_arr[:, 0] & 1).astype(np.int32)
-    args = tuple(
-        jnp.asarray(np.ascontiguousarray(a.T))
-        for a in (pkx, u1_arr, u2_arr, r_arr, rpn_arr)
-    )
-    return args, jnp.asarray(parity), host_ok
+    parity = (pk_arr[:, 0] & 1).astype(np.uint8)
+    # ONE [168, B] host plane: 5 byte planes + the parity row (+7 zero
+    # rows to an 8-multiple) — single H2D transfer, split on device
+    # (per-RPC latency dominates on the tunnel; see
+    # verify.prepare_batch_packed)
+    packed = np.concatenate(
+        [np.ascontiguousarray(a.T)
+         for a in (pkx, u1_arr, u2_arr, r_arr, rpn_arr)]
+        + [parity[None, :], np.zeros((7, B), dtype=np.uint8)], axis=0)
+    return packed, host_ok
+
+
+def split_packed_k1(packed):
+    """Device-side: [168, B] -> ((pkx, u1, u2, r, rpn) [32, B], parity
+    [B] int32)."""
+    planes = tuple(packed[32 * i : 32 * (i + 1)] for i in range(5))
+    return planes, packed[160].astype(jnp.int32)
+
+
+def prepare_k1_batch(pks, msgs, sigs):
+    """Per-plane form of prepare_k1_batch_packed (tests): ((pkx, u1, u2,
+    r, rpn) [32, B] jnp, parity [B] int32, host_ok)."""
+    packed, host_ok = prepare_k1_batch_packed(pks, msgs, sigs)
+    planes, parity = split_packed_k1(jnp.asarray(packed))
+    return planes, parity, host_ok
 
 
 _BASE_TABLE_F32 = None
@@ -371,17 +390,27 @@ def _k1_verify_compact_jit(pkx_b, parity, u1_b, u2_b, r_b, rpn_b, table):
     return verify_core_compact(pkx_b, parity, u1_b, u2_b, r_b, rpn_b, table)
 
 
+@jax.jit
+def _k1_verify_packed_jit(packed, table):
+    """Packed-input twin: ONE [168, B] uint8 H2D transfer, split device-
+    side (slices are free under jit)."""
+    planes, parity = split_packed_k1(packed)
+    return verify_core_compact(planes[0], parity, *planes[1:], table)
+
+
+@jax.jit
+def _k1_kernel_packed_jit(packed):
+    from tmtpu.tpu import k1_kernel as kk
+
+    planes, parity = split_packed_k1(packed)
+    return kk.k1_verify_compact_kernel(planes[0], parity, *planes[1:])
+
+
 # Pallas-kernel fallback latch, same policy as tmtpu.tpu.sr_verify: latch
 # permanently only on deterministic compile/lowering rejections, give
 # transient runtime faults one retry.
 _kernel_broken = False
 _kernel_failures = 0
-
-
-def _pad_parity(parity, B: int, padded: int):
-    if padded == B:
-        return parity
-    return jnp.concatenate([parity, jnp.repeat(parity[:1], padded - B)])
 
 
 def batch_verify_k1(pks, msgs, sigs) -> np.ndarray:
@@ -391,21 +420,20 @@ def batch_verify_k1(pks, msgs, sigs) -> np.ndarray:
     device half in VMEM; the plain-XLA graph remains the CPU/virtual-mesh
     path and the fallback should Mosaic reject the kernel."""
     from tmtpu.tpu import verify as tv
-    from tmtpu.tpu.verify import pad_args_to_bucket
+    from tmtpu.tpu.verify import pad_packed
 
     B = len(sigs)
     if B == 0:
         return np.zeros(0, dtype=bool)
-    args, parity, host_ok = prepare_k1_batch(pks, msgs, sigs)
+    packed, host_ok = prepare_k1_batch_packed(pks, msgs, sigs)
     global _kernel_broken, _kernel_failures
     if not _kernel_broken and tv.use_pallas_kernel():
         from tmtpu.tpu import k1_kernel as kk
 
         padded = max(kk.DEFAULT_TILE, tv._pad_to_bucket(B))
-        kargs = pad_args_to_bucket(args, B, padded)
         try:
-            mask = np.asarray(kk.k1_verify_compact_kernel(
-                kargs[0], _pad_parity(parity, B, padded), *kargs[1:]))[:B]
+            mask = np.asarray(_k1_kernel_packed_jit(
+                jnp.asarray(pad_packed(packed, padded))))[:B]
             _kernel_failures = 0
             return mask & host_ok
         except Exception as e:  # noqa: BLE001
@@ -419,10 +447,7 @@ def batch_verify_k1(pks, msgs, sigs) -> np.ndarray:
                 f"{'disabled' if _kernel_broken else 'failed (will retry)'}"
                 f": {e!r}",
                 file=sys.stderr)
-    padded = tv._pad_to_bucket(B)
-    args = pad_args_to_bucket(args, B, padded)
+    packed = pad_packed(packed, tv._pad_to_bucket(B))
     mask = np.asarray(
-        _k1_verify_compact_jit(args[0], _pad_parity(parity, B, padded),
-                               *args[1:], base_table_f32())
-    )[:B]
+        _k1_verify_packed_jit(jnp.asarray(packed), base_table_f32()))[:B]
     return mask & host_ok

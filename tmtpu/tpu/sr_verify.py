@@ -44,7 +44,6 @@ from tmtpu.tpu.verify import (
     base_table_f32,
     digits_msb_device,
     lt_le,
-    pad_args_to_bucket,
 )
 
 L = ref.L
@@ -180,8 +179,9 @@ def _challenge_k(pk: bytes, msg: bytes, r_bytes: bytes) -> bytes:
     return k.to_bytes(32, "little")
 
 
-def prepare_sr_batch(pks, msgs, sigs):
-    """Host prep: ([32, B] uint8 x4 (pk, r, s, k), host_ok).
+def prepare_sr_batch_packed(pks, msgs, sigs):
+    """Host prep, packed form: (numpy [128, B] uint8 — pk/r/s/k stacked,
+    host_ok). Callers device_put the single plane.
 
     Host-rejected lanes (wrong length, missing schnorrkel marker bit,
     s >= L, non-canonical A or R encoding) get well-formed dummy inputs and
@@ -230,18 +230,46 @@ def prepare_sr_batch(pks, msgs, sigs):
             ),
             dtype=np.uint8,
         ).reshape(B, 32)
-    args = (
-        jnp.asarray(np.ascontiguousarray(pk_arr.T)),
-        jnp.asarray(np.ascontiguousarray(r_arr.T)),
-        jnp.asarray(np.ascontiguousarray(s_arr.T)),
-        jnp.asarray(np.ascontiguousarray(k_arr.T)),
-    )
-    return args, host_ok
+    # ONE [128, B] host plane (pk/r/s/k stacked): callers device_put it as
+    # a single transfer — per-RPC latency dominates bandwidth on the
+    # tunnel-attached TPU, same reason the ed25519 path packs
+    # (verify.prepare_batch_packed)
+    packed = np.concatenate([
+        np.ascontiguousarray(pk_arr.T), np.ascontiguousarray(r_arr.T),
+        np.ascontiguousarray(s_arr.T), np.ascontiguousarray(k_arr.T),
+    ], axis=0)
+    return packed, host_ok
+
+
+def prepare_sr_batch(pks, msgs, sigs):
+    """Per-plane form of prepare_sr_batch_packed: ([32, B] jnp x4
+    (pk, r, s, k), host_ok) — tests and the sharded per-plane path."""
+    packed, host_ok = prepare_sr_batch_packed(pks, msgs, sigs)
+    from tmtpu.tpu.verify import split_packed
+
+    return tuple(jnp.asarray(p) for p in split_packed(packed)), host_ok
 
 
 @jax.jit
 def _sr_verify_compact_jit(pk_b, r_b, s_b, k_b, table):
     return sr_verify_core_compact(pk_b, r_b, s_b, k_b, table)
+
+
+@jax.jit
+def _sr_verify_packed_jit(packed, table):
+    """Packed-input twin: ONE [128, B] uint8 H2D transfer, split device-
+    side (slices are free under jit)."""
+    from tmtpu.tpu.verify import split_packed
+
+    return sr_verify_core_compact(*split_packed(packed), table)
+
+
+@jax.jit
+def _sr_kernel_packed_jit(packed):
+    from tmtpu.tpu import kernel as tk
+    from tmtpu.tpu.verify import split_packed
+
+    return tk.sr_verify_compact_kernel(*split_packed(packed))
 
 
 # set on a Pallas compile/lowering failure (or 2 consecutive failures of
@@ -262,16 +290,17 @@ def batch_verify_sr(pks, msgs, sigs) -> np.ndarray:
     if B == 0:
         return np.zeros(0, dtype=bool)
     from tmtpu.tpu import verify as tv
+    from tmtpu.tpu.verify import pad_packed
 
-    args, host_ok = prepare_sr_batch(pks, msgs, sigs)
+    packed, host_ok = prepare_sr_batch_packed(pks, msgs, sigs)
     global _kernel_broken, _kernel_failures
     if not _kernel_broken and tv.use_pallas_kernel():
         from tmtpu.tpu import kernel as tk
 
         padded = max(tk.DEFAULT_TILE, tv._pad_to_bucket(B))
-        kargs = pad_args_to_bucket(args, B, padded)
         try:
-            mask = np.asarray(tk.sr_verify_compact_kernel(*kargs))[:B]
+            mask = np.asarray(_sr_kernel_packed_jit(
+                jnp.asarray(pad_packed(packed, padded))))[:B]
             _kernel_failures = 0
             return mask & host_ok
         except Exception as e:  # noqa: BLE001
@@ -292,6 +321,7 @@ def batch_verify_sr(pks, msgs, sigs) -> np.ndarray:
                 file=sys.stderr)
     # attribute lookup (not an import-time binding) so tests can pin one
     # bucket via monkeypatch, same as the ed25519/secp256k1 paths
-    args = pad_args_to_bucket(args, B, tv._pad_to_bucket(B))
-    mask = np.asarray(_sr_verify_compact_jit(*args, base_table_f32()))[:B]
+    packed = pad_packed(packed, tv._pad_to_bucket(B))
+    mask = np.asarray(
+        _sr_verify_packed_jit(jnp.asarray(packed), base_table_f32()))[:B]
     return mask & host_ok

@@ -236,8 +236,9 @@ def split_packed(packed):
 
 
 def pad_packed(packed: np.ndarray, padded: int) -> np.ndarray:
-    """numpy [128, B] -> [128, padded], replicating lane 0 (well-formed;
-    pad results are discarded)."""
+    """numpy [rows, B] -> [rows, padded], replicating lane 0 (well-formed;
+    pad results are discarded). Row-count agnostic: ed25519/sr25519 pack
+    128 rows, secp256k1 packs 168 (k1_verify.prepare_k1_batch_packed)."""
     B = packed.shape[1]
     if padded == B:
         return packed
