@@ -65,3 +65,61 @@ def test_sharded_kernel_step_cpu_mesh():
     assert sh.limb_sums_to_int(power_sums) == 7 * (lanes - 1)
     assert sh.limb_sums_to_int(rsums) == 7 * (lanes - 1)
     assert np.array_equal(np.asarray(bits), np.asarray(rbits))
+
+
+@pytest.mark.slow  # two XLA:CPU curve-graph compiles (~3 min)
+def test_sharded_sr_and_k1_cpu_mesh():
+    """All three curves shard over the mesh: the lane-sharded sr25519 and
+    secp256k1 steps agree with the unsharded batch verifiers on an
+    8-device CPU mesh, mixed valid/corrupt lanes."""
+    import hashlib
+
+    import jax
+    import jax.numpy as jnp
+
+    from tmtpu.crypto import secp256k1 as k1
+    from tmtpu.crypto import sr25519 as sr
+    from tmtpu.tpu import k1_verify as kv
+    from tmtpu.tpu import sr_verify as srv
+    from tmtpu.tpu import verify as tv
+
+    n = 8
+    mesh = sh.make_mesh(n)
+    lanes = 2 * n  # 16 lanes, 2 per device
+
+    sr_keys = [sr.gen_priv_key_from_secret(b"shard-sr-%d" % i)
+               for i in range(lanes)]
+    sr_msgs = [b"sharded-sr-%d" % i for i in range(lanes)]
+    sr_sigs = [bytearray(k.sign(m)) for k, m in zip(sr_keys, sr_msgs)]
+    sr_sigs[3][1] ^= 1  # corrupt one lane
+    sr_sigs = [bytes(s) for s in sr_sigs]
+    sr_pks = [k.pub_key().bytes() for k in sr_keys]
+
+    packed, host_ok = srv.prepare_sr_batch_packed(sr_pks, sr_msgs, sr_sigs)
+    assert host_ok.all()
+    step = sh.sharded_verify_sr(mesh)
+    mask = np.asarray(jax.block_until_ready(
+        step(jnp.asarray(packed), tv.base_table_f32())))
+    want = srv.batch_verify_sr(sr_pks, sr_msgs, sr_sigs)
+    assert np.array_equal(mask, np.asarray(want))
+    assert not mask[3] and mask.sum() == lanes - 1
+
+    k1_keys = [
+        k1.PrivKeySecp256k1(
+            (int.from_bytes(hashlib.sha256(b"shard-k1-%d" % i).digest(),
+                            "big") % (k1.N - 1) + 1).to_bytes(32, "big"))
+        for i in range(lanes)
+    ]
+    k1_msgs = [b"sharded-k1-%d" % i for i in range(lanes)]
+    k1_sigs = [bytearray(k.sign(m)) for k, m in zip(k1_keys, k1_msgs)]
+    k1_sigs[6][40] ^= 1
+    k1_sigs = [bytes(s) for s in k1_sigs]
+    k1_pks = [k.pub_key().bytes() for k in k1_keys]
+
+    packed, host_ok = kv.prepare_k1_batch_packed(k1_pks, k1_msgs, k1_sigs)
+    kstep = sh.sharded_verify_k1(mesh)
+    kmask = np.asarray(jax.block_until_ready(
+        kstep(jnp.asarray(packed), kv.base_table_f32()))) & host_ok
+    kwant = kv.batch_verify_k1(k1_pks, k1_msgs, k1_sigs)
+    assert np.array_equal(kmask, np.asarray(kwant))
+    assert not kmask[6] and kmask.sum() == lanes - 1

@@ -167,6 +167,44 @@ def sharded_verify_tally_kernel(mesh: Mesh, *, tile: int | None = None,
     ))
 
 
+def sharded_verify_sr(mesh: Mesh):
+    """Lane-sharded sr25519 batch verify over ``mesh``: the [128, B]
+    packed plane (pk|r|s|k — sr_verify.prepare_sr_batch_packed) shards on
+    lanes, the fixed-base table replicates, and ristretto decode + the
+    shared-doubling ladder run shard-locally. Verification is
+    embarrassingly parallel — no collective at all; the sharded mask
+    feeds whatever reduction the caller wants."""
+    from tmtpu.tpu import sr_verify as srv
+
+    lane = NamedSharding(mesh, P(None, "sig"))
+    flat = NamedSharding(mesh, P("sig"))
+    repl = NamedSharding(mesh, P())
+
+    def step(packed, table):
+        return srv.sr_verify_core_compact(*tv.split_packed(packed), table)
+
+    return jax.jit(step, in_shardings=(lane, repl), out_shardings=flat)
+
+
+def sharded_verify_k1(mesh: Mesh):
+    """Lane-sharded secp256k1 batch verify over ``mesh``: the [168, B]
+    packed plane (k1_verify.prepare_k1_batch_packed) shards on lanes, the
+    fixed-base table replicates; decompression, the Straus ladder and the
+    projective x(R) ≡ r check run shard-locally with no collectives."""
+    from tmtpu.tpu import k1_verify as kv
+
+    lane = NamedSharding(mesh, P(None, "sig"))
+    flat = NamedSharding(mesh, P("sig"))
+    repl = NamedSharding(mesh, P())
+
+    def step(packed, table):
+        planes, parity = kv.split_packed_k1(packed)
+        return kv.verify_core_compact(planes[0], parity, *planes[1:],
+                                      table)
+
+    return jax.jit(step, in_shardings=(lane, repl), out_shardings=flat)
+
+
 _fused_jit = None
 _fused_kernel_jit = None
 
