@@ -78,9 +78,12 @@ at_height = 5
 
 
 def test_e2e_sustained_load_commits():
-    """Regression for the tx-load livelock (PERF.md): under steady load a
+    """Regression for the tx-load livelock and the round-2 ingest knee
+    (PERF.md): under steady load well past the old 143 tx/s knee, a
     4-node subprocess testnet must keep committing blocks and drain the
-    offered txs, not cycle failed rounds at one height."""
+    offered txs, not cycle failed rounds at one height. 250 tx/s offered
+    is conservative vs the measured 582 tx/s knee (tools/load_knee.py) to
+    stay robust on a loaded full-suite core."""
     import time
 
     m = Manifest(
@@ -89,7 +92,7 @@ def test_e2e_sustained_load_commits():
         timeout_s=60.0,
         nodes=[NodeSpec(name=f"v{i}") for i in range(4)],
     )
-    m.load.rate = 130.0
+    m.load.rate = 250.0
     m.load.size = 160
     out = tempfile.mkdtemp(prefix="tmtpu-e2e-load-")
     r = Runner(m, out)
@@ -109,7 +112,7 @@ def test_e2e_sustained_load_commits():
         offered = len(r.txs_sent)
         blocks = h1 - h0
         assert blocks >= 10, f"only {blocks} blocks in 15s under load"
-        assert offered > 250, f"load generator managed only {offered}"
+        assert offered > 2000, f"load generator managed only {offered}"
         assert n_txs >= offered * 0.8, (
             f"committed {n_txs}/{offered} offered txs — backlog growing")
     finally:
