@@ -75,6 +75,44 @@ def test_grpc_unknown_method_is_grpc_error():
         server.stop()
 
 
+def test_hpack_decoder_foreign_encodings():
+    """The HPACK decoder must handle encodings our own (stateless literal)
+    encoder never produces: static-table indexed fields, literal with
+    incremental indexing + later dynamic-table hits, table size updates,
+    and reject Huffman strings with the documented clear error
+    (RFC 7541 wire forms hand-assembled here)."""
+    import pytest
+
+    from tmtpu.libs.h2 import H2Error, HpackDecoder
+
+    def lit_inc(name: bytes, value: bytes) -> bytes:
+        # 0x40: literal with incremental indexing, new name
+        return (bytes([0x40, len(name)]) + name
+                + bytes([len(value)]) + value)
+
+    d = HpackDecoder()
+    block = (
+        bytes([0x82])                      # indexed: static 2 = :method GET
+        + bytes([0x86])                    # indexed: static 6 = :scheme http
+        + lit_inc(b"x-custom", b"abc")     # enters dynamic table
+        + bytes([0xBE])                    # indexed: dynamic 1 (62) = x-custom
+    )
+    headers = d.decode(block)
+    assert headers == [(":method", "GET"), (":scheme", "http"),
+                       ("x-custom", "abc"), ("x-custom", "abc")]
+
+    # dynamic table size update to 0 evicts; indexing 62 afterwards errors
+    d2 = HpackDecoder()
+    d2.decode(lit_inc(b"k", b"v"))
+    d2.decode(bytes([0x20]))  # size update -> 0
+    with pytest.raises(H2Error):
+        d2.decode(bytes([0xBE]))
+
+    # Huffman bit set -> explicit unsupported error, not garbage
+    with pytest.raises(H2Error, match="Huffman"):
+        HpackDecoder().decode(bytes([0x00, 0x81, 0xFF, 0x01]) + b"v")
+
+
 def test_grpc_large_message_flow_control():
     """A DATA payload far beyond one 16 KiB frame and the default 64 KiB
     window must round-trip (chunked frames + the big advertised

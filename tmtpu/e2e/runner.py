@@ -30,30 +30,45 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def _free_port() -> int:
+def _hold_port():
+    """Allocate an ephemeral port and KEEP the socket bound: closing
+    immediately (the usual free-port idiom) leaves a seconds-wide window
+    in which a concurrently-starting testnet grabs the port and the node
+    dies with EADDRINUSE (observed flake). The holder is closed right
+    before the node process launches, shrinking the race to
+    milliseconds."""
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    return s.getsockname()[1], s
 
 
 class _Node:
-    def __init__(self, spec: NodeSpec, home: str, p2p_port: int,
-                 rpc_port: int):
+    def __init__(self, spec: NodeSpec, home: str):
         self.spec = spec
         self.home = home
-        self.p2p_port = p2p_port
-        self.rpc_port = rpc_port
+        self.p2p_port, self._p2p_hold = _hold_port()
+        self.rpc_port, self._rpc_hold = _hold_port()
         self.proc: subprocess.Popen | None = None
-        self.client = HTTPClient(f"http://127.0.0.1:{rpc_port}", timeout=5.0)
+        self.client = HTTPClient(f"http://127.0.0.1:{self.rpc_port}",
+                                 timeout=5.0)
         self.node_id = ""
 
     @property
     def running(self) -> bool:
         return self.proc is not None and self.proc.poll() is None
 
+    def _release_ports(self):
+        for attr in ("_p2p_hold", "_rpc_hold"):
+            sock = getattr(self, attr)
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                setattr(self, attr, None)
+
     def start(self):
+        self._release_ports()
         env = dict(os.environ)
         env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
         # nodes run CPU crypto: no jax import in-subprocess, keeps spawn fast
@@ -111,7 +126,7 @@ class Runner:
             home = os.path.join(self.outdir, spec.name)
             os.makedirs(os.path.join(home, "config"), exist_ok=True)
             os.makedirs(os.path.join(home, "data"), exist_ok=True)
-            node = _Node(spec, home, _free_port(), _free_port())
+            node = _Node(spec, home)
             cfg = self._node_config(node)
             pv = FilePV.load_or_generate(
                 cfg.rooted(cfg.base.priv_validator_key_file),
