@@ -366,6 +366,84 @@ def cmd_light(args) -> int:
     return 0
 
 
+def _proto_to_jsonable(m):
+    """Generic ProtoMessage -> JSON-able dict (bytes as hex, nested
+    messages recursed, absent fields omitted) — the wal2json view."""
+    from tmtpu.libs.protoio import ProtoMessage
+
+    if isinstance(m, ProtoMessage):
+        out = {}
+        for _, name, _spec in m.FIELDS:
+            v = getattr(m, name)
+            if v is not None:
+                out[name] = _proto_to_jsonable(v)
+        return out
+    if isinstance(m, (bytes, bytearray)):
+        return bytes(m).hex()
+    if isinstance(m, list):
+        return [_proto_to_jsonable(x) for x in m]
+    return m
+
+
+def _jsonable_to_proto(cls, data):
+    """Inverse of _proto_to_jsonable for a known message class."""
+    kw = {}
+    for _, name, spec in cls.FIELDS:
+        if name not in data:
+            continue
+        v = data[name]
+        kind = spec[0] if isinstance(spec, tuple) else spec
+        if kind in ("msg", "msg!"):
+            kw[name] = _jsonable_to_proto(spec[1], v)
+        elif kind == "rep":
+            inner = spec[1]
+            if isinstance(inner, tuple):  # ("msg"/"msg!", cls)
+                kw[name] = [_jsonable_to_proto(inner[1], x) for x in v]
+            elif inner == "bytes":
+                kw[name] = [bytes.fromhex(x) for x in v]
+            else:
+                kw[name] = list(v)
+        elif kind == "bytes":
+            kw[name] = bytes.fromhex(v)
+        else:
+            kw[name] = v
+    return cls(**kw)
+
+
+def cmd_wal2json(args) -> int:
+    """wal2json — decode a consensus WAL to JSON lines (reference
+    scripts/wal2json/main.go). Tolerates a torn tail unless --strict."""
+    import json as _json
+
+    from tmtpu.consensus.wal import WAL
+
+    for msg in WAL.iter_messages(args.wal_file, strict=args.strict):
+        print(_json.dumps(_proto_to_jsonable(msg)))
+    return 0
+
+
+def cmd_json2wal(args) -> int:
+    """json2wal — rebuild a WAL file from wal2json output (reference
+    scripts/json2wal/main.go; used to craft replay/corruption fixtures)."""
+    import json as _json
+    import struct
+    import zlib
+
+    from tmtpu.consensus.wal import WALMessagePB
+    from tmtpu.libs import protoio
+
+    with open(args.json_file) as jf, open(args.wal_file, "wb") as wf:
+        for line in jf:
+            line = line.strip()
+            if not line:
+                continue
+            msg = _jsonable_to_proto(WALMessagePB, _json.loads(line))
+            payload = msg.encode()
+            wf.write(struct.pack(">I", zlib.crc32(payload))
+                     + protoio.encode_uvarint(len(payload)) + payload)
+    return 0
+
+
 def cmd_signer_harness(args) -> int:
     """signer-harness — remote-signer conformance checks
     (tools/tm-signer-harness/main.go)."""
@@ -462,6 +540,18 @@ def main(argv=None) -> int:
                     default=7 * 24 * 3600.0, help="seconds")
     sp.add_argument("--laddr", default="tcp://127.0.0.1:8888")
     sp.set_defaults(fn=cmd_light)
+
+    sp = sub.add_parser("wal2json", help="decode a WAL to JSON lines")
+    sp.add_argument("wal_file")
+    sp.add_argument("--strict", action="store_true",
+                    help="fail on torn/corrupt records instead of stopping")
+    sp.set_defaults(fn=cmd_wal2json)
+
+    sp = sub.add_parser("json2wal",
+                        help="rebuild a WAL from wal2json output")
+    sp.add_argument("json_file")
+    sp.add_argument("wal_file")
+    sp.set_defaults(fn=cmd_json2wal)
 
     sp = sub.add_parser("signer-harness",
                         help="remote-signer conformance checks")
