@@ -325,7 +325,24 @@ def prepare_k1_batch_packed(pks, msgs, sigs):
         & nonzero_r & _lt_be(r_arr, _N_BE)
         & nonzero_s & _lt_be(s_arr, _HALF_N1_BE)  # s <= n/2 (low-S)
     )
-    # scalar work per lane (Python bigints): w = s^-1, u1 = h*w, u2 = r*w
+    # scalar work per lane (Python bigints): w = s^-1, u1 = h*w, u2 = r*w.
+    # The n inversions fold into ONE via Montgomery's batch-inversion
+    # trick (prefix products + a single pow(-1) + backward sweep): 9 ms
+    # vs 103 ms per 4096 lanes — host prep would otherwise bottleneck the
+    # fused kernel's device rate on this single-core host.
+    ok_idx = [i for i in range(B) if host_ok[i]]
+    svals = [int.from_bytes(s_arr[i], "big") for i in ok_idx]
+    w_of = {}
+    if svals:
+        prefix = [0] * len(svals)
+        acc = 1
+        for j, s in enumerate(svals):
+            prefix[j] = acc
+            acc = acc * s % N
+        inv_acc = pow(acc, -1, N)
+        for j in range(len(svals) - 1, -1, -1):
+            w_of[ok_idx[j]] = inv_acc * prefix[j] % N
+            inv_acc = inv_acc * svals[j] % N
     u1_list, u2_list, rpn_list = [], [], []
     for i in range(B):
         if not host_ok[i]:
@@ -334,9 +351,8 @@ def prepare_k1_batch_packed(pks, msgs, sigs):
             rpn_list.append(_DUMMY_SCALAR)
             continue
         r = int.from_bytes(r_arr[i], "big")
-        s = int.from_bytes(s_arr[i], "big")
         h = int.from_bytes(hashlib.sha256(bytes(msgs[i])).digest(), "big")
-        w = pow(s, -1, N)
+        w = w_of[i]
         u1_list.append((h * w % N).to_bytes(32, "big"))
         u2_list.append((r * w % N).to_bytes(32, "big"))
         rpn = r + N
