@@ -59,6 +59,57 @@ def test_grpc_async_checktx_with_callback():
         server.stop()
 
 
+def test_node_runs_against_grpc_app():
+    """A full node drives an OUT-OF-PROC app over the gRPC transport
+    (config base.abci = "grpc"): handshake, empty-block consensus,
+    broadcast_tx_commit, abci_query through the proxy's four gRPC
+    connections (reference: --abci grpc / proxy client.go transport
+    switch)."""
+    import time
+
+    from tmtpu.config.config import Config
+    from tmtpu.node.node import Node
+    from tmtpu.privval.file_pv import FilePV
+    from tmtpu.rpc.client import HTTPClient
+    from tmtpu.types.genesis import GenesisDoc, GenesisValidator
+    import tempfile
+    import pathlib
+
+    app = KVStoreApplication()
+    server = GRPCServer("tcp://127.0.0.1:0", app)
+    server.start()
+
+    home = pathlib.Path(tempfile.mkdtemp(prefix="tmtpu-grpc-node-"))
+    (home / "config").mkdir()
+    (home / "data").mkdir()
+    cfg = Config.test_config()
+    cfg.base.home = str(home)
+    cfg.base.crypto_backend = "cpu"
+    cfg.base.proxy_app = f"tcp://127.0.0.1:{server.listen_port}"
+    cfg.base.abci = "grpc"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    pv = FilePV.load_or_generate(
+        cfg.rooted(cfg.base.priv_validator_key_file),
+        cfg.rooted(cfg.base.priv_validator_state_file))
+    gen = GenesisDoc(chain_id="grpc-chain", genesis_time=time.time_ns(),
+                     validators=[GenesisValidator(pv.get_pub_key(), 10)])
+    gen.save_as(cfg.genesis_path)
+    n = Node(cfg)
+    n.start()
+    try:
+        cli = HTTPClient(f"http://127.0.0.1:{n.rpc_server.port}")
+        res = cli.broadcast_tx_commit(b"gk=gv")
+        assert res["deliver_tx"]["code"] == 0
+        q = cli.abci_query(path="/key", data="gk")
+        import base64
+
+        assert base64.b64decode(q["response"]["value"]) == b"gv"
+        assert n.block_store.height() >= 1
+    finally:
+        n.stop()
+        server.stop()
+
+
 def test_grpc_unknown_method_is_grpc_error():
     from tmtpu.abci.client import ClientError
 

@@ -69,7 +69,12 @@ class Node(BaseService):
                 app = Application()
             else:
                 app = config.base.proxy_app  # socket address
-        self.proxy_app = AppConns(default_client_creator(app))
+        # base.abci selects the remote transport; "local" only makes
+        # sense for in-proc apps, where the creator ignores it
+        transport = config.base.abci \
+            if config.base.abci in ("socket", "grpc") else "socket"
+        self.proxy_app = AppConns(
+            default_client_creator(app, transport=transport))
         self.proxy_app.start()
 
         # --- event bus + tx indexer (node.go createAndStartEventBus /

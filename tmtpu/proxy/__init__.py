@@ -29,11 +29,23 @@ class LocalClientCreator(ClientCreator):
 
 
 class RemoteClientCreator(ClientCreator):
-    def __init__(self, addr: str):
+    """Out-of-proc app over the socket protocol (default) or gRPC
+    (proxy/client.go NewRemoteClientCreator's transport switch; config
+    field ``base.abci``)."""
+
+    def __init__(self, addr: str, transport: str = "socket"):
+        if transport not in ("socket", "grpc"):
+            raise ValueError(f"unknown ABCI transport {transport!r}")
         self.addr = addr
+        self.transport = transport
 
     def new_abci_client(self) -> Client:
-        c = SocketClient(self.addr)
+        if self.transport == "grpc":
+            from tmtpu.abci.grpc import GRPCClient
+
+            c: Client = GRPCClient(self.addr)
+        else:
+            c = SocketClient(self.addr)
         c.start()
         return c
 
@@ -64,7 +76,8 @@ class AppConns:
                 c.stop()
 
 
-def default_client_creator(app_or_addr) -> ClientCreator:
+def default_client_creator(app_or_addr,
+                           transport: str = "socket") -> ClientCreator:
     if isinstance(app_or_addr, str):
-        return RemoteClientCreator(app_or_addr)
+        return RemoteClientCreator(app_or_addr, transport)
     return LocalClientCreator(app_or_addr)
