@@ -95,8 +95,9 @@ def _probe_device_backend() -> bool:
         return False
 
 
-def _init_backend() -> str:
-    """Win a device backend within PROBE_BUDGET_S, else CPU fallback.
+def _init_backend_probe() -> str:
+    """Win a device backend within PROBE_BUDGET_S, else report "cpu" —
+    pure subprocess probing, NO jax state in this process.
 
     VERDICT r2 weak #1: a wedged tunnel outlasted two 180 s probes and the
     driver recorded the CPU number. Wedges are transient, so keep probing
@@ -127,10 +128,38 @@ def _init_backend() -> str:
         time.sleep(pause)
     print(f"bench: no device backend after {attempt} attempts / "
           f"{PROBE_BUDGET_S:.0f}s — falling back to CPU", file=sys.stderr)
+    return "cpu"
+
+
+def _force_cpu() -> None:
+    """Pin this process to the CPU backend (env vars are not enough —
+    this image's sitecustomize force-sets jax_platforms=axon in config)."""
     from tmtpu.tpu.compat import force_cpu_backend
 
     force_cpu_backend(1)
-    return "cpu"
+
+
+def _init_backend() -> str:
+    """Compat entry for tools/curve_bench.py: probe, and when the answer
+    is CPU force the CPU backend in-process (the tool then measures the
+    CPU path)."""
+    backend = _init_backend_probe()
+    if backend == "cpu":
+        _force_cpu()
+    return backend
+
+
+def _emit_with_provenance(json_line: str, parent_attempts) -> None:
+    """Merge the parent's probe provenance into the child's JSON line and
+    print the single final line."""
+    out = json.loads(json_line)
+    probe = out.setdefault("probe", {})
+    probe["attempts"] = len(_probe_log)
+    probe["log"] = _probe_log[-6:]
+    probe["budget_s"] = PROBE_BUDGET_S
+    if parent_attempts:
+        probe["parent_fallbacks"] = parent_attempts
+    print(json.dumps(out))
 
 
 def _make_votes(n: int):
@@ -164,8 +193,74 @@ def _make_votes(n: int):
     return pks, msgs, sigs
 
 
+def _run_child(backend: str, timeout_s: float):
+    """Run the measurement in a CHILD process pinned to ``backend``.
+
+    The wedge-prone tunnel can die MID-measurement (observed: the
+    remote-compile endpoint dropped between two curve passes), and a
+    process whose jax already initialized the device backend cannot fall
+    back to CPU in-process — so the parent holds no jax state at all and
+    simply re-runs the child on CPU if the device child dies. Returns the
+    child's JSON line (str) or None."""
+    env = dict(os.environ)
+    # the child branch pins CPU via force_cpu_backend(1) — this image's
+    # sitecustomize overrides JAX_PLATFORMS, so env alone would not do it
+    env["TMTPU_BENCH_CHILD"] = backend
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        stdout=subprocess.PIPE, stderr=sys.stderr,
+        env=env, start_new_session=True, text=True,
+    )
+    timed_out = False
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        import signal
+
+        timed_out = True
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        # drain whatever the child already printed: a measurement can
+        # complete and THEN wedge in PJRT teardown on the dead tunnel —
+        # the finished JSON is sitting in the pipe buffer
+        out, _ = proc.communicate()
+        print(f"bench: {backend} child timed out after {timeout_s:.0f}s",
+              file=sys.stderr)
+    lines = [ln for ln in (out or "").splitlines()
+             if ln.startswith("{") and '"metric"' in ln]
+    if lines and (timed_out or proc.returncode == 0):
+        return lines[-1]
+    print(f"bench: {backend} child rc={proc.returncode}, "
+          f"{len(lines)} JSON lines", file=sys.stderr)
+    return None
+
+
 def main():
-    backend = _init_backend()
+    if not os.environ.get("TMTPU_BENCH_CHILD"):
+        # PARENT: no jax state; probe, then delegate to children
+        t0 = time.perf_counter()
+        backend = _init_backend_probe()
+        attempts = []
+        if backend == "device":
+            out = _run_child("device", timeout_s=2400)
+            if out is not None:
+                _emit_with_provenance(out, attempts)
+                return
+            attempts.append("device-child-failed")
+        out = _run_child("cpu", timeout_s=2400)
+        if out is None:
+            raise RuntimeError(f"no bench child produced a result "
+                               f"(attempts: {attempts})")
+        _emit_with_provenance(out, attempts)
+        print(f"bench: total wall {time.perf_counter() - t0:.0f}s",
+              file=sys.stderr)
+        return
+
+    backend = os.environ["TMTPU_BENCH_CHILD"]
+    if backend == "cpu":
+        _force_cpu()
     import jax
     import jax.numpy as jnp
     import numpy as np
