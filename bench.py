@@ -244,12 +244,15 @@ def main():
         backend = _init_backend_probe()
         attempts = []
         if backend == "device":
-            out = _run_child("device", timeout_s=2400)
+            # expected device run ~12 min (compile + structures + curves);
+            # 25 min cap keeps the worst case (probe budget + dead device
+            # child + CPU child) inside ~65 min of driver wall
+            out = _run_child("device", timeout_s=1500)
             if out is not None:
                 _emit_with_provenance(out, attempts)
                 return
             attempts.append("device-child-failed")
-        out = _run_child("cpu", timeout_s=2400)
+        out = _run_child("cpu", timeout_s=1200)
         if out is None:
             raise RuntimeError(f"no bench child produced a result "
                                f"(attempts: {attempts})")
