@@ -143,6 +143,22 @@ def curve_measurements(lanes_sr: int, lanes_k1: int, backend: str) -> dict:
         except Exception as e:  # noqa: BLE001
             out[name] = {"error": repr(e)}
             print(f"curve_bench: {name} FAILED: {e!r}", file=sys.stderr)
+            continue
+        # Persist on-chip evidence immediately — the tunnel can wedge
+        # before the next curve finishes (VERDICT r3 #1). Outside the
+        # measurement try (a cache-path surprise must not erase a number
+        # already measured), and guarded on the MEASURED platform, not
+        # the caller's backend string.
+        try:
+            import jax
+
+            from tools import devcache
+
+            if jax.devices()[0].platform != "cpu":
+                devcache.record(name, out[name])
+        except Exception as e:  # noqa: BLE001
+            print(f"curve_bench: cache record skipped: {e!r}",
+                  file=sys.stderr)
     return out
 
 

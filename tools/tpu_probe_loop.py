@@ -1,0 +1,58 @@
+"""Background tunnel watcher: probe the TPU in subprocesses until it is
+alive, then exit 0. Writes a JSONL log to /tmp/tpu_probe.jsonl and a flag
+file /tmp/tpu_alive when a probe succeeds.
+
+The axon tunnel on this box wedges for minutes-to-hours; jax.devices()
+can hang indefinitely, so every probe is a killable subprocess
+(bench.py's _probe_device_backend discipline)."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+PROBE_TIMEOUT = 90.0
+INTERVAL = 45.0
+BUDGET = float(os.environ.get("TPU_PROBE_BUDGET", 6 * 3600))
+LOG = "/tmp/tpu_probe.jsonl"
+FLAG = "/tmp/tpu_alive"
+
+code = ("import jax; ds = jax.devices(); "
+        "import sys; sys.exit(0 if ds and ds[0].platform != 'cpu' else 3)")
+
+t_start = time.time()
+attempt = 0
+while time.time() - t_start < BUDGET:
+    attempt += 1
+    t0 = time.time()
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL,
+                            start_new_session=True)
+    try:
+        rc = proc.wait(timeout=PROBE_TIMEOUT)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        try:
+            proc.wait(timeout=10)  # reap — no zombie per timed-out probe
+        except subprocess.TimeoutExpired:
+            pass
+        rc = "timeout"
+    dt = time.time() - t0
+    with open(LOG, "a") as f:
+        f.write(json.dumps({"t": round(time.time()), "attempt": attempt,
+                            "rc": rc, "s": round(dt, 1)}) + "\n")
+    if rc == 0:
+        with open(FLAG, "w") as f:
+            f.write(json.dumps({"alive_at": time.time(),
+                                "attempt": attempt}))
+        print(f"TPU ALIVE after {attempt} attempts, "
+              f"{time.time() - t_start:.0f}s")
+        sys.exit(0)
+    time.sleep(INTERVAL)
+print(f"budget exhausted after {attempt} attempts")
+sys.exit(1)
