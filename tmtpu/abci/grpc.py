@@ -8,7 +8,7 @@ HTTP/2 framing, HPACK, length-prefixed messages, ``grpc-status``
 trailers, ``/tendermint.abci.ABCIApplication/<Method>`` paths — through
 the from-scratch stack in tmtpu.libs.h2. The tmtpu client and server
 fully interoperate with each other; the documented protocol limits
-(no Huffman HPACK strings, h2c only) live in tmtpu/libs/h2.py. The
+(h2c prior-knowledge only; HPACK incl. Huffman decoding) live in tmtpu/libs/h2.py. The
 socket transport remains the production default, as in the reference.
 """
 

@@ -10,12 +10,11 @@ under abci/client/grpc_client.go). Scope — documented, not hidden:
 - frames: DATA, HEADERS(+CONTINUATION), RST_STREAM, SETTINGS, PING,
   GOAWAY, WINDOW_UPDATE; others are ignored per RFC 7540 §4.1;
 - HPACK: full static table, dynamic-table *decoding* (incremental
-  indexing + size updates), encoding as literal-never-indexed (always
-  valid, stateless); Huffman-coded strings are rejected with a clear
-  error — this codec's own encoder never emits them, so the tmtpu
-  client/server pair round-trips; foreign clients that Huffman-encode
-  (most do by default) need the socket transport, which remains the
-  production ABCI path as in the reference;
+  indexing + size updates), Huffman *decoding* (RFC 7541 Appendix B,
+  tmtpu/libs/hpack_huffman.py) so foreign gRPC clients — which
+  Huffman-encode header strings by default, as grpc-go does behind the
+  reference's abci/server/grpc_server.go — interoperate; encoding stays
+  literal-never-indexed with raw strings (always valid, stateless);
 - flow control: both sides advertise large windows up front
   (SETTINGS_INITIAL_WINDOW_SIZE + a connection WINDOW_UPDATE) and the
   sender chunks DATA to 16 KiB frames while honoring the peer's
@@ -87,7 +86,8 @@ def read_frame(rfile):
 
 # ---------------------------------------------------------------------------
 # HPACK (RFC 7541). Encoding: literal-never-indexed only (stateless,
-# always valid). Decoding: static + dynamic tables, no Huffman.
+# always valid). Decoding: static + dynamic tables + Huffman strings
+# (tmtpu/libs/hpack_huffman.py).
 
 _STATIC_TABLE = [
     (":authority", ""), (":method", "GET"), (":method", "POST"),
@@ -189,10 +189,12 @@ class HpackDecoder:
         raw = data[pos : pos + length]
         pos += length
         if huffman:
-            raise H2Error(
-                "HPACK Huffman-coded string: not supported by this "
-                "minimal codec — use the socket ABCI transport for "
-                "foreign gRPC clients")
+            from tmtpu.libs import hpack_huffman
+
+            try:
+                raw = hpack_huffman.decode(raw)
+            except hpack_huffman.HuffmanError as e:
+                raise H2Error(f"HPACK Huffman string: {e}") from e
         return raw.decode("utf-8", "surrogateescape"), pos
 
     def decode(self, data: bytes):

@@ -118,10 +118,14 @@ def _batch_verify_mixed(pk_objs, msgs, sigs):
     return np.asarray(mask)
 
 
-def curve_measurements(lanes_sr: int, lanes_k1: int, backend: str) -> dict:
+def curve_measurements(lanes_sr: int, lanes_k1: int, backend: str,
+                       only=None) -> dict:
     """sr25519 + secp256k1 + mixed-set device-path rates keyed by curve;
     failures are recorded per curve (a flaky tunnel RPC during one curve's
-    pass must not lose the others' numbers)."""
+    pass must not lose the others' numbers). ``only``: optional iterable
+    of curve names to measure (signature generation for the skipped
+    curves is skipped too — pure-Python k1 keygen is minutes at 4k+
+    lanes)."""
     from tmtpu.crypto import secp256k1 as k1
     from tmtpu.crypto import sr25519 as sr
     from tmtpu.tpu import k1_verify as kv
@@ -137,6 +141,8 @@ def curve_measurements(lanes_sr: int, lanes_k1: int, backend: str) -> dict:
          _batch_verify_mixed,
          lambda pk, m, s: pk.verify_signature(m, s)),
     ):
+        if only is not None and name not in only:
+            continue
         try:
             out[name] = measure_curve(name, lanes, gen, batch_fn,
                                       serial_fn, backend=backend)
@@ -167,7 +173,17 @@ def main():
     ap.add_argument("--lanes-sr", type=int, default=512)
     ap.add_argument("--lanes-k1", type=int, default=2048)
     ap.add_argument("--backend", default="auto", choices=("auto", "cpu"))
+    ap.add_argument("--curves", default=None,
+                    help="comma list: sr25519,secp256k1,mixed (default all)")
     args = ap.parse_args()
+    only = None
+    if args.curves:
+        only = {c.strip() for c in args.curves.split(",") if c.strip()}
+        known = {"sr25519", "secp256k1", "mixed"}
+        bad = only - known
+        if bad or not only:
+            ap.error(f"unknown curves {sorted(bad)}; choose from "
+                     f"{sorted(known)}")
 
     # the axon tunnel can wedge backend init indefinitely — reuse
     # bench.py's hardened init (subprocess probe with hard timeout,
@@ -187,7 +203,8 @@ def main():
         args.lanes_k1 = min(args.lanes_k1, 64)
 
     backend = "device" if device else "cpu"
-    results = curve_measurements(args.lanes_sr, args.lanes_k1, backend)
+    results = curve_measurements(args.lanes_sr, args.lanes_k1, backend,
+                                 only=only)
     for res in results.values():
         print(json.dumps(res))
     sys.exit(0 if all("error" not in r for r in results.values()) else 1)

@@ -56,7 +56,9 @@ def record(kind: str, payload: dict) -> None:
             "git_rev": _git_rev(),
             "payload": payload,
         }
-        os.makedirs(os.path.dirname(CACHE_PATH), exist_ok=True)
+        parent = os.path.dirname(CACHE_PATH)
+        if parent:  # bare-filename override: cwd needs no makedirs
+            os.makedirs(parent, exist_ok=True)
         line = json.dumps(entry) + "\n"
         fd = os.open(CACHE_PATH, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
                      0o644)

@@ -1,0 +1,144 @@
+"""On-chip measurement battery: run every device measurement the moment
+the tunnel is alive (VERDICT r3 #1/#2/#3/#7).
+
+The axon tunnel wedges for hours at a time; when it IS alive, this
+script fires the full measurement list serially (single chip, single
+host core), each step in its own subprocess with a hard timeout so a
+mid-battery wedge cannot hang the battery. Every step records its own
+results to the device cache (tools/devcache.py) the moment they exist,
+so partial batteries still bank evidence.
+
+Steps (ordered by evidence value):
+  1. bench.py               — ed25519 10k-VoteSet e2e headline
+  2. k1_sweep               — secp256k1 fused-kernel tile sweep (first
+                              ever on-chip k1 numbers) + e2e at best tile
+  3. curve_bench sr 8192    — sr25519 at amortizing lane count
+  4. tpu_live_round         — live 10k-validator round, proposal->commit
+  5. tpu_live_round --mixed — 3-curve valset live round (chip dispatches
+                              all three curve kernels in one commit)
+  6. curve_bench sr 16384   — sr25519 deeper amortization point
+
+Between steps the tunnel is re-probed (60 s subprocess); after
+PROBE_GRACE consecutive dead probes the battery exits, keeping whatever
+was banked.
+
+Usage: python tools/device_battery.py [--steps 1,2,3,4,5,6]
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+PY = sys.executable
+
+STEPS = {
+    1: ("bench_ed25519", [PY, "bench.py"], 2400,
+        {"TMTPU_BENCH_PROBE_BUDGET": "300"}),
+    2: ("k1_sweep", [PY, "tools/k1_sweep.py", "--lanes", "4096"], 2400, {}),
+    3: ("sr_8192", [PY, "tools/curve_bench.py", "--curves", "sr25519",
+                    "--lanes-sr", "8192"], 2400,
+        {"TMTPU_BENCH_PROBE_BUDGET": "300"}),
+    4: ("live_round_10k", [PY, "tools/tpu_live_round.py"], 2400, {}),
+    5: ("live_round_mixed", [PY, "tools/tpu_live_round.py", "--mixed",
+                             "--co", "999"], 2400, {}),
+    6: ("sr_16384", [PY, "tools/curve_bench.py", "--curves", "sr25519",
+                     "--lanes-sr", "16384"], 2400,
+        {"TMTPU_BENCH_PROBE_BUDGET": "300"}),
+}
+
+
+def probe_alive(timeout=60.0) -> bool:
+    code = ("import jax; ds = jax.devices(); "
+            "import sys; sys.exit(0 if ds and ds[0].platform != 'cpu' "
+            "else 3)")
+    proc = subprocess.Popen([PY, "-c", code], stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL,
+                            start_new_session=True)
+    try:
+        return proc.wait(timeout=timeout) == 0
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+        return False
+
+
+def run_step(name, cmd, timeout, env_extra) -> dict:
+    t0 = time.time()
+    env = dict(os.environ, **env_extra)
+    proc = subprocess.Popen(cmd, cwd=REPO, env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT,
+                            start_new_session=True, text=True)
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        out, _ = proc.communicate()
+        rc = "timeout"
+    dt = time.time() - t0
+    tail = "\n".join((out or "").splitlines()[-25:])
+    print(f"=== {name}: rc={rc} in {dt:.0f}s ===\n{tail}\n",
+          file=sys.stderr, flush=True)
+    return {"name": name, "rc": rc, "s": round(dt), "tail": tail[-2000:]}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", default="1,2,3,4,5,6")
+    ap.add_argument("--probe-grace", type=int, default=3,
+                    help="consecutive dead probes before aborting")
+    args = ap.parse_args()
+    order = [int(s) for s in args.steps.split(",")]
+
+    results = []
+    dead = 0
+    for s in order:
+        name, cmd, timeout, env_extra = STEPS[s]
+        while not probe_alive():
+            dead += 1
+            print(f"battery: tunnel dead before {name} "
+                  f"({dead}/{args.probe_grace})", file=sys.stderr,
+                  flush=True)
+            if dead >= args.probe_grace:
+                print("battery: tunnel stayed dead — stopping, "
+                      f"{len(results)} steps banked", file=sys.stderr)
+                _emit(results, aborted=True)
+                return
+            time.sleep(60)
+        dead = 0
+        results.append(run_step(name, cmd, timeout, env_extra))
+    _emit(results, aborted=False)
+
+
+def _emit(results, aborted):
+    summary = {"battery": results, "aborted": aborted,
+               "done_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                        time.gmtime())}
+    print(json.dumps(summary))
+    path = os.path.join(REPO, "artifacts",
+                        "battery_%d.json" % int(time.time()))
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(summary, f, indent=1)
+    print(f"battery: summary -> {path}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
