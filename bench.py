@@ -202,8 +202,8 @@ def _merge_cached_device(cpu_out: dict) -> dict:
 
     def _best(kind):
         es = [e for e in entries if e.get("kind") == kind
-              and isinstance((e.get("payload") or {}).get("value"),
-                             (int, float))]
+              and isinstance(e.get("payload"), dict)
+              and isinstance(e["payload"].get("value"), (int, float))]
         return max(es, key=lambda e: e["payload"]["value"], default=None)
 
     # headline = FRESHEST cached device run of the same metric (never the

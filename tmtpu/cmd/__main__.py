@@ -153,21 +153,26 @@ def cmd_show_validator(args) -> int:
     cfg = _load_config(args.home)
     pv = FilePV.load(cfg.rooted(cfg.base.priv_validator_key_file),
                      cfg.rooted(cfg.base.priv_validator_state_file))
+    from tmtpu.libs import amino_json
+
     pub = pv.get_pub_key()
-    print(json.dumps({"type": pub.type_value(),
-                      "value": pub.bytes().hex()}))
+    # reference `tendermint show-validator` prints the amino JSON form
+    print(json.dumps(amino_json.marshal_pub_key(pub)))
     return 0
 
 
 def cmd_gen_validator(args) -> int:
     from tmtpu.crypto import ed25519
+    from tmtpu.libs import amino_json
 
     priv = ed25519.gen_priv_key()
     pub = priv.pub_key()
+    # amino JSON shape (cmd/tendermint/commands/gen_validator.go) so the
+    # output pastes into a reference genesis/priv_validator_key file
     print(json.dumps({
         "address": pub.address().hex().upper(),
-        "pub_key": {"type": "ed25519", "value": pub.bytes().hex()},
-        "priv_key": {"type": "ed25519", "value": priv.bytes().hex()},
+        "pub_key": amino_json.marshal_pub_key(pub),
+        "priv_key": amino_json.marshal_priv_key(priv),
     }, indent=2))
     return 0
 

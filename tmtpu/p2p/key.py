@@ -28,14 +28,18 @@ class NodeKey:
 
     @classmethod
     def load_or_gen(cls, path: str) -> "NodeKey":
+        """node_key.json in the reference's amino form (p2p/key.go
+        NodeKey through libs/json: tendermint/PrivKeyEd25519 + base64);
+        legacy tmtpu hex files still load."""
+        from tmtpu.libs import amino_json
+
         if os.path.exists(path):
             with open(path) as f:
                 d = json.load(f)
-            return cls(ed25519.PrivKeyEd25519(
-                bytes.fromhex(d["priv_key"]["value"])))
+            return cls(amino_json.unmarshal_priv_key(d["priv_key"]))
         nk = cls.generate()
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         with open(path, "w") as f:
-            json.dump({"priv_key": {"type": "ed25519",
-                                    "value": nk.priv_key.bytes().hex()}}, f)
+            json.dump(
+                {"priv_key": amino_json.marshal_priv_key(nk.priv_key)}, f)
         return nk

@@ -16,7 +16,6 @@ import tempfile
 from typing import Optional, Tuple
 
 from tmtpu.crypto import ed25519
-from tmtpu.crypto.keys import KEY_TYPES
 from tmtpu.libs import protoio
 from tmtpu.types import pb
 from tmtpu.types.priv_validator import PrivValidator
@@ -89,13 +88,11 @@ class FilePV(PrivValidator):
 
     @classmethod
     def load(cls, key_file: str, state_file: str) -> "FilePV":
+        from tmtpu.libs import amino_json
+
         with open(key_file) as f:
             kd = json.load(f)
-        ktype = kd["priv_key"]["type"]
-        entry = KEY_TYPES.get(ktype)
-        if entry is None:
-            raise ValueError(f"unknown key type {ktype!r}")
-        pv = cls(entry[1](bytes.fromhex(kd["priv_key"]["value"])),
+        pv = cls(amino_json.unmarshal_priv_key(kd["priv_key"]),
                  key_file, state_file)
         # file.go LoadFilePV fails loudly when the state file is unreadable:
         # a silently-fresh sign state would disable double-sign protection.
@@ -105,11 +102,13 @@ class FilePV(PrivValidator):
                 f"start with empty sign state (double-sign risk)")
         with open(state_file) as f:
             sd = json.load(f)
+        # reference state form (privval/file.go:76-80): height is an
+        # int64 -> string, signature base64, signbytes hex; legacy tmtpu
+        # files had int height + hex signature — accept both
         pv.height = int(sd.get("height", 0))
         pv.round = int(sd.get("round", 0))
         pv.step = int(sd.get("step", 0))
-        sig = sd.get("signature")
-        pv.signature = bytes.fromhex(sig) if sig else None
+        pv.signature = amino_json.bytes_from_b64(sd.get("signature"))
         sb = sd.get("signbytes")
         pv.sign_bytes = bytes.fromhex(sb) if sb else None
         return pv
@@ -124,22 +123,33 @@ class FilePV(PrivValidator):
         return cls.generate(key_file, state_file, key_type)
 
     def save(self) -> None:
+        """Write the key file in the reference's amino JSON form
+        (privval/file.go FilePVKey through libs/json): base64 values
+        under tendermint/PrivKey* type tags — loadable by the reference
+        and round-trippable here."""
+        from tmtpu.libs import amino_json
+
         pub = self.priv_key.pub_key()
         _atomic_write(self.key_file, json.dumps({
             "address": pub.address().hex().upper(),
-            "pub_key": {"type": pub.type_value(),
-                        "value": pub.bytes().hex()},
-            "priv_key": {"type": self.priv_key.type_value(),
-                         "value": self.priv_key.bytes().hex()},
+            "pub_key": amino_json.marshal_pub_key(pub),
+            "priv_key": amino_json.marshal_priv_key(self.priv_key),
         }, indent=2))
         self._save_state()
 
     def _save_state(self) -> None:
-        _atomic_write(self.state_file, json.dumps({
-            "height": self.height, "round": self.round, "step": self.step,
-            "signature": self.signature.hex() if self.signature else None,
-            "signbytes": self.sign_bytes.hex() if self.sign_bytes else None,
-        }, indent=2))
+        """Reference FilePVLastSignState shape (privval/file.go:76-80):
+        height as string (amino int64), round/step numeric, signature
+        base64, signbytes uppercase hex."""
+        from tmtpu.libs import amino_json
+
+        d = {"height": str(self.height), "round": self.round,
+             "step": self.step}
+        if self.signature:
+            d["signature"] = amino_json.b64_or_none(self.signature)
+        if self.sign_bytes:
+            d["signbytes"] = self.sign_bytes.hex().upper()
+        _atomic_write(self.state_file, json.dumps(d, indent=2))
 
     # -- PrivValidator ------------------------------------------------------
 

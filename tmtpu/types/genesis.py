@@ -7,7 +7,7 @@ import time
 from typing import List, Optional
 
 from tmtpu.crypto import tmhash
-from tmtpu.crypto.keys import KEY_TYPES, PubKey
+from tmtpu.crypto.keys import PubKey
 from tmtpu.types.params import ConsensusParams
 from tmtpu.types.validator import Validator, ValidatorSet
 
@@ -65,10 +65,20 @@ class GenesisDoc:
         return tmhash.sum(self.to_json().encode())
 
     # -- JSON round-trip (genesis.json on disk) -----------------------------
+    #
+    # Wire shape matches the reference's amino JSON (types/genesis.go
+    # marshaled through libs/json): genesis_time as RFC3339Nano, 64-bit
+    # ints as strings, pub keys as {"type": "tendermint/PubKeyEd25519",
+    # "value": "<base64>"}, app_hash as hex — so a reference-generated
+    # genesis.json loads here unchanged and vice versa. from_json also
+    # accepts the legacy tmtpu form (int genesis_time, bare type names,
+    # hex values) written by earlier rounds.
 
     def to_json(self) -> str:
+        from tmtpu.libs import amino_json
+
         return json.dumps({
-            "genesis_time": self.genesis_time,
+            "genesis_time": amino_json.rfc3339_from_ns(self.genesis_time),
             "chain_id": self.chain_id,
             "initial_height": str(self.initial_height),
             "consensus_params": {
@@ -93,8 +103,7 @@ class GenesisDoc:
             "validators": [
                 {
                     "address": v.address.hex().upper(),
-                    "pub_key": {"type": v.pub_key.type_value(),
-                                "value": v.pub_key.bytes().hex()},
+                    "pub_key": amino_json.marshal_pub_key(v.pub_key),
                     "power": str(v.power),
                     "name": v.name,
                 }
@@ -122,18 +131,19 @@ class GenesisDoc:
             pub_key_types=vp.get("pub_key_types", ["ed25519"]),
             app_version=int(ver.get("app_version", 0)),
         )
+        from tmtpu.libs import amino_json
+
         vals = []
         for v in d.get("validators", []):
-            ktype = v["pub_key"]["type"]
-            entry = KEY_TYPES.get(ktype)
-            if entry is None:
-                raise ValueError(f"unknown pubkey type {ktype!r}")
-            pk = entry[0](bytes.fromhex(v["pub_key"]["value"]))
+            pk = amino_json.unmarshal_pub_key(v["pub_key"])
             vals.append(GenesisValidator(pk, int(v["power"]),
                                          v.get("name", "")))
+        gt = d.get("genesis_time", 0)
+        if isinstance(gt, str):
+            gt = amino_json.ns_from_rfc3339(gt)  # reference RFC3339 form
         doc = cls(
             chain_id=d["chain_id"],
-            genesis_time=int(d.get("genesis_time", 0)),
+            genesis_time=int(gt),
             initial_height=int(d.get("initial_height", 1)),
             consensus_params=params,
             validators=vals,

@@ -106,6 +106,9 @@ def main():
                     help="consecutive dead probes before aborting")
     args = ap.parse_args()
     order = [int(s) for s in args.steps.split(",")]
+    unknown = [s for s in order if s not in STEPS]
+    if unknown:
+        ap.error(f"unknown steps {unknown}; valid: {sorted(STEPS)}")
 
     results = []
     dead = 0

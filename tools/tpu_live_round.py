@@ -78,7 +78,10 @@ def main():
     crypto_batch._default_backend = "tpu"
     crypto_batch._tpu_usable = True
     bucket = args.bucket
-    tv._pad_to_bucket = lambda n: bucket
+    real_pad = tv._pad_to_bucket
+    # one big jit bucket so the big compile happens once — but a drained
+    # batch larger than the bucket must still pad UP, not negative-pad
+    tv._pad_to_bucket = lambda n: max(real_pad(n), bucket)
 
     n_co = args.co
     t0 = time.perf_counter()
