@@ -1,0 +1,210 @@
+"""Fast sync v2 (tmtpu/blocksync/v2/ — reference blockchain/v2/): the
+scheduler and processor are pure state machines, so their reference
+semantics (scheduler.go/processor.go) are asserted event-by-event with
+no network; then a real late node joins a live 4-validator TCP net with
+``block_sync.version = "v2"`` and catches up through the batched-run
+verification path."""
+
+import time
+
+import pytest
+
+from tmtpu.blocksync.v2.processor import Processor
+from tmtpu.blocksync.v2.scheduler import (
+    BlockRequest, Finished, PeerError, Scheduler,
+)
+
+
+def _reqs(events):
+    return [(e.peer_id, e.height) for e in events
+            if isinstance(e, BlockRequest)]
+
+
+def test_scheduler_happy_path_to_finished():
+    s = Scheduler(1, target_pending=4, max_pending_per_peer=4)
+    s.add_peer("p1", now=0.0)
+    assert s.tick(0.0) == []  # peer not ready until a status arrives
+    assert s.status("p1", 1, 3, now=0.1) == []
+    out = s.tick(0.2)
+    assert _reqs(out) == [("p1", 1), ("p1", 2), ("p1", 3)]
+    assert s.tick(0.3) == []  # no double-requests while pending
+    for h in (1, 2, 3):
+        assert s.block_received("p1", h, 100, now=0.4) == []
+    assert s.processed(1) == []
+    assert s.processed(2) == []
+    fin = s.processed(3)
+    assert any(isinstance(e, Finished) for e in fin)
+    assert s.finished and s.height == 4
+
+
+def test_scheduler_spreads_load_and_respects_ranges():
+    s = Scheduler(1, target_pending=8, max_pending_per_peer=2)
+    s.status("a", 1, 10, now=0.0)
+    s.status("b", 5, 10, now=0.0)  # b pruned below height 5
+    reqs = _reqs(s.tick(0.1))
+    # per-peer cap 2 ⇒ 4 requests total; heights 1-2 can only go to a
+    by_peer = {}
+    for pid, h in reqs:
+        by_peer.setdefault(pid, []).append(h)
+    assert len(by_peer["a"]) == 2 and len(by_peer["b"]) == 2
+    assert set(by_peer["a"]) == {1, 2}  # b's base excludes them
+    assert all(h >= 5 for h in by_peer["b"])
+
+
+def test_scheduler_peer_timeout_reschedules():
+    s = Scheduler(1, peer_timeout_s=5.0, target_pending=4)
+    s.status("slow", 1, 2, now=0.0)
+    s.status("ok", 1, 2, now=0.0)
+    first = dict(_reqs(s.tick(0.1)))
+    assert set(first.values()) == {1, 2}
+    # "ok" stays fresh via a later status; "slow" goes silent
+    s.status("ok", 1, 2, now=4.0)
+    out = s.tick(6.0)
+    errs = [e for e in out if isinstance(e, PeerError)]
+    assert [e.peer_id for e in errs] == ["slow"]
+    assert "slow" not in s.peers
+    # slow's heights were rescheduled onto ok in the same tick
+    assert all(pid == "ok" for pid, _ in _reqs(out)) and _reqs(out)
+
+
+def test_scheduler_rejects_unsolicited_and_regression():
+    # a block from a peer that was never asked for that height
+    s = Scheduler(1, target_pending=2)
+    s.status("honest", 1, 5, now=0.0)
+    s.tick(0.1)  # both requests go to honest
+    s.status("liar", 1, 5, now=0.0)
+    out = s.block_received("liar", 1, 10, now=0.2)
+    assert any(isinstance(e, PeerError) for e in out)
+    assert "liar" not in s.peers
+    # a peer whose reported height regresses is errored
+    s2 = Scheduler(1)
+    s2.status("p", 1, 50, now=0.0)
+    out = s2.status("p", 1, 10, now=1.0)
+    assert any(isinstance(e, PeerError) for e in out)
+    assert "p" not in s2.peers
+
+
+def test_scheduler_verification_failure_punishes_both_suppliers():
+    s = Scheduler(1, target_pending=4, max_pending_per_peer=1)
+    s.status("a", 1, 2, now=0.0)
+    s.status("b", 1, 2, now=0.0)
+    reqs = dict((h, pid) for pid, h in _reqs(s.tick(0.1)))
+    assert set(reqs) == {1, 2} and len(set(reqs.values())) == 2
+    s.block_received(reqs[1], 1, 10, now=0.2)
+    s.block_received(reqs[2], 2, 10, now=0.2)
+    out = s.verification_failure(1)
+    errd = {e.peer_id for e in out if isinstance(e, PeerError)}
+    assert errd == {"a", "b"}  # both h and h+1 suppliers
+    assert not s.peers
+    # heights are back to new: a fresh peer gets them re-requested
+    s.status("c", 1, 2, now=0.3)
+    s.max_pending_per_peer = 4
+    assert sorted(h for _, h in _reqs(s.tick(0.4))) == [1, 2]
+
+
+def test_scheduler_no_block_removes_peer():
+    s = Scheduler(1, target_pending=1)
+    s.status("p", 1, 3, now=0.0)
+    s.tick(0.1)
+    out = s.no_block("p", 1)
+    assert any(isinstance(e, PeerError) for e in out)
+    assert "p" not in s.peers
+
+
+def test_processor_runs_and_failures():
+    p = Processor(5, max_run=3)
+    p.enqueue(4, "stale", "x")      # below height: ignored
+    p.enqueue(7, "b7", "p1")
+    assert p.next_run() == []       # gap at 5
+    p.enqueue(5, "b5", "p1")
+    p.enqueue(6, "b6", "p2")
+    p.enqueue(6, "dup", "p3")       # duplicate ignored (first kept)
+    run = p.next_run()
+    assert [(q.height, q.block) for q in run] == \
+        [(5, "b5"), (6, "b6"), (7, "b7")]
+    p.applied(2)
+    assert p.height == 7 and 5 not in p.queue and 6 not in p.queue
+    p.enqueue(8, "b8", "p4")
+    a, b = p.failed(7)
+    assert (a, b) == ("p1", "p4")
+    assert p.next_run() == []
+    # purge drops a peer's blocks
+    p.enqueue(7, "b7'", "p9")
+    p.enqueue(8, "b8'", "p9")
+    assert sorted(p.purge_peer("p9")) == [7, 8]
+    assert p.queue == {}
+
+
+def test_processor_run_cap_includes_verifier_block():
+    p = Processor(1, max_run=2)
+    for h in range(1, 6):
+        p.enqueue(h, f"b{h}", "p")
+    # cap 2 applied blocks + 1 verifying successor
+    assert [q.height for q in p.next_run()] == [1, 2, 3]
+
+
+@pytest.mark.slow
+def test_late_node_v2_fast_syncs_and_joins_consensus(tmp_path):
+    """The live half: same harness as the v0 joiner test, but the
+    joiner runs block_sync.version=v2 — scheduler-driven requests over
+    real TCP, contiguous runs verified in batched dispatches, handover
+    to live consensus."""
+    from tmtpu.blocksync.v2 import BlocksyncReactorV2
+    from tmtpu.config.config import Config
+    from tmtpu.node.node import Node
+    from tmtpu.privval.file_pv import FilePV
+    from tests.test_p2p import _mk_net_nodes
+
+    nodes = _mk_net_nodes(4, tmp_path)
+    joiner = None
+    try:
+        for nd in nodes:
+            nd.start()
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and \
+                any(nd.switch.num_peers() < 3 for nd in nodes):
+            time.sleep(0.1)
+        for nd in nodes:
+            assert nd.consensus.wait_for_height(15, timeout=180), \
+                f"stuck at {nd.consensus.rs.height_round_step()}"
+
+        home = tmp_path / "joiner-v2"
+        (home / "config").mkdir(parents=True)
+        (home / "data").mkdir(parents=True)
+        cfg = Config.test_config()
+        cfg.base.home = str(home)
+        cfg.base.crypto_backend = "cpu"
+        cfg.block_sync.version = "v2"
+        cfg.rpc.laddr = ""
+        FilePV.load_or_generate(
+            cfg.rooted(cfg.base.priv_validator_key_file),
+            cfg.rooted(cfg.base.priv_validator_state_file))
+        nodes[0].genesis_doc.save_as(cfg.genesis_path)
+        joiner = Node(cfg)
+        assert isinstance(joiner.blocksync_reactor, BlocksyncReactorV2)
+        assert joiner.fast_sync
+        joiner.switch.set_persistent_peers(
+            [f"{nd.node_id}@127.0.0.1:{nd.p2p_port}" for nd in nodes])
+        joiner.start()
+
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and \
+                joiner.blocksync_reactor.blocks_synced < 14:
+            time.sleep(0.25)
+        assert joiner.blocksync_reactor.blocks_synced >= 14, (
+            f"v2 joiner only reached {joiner.block_store.height()} "
+            f"(sched h={joiner.blocksync_reactor.sched.height}, "
+            f"maxpeer={joiner.blocksync_reactor.sched.max_peer_height()})")
+        b10 = joiner.block_store.load_block(10)
+        assert b10.hash() == nodes[0].block_store.load_block(10).hash()
+
+        target = joiner.block_store.height() + 2
+        assert joiner.consensus.wait_for_height(target, timeout=60), \
+            "v2 joiner did not switch to live consensus"
+        assert joiner.consensus.state.app_hash in {
+            nd.consensus.state.app_hash for nd in nodes}
+    finally:
+        if joiner is not None:
+            joiner.stop()
+        for nd in nodes:
+            nd.stop()

@@ -77,6 +77,73 @@ at_height = 5
     assert m.load.rate == 10.0
 
 
+def test_e2e_priority_mempool_v1_testnet():
+    """The priority mempool (v1) riding its REACTOR path over real TCP
+    (VERDICT r3 #8; reference mempool/v1/mempool.go is a full
+    reactor-backed mempool, not a unit-test-only structure): a 4-node
+    subprocess testnet with mempool.version=v1 on every node takes
+    round-robin load — so most committed txs crossed peers via mempool
+    gossip — and keeps committing without backlog."""
+    import time
+
+    m = Manifest(
+        chain_id="e2e-mpv1",
+        target_height=5,
+        timeout_s=90.0,
+        nodes=[NodeSpec(name=f"v{i}", config={"mempool.version": "v1"})
+               for i in range(4)],
+    )
+    m.load.rate = 150.0
+    m.load.size = 120
+    out = tempfile.mkdtemp(prefix="tmtpu-e2e-mpv1-")
+    r = Runner(m, out)
+    try:
+        r.setup()
+        # the written config.toml actually selects v1 on every node (the
+        # same file the subprocess node boots from)
+        for node in r.nodes:
+            toml_text = pathlib.Path(
+                node.home, "config", "config.toml").read_text()
+            assert 'version = "v1"' in toml_text
+        r.start()
+        r.wait_for(3)
+        h0 = r.nodes[0].height()
+        r.start_load()
+        time.sleep(12)
+        r.stop_load()
+        time.sleep(3)
+        h1 = r.nodes[0].height()
+        cli = r.nodes[0].client
+        n_txs = sum(len(cli.block(h)["block"]["data"].get("txs") or [])
+                    for h in range(h0 + 1, h1 + 1))
+        offered = len(r.txs_sent)
+        assert h1 - h0 >= 6, f"only {h1 - h0} blocks under v1 mempool load"
+        assert offered > 800, f"load generator managed only {offered}"
+        assert n_txs >= offered * 0.7, (
+            f"committed {n_txs}/{offered} — v1 gossip/recheck backlog")
+        # sanity: a tx broadcast to a single non-proposing node commits —
+        # pure reactor-gossip path
+        probe = b"mpv1-gossip-probe=1"
+        r.nodes[3].client.broadcast_tx_sync(probe)
+        import base64
+
+        deadline = time.time() + 30
+        found = False
+        scanned_to = h1
+        while time.time() < deadline and not found:
+            time.sleep(1)
+            h2 = r.nodes[0].height()
+            for h in range(scanned_to, h2 + 1):
+                txs = cli.block(h)["block"]["data"].get("txs") or []
+                if any(base64.b64decode(t) == probe for t in txs):
+                    found = True
+                    break
+            scanned_to = max(scanned_to, h2)
+        assert found, "gossip probe tx never committed under mempool v1"
+    finally:
+        r.stop()
+
+
 def test_e2e_sustained_load_commits():
     """Regression for the tx-load livelock and the round-2 ingest knee
     (PERF.md): under steady load well past the old 143 tx/s knee, a

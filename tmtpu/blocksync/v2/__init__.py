@@ -1,0 +1,22 @@
+"""Fast sync v2: event-driven scheduler/processor (reference:
+blockchain/v2/). Selected via ``block_sync.version = "v2"``.
+
+The reference splits v2 into three actors (scheduler, processor,
+demuxing reactor) joined by routines (blockchain/v2/routine.go). Here
+the scheduler and processor are PURE deterministic state machines —
+events in, events out, no threads, no sockets, no clocks of their own —
+and the reactor serializes them on one pump thread (a single-queue
+actor loop; same serialization the reference gets from its demuxer,
+with far less machinery). Purity is what makes the v2 design testable:
+tests drive event sequences and assert exact outputs.
+
+Batch-first twist: the processor releases blocks in CONTIGUOUS RUNS and
+the reactor verifies a whole run's commits in ONE batched device
+dispatch (types/commit_verify.verify_commits_light_batch), like the v0
+reactor — the reference verifies one block at a time
+(blockchain/v2/processor.go:120).
+"""
+
+from tmtpu.blocksync.v2.reactor import BlocksyncReactorV2
+
+__all__ = ["BlocksyncReactorV2"]

@@ -1,0 +1,68 @@
+"""v2 processor: a pure state machine ordering received blocks into
+contiguous runs for batch verification (reference:
+blockchain/v2/processor.go).
+
+The reference processor verifies one block per pcProcessBlock event.
+Batch-first redesign: ``next_run()`` exposes the longest contiguous run
+of queued blocks starting at the processing height; the reactor
+verifies the WHOLE run's commits in one device dispatch and reports
+either ``applied(n)`` or ``failed(height)``. The queue itself stays
+pure — no verification happens here, so tests can drive it without
+crypto."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class QueuedBlock:
+    height: int
+    block: object
+    peer_id: str
+
+
+class Processor:
+    def __init__(self, initial_height: int, max_run: int = 32):
+        self.height = initial_height    # next height to apply
+        self.max_run = max_run
+        self.queue: Dict[int, QueuedBlock] = {}
+
+    def enqueue(self, height: int, block, peer_id: str) -> None:
+        """Keep the first copy (processor.go ignores duplicates)."""
+        if height >= self.height and height not in self.queue:
+            self.queue[height] = QueuedBlock(height, block, peer_id)
+
+    def next_run(self) -> List[QueuedBlock]:
+        """Longest contiguous [height, height+k] run, capped at
+        max_run + 1 (the +1 block supplies the last verifying commit —
+        block h is verified by h+1's LastCommit, processor.go:120)."""
+        run: List[QueuedBlock] = []
+        h = self.height
+        while h in self.queue and len(run) < self.max_run + 1:
+            run.append(self.queue[h])
+            h += 1
+        return run
+
+    def applied(self, n: int) -> None:
+        """First ``n`` blocks of the run were verified + applied."""
+        for h in range(self.height, self.height + n):
+            self.queue.pop(h, None)
+        self.height += n
+
+    def failed(self, height: int) -> Tuple[Optional[str], Optional[str]]:
+        """Verification failed at ``height``: drop block h and h+1 (both
+        suppliers suspect, processor.go handleVerificationFailure) and
+        return their peer ids for scheduler errors."""
+        a = self.queue.pop(height, None)
+        b = self.queue.pop(height + 1, None)
+        return (a.peer_id if a else None, b.peer_id if b else None)
+
+    def purge_peer(self, peer_id: str) -> List[int]:
+        """Peer removed: drop its queued blocks; the scheduler will
+        re-request those heights. Returns the dropped heights."""
+        drop = [h for h, q in self.queue.items() if q.peer_id == peer_id]
+        for h in drop:
+            del self.queue[h]
+        return drop

@@ -1,0 +1,306 @@
+"""v2 fast-sync reactor: the pump joining scheduler + processor to the
+switch (reference: blockchain/v2/reactor.go + routine.go + io.go).
+
+Same wire protocol and channel as v0 (the reference v2 speaks the
+identical blockchain channel messages — blockchain/v2/io.go), so a v2
+node syncs from v0 peers and serves them. The reference demuxes three
+actor routines over buffered queues; here one pump thread serializes
+scheduler and processor transitions (they are pure state machines, see
+tmtpu/blocksync/v2/__init__.py) and does the block I/O + the batched
+run verification.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Optional
+
+from tmtpu.blocksync.msgs import (
+    BlockRequestPB, BlockResponsePB, BlocksyncMessagePB, NoBlockResponsePB,
+    StatusRequestPB, StatusResponsePB,
+)
+from tmtpu.blocksync.v2 import processor as proc_mod
+from tmtpu.blocksync.v2 import scheduler as sched_mod
+from tmtpu.p2p.conn.connection import ChannelDescriptor
+from tmtpu.p2p.switch import Peer, Reactor
+from tmtpu.types import commit_verify
+from tmtpu.types.block import Block, BlockID
+from tmtpu.types.part_set import PartSet
+
+BLOCKCHAIN_CHANNEL = 0x40
+STATUS_UPDATE_INTERVAL_S = 10.0
+TICK_S = 0.02
+MAX_BATCH_BLOCKS = 32
+
+
+class BlocksyncReactorV2(Reactor):
+    """Drop-in alternative to BlocksyncReactor, selected by
+    ``block_sync.version = "v2"`` (node.go NewNode picks the blockchain
+    reactor by config the same way)."""
+
+    def __init__(self, state, block_exec, block_store, fast_sync: bool,
+                 consensus_reactor=None,
+                 verify_backend: Optional[str] = None):
+        super().__init__("BLOCKSYNC")
+        if state.last_block_height != block_store.height():
+            raise ValueError(
+                f"state ({state.last_block_height}) and store "
+                f"({block_store.height()}) height mismatch")
+        self.state = state
+        self.block_exec = block_exec
+        self.store = block_store
+        self.fast_sync = fast_sync
+        self.consensus_reactor = consensus_reactor
+        self.verify_backend = verify_backend
+        start = block_store.height() + 1
+        if start == 1:
+            start = state.initial_height
+        self.sched = sched_mod.Scheduler(start)
+        self.proc = proc_mod.Processor(start, max_run=MAX_BATCH_BLOCKS)
+        self.blocks_synced = 0
+        self._events: "queue.Queue" = queue.Queue(maxsize=10_000)
+        self._pump_alive = False
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._started_at = 0.0
+        # caught-up grace: like v0's pool, don't hand over before we've
+        # heard from peers at all
+        self._grace_s = 3.0
+
+    # -- reactor interface --------------------------------------------------
+
+    def get_channels(self):
+        return [ChannelDescriptor(BLOCKCHAIN_CHANNEL, priority=5,
+                                  send_queue_capacity=1000)]
+
+    def on_start(self) -> None:
+        if self.fast_sync:
+            self._start_pump(state_synced=False)
+
+    def _start_pump(self, state_synced: bool) -> None:
+        self._started_at = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._pump, args=(state_synced,), daemon=True,
+            name="blocksync-v2")
+        self._thread.start()
+
+    def on_stop(self) -> None:
+        self._stopped.set()
+
+    def _enqueue(self, ev) -> None:
+        """Events are only meaningful while the pump is running; after
+        handover (or if the queue is somehow full) they are DROPPED —
+        a blocking put here would wedge the p2p receive thread."""
+        if not self._pump_alive:
+            return
+        try:
+            self._events.put_nowait(ev)
+        except queue.Full:
+            pass
+
+    def add_peer(self, peer: Peer) -> None:
+        peer.send(BLOCKCHAIN_CHANNEL, self._status_msg())
+        self._enqueue(("add_peer", peer.node_id))
+
+    def remove_peer(self, peer: Peer, reason) -> None:
+        self._enqueue(("remove_peer", peer.node_id))
+
+    def receive(self, channel_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        msg = BlocksyncMessagePB.decode(msg_bytes)
+        if msg.block_request is not None:
+            self._respond_to_peer(msg.block_request.height, peer)
+        elif msg.status_request is not None:
+            peer.try_send(BLOCKCHAIN_CHANNEL, self._status_msg())
+        elif msg.block_response is not None:
+            block = Block.from_proto(msg.block_response.block)
+            self._enqueue(
+                ("block", peer.node_id, block, len(msg_bytes)))
+        elif msg.status_response is not None:
+            self._enqueue(("status", peer.node_id,
+                           msg.status_response.base,
+                           msg.status_response.height))
+        elif msg.no_block_response is not None:
+            self._enqueue(
+                ("no_block", peer.node_id, msg.no_block_response.height))
+
+    # -- serving (same as v0) ----------------------------------------------
+
+    def _status_msg(self) -> bytes:
+        return BlocksyncMessagePB(status_response=StatusResponsePB(
+            height=self.store.height(), base=self.store.base())).encode()
+
+    def _respond_to_peer(self, height: int, peer: Peer) -> None:
+        block = self.store.load_block(height)
+        if block is not None:
+            m = BlocksyncMessagePB(
+                block_response=BlockResponsePB(block=block.to_proto()))
+        else:
+            m = BlocksyncMessagePB(
+                no_block_response=NoBlockResponsePB(height=height))
+        peer.try_send(BLOCKCHAIN_CHANNEL, m.encode())
+
+    # -- the pump (reactor.go demux loop) -----------------------------------
+
+    def _pump(self, state_synced: bool) -> None:
+        self._pump_alive = True
+        try:
+            self._pump_loop(state_synced)
+        except Exception:  # noqa: BLE001 — a dead pump must be loud
+            import traceback
+
+            traceback.print_exc()
+            raise
+        finally:
+            self._pump_alive = False
+
+    def _pump_loop(self, state_synced: bool) -> None:
+        last_status = 0.0
+        while not self._stopped.is_set():
+            now = time.monotonic()
+            if now - last_status > STATUS_UPDATE_INTERVAL_S:
+                last_status = now
+                if self.switch is not None:
+                    self.switch.broadcast(
+                        BLOCKCHAIN_CHANNEL,
+                        BlocksyncMessagePB(
+                            status_request=StatusRequestPB()).encode())
+            # drain queued events into scheduler/processor transitions
+            drained = False
+            try:
+                while True:
+                    ev = self._events.get_nowait()
+                    drained = True
+                    self._dispatch(ev, time.monotonic())
+            except queue.Empty:
+                pass
+            self._emit(self.sched.tick(time.monotonic()))
+            if self._process_runs():
+                drained = True
+            if self.sched.finished or self._caught_up(now):
+                self._switch_to_consensus(state_synced)
+                return
+            if not drained:
+                self._stopped.wait(TICK_S)
+
+    def _dispatch(self, ev, now: float) -> None:
+        kind = ev[0]
+        if kind == "add_peer":
+            self.sched.add_peer(ev[1], now)
+        elif kind == "remove_peer":
+            # scheduler reschedules the peer's in-flight heights; the
+            # processor drops its queued blocks (they'll be re-fetched)
+            self._emit(self.sched.remove_peer(ev[1]))
+            self.proc.purge_peer(ev[1])
+        elif kind == "status":
+            self._emit(self.sched.status(ev[1], ev[2], ev[3], now))
+        elif kind == "block":
+            _, peer_id, block, size = ev
+            h = block.header.height
+            out = self.sched.block_received(peer_id, h, size, now)
+            if not out:  # solicited: queue for processing
+                self.proc.enqueue(h, block, peer_id)
+            self._emit(out)
+        elif kind == "no_block":
+            self._emit(self.sched.no_block(ev[1], ev[2]))
+
+    def _emit(self, events) -> None:
+        for e in events:
+            if isinstance(e, sched_mod.BlockRequest):
+                peer = (self.switch.peers.get(e.peer_id)
+                        if self.switch else None)
+                if peer is not None:
+                    peer.try_send(
+                        BLOCKCHAIN_CHANNEL,
+                        BlocksyncMessagePB(block_request=BlockRequestPB(
+                            height=e.height)).encode())
+            elif isinstance(e, sched_mod.PeerError):
+                self._stop_peer(e.peer_id, e.reason)
+            # Finished is read via sched.finished in the pump loop
+
+    # -- batched run verification (the v0 fused path, v2-scheduled) ---------
+
+    def _process_runs(self) -> bool:
+        run = self.proc.next_run()
+        if len(run) < 2:
+            return False
+        blocks = [q.block for q in run[:-1]]
+        successors = [q.block for q in run[1:]]
+        vals_now = self.state.validators
+        if any(b.header.validators_hash != vals_now.hash()
+               for b in blocks):
+            blocks, successors = blocks[:1], successors[:1]  # valset edge
+        chain_id = self.state.chain_id
+        entries = []
+        parts_bids = []  # reused in the apply loop: encode + merkle part
+        #                  hashing is nontrivial per 22 MB block
+        for blk, nxt in zip(blocks, successors):
+            parts = PartSet.from_data(blk.encode())
+            bid = BlockID(blk.hash(), parts.total, parts.hash)
+            parts_bids.append((parts, bid))
+            entries.append((vals_now, chain_id, bid, blk.header.height,
+                            nxt.last_commit))
+        results = commit_verify.verify_commits_light_batch(
+            entries, backend=self.verify_backend)
+        applied = 0
+        for blk, nxt, err, (parts, bid) in zip(blocks, successors, results,
+                                               parts_bids):
+            if err is not None:
+                self._fail_height(blk.header.height, err)
+                break
+            try:
+                self.block_exec.validate_block(self.state, blk)
+            except Exception as e:  # noqa: BLE001
+                self._fail_height(blk.header.height, e)
+                break
+            self.store.save_block(blk, parts, nxt.last_commit)
+            self.state, _ = self.block_exec.apply_block(
+                self.state, bid, blk)
+            self.blocks_synced += 1
+            applied += 1
+        if applied:
+            self.proc.applied(applied)
+            for h in range(self.sched.height, self.sched.height + applied):
+                self._emit(self.sched.processed(h))
+        return applied > 0
+
+    def _fail_height(self, height: int, err) -> None:
+        self.proc.failed(height)
+        self._emit(self.sched.verification_failure(height))
+
+    def _caught_up(self, now: float) -> bool:
+        """v0 pool.is_caught_up analogue (pool.go:170-186): past the
+        grace period, at least one ready peer heard from, and past the
+        best reported peer height (max_h == 0 means peers are at
+        genesis — nothing to sync)."""
+        if now - self._started_at < self._grace_s:
+            return False
+        ready = any(p.state == "ready" for p in self.sched.peers.values())
+        # within one block of the best peer height, like v0: the tip
+        # block cannot fast-sync (its verifying successor commit doesn't
+        # exist yet on a LIVE chain) — consensus gossip fetches it after
+        # the handover (pool.go:181 uses the same >= max-1 shape)
+        return ready and self.sched.height >= self.sched.max_peer_height()
+
+    def _stop_peer(self, peer_id: str, reason: str) -> None:
+        if self.switch is None:
+            return
+        peer = self.switch.peers.get(peer_id)
+        if peer is not None:
+            self.switch.stop_peer_for_error(peer, reason)
+
+    def _switch_to_consensus(self, state_synced: bool) -> None:
+        if self.consensus_reactor is not None:
+            self.consensus_reactor.switch_to_consensus(
+                self.state, skip_wal=self.blocks_synced > 0 or state_synced)
+
+    # -- statesync handoff --------------------------------------------------
+
+    def switch_to_fast_sync(self, state) -> None:
+        self.state = state
+        self.fast_sync = True
+        h = state.last_block_height + 1
+        self.sched = sched_mod.Scheduler(h)
+        self.proc = proc_mod.Processor(h, max_run=MAX_BATCH_BLOCKS)
+        self._start_pump(state_synced=True)

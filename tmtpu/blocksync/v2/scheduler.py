@@ -1,0 +1,231 @@
+"""v2 scheduler: a pure state machine deciding which peer to ask for
+which height (reference: blockchain/v2/scheduler.go).
+
+Inputs are plain method calls (one per reference event); outputs are
+lists of Event dataclasses. No I/O, no threads, no wall clock — the
+caller passes ``now`` into time-dependent transitions, so every
+behavior (touch timeouts, slow-peer pruning, termination) is unit
+testable deterministically.
+
+Block lifecycle per height (scheduler.go blockState):
+    new -> pending (request sent) -> received -> processed
+A pruned/errored peer sends its pending/received heights back to new.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+# scheduler.go defaults
+MAX_PENDING_PER_PEER = 20
+PEER_TIMEOUT_S = 15.0       # no useful message for this long -> prune
+MIN_RECV_RATE = 0           # bytes/s; 0 disables rate pruning (as v0 does
+#                             on small nets; reference uses 7680 in prod)
+TARGET_PENDING = 64         # total in-flight request budget
+
+
+# -- output events ----------------------------------------------------------
+
+
+@dataclass
+class BlockRequest:
+    peer_id: str
+    height: int
+
+
+@dataclass
+class PeerError:
+    peer_id: str
+    reason: str
+
+
+@dataclass
+class Finished:
+    reason: str
+
+
+@dataclass
+class _Peer:
+    peer_id: str
+    base: int = 0
+    height: int = 0
+    state: str = "new"          # new | ready | removed
+    last_touch: float = 0.0
+    pending: Dict[int, float] = field(default_factory=dict)  # height->sent
+    received_bytes: int = 0
+    first_request: float = 0.0
+
+
+class Scheduler:
+    def __init__(self, initial_height: int, *,
+                 max_pending_per_peer: int = MAX_PENDING_PER_PEER,
+                 peer_timeout_s: float = PEER_TIMEOUT_S,
+                 target_pending: int = TARGET_PENDING):
+        self.height = initial_height      # next height to schedule/process
+        self.max_pending_per_peer = max_pending_per_peer
+        self.peer_timeout_s = peer_timeout_s
+        self.target_pending = target_pending
+        self.peers: Dict[str, _Peer] = {}
+        # height -> state ("pending"|"received"); absent = new/processed
+        self.pending: Dict[int, str] = {}
+        self.pending_peer: Dict[int, str] = {}
+        self.received_peer: Dict[int, str] = {}
+        self.finished = False
+
+    # -- peer events (scheduler.go handleAddNewPeer etc.) -------------------
+
+    def add_peer(self, peer_id: str, now: float) -> None:
+        if peer_id not in self.peers:
+            self.peers[peer_id] = _Peer(peer_id, last_touch=now)
+
+    def remove_peer(self, peer_id: str) -> List[object]:
+        """Peer gone: its in-flight heights go back to new so another
+        peer picks them up (scheduler.go removePeer)."""
+        p = self.peers.pop(peer_id, None)
+        if p is None:
+            return []
+        for h in list(self.pending_peer):
+            if self.pending_peer[h] == peer_id:
+                del self.pending_peer[h]
+                self.pending.pop(h, None)
+        for h in list(self.received_peer):
+            if self.received_peer[h] == peer_id:
+                del self.received_peer[h]
+                self.pending.pop(h, None)
+        return self._maybe_finished()
+
+    def status(self, peer_id: str, base: int, height: int,
+               now: float) -> List[object]:
+        """StatusResponse (scheduler.go handleStatusResponse): a peer
+        reporting a LOWER height than before is suspect."""
+        p = self.peers.get(peer_id)
+        if p is None:
+            self.add_peer(peer_id, now)
+            p = self.peers[peer_id]
+        if height < p.height:
+            self.remove_peer(peer_id)
+            return [PeerError(peer_id, "peer height regressed")]
+        p.base, p.height = base, height
+        p.state = "ready"
+        p.last_touch = now
+        return []
+
+    # -- block events -------------------------------------------------------
+
+    def block_received(self, peer_id: str, height: int, size: int,
+                       now: float) -> List[object]:
+        p = self.peers.get(peer_id)
+        if p is None or self.pending_peer.get(height) != peer_id:
+            # unsolicited block (scheduler.go: error the peer)
+            self.remove_peer(peer_id)
+            return [PeerError(peer_id, f"unsolicited block {height}")]
+        p.last_touch = now
+        p.received_bytes += size
+        p.pending.pop(height, None)
+        del self.pending_peer[height]
+        self.pending[height] = "received"
+        self.received_peer[height] = peer_id
+        return []
+
+    def no_block(self, peer_id: str, height: int) -> List[object]:
+        """Peer advertised the height but won't serve it
+        (scheduler.go handleNoBlockResponse: remove the peer)."""
+        if self.pending_peer.get(height) == peer_id:
+            out = self.remove_peer(peer_id)
+            return [PeerError(peer_id, f"no block at {height}")] + out
+        return []
+
+    def processed(self, height: int) -> List[object]:
+        """Processor applied ``height`` (scheduler.go handleBlockProcessed)."""
+        self.pending.pop(height, None)
+        self.received_peer.pop(height, None)
+        if height >= self.height:
+            self.height = height + 1
+        return self._maybe_finished()
+
+    def verification_failure(self, height: int) -> List[object]:
+        """Block h failed verification against h+1 (scheduler.go
+        handleBlockProcessError): both suppliers are suspect; their
+        heights reschedule."""
+        out: List[object] = []
+        for h in (height, height + 1):
+            pid = self.received_peer.get(h) or self.pending_peer.get(h)
+            if pid is not None and pid in self.peers:
+                out.append(PeerError(pid, f"bad block run at {height}"))
+                out += self.remove_peer(pid)
+        return out
+
+    # -- tick: scheduling + pruning (rTrySchedule / rTryPrunePeer) ----------
+
+    def tick(self, now: float) -> List[object]:
+        out: List[object] = []
+        out += self._prune(now)
+        out += self._schedule(now)
+        out += self._maybe_finished()
+        return out
+
+    def _prune(self, now: float) -> List[object]:
+        out: List[object] = []
+        for pid, p in list(self.peers.items()):
+            if p.state != "ready":
+                continue
+            if now - p.last_touch > self.peer_timeout_s:
+                out.append(PeerError(pid, "peer timeout"))
+                out += self.remove_peer(pid)
+        return out
+
+    def _schedule(self, now: float) -> List[object]:
+        out: List[object] = []
+        budget = self.target_pending - len(self.pending)
+        h = self.height
+        max_h = self.max_peer_height()
+        while budget > 0 and h <= max_h:
+            if not any(p.state == "ready"
+                       and len(p.pending) < self.max_pending_per_peer
+                       for p in self.peers.values()):
+                break  # every ready peer at its cap: scanning further
+                #        heights is pure waste (500k-height chains would
+                #        otherwise burn the pump thread every tick)
+            if h not in self.pending:
+                p = self._pick_peer(h)
+                if p is None:
+                    # no peer can serve h right now (base above it) —
+                    # skip it this tick but keep scanning so other
+                    # peers prefetch later heights
+                    h += 1
+                    continue
+                p.pending[h] = now
+                if not p.first_request:
+                    p.first_request = now
+                self.pending[h] = "pending"
+                self.pending_peer[h] = p.peer_id
+                out.append(BlockRequest(p.peer_id, h))
+                budget -= 1
+            h += 1
+        return out
+
+    def _pick_peer(self, height: int) -> Optional[_Peer]:
+        best = None
+        for p in self.peers.values():
+            if (p.state == "ready" and p.base <= height <= p.height
+                    and len(p.pending) < self.max_pending_per_peer):
+                if best is None or len(p.pending) < len(best.pending):
+                    best = p
+        return best
+
+    def max_peer_height(self) -> int:
+        return max((p.height for p in self.peers.values()
+                    if p.state == "ready"), default=0)
+
+    def _maybe_finished(self) -> List[object]:
+        """scheduler.go allBlocksProcessed: every height up to the best
+        peer height is processed and nothing is in flight."""
+        if self.finished:
+            return []
+        ready = [p for p in self.peers.values() if p.state == "ready"]
+        if ready and not self.pending and \
+                self.height > self.max_peer_height():
+            self.finished = True
+            return [Finished("caught up to max peer height")]
+        return []

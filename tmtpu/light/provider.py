@@ -15,7 +15,6 @@ import time
 import urllib.request
 from typing import Optional
 
-from tmtpu.crypto.keys import KEY_TYPES
 from tmtpu.types.block import BlockID, Commit, CommitSig, Header
 from tmtpu.types.light_block import LightBlock, SignedHeader
 from tmtpu.types.validator import Validator, ValidatorSet
@@ -111,12 +110,15 @@ def commit_from_json(d: dict) -> Commit:
 
 
 def validator_from_json(d: dict) -> Validator:
-    pk = d["pub_key"]
-    entry = KEY_TYPES.get(pk["type"])
-    if entry is None:
-        raise ErrBadLightBlock(f"unknown key type {pk['type']!r}")
-    return Validator(entry[0](base64.b64decode(pk["value"])),
-                     int(d["voting_power"]),
+    # amino type names (tendermint/PubKeyEd25519 — what the reference's
+    # RPC and ours emit) and legacy bare names both parse
+    from tmtpu.libs import amino_json
+
+    try:
+        pk = amino_json.unmarshal_pub_key(d["pub_key"])
+    except (ValueError, KeyError) as e:
+        raise ErrBadLightBlock(f"bad validator pub_key: {e}") from e
+    return Validator(pk, int(d["voting_power"]),
                      int(d.get("proposer_priority", 0)))
 
 

@@ -229,11 +229,18 @@ class Node(BaseService):
             self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
             self.switch.add_reactor("MEMPOOL", MempoolReactor(
                 self.mempool, broadcast=config.mempool.broadcast))
-            from tmtpu.blocksync.reactor import BlocksyncReactor
+            # blocksync reactor version per config (node.go:450 picks the
+            # blockchain reactor by config.FastSync.Version the same way)
+            if config.block_sync.version == "v2":
+                from tmtpu.blocksync.v2 import BlocksyncReactorV2 \
+                    as blocksync_cls
+            else:
+                from tmtpu.blocksync.reactor import BlocksyncReactor \
+                    as blocksync_cls
 
             # with statesync pending, blocksync starts LATER via
             # switch_to_fast_sync once the snapshot state is planted
-            self.blocksync_reactor = BlocksyncReactor(
+            self.blocksync_reactor = blocksync_cls(
                 self.state, self.block_exec, self.block_store,
                 self.fast_sync and not self.state_sync,
                 consensus_reactor=self.consensus_reactor)

@@ -8,6 +8,8 @@ import time
 from typing import Optional
 
 from tmtpu.abci import types as abci
+from tmtpu.crypto import encoding as crypto_encoding
+from tmtpu.libs import amino_json
 from tmtpu.types.event_bus import EVENT_TX
 from tmtpu.version import TMCoreSemVer
 
@@ -199,8 +201,8 @@ def build_routes(env: Environment) -> dict:
             },
             "validator_info": {
                 "address": _hex(pub.address()) if pub else "",
-                "pub_key": {"type": pub.type_value(),
-                            "value": _b64(pub.bytes())} if pub else None,
+                "pub_key": amino_json.marshal_pub_key(pub)
+                if pub else None,
                 "voting_power": str(_own_power(node, state)),
             },
         }
@@ -303,8 +305,8 @@ def build_routes(env: Environment) -> dict:
             "begin_block_events": [],
             "end_block_events": [],
             "validator_updates": [
-                {"pub_key": {"type": "ed25519",
-                             "value": _b64(v.pub_key.ed25519)},
+                {"pub_key": amino_json.marshal_pub_key(
+                    crypto_encoding.pubkey_from_proto(v.pub_key)),
                  "power": str(v.power)}
                 for v in res.end_block.validator_updates
             ],
@@ -336,8 +338,7 @@ def build_routes(env: Environment) -> dict:
             "block_height": str(h),
             "validators": [{
                 "address": _hex(v.address),
-                "pub_key": {"type": v.pub_key.type_value(),
-                            "value": _b64(v.pub_key.bytes())},
+                "pub_key": amino_json.marshal_pub_key(v.pub_key),
                 "voting_power": str(v.voting_power),
                 "proposer_priority": str(v.proposer_priority),
             } for v in chunk],
