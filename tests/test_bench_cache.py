@@ -93,6 +93,18 @@ def test_merge_headline_is_freshest_not_best_ever(cache):
     assert m["curves_cached"]["sr25519"]["value"] == 50000.0  # best curve
 
 
+def test_live_device_result_attaches_cached_extras(cache):
+    """A live on-chip headline still carries the battery's banked
+    higher-lane curve runs + live rounds into the one emitted line."""
+    import bench
+
+    cache.record("secp256k1", {"value": 30000.0, "lanes": 4096})
+    cache.record("live_10k_round", {"value": 2.5, "backend": "tpu"})
+    out = bench._attach_cached_extras({"value": 2e5, "backend": "tpu"})
+    assert out["curves_cached"]["secp256k1"]["lanes"] == 4096
+    assert out["live_10k_round_cached"]["value"] == 2.5
+
+
 def test_merge_live_cpu_carries_degradation_marker(cache):
     import bench
 

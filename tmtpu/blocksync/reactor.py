@@ -20,10 +20,10 @@ import threading
 import time
 from typing import Optional
 
-from tmtpu.blocksync.msgs import (
-    BlockRequestPB, BlockResponsePB, BlocksyncMessagePB, NoBlockResponsePB,
-    StatusRequestPB, StatusResponsePB,
+from tmtpu.blocksync.common import (
+    BLOCKCHAIN_CHANNEL, BlockServingMixin, verify_block_run,
 )
+from tmtpu.blocksync.msgs import BlockRequestPB, BlocksyncMessagePB
 from tmtpu.blocksync.pool import BlockPool
 from tmtpu.p2p.conn.connection import ChannelDescriptor
 from tmtpu.p2p.switch import Peer, Reactor
@@ -31,7 +31,6 @@ from tmtpu.types import commit_verify
 from tmtpu.types.block import Block, BlockID
 from tmtpu.types.part_set import PartSet
 
-BLOCKCHAIN_CHANNEL = 0x40
 
 TRY_SYNC_INTERVAL_S = 0.01          # trySyncIntervalMS
 STATUS_UPDATE_INTERVAL_S = 10.0     # statusUpdateIntervalSeconds
@@ -39,7 +38,7 @@ SWITCH_TO_CONSENSUS_INTERVAL_S = 1.0
 MAX_BATCH_BLOCKS = 32               # commits fused per device dispatch
 
 
-class BlocksyncReactor(Reactor):
+class BlocksyncReactor(BlockServingMixin, Reactor):
     def __init__(self, state, block_exec, block_store, fast_sync: bool,
                  consensus_reactor=None, verify_backend: Optional[str] = None):
         super().__init__("BLOCKSYNC")
@@ -100,35 +99,8 @@ class BlocksyncReactor(Reactor):
         elif msg.no_block_response is not None:
             pass  # reactor.go just logs it
 
-    # -- serving ------------------------------------------------------------
-
-    def _status_msg(self) -> bytes:
-        return BlocksyncMessagePB(status_response=StatusResponsePB(
-            height=self.store.height(), base=self.store.base(),
-        )).encode()
-
-    def _respond_to_peer(self, height: int, peer: Peer) -> None:
-        block = self.store.load_block(height)
-        if block is not None:
-            m = BlocksyncMessagePB(
-                block_response=BlockResponsePB(block=block.to_proto()))
-        else:
-            m = BlocksyncMessagePB(
-                no_block_response=NoBlockResponsePB(height=height))
-        peer.try_send(BLOCKCHAIN_CHANNEL, m.encode())
-
-    def _stop_peer(self, peer_id: str, reason: str) -> None:
-        if self.switch is None:
-            return
-        peer = self.switch.peers.get(peer_id)
-        if peer is not None:
-            self.switch.stop_peer_for_error(peer, reason)
-
-    def broadcast_status_request(self) -> None:
-        if self.switch is not None:
-            self.switch.broadcast(
-                BLOCKCHAIN_CHANNEL,
-                BlocksyncMessagePB(status_request=StatusRequestPB()).encode())
+    # serving + handover (status/respond/stop-peer/switch-to-consensus)
+    # come from BlockServingMixin — shared with BlocksyncReactorV2
 
     # -- the sync loop (reactor.go poolRoutine) -----------------------------
 
@@ -171,21 +143,15 @@ class BlocksyncReactor(Reactor):
         vals_now = self.state.validators
         if any(b.header.validators_hash != vals_now.hash() for b in blocks):
             return self._try_sync_one()
-        chain_id = self.state.chain_id
-        entries = []
-        for blk, nxt in zip(blocks, successors):
-            parts = PartSet.from_data(blk.encode())
-            bid = BlockID(blk.hash(), parts.total, parts.hash)
-            entries.append((vals_now, chain_id, bid, blk.header.height,
-                            nxt.last_commit))
-        results = commit_verify.verify_commits_light_batch(
-            entries, backend=self.verify_backend)
+        results, parts_bids = verify_block_run(
+            self.state, blocks, successors, self.verify_backend)
         applied = False
-        for blk, nxt, err in zip(blocks, successors, results):
+        for blk, nxt, err, (parts, bid) in zip(blocks, successors, results,
+                                               parts_bids):
             if err is not None:
                 self._handle_bad_block(blk.header.height, err)
                 return applied
-            if not self._apply_one(blk, nxt):
+            if not self._apply_one(blk, nxt, parts, bid):
                 return applied
             applied = True
         return applied
@@ -205,9 +171,11 @@ class BlocksyncReactor(Reactor):
             return False
         return self._apply_one(first, second)
 
-    def _apply_one(self, block: Block, successor: Block) -> bool:
-        parts = PartSet.from_data(block.encode())
-        bid = BlockID(block.hash(), parts.total, parts.hash)
+    def _apply_one(self, block: Block, successor: Block,
+                   parts=None, bid=None) -> bool:
+        if parts is None:
+            parts = PartSet.from_data(block.encode())
+            bid = BlockID(block.hash(), parts.total, parts.hash)
         try:
             self.block_exec.validate_block(self.state, block)
         except Exception as e:  # noqa: BLE001
@@ -226,11 +194,6 @@ class BlocksyncReactor(Reactor):
             bad = self.pool.redo_request(h)
             if bad is not None:
                 self._stop_peer(bad, f"blocksync validation error: {err}")
-
-    def _switch_to_consensus(self, state_synced: bool) -> None:
-        if self.consensus_reactor is not None:
-            self.consensus_reactor.switch_to_consensus(
-                self.state, skip_wal=self.blocks_synced > 0 or state_synced)
 
     # -- statesync handoff (reactor.go SwitchToFastSync) --------------------
 

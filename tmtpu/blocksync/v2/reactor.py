@@ -17,25 +17,22 @@ import threading
 import time
 from typing import Optional
 
-from tmtpu.blocksync.msgs import (
-    BlockRequestPB, BlockResponsePB, BlocksyncMessagePB, NoBlockResponsePB,
-    StatusRequestPB, StatusResponsePB,
+from tmtpu.blocksync.common import (
+    BLOCKCHAIN_CHANNEL, BlockServingMixin, verify_block_run,
 )
+from tmtpu.blocksync.msgs import BlockRequestPB, BlocksyncMessagePB
 from tmtpu.blocksync.v2 import processor as proc_mod
 from tmtpu.blocksync.v2 import scheduler as sched_mod
 from tmtpu.p2p.conn.connection import ChannelDescriptor
 from tmtpu.p2p.switch import Peer, Reactor
-from tmtpu.types import commit_verify
-from tmtpu.types.block import Block, BlockID
-from tmtpu.types.part_set import PartSet
+from tmtpu.types.block import Block
 
-BLOCKCHAIN_CHANNEL = 0x40
 STATUS_UPDATE_INTERVAL_S = 10.0
 TICK_S = 0.02
 MAX_BATCH_BLOCKS = 32
 
 
-class BlocksyncReactorV2(Reactor):
+class BlocksyncReactorV2(BlockServingMixin, Reactor):
     """Drop-in alternative to BlocksyncReactor, selected by
     ``block_sync.version = "v2"`` (node.go NewNode picks the blockchain
     reactor by config the same way)."""
@@ -81,6 +78,10 @@ class BlocksyncReactorV2(Reactor):
 
     def _start_pump(self, state_synced: bool) -> None:
         self._started_at = time.monotonic()
+        # alive BEFORE start(): on a single-core box the switch can
+        # deliver add_peer/status for already-connected peers before the
+        # pump thread is ever scheduled — those events must not drop
+        self._pump_alive = True
         self._thread = threading.Thread(
             target=self._pump, args=(state_synced,), daemon=True,
             name="blocksync-v2")
@@ -125,26 +126,11 @@ class BlocksyncReactorV2(Reactor):
             self._enqueue(
                 ("no_block", peer.node_id, msg.no_block_response.height))
 
-    # -- serving (same as v0) ----------------------------------------------
-
-    def _status_msg(self) -> bytes:
-        return BlocksyncMessagePB(status_response=StatusResponsePB(
-            height=self.store.height(), base=self.store.base())).encode()
-
-    def _respond_to_peer(self, height: int, peer: Peer) -> None:
-        block = self.store.load_block(height)
-        if block is not None:
-            m = BlocksyncMessagePB(
-                block_response=BlockResponsePB(block=block.to_proto()))
-        else:
-            m = BlocksyncMessagePB(
-                no_block_response=NoBlockResponsePB(height=height))
-        peer.try_send(BLOCKCHAIN_CHANNEL, m.encode())
+    # serving + handover come from BlockServingMixin
 
     # -- the pump (reactor.go demux loop) -----------------------------------
 
     def _pump(self, state_synced: bool) -> None:
-        self._pump_alive = True
         try:
             self._pump_loop(state_synced)
         except Exception:  # noqa: BLE001 — a dead pump must be loud
@@ -161,11 +147,7 @@ class BlocksyncReactorV2(Reactor):
             now = time.monotonic()
             if now - last_status > STATUS_UPDATE_INTERVAL_S:
                 last_status = now
-                if self.switch is not None:
-                    self.switch.broadcast(
-                        BLOCKCHAIN_CHANNEL,
-                        BlocksyncMessagePB(
-                            status_request=StatusRequestPB()).encode())
+                self.broadcast_status_request()
             # drain queued events into scheduler/processor transitions
             drained = False
             try:
@@ -231,18 +213,8 @@ class BlocksyncReactorV2(Reactor):
         if any(b.header.validators_hash != vals_now.hash()
                for b in blocks):
             blocks, successors = blocks[:1], successors[:1]  # valset edge
-        chain_id = self.state.chain_id
-        entries = []
-        parts_bids = []  # reused in the apply loop: encode + merkle part
-        #                  hashing is nontrivial per 22 MB block
-        for blk, nxt in zip(blocks, successors):
-            parts = PartSet.from_data(blk.encode())
-            bid = BlockID(blk.hash(), parts.total, parts.hash)
-            parts_bids.append((parts, bid))
-            entries.append((vals_now, chain_id, bid, blk.header.height,
-                            nxt.last_commit))
-        results = commit_verify.verify_commits_light_batch(
-            entries, backend=self.verify_backend)
+        results, parts_bids = verify_block_run(
+            self.state, blocks, successors, self.verify_backend)
         applied = 0
         for blk, nxt, err, (parts, bid) in zip(blocks, successors, results,
                                                parts_bids):
@@ -282,18 +254,6 @@ class BlocksyncReactorV2(Reactor):
         # exist yet on a LIVE chain) — consensus gossip fetches it after
         # the handover (pool.go:181 uses the same >= max-1 shape)
         return ready and self.sched.height >= self.sched.max_peer_height()
-
-    def _stop_peer(self, peer_id: str, reason: str) -> None:
-        if self.switch is None:
-            return
-        peer = self.switch.peers.get(peer_id)
-        if peer is not None:
-            self.switch.stop_peer_for_error(peer, reason)
-
-    def _switch_to_consensus(self, state_synced: bool) -> None:
-        if self.consensus_reactor is not None:
-            self.consensus_reactor.switch_to_consensus(
-                self.state, skip_wal=self.blocks_synced > 0 or state_synced)
 
     # -- statesync handoff --------------------------------------------------
 

@@ -25,6 +25,7 @@ from __future__ import annotations
 import base64
 from typing import Optional
 
+from tmtpu.libs import amino_json
 from tmtpu.crypto.merkle import Proof
 from tmtpu.light import provider as prov
 from tmtpu.light.client import Client
@@ -101,9 +102,7 @@ class VerifyingClient:
             "block_height": str(lb.height()),
             "validators": [{
                 "address": v.address.hex().upper(),
-                "pub_key": {"type": v.pub_key.type_value(),
-                            "value": base64.b64encode(
-                                v.pub_key.bytes()).decode()},
+                "pub_key": amino_json.marshal_pub_key(v.pub_key),
                 "voting_power": str(v.voting_power),
                 "proposer_priority": str(v.proposer_priority),
             } for v in chunk],

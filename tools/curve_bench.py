@@ -146,6 +146,19 @@ def curve_measurements(lanes_sr: int, lanes_k1: int, backend: str,
         try:
             out[name] = measure_curve(name, lanes, gen, batch_fn,
                                       serial_fn, backend=backend)
+            if name == "sr25519":
+                # serial_cpu_sig_s above is THIS repo's pure-Python
+                # schnorrkel (the only serial impl in the image); the
+                # fair reference comparator is go-schnorrkel
+                # (crypto/sr25519/pubkey.go:50), estimated low-thousands
+                # sig/s/core — no Go toolchain exists here to measure
+                # it, so speedup claims must quote this row, not the
+                # pure-Python one (PERF.md fairness note).
+                out[name]["fair_serial_baseline"] = {
+                    "impl": "go-schnorrkel (reference crypto/sr25519)",
+                    "est_sig_s": [2000, 4000],
+                    "method": "estimate; Go toolchain absent in image",
+                }
         except Exception as e:  # noqa: BLE001
             out[name] = {"error": repr(e)}
             print(f"curve_bench: {name} FAILED: {e!r}", file=sys.stderr)
