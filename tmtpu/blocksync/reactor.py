@@ -169,7 +169,7 @@ class BlocksyncReactor(BlockServingMixin, Reactor):
         except commit_verify.VerificationError as e:
             self._handle_bad_block(first.header.height, e)
             return False
-        return self._apply_one(first, second)
+        return self._apply_one(first, second, parts, bid)
 
     def _apply_one(self, block: Block, successor: Block,
                    parts=None, bid=None) -> bool:
