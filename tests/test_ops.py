@@ -268,3 +268,52 @@ def test_reindex_event_and_compact_db(tmp_path):
     r = _cli("--home", home, "compact-db")
     assert r.returncode == 0, r.stderr
     assert "Reclaimed" in r.stdout
+
+
+def test_reset_family_and_gen_node_key(tmp_path):
+    """commands/reset.go + gen_node_key.go semantics: reset-state keeps
+    keys AND sign state; unsafe-reset-priv-validator zeroes the sign
+    state but keeps the key identity; unsafe-reset-all leaves a FRESH
+    zero state file (FilePV.load refuses to start without one);
+    gen-node-key refuses to clobber an existing key."""
+    home = str(tmp_path / "h")
+    assert _cli("init", "--home", home).returncode == 0
+
+    key0 = json.load(open(os.path.join(
+        home, "config", "priv_validator_key.json")))
+    state_path = os.path.join(home, "data", "priv_validator_state.json")
+    json.dump({"height": "7", "round": 1, "step": 3},
+              open(state_path, "w"))
+    os.makedirs(os.path.join(home, "data", "blockstore.db"), exist_ok=True)
+
+    r = _cli("reset-state", "--home", home)
+    assert r.returncode == 0
+    assert not os.path.exists(os.path.join(home, "data", "blockstore.db"))
+    # keys and sign state intact
+    assert json.load(open(state_path))["height"] == "7"
+
+    r = _cli("unsafe-reset-priv-validator", "--home", home)
+    assert r.returncode == 0
+    assert json.load(open(state_path))["height"] == "0"
+    key1 = json.load(open(os.path.join(
+        home, "config", "priv_validator_key.json")))
+    assert key1["priv_key"] == key0["priv_key"]  # identity preserved
+
+    r = _cli("unsafe-reset-all", "--home", home)
+    assert r.returncode == 0
+    sd = json.load(open(state_path))
+    assert sd["height"] == "0" and "signature" not in sd
+    # and the node-facing loader accepts the post-reset layout
+    from tmtpu.privval.file_pv import FilePV
+
+    pv = FilePV.load(os.path.join(home, "config",
+                                  "priv_validator_key.json"), state_path)
+    assert pv.height == 0
+
+    r = _cli("gen-node-key", "--home", home)
+    assert r.returncode == 0
+    node_id = r.stdout.strip()
+    assert len(node_id) == 40 and bytes.fromhex(node_id)
+    r = _cli("gen-node-key", "--home", home)
+    assert r.returncode == 1  # refuses to clobber
+    assert "already exists" in r.stderr

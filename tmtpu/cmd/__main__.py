@@ -177,19 +177,164 @@ def cmd_gen_validator(args) -> int:
     return 0
 
 
+def _reset_file_pv(key_file: str, state_file: str) -> None:
+    """reset.go resetFilePV: existing key keeps its identity but the
+    sign state returns to genesis (a FRESH zero state file — FilePV.load
+    refuses to start without one); no key means generate both."""
+    import json as _json
+
+    from tmtpu.libs import amino_json
+    from tmtpu.privval.file_pv import FilePV
+
+    if os.path.exists(key_file):
+        with open(key_file) as f:
+            kd = _json.load(f)
+        pv = FilePV(amino_json.unmarshal_priv_key(kd["priv_key"]),
+                    key_file, state_file)
+        os.makedirs(os.path.dirname(state_file) or ".", exist_ok=True)
+        pv.save()
+        print("Reset private validator file to genesis state")
+    else:
+        os.makedirs(os.path.dirname(key_file) or ".", exist_ok=True)
+        os.makedirs(os.path.dirname(state_file) or ".", exist_ok=True)
+        FilePV.generate(key_file, state_file)
+        print("Generated private validator file")
+
+
 def cmd_unsafe_reset_all(args) -> int:
-    """Wipe data dir, keep config + priv key (commands/reset.go)."""
+    """Wipe data dir + addrbook, reset validator sign state to genesis
+    (commands/reset.go resetAll)."""
     cfg = _load_config(args.home)
+    if not getattr(args, "keep_addr_book", False):
+        ab = cfg.rooted("config/addrbook.json")  # node.py:258 path
+        if os.path.exists(ab):
+            os.unlink(ab)
+            print(f"Removed address book {ab}")
+    else:
+        print("The address book remains intact")
     data = cfg.rooted(cfg.base.db_dir)
     if os.path.isdir(data):
         shutil.rmtree(data)
         os.makedirs(data)
         print(f"Removed all data in {data}")
-    # reset priv validator sign state (double-sign safety preserved by
-    # operator discipline, as in the reference)
-    st = cfg.rooted(cfg.base.priv_validator_state_file)
-    if os.path.exists(st):
-        os.unlink(st)
+    _reset_file_pv(cfg.rooted(cfg.base.priv_validator_key_file),
+                   cfg.rooted(cfg.base.priv_validator_state_file))
+    return 0
+
+
+def cmd_reset_state(args) -> int:
+    """Remove the chain databases + WAL, keep keys AND validator sign
+    state (commands/reset.go resetState)."""
+    cfg = _load_config(args.home)
+    data = cfg.rooted(cfg.base.db_dir)
+    for name in ("blockstore.db", "state.db", "evidence.db",
+                 "tx_index.db"):
+        p = os.path.join(data, name)
+        if os.path.exists(p):
+            shutil.rmtree(p) if os.path.isdir(p) else os.unlink(p)
+            print(f"Removed {p}")
+    # the WAL lives wherever consensus.wal_file points (config.py:27) —
+    # a stale WAL after a state wipe bricks startup with "#ENDHEIGHT >=
+    # current height"
+    wal_path = cfg.rooted(cfg.consensus.wal_file)
+    wal_dir = os.path.dirname(wal_path)
+    if os.path.basename(wal_dir) == "cs.wal":
+        if os.path.isdir(wal_dir):
+            shutil.rmtree(wal_dir)
+            print(f"Removed {wal_dir}")
+    else:
+        # custom location: remove the group head + rotated segments only
+        base = os.path.basename(wal_path)
+        for fn in sorted(os.listdir(wal_dir)) if os.path.isdir(wal_dir) \
+                else []:
+            if fn == base or fn.startswith(base + "."):
+                os.unlink(os.path.join(wal_dir, fn))
+                print(f"Removed {os.path.join(wal_dir, fn)}")
+    return 0
+
+
+def cmd_unsafe_reset_priv_validator(args) -> int:
+    """Reset this node's validator sign state to genesis
+    (commands/reset.go ResetPrivValidatorCmd)."""
+    cfg = _load_config(args.home)
+    _reset_file_pv(cfg.rooted(cfg.base.priv_validator_key_file),
+                   cfg.rooted(cfg.base.priv_validator_state_file))
+    return 0
+
+
+def cmd_gen_node_key(args) -> int:
+    """Generate the node key and print its ID
+    (commands/gen_node_key.go — errors if one already exists)."""
+    from tmtpu.p2p.key import NodeKey
+
+    cfg = _load_config(args.home)
+    path = cfg.rooted(cfg.base.node_key_file)
+    if os.path.exists(path):
+        print(f"node key at {path!r} already exists", file=sys.stderr)
+        return 1
+    nk = NodeKey.load_or_gen(path)
+    print(nk.node_id)
+    return 0
+
+
+def cmd_probe_upnp(args) -> int:
+    """Probe the LAN for a UPnP IGD and report its external IP
+    (commands/probe_upnp.go)."""
+    import json as _json
+
+    from tmtpu.p2p import upnp
+
+    gw = upnp.discover(timeout_s=args.timeout)
+    if gw is None:
+        print(_json.dumps({"success": False}))
+        return 1
+    out = {"success": True, "control_url": gw.control_url,
+           "service": gw.service}
+    try:
+        out["external_ip"] = gw.external_ip()
+    except Exception as e:  # noqa: BLE001 — gateway present, call failed
+        out["external_ip_error"] = repr(e)
+    print(_json.dumps(out))
+    return 0
+
+
+def cmd_replay_console(args) -> int:
+    """replay-console — step through the consensus WAL's in-progress
+    height one message at a time (commands/replay.go replay-console):
+    app replay via handshake first, then each WAL message is printed and
+    applied on Enter (or immediately with --no-input)."""
+    import json as _json
+
+    from tmtpu.node.node import Node
+
+    cfg = _load_config(args.home)
+    cfg.rpc.laddr = ""
+    cfg.p2p.laddr = ""
+    node = Node(cfg)  # handshake replays the app to the store height
+
+    def on_msg(m):
+        print("--> " + _json.dumps(_proto_to_jsonable(m)))
+        if not args.no_input:
+            input("press Enter to apply...")
+
+    try:
+        cs = node.consensus
+        cs.do_wal_catchup = False  # we drive it ourselves
+        # mirror on_start's recovery sequence (state.py:148-151), minus
+        # the live round re-drive: an inspection tool must never sign or
+        # append to the WAL it is examining
+        cs._reconstruct_last_commit()
+        cs.catchup_replay(on_msg=on_msg, live_redrive=False)
+        rs = cs.rs
+        print(f"Replayed console to height {rs.height}, round {rs.round}, "
+              f"step {rs.step}")
+    finally:
+        # the node was never start()ed, so node.stop() would no-op
+        # (libs/service.py guards on _started) — shut the pieces that
+        # Node.__init__ opened down explicitly
+        if node.consensus.wal is not None:
+            node.consensus.wal.close()
+        node.proxy_app.stop()
     return 0
 
 
@@ -507,7 +652,32 @@ def main(argv=None) -> int:
     sp.set_defaults(fn=cmd_gen_validator)
 
     sp = sub.add_parser("unsafe-reset-all")
+    sp.add_argument("--keep-addr-book", action="store_true",
+                    help="keep the address book intact")
     sp.set_defaults(fn=cmd_unsafe_reset_all)
+
+    sp = sub.add_parser("reset-state",
+                        help="remove the chain DBs + WAL, keep keys and "
+                             "validator sign state")
+    sp.set_defaults(fn=cmd_reset_state)
+
+    sp = sub.add_parser("unsafe-reset-priv-validator",
+                        help="reset validator sign state to genesis")
+    sp.set_defaults(fn=cmd_unsafe_reset_priv_validator)
+
+    sp = sub.add_parser("gen-node-key",
+                        help="generate config/node_key.json, print its ID")
+    sp.set_defaults(fn=cmd_gen_node_key)
+
+    sp = sub.add_parser("probe-upnp", help="probe the LAN for a UPnP IGD")
+    sp.add_argument("--timeout", type=float, default=3.0)
+    sp.set_defaults(fn=cmd_probe_upnp)
+
+    sp = sub.add_parser("replay-console",
+                        help="step through the consensus WAL interactively")
+    sp.add_argument("--no-input", action="store_true",
+                    help="apply without pausing")
+    sp.set_defaults(fn=cmd_replay_console)
 
     sp = sub.add_parser("show-node-id")
     sp.set_defaults(fn=cmd_show_node_id)

@@ -173,7 +173,14 @@ class ConsensusState(BaseService):
             raise RuntimeError("reconstructed commit lacks +2/3 majority")
         self.rs.last_commit = vs
 
-    def catchup_replay(self) -> None:
+    def catchup_replay(self, on_msg=None, live_redrive: bool = True) -> None:
+        """Replay the in-progress height from the WAL (replay.go:93
+        catchupReplay). ``on_msg(wal_msg)`` — when given — is invoked
+        before each message is applied; `tmtpu replay-console` uses it
+        to step interactively (commands/replay.go replay-console).
+        ``live_redrive=False`` suppresses the post-replay round re-drive
+        — an INSPECTION caller must never sign proposals/votes or append
+        to the WAL it is examining."""
         if self.wal is None:
             return
         msgs = list(WAL.iter_messages(self.wal.path))
@@ -194,6 +201,8 @@ class ConsensusState(BaseService):
         self.replay_mode = True
         try:
             for m in msgs[start:]:
+                if on_msg is not None:
+                    on_msg(m)
                 with self._mtx:
                     if m.msg_info is not None:
                         self._replay_msg_info(m.msg_info)
@@ -203,6 +212,8 @@ class ConsensusState(BaseService):
                             m.timeout.round, m.timeout.step))
         finally:
             self.replay_mode = False
+        if not live_redrive:
+            return
         # Liveness after a mid-round crash: replay may have advanced the
         # step past actions we never performed (e.g. the step reached
         # Precommit but our own precommit was never signed before the
