@@ -1,0 +1,152 @@
+"""RPC server CORS + HTTPS (reference rpc/jsonrpc/server: rs/cors
+middleware over the mux, TLS when both cert and key are configured —
+config.go:315-321, :398)."""
+
+import datetime
+import json
+import ssl
+import urllib.request
+
+import pytest
+
+from tmtpu.rpc.server import RPCServer
+
+
+@pytest.fixture
+def routes_server():
+    srv = RPCServer("tcp://127.0.0.1:0",
+                    routes={"ping": lambda: {"ok": True}},
+                    cors_origins=["http://example.com"])
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _raw_request(port, method="GET", path="/ping", headers=None,
+                 scheme="http", ctx=None):
+    req = urllib.request.Request(
+        f"{scheme}://127.0.0.1:{port}{path}", method=method,
+        headers=headers or {})
+    return urllib.request.urlopen(req, timeout=10, context=ctx)
+
+
+def test_cors_preflight_and_response_headers(routes_server):
+    port = routes_server.port
+    # preflight
+    r = _raw_request(port, method="OPTIONS",
+                     headers={"Origin": "http://example.com",
+                              "Access-Control-Request-Method": "POST"})
+    assert r.status == 204
+    assert r.headers["Access-Control-Allow-Origin"] == "http://example.com"
+    assert "POST" in r.headers["Access-Control-Allow-Methods"]
+    assert "Content-Type" in r.headers["Access-Control-Allow-Headers"]
+    # actual request carries the origin header back
+    r = _raw_request(port, headers={"Origin": "http://example.com"})
+    assert json.loads(r.read())["result"]["ok"] is True
+    assert r.headers["Access-Control-Allow-Origin"] == "http://example.com"
+    # disallowed origin: no CORS headers (browser blocks), body still 200
+    r = _raw_request(port, headers={"Origin": "http://evil.test"})
+    assert r.headers.get("Access-Control-Allow-Origin") is None
+    assert r.status == 200
+
+
+def test_cors_wildcard():
+    srv = RPCServer("tcp://127.0.0.1:0",
+                    routes={"ping": lambda: {}}, cors_origins=["*"])
+    srv.start()
+    try:
+        r = _raw_request(srv.port, headers={"Origin": "http://any.where"})
+        assert r.headers["Access-Control-Allow-Origin"] == "*"
+    finally:
+        srv.stop()
+
+
+def test_cors_disabled_by_default():
+    srv = RPCServer("tcp://127.0.0.1:0", routes={"ping": lambda: {}})
+    srv.start()
+    try:
+        r = _raw_request(srv.port, headers={"Origin": "http://example.com"})
+        assert r.headers.get("Access-Control-Allow-Origin") is None
+    finally:
+        srv.stop()
+
+
+def _self_signed(tmp_path):
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "127.0.0.1")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=1))
+            .not_valid_after(now + datetime.timedelta(days=1))
+            .add_extension(x509.SubjectAlternativeName(
+                [x509.IPAddress(__import__("ipaddress")
+                                .ip_address("127.0.0.1"))]), critical=False)
+            .sign(key, hashes.SHA256()))
+    cert_p = tmp_path / "rpc.crt"
+    key_p = tmp_path / "rpc.key"
+    cert_p.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    key_p.write_bytes(key.private_bytes(
+        serialization.Encoding.PEM, serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption()))
+    return str(cert_p), str(key_p)
+
+
+def test_https_when_cert_and_key_configured(tmp_path):
+    cert, key = _self_signed(tmp_path)
+    srv = RPCServer("tcp://127.0.0.1:0",
+                    routes={"ping": lambda: {"secure": True}},
+                    tls_cert=cert, tls_key=key)
+    srv.start()
+    try:
+        ctx = ssl.create_default_context(cafile=cert)
+        r = _raw_request(srv.port, scheme="https", ctx=ctx)
+        assert json.loads(r.read())["result"]["secure"] is True
+        # plain HTTP against the TLS port must fail
+        with pytest.raises(Exception):  # noqa: PT011 — urllib wraps it
+            _raw_request(srv.port)
+    finally:
+        srv.stop()
+
+
+def test_head_requests_and_metrics_cors(routes_server):
+    port = routes_server.port
+    r = _raw_request(port, method="HEAD",
+                     headers={"Origin": "http://example.com"})
+    assert r.status == 200
+    assert r.read() == b""  # headers only
+    assert int(r.headers["Content-Length"]) > 0
+    assert r.headers["Access-Control-Allow-Origin"] == "http://example.com"
+    # restricted origins always vary on Origin, even on mismatch
+    r = _raw_request(port, headers={"Origin": "http://evil.test"})
+    assert r.headers["Vary"] == "Origin"
+
+
+def test_tls_slow_client_does_not_block_others(tmp_path):
+    """One TCP connection that never sends a ClientHello must not
+    freeze the accept loop (deferred per-connection handshake)."""
+    import socket
+
+    cert, key = _self_signed(tmp_path)
+    srv = RPCServer("tcp://127.0.0.1:0",
+                    routes={"ping": lambda: {"ok": 1}},
+                    tls_cert=cert, tls_key=key)
+    srv.start()
+    try:
+        stalled = socket.create_connection(("127.0.0.1", srv.port))
+        try:
+            ctx = ssl.create_default_context(cafile=cert)
+            r = _raw_request(srv.port, scheme="https", ctx=ctx)
+            assert json.loads(r.read())["result"]["ok"] == 1
+        finally:
+            stalled.close()
+    finally:
+        srv.stop()

@@ -8,6 +8,12 @@ from dataclasses import dataclass, field, asdict
 
 MS = 1_000_000  # ns per ms
 
+# canonical CORS defaults (config.go:318-321) — rpc/server.py imports
+# these so a directly-constructed RPCServer cannot drift from RPCConfig
+CORS_DEFAULT_METHODS = ("HEAD", "GET", "POST")
+CORS_DEFAULT_HEADERS = ("Origin", "Accept", "Content-Type",
+                        "X-Requested-With", "X-Server-Time")
+
 
 @dataclass
 class ConsensusConfig:
@@ -89,11 +95,20 @@ class RPCConfig:
     laddr: str = "tcp://127.0.0.1:26657"
     grpc_laddr: str = ""
     unsafe: bool = False
+    # CORS for browser RPC clients (config.go:315-321; empty = disabled)
+    cors_allowed_origins: list = field(default_factory=list)
+    cors_allowed_methods: list = field(
+        default_factory=lambda: list(CORS_DEFAULT_METHODS))
+    cors_allowed_headers: list = field(
+        default_factory=lambda: list(CORS_DEFAULT_HEADERS))
     max_open_connections: int = 900
     max_subscription_clients: int = 100
     max_subscriptions_per_client: int = 5
     timeout_broadcast_tx_commit_ns: int = 10_000 * MS
     max_body_bytes: int = 1000000
+    # both must be set for HTTPS (config.go:398); paths rooted at home
+    tls_cert_file: str = ""
+    tls_key_file: str = ""
     pprof_laddr: str = ""
 
 

@@ -297,7 +297,16 @@ class Node(BaseService):
         if config.rpc.laddr:
             from tmtpu.rpc.server import RPCServer
 
-            self.rpc_server = RPCServer(config.rpc.laddr, self)
+            rc = config.rpc
+            self.rpc_server = RPCServer(
+                rc.laddr, self,
+                cors_origins=rc.cors_allowed_origins,
+                cors_methods=rc.cors_allowed_methods,
+                cors_headers=rc.cors_allowed_headers,
+                tls_cert=config.rooted(rc.tls_cert_file)
+                if rc.tls_cert_file else "",
+                tls_key=config.rooted(rc.tls_key_file)
+                if rc.tls_key_file else "")
 
         # --- pprof (node.go:894-900: gated on RPC.PprofListenAddress) ---
         self.pprof_server = None

@@ -16,6 +16,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from tmtpu.abci import types as abci
+from tmtpu.config.config import CORS_DEFAULT_HEADERS, CORS_DEFAULT_METHODS
 from tmtpu.rpc import core, websocket
 from tmtpu.version import TMCoreSemVer
 
@@ -29,18 +30,28 @@ class RPCError(Exception):
 
 
 class RPCServer:
-    def __init__(self, laddr: str, node=None, routes=None):
+    def __init__(self, laddr: str, node=None, routes=None,
+                 cors_origins=None, cors_methods=None, cors_headers=None,
+                 tls_cert: str = "", tls_key: str = ""):
         """Serve a node's core routes (node=...) or an arbitrary routes
         dict (routes=..., e.g. the light proxy) — same HTTP/JSON-RPC
-        machinery either way; WebSocket upgrade needs a node's event bus."""
+        machinery either way; WebSocket upgrade needs a node's event bus.
+
+        CORS (rpc/jsonrpc/server via rs/cors in the reference): enabled
+        when ``cors_origins`` is non-empty ("*" or exact origins).
+        HTTPS: when BOTH ``tls_cert`` and ``tls_key`` are set
+        (config.go:398 — one without the other is plain HTTP)."""
         addr = laddr[len("tcp://"):] if laddr.startswith("tcp://") else laddr
         host, _, port = addr.rpartition(":")
         self.host = host or "127.0.0.1"
-        if self.host == "0.0.0.0":
-            pass
         self.port = int(port)
         self.node = node
         self.routes = routes
+        self.cors_origins = list(cors_origins or [])
+        self.cors_methods = list(cors_methods or CORS_DEFAULT_METHODS)
+        self.cors_headers = list(cors_headers or CORS_DEFAULT_HEADERS)
+        self.tls_cert = tls_cert
+        self.tls_key = tls_key
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -51,19 +62,58 @@ class RPCServer:
         else:
             env, routes = None, dict(self.routes or {})
 
+        srv = self
+
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
 
             def log_message(self, fmt, *args):  # quiet
                 pass
 
+            def _cors(self) -> None:
+                """Access-Control headers for allowed origins (the
+                reference mounts rs/cors over the whole mux)."""
+                if not srv.cors_origins:
+                    return
+                if "*" in srv.cors_origins:
+                    self.send_header("Access-Control-Allow-Origin", "*")
+                    return
+                # restricted origins: ALWAYS vary on Origin so shared
+                # caches never serve a header-less variant to an
+                # allowed origin (rs/cors behavior)
+                self.send_header("Vary", "Origin")
+                origin = self.headers.get("Origin", "")
+                if origin in srv.cors_origins:
+                    self.send_header("Access-Control-Allow-Origin", origin)
+
+            def do_OPTIONS(self):
+                """CORS preflight."""
+                self.send_response(204)
+                self._cors()
+                if srv.cors_origins:
+                    self.send_header("Access-Control-Allow-Methods",
+                                     ", ".join(srv.cors_methods))
+                    self.send_header("Access-Control-Allow-Headers",
+                                     ", ".join(srv.cors_headers))
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
             def _respond(self, obj, status=200):
                 body = json.dumps(obj).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                self._cors()
                 self.end_headers()
-                self.wfile.write(body)
+                if not getattr(self, "_head", False):
+                    self.wfile.write(body)  # HEAD: headers only
+
+            def do_HEAD(self):
+                """GET semantics minus the body (Go's http server
+                discards handler bodies on HEAD the same way) — the
+                advertised CORS method list includes HEAD."""
+                self._head = True
+                self.do_GET()
 
             def _run(self, method: str, params: dict, req_id):
                 fn = routes.get(method)
@@ -99,8 +149,10 @@ class RPCServer:
                     self.send_header("Content-Type",
                                      "text/plain; version=0.0.4")
                     self.send_header("Content-Length", str(len(body)))
+                    self._cors()
                     self.end_headers()
-                    self.wfile.write(body)
+                    if not getattr(self, "_head", False):
+                        self.wfile.write(body)
                     return
                 if method == "":
                     # route list, like the reference's index page
@@ -161,7 +213,30 @@ class RPCServer:
                 else:
                     self._respond(invalid)
 
-        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        if self.tls_cert and self.tls_key:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(self.tls_cert, self.tls_key)
+
+            class TLSServer(ThreadingHTTPServer):
+                """Per-CONNECTION TLS wrap with a deferred handshake:
+                wrapping the listening socket would run the handshake
+                inside the lone accept loop, letting one stalled client
+                (TCP open, no ClientHello) freeze every other RPC
+                client. Deferred, the handshake happens on first read
+                in the per-request handler thread."""
+
+                def get_request(self):
+                    sock, addr = super().get_request()
+                    return ctx.wrap_socket(
+                        sock, server_side=True,
+                        do_handshake_on_connect=False), addr
+
+            self._httpd = TLSServer((self.host, self.port), Handler)
+        else:
+            self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                              Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True, name="rpc-http")
