@@ -306,7 +306,12 @@ class Node(BaseService):
                 tls_cert=config.rooted(rc.tls_cert_file)
                 if rc.tls_cert_file else "",
                 tls_key=config.rooted(rc.tls_key_file)
-                if rc.tls_key_file else "")
+                if rc.tls_key_file else "",
+                max_body_bytes=rc.max_body_bytes,
+                max_open_connections=rc.max_open_connections,
+                max_subscription_clients=rc.max_subscription_clients,
+                max_subscriptions_per_client=
+                rc.max_subscriptions_per_client)
 
         # --- pprof (node.go:894-900: gated on RPC.PprofListenAddress) ---
         self.pprof_server = None

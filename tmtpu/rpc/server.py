@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import base64
 import json
+import os
 import threading
 import time
 import urllib.parse
@@ -32,19 +33,34 @@ class RPCError(Exception):
 class RPCServer:
     def __init__(self, laddr: str, node=None, routes=None,
                  cors_origins=None, cors_methods=None, cors_headers=None,
-                 tls_cert: str = "", tls_key: str = ""):
+                 tls_cert: str = "", tls_key: str = "",
+                 max_body_bytes: int = 1_000_000,
+                 max_open_connections: int = 900,
+                 max_subscription_clients: int = 100,
+                 max_subscriptions_per_client: int = 5):
         """Serve a node's core routes (node=...) or an arbitrary routes
         dict (routes=..., e.g. the light proxy) — same HTTP/JSON-RPC
         machinery either way; WebSocket upgrade needs a node's event bus.
 
-        CORS (rpc/jsonrpc/server via rs/cors in the reference): enabled
-        when ``cors_origins`` is non-empty ("*" or exact origins).
-        HTTPS: when BOTH ``tls_cert`` and ``tls_key`` are set
-        (config.go:398 — one without the other is plain HTTP)."""
-        addr = laddr[len("tcp://"):] if laddr.startswith("tcp://") else laddr
-        host, _, port = addr.rpartition(":")
-        self.host = host or "127.0.0.1"
-        self.port = int(port)
+        laddr: ``tcp://host:port`` or ``unix:///path/sock``
+        (http_server.go:265 accepts both). CORS (rpc/jsonrpc/server via
+        rs/cors in the reference): enabled when ``cors_origins`` is
+        non-empty ("*" or exact origins). HTTPS: when BOTH ``tls_cert``
+        and ``tls_key`` are set (config.go:398 — one without the other
+        is plain HTTP; tcp only). The four limits mirror RPCConfig
+        (config.go:328-344): body size is enforced per POST, open
+        connections via a LimitListener-style accept gate, and the
+        subscription caps in the websocket upgrade path."""
+        self.unix_path = ""
+        if laddr.startswith("unix://"):
+            self.unix_path = laddr[len("unix://"):]
+            self.host, self.port = "", 0
+        else:
+            addr = laddr[len("tcp://"):] \
+                if laddr.startswith("tcp://") else laddr
+            host, _, port = addr.rpartition(":")
+            self.host = host or "127.0.0.1"
+            self.port = int(port)
         self.node = node
         self.routes = routes
         self.cors_origins = list(cors_origins or [])
@@ -52,6 +68,12 @@ class RPCServer:
         self.cors_headers = list(cors_headers or CORS_DEFAULT_HEADERS)
         self.tls_cert = tls_cert
         self.tls_key = tls_key
+        self.max_body_bytes = max_body_bytes
+        self.max_open_connections = max_open_connections
+        self.max_subscription_clients = max_subscription_clients
+        self.max_subscriptions_per_client = max_subscriptions_per_client
+        self._ws_clients = 0
+        self._ws_lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -111,7 +133,9 @@ class RPCServer:
             def do_HEAD(self):
                 """GET semantics minus the body (Go's http server
                 discards handler bodies on HEAD the same way) — the
-                advertised CORS method list includes HEAD."""
+                advertised CORS method list includes HEAD. The flag is
+                cleared in do_GET's finally: keep-alive reuses this
+                handler instance for subsequent requests."""
                 self._head = True
                 self.do_GET()
 
@@ -135,6 +159,12 @@ class RPCServer:
                         "data": str(e)}}
 
             def do_GET(self):
+                try:
+                    self._do_get()
+                finally:
+                    self._head = False
+
+            def _do_get(self):
                 parsed = urllib.parse.urlparse(self.path)
                 method = parsed.path.lstrip("/")
                 if method == "websocket" and env is not None and \
@@ -174,19 +204,40 @@ class RPCServer:
                 if not key:
                     self.send_error(400, "missing Sec-WebSocket-Key")
                     return
-                self.send_response(101, "Switching Protocols")
-                self.send_header("Upgrade", "websocket")
-                self.send_header("Connection", "Upgrade")
-                self.send_header("Sec-WebSocket-Accept",
-                                 websocket.handshake_accept_key(key))
-                self.end_headers()
-                self.close_connection = True
-                session = websocket.WSSession(self, env, routes,
-                                              core.event_data_json)
-                session.serve()
+                with srv._ws_lock:
+                    if srv._ws_clients >= srv.max_subscription_clients:
+                        # events.go ErrMaxSubscriptionClients
+                        self.send_error(
+                            503, "max_subscription_clients reached")
+                        return
+                    srv._ws_clients += 1
+                try:
+                    self.send_response(101, "Switching Protocols")
+                    self.send_header("Upgrade", "websocket")
+                    self.send_header("Connection", "Upgrade")
+                    self.send_header("Sec-WebSocket-Accept",
+                                     websocket.handshake_accept_key(key))
+                    self.end_headers()
+                    self.close_connection = True
+                    session = websocket.WSSession(
+                        self, env, routes, core.event_data_json,
+                        max_subs=srv.max_subscriptions_per_client)
+                    session.serve()
+                finally:
+                    with srv._ws_lock:
+                        srv._ws_clients -= 1
 
             def do_POST(self):
                 n = int(self.headers.get("Content-Length", 0))
+                if n > srv.max_body_bytes:
+                    # http_server.go maxBodyBytes: refuse before reading
+                    self.close_connection = True
+                    self._respond({"jsonrpc": "2.0", "id": -1, "error": {
+                        "code": -32600,
+                        "message": f"request body too large "
+                                   f"(max {srv.max_body_bytes} bytes)"}},
+                        status=413)
+                    return
                 try:
                     req = json.loads(self.rfile.read(n) or b"{}")
                 except json.JSONDecodeError:
@@ -213,13 +264,75 @@ class RPCServer:
                 else:
                     self._respond(invalid)
 
-        if self.tls_cert and self.tls_key:
+        sem = (threading.BoundedSemaphore(self.max_open_connections)
+               if self.max_open_connections > 0 else None)
+
+        class _LimitMixin:
+            """netutil.LimitListener analogue: accept blocks while
+            max_open_connections are in flight; the slot frees when the
+            connection closes. The acquire polls a shutdown flag so
+            RPCServer.stop() cannot hang behind a saturated cap (Go's
+            LimitListener unblocks on Close the same way)."""
+
+            _stopping = False
+
+            def get_request(self):
+                if sem is not None:
+                    while not sem.acquire(timeout=0.5):
+                        if self._stopping:
+                            raise OSError("server shutting down")
+                try:
+                    return super().get_request()
+                except BaseException:
+                    if sem is not None:
+                        sem.release()
+                    raise
+
+            def close_request(self, request):
+                try:
+                    super().close_request(request)
+                finally:
+                    if sem is not None:
+                        sem.release()
+
+        if self.unix_path:
+            import socketserver
+
+            class UnixHTTPServer(_LimitMixin, socketserver.ThreadingMixIn,
+                                 socketserver.UnixStreamServer):
+                daemon_threads = True
+
+                def get_request(self):
+                    request, _ = super().get_request()
+                    # BaseHTTPRequestHandler wants a (host, port) pair
+                    return request, ("unix", 0)
+
+            if os.path.exists(self.unix_path):
+                # only a STALE socket (crashed server) may be unlinked;
+                # hijacking a live server's address must fail like
+                # Go's net.Listen "address already in use"
+                import socket as _socket
+
+                probe = _socket.socket(_socket.AF_UNIX,
+                                       _socket.SOCK_STREAM)
+                try:
+                    probe.settimeout(1.0)
+                    probe.connect(self.unix_path)
+                    probe.close()
+                    raise OSError(
+                        f"unix socket {self.unix_path!r} is in use")
+                except (ConnectionRefusedError, FileNotFoundError,
+                        _socket.timeout, TimeoutError):
+                    probe.close()
+                    os.unlink(self.unix_path)
+            self._httpd = UnixHTTPServer(self.unix_path, Handler)
+        elif self.tls_cert and self.tls_key:
             import ssl
 
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ctx.load_cert_chain(self.tls_cert, self.tls_key)
 
-            class TLSServer(ThreadingHTTPServer):
+            class TLSServer(_LimitMixin, ThreadingHTTPServer):
                 """Per-CONNECTION TLS wrap with a deferred handshake:
                 wrapping the listening socket would run the handshake
                 inside the lone accept loop, letting one stalled client
@@ -229,20 +342,35 @@ class RPCServer:
 
                 def get_request(self):
                     sock, addr = super().get_request()
-                    return ctx.wrap_socket(
-                        sock, server_side=True,
-                        do_handshake_on_connect=False), addr
+                    try:
+                        return ctx.wrap_socket(
+                            sock, server_side=True,
+                            do_handshake_on_connect=False), addr
+                    except BaseException:
+                        # the accept succeeded: this connection owns a
+                        # semaphore slot and a live fd — a wrap failure
+                        # must free both or the cap leaks to zero
+                        sock.close()
+                        if sem is not None:
+                            sem.release()
+                        raise
 
             self._httpd = TLSServer((self.host, self.port), Handler)
         else:
-            self._httpd = ThreadingHTTPServer((self.host, self.port),
-                                              Handler)
-        self.port = self._httpd.server_address[1]
+            class TCPServer(_LimitMixin, ThreadingHTTPServer):
+                pass
+
+            self._httpd = TCPServer((self.host, self.port), Handler)
+        if not self.unix_path:
+            self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True, name="rpc-http")
         self._thread.start()
 
     def stop(self) -> None:
         if self._httpd is not None:
+            self._httpd._stopping = True  # unpark a cap-blocked accept
             self._httpd.shutdown()
             self._httpd.server_close()
+            if self.unix_path and os.path.exists(self.unix_path):
+                os.unlink(self.unix_path)

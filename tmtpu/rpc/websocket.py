@@ -107,7 +107,9 @@ def read_frame(rfile, on_control=None):
 class WSSession:
     """One connected websocket client: its subscriptions + write lock."""
 
-    def __init__(self, handler, env, routes, event_encoder):
+    def __init__(self, handler, env, routes, event_encoder,
+                 max_subs: int = 5):
+        self.max_subs = max_subs
         self.handler = handler
         self.sock = handler.connection
         self.rfile = handler.rfile
@@ -199,7 +201,8 @@ class WSSession:
     # -- subscriptions (rpc/core/events.go Subscribe) ------------------------
 
     def _subscribe(self, query_str: str, req_id) -> None:
-        if len(self._subs) >= 5:  # max_subscriptions_per_client default
+        if len(self._subs) >= self.max_subs:
+            # events.go:36 ErrMaxSubscriptionsPerClientReached
             self._respond(req_id, error={
                 "code": -32603, "message": "max subscriptions reached"})
             return
