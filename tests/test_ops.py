@@ -317,3 +317,53 @@ def test_reset_family_and_gen_node_key(tmp_path):
     r = _cli("gen-node-key", "--home", home)
     assert r.returncode == 1  # refuses to clobber
     assert "already exists" in r.stderr
+
+
+def test_debug_dump_and_kill_archives(tmp_path):
+    """commands/debug: `debug dump` produces timestamped zip archives of
+    the RPC state dumps; `debug kill` aggregates dumps + WAL + config
+    (never the validator private key) and SIGABRTs the pid."""
+    import signal
+    import zipfile
+
+    home = str(tmp_path / "h")
+    assert _cli("init", "--home", home).returncode == 0
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tmtpu.cmd", "start", "--home", home,
+         "--proxy-app", "kvstore"], cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.time() + 60
+        up = False
+        while time.time() < deadline and not up:
+            try:
+                urllib.request.urlopen(
+                    "http://127.0.0.1:26657/status", timeout=2)
+                up = True
+            except Exception:
+                time.sleep(1)
+        assert up, "node RPC never came up"
+
+        out = str(tmp_path / "dumps")
+        r = _cli("debug", "dump", out, "--iterations", "1")
+        assert r.returncode == 0, r.stderr
+        archives = os.listdir(out)
+        assert len(archives) == 1 and archives[0].endswith(".zip")
+        names = zipfile.ZipFile(
+            os.path.join(out, archives[0])).namelist()
+        assert "status.json" in names and "net_info.json" in names
+
+        kill_zip = str(tmp_path / "kill.zip")
+        r = _cli("--home", home, "debug", "kill", str(proc.pid), kill_zip,
+                 timeout=90)
+        assert r.returncode == 0, r.stderr
+        names = zipfile.ZipFile(kill_zip).namelist()
+        assert "status.json" in names
+        assert any(n.startswith("config/") for n in names)
+        assert not any("priv_validator_key" in n for n in names)
+        assert proc.wait(timeout=30) != 0  # SIGABRT'd
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait()

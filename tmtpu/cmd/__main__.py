@@ -82,25 +82,104 @@ def cmd_init(args) -> int:
     return 0
 
 
-def cmd_debug_dump(args) -> int:
-    """debug dump — capture a node's state via RPC (commands/debug):
-    status, consensus state, net info into a timestamped dir."""
+def _rpc_dumps(rpc_laddr: str, out_dir: str) -> None:
+    """Fetch the standard debug RPC dumps into ``out_dir``
+    (debug/util.go dumpStatus/dumpNetInfo/dumpConsensusState)."""
     import urllib.request
 
-    out = os.path.join(os.path.expanduser(args.output_dir),
-                       time.strftime("%Y%m%d-%H%M%S"))
-    os.makedirs(out, exist_ok=True)
-    base = args.rpc_laddr.replace("tcp://", "http://")
+    base = rpc_laddr.replace("tcp://", "http://")
     for name in ("status", "consensus_state", "dump_consensus_state",
                  "net_info", "num_unconfirmed_txs"):
         try:
             with urllib.request.urlopen(f"{base}/{name}", timeout=10) as r:
                 body = r.read()
-            with open(os.path.join(out, f"{name}.json"), "wb") as f:
+            with open(os.path.join(out_dir, f"{name}.json"), "wb") as f:
                 f.write(body)
         except Exception as e:  # noqa: BLE001
             print(f"  {name}: {e}", file=sys.stderr)
-    print(f"Wrote debug dump to {out}")
+
+
+def _copy_home_debug(home: str, out_dir: str) -> None:
+    """WAL + config copies for a debug archive (debug/kill.go
+    copyWAL/copyConfig)."""
+    cfg = _load_config(home)
+    wal_dir = os.path.dirname(cfg.rooted(cfg.consensus.wal_file))
+    if os.path.isdir(wal_dir):
+        shutil.copytree(wal_dir, os.path.join(out_dir, "cs.wal"),
+                        dirs_exist_ok=True)
+    conf_dir = cfg.rooted("config")
+    if os.path.isdir(conf_dir):
+        os.makedirs(os.path.join(out_dir, "config"), exist_ok=True)
+        # never exfiltrate PRIVATE KEYS into a debug archive that gets
+        # shared around — resolve the configured paths, not hardcoded
+        # names (priv_validator_key_file is operator-settable)
+        secret_paths = {
+            os.path.realpath(cfg.rooted(cfg.base.priv_validator_key_file)),
+            os.path.realpath(cfg.rooted(cfg.base.node_key_file)),
+        }
+        for fn in os.listdir(conf_dir):
+            src = os.path.join(conf_dir, fn)
+            if os.path.realpath(src) in secret_paths:
+                continue
+            if os.path.isfile(src):
+                shutil.copy2(src, os.path.join(out_dir, "config", fn))
+
+
+def cmd_debug_dump(args) -> int:
+    """debug dump [dir] — poll a node's state every --frequency seconds
+    into timestamped archives (commands/debug/dump.go); --iterations
+    bounds the loop (the reference polls forever)."""
+    import tempfile
+    import zipfile
+
+    out_root = os.path.expanduser(args.output_dir)
+    os.makedirs(out_root, exist_ok=True)
+    it = 0
+    while True:
+        it += 1
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        with tempfile.TemporaryDirectory() as tmp:
+            _rpc_dumps(args.rpc_laddr, tmp)
+            # iteration suffix: sub-second --frequency must not
+            # overwrite the previous archive (same-second stamp)
+            archive = os.path.join(out_root, f"{stamp}-{it:04d}.zip")
+            with zipfile.ZipFile(archive, "w",
+                                 zipfile.ZIP_DEFLATED) as z:
+                for fn in sorted(os.listdir(tmp)):
+                    z.write(os.path.join(tmp, fn), fn)
+        print(f"Wrote debug archive {archive}")
+        if args.iterations and it >= args.iterations:
+            return 0
+        time.sleep(args.frequency)
+
+
+def cmd_debug_kill(args) -> int:
+    """debug kill <pid> <out.zip> — aggregate node state (RPC dumps +
+    WAL + config), archive it, then SIGABRT the process
+    (commands/debug/kill.go)."""
+    import signal as _signal
+    import tempfile
+    import zipfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        _rpc_dumps(args.rpc_laddr, tmp)
+        try:
+            _copy_home_debug(args.home, tmp)
+        except Exception as e:  # noqa: BLE001
+            print(f"  home copy: {e}", file=sys.stderr)
+        out = os.path.expanduser(args.out_file)
+        with zipfile.ZipFile(out, "w", zipfile.ZIP_DEFLATED) as z:
+            for root, _dirs, files in os.walk(tmp):
+                for fn in files:
+                    p = os.path.join(root, fn)
+                    z.write(p, os.path.relpath(p, tmp))
+    print(f"Wrote debug archive {out}")
+    try:
+        os.kill(args.pid, _signal.SIGABRT)
+        print(f"Sent SIGABRT to {args.pid}")
+    except ProcessLookupError:
+        print(f"no such process {args.pid}", file=sys.stderr)
+        return 1
     return 0
 
 
@@ -689,10 +768,27 @@ def main(argv=None) -> int:
     sp.set_defaults(fn=cmd_replay)
 
     sp = sub.add_parser("debug", help="capture a running node's state")
-    sp.add_argument("--rpc-laddr", dest="rpc_laddr",
-                    default="tcp://127.0.0.1:26657")
-    sp.add_argument("--output-dir", dest="output_dir", default="./debug")
-    sp.set_defaults(fn=cmd_debug_dump)
+    dbg = sp.add_subparsers(dest="debug_cmd")
+    dmp = dbg.add_parser("dump", help="poll + archive node state")
+    dmp.add_argument("output_dir", nargs="?", default="./debug")
+    dmp.add_argument("--rpc-laddr", dest="rpc_laddr",
+                     default="tcp://127.0.0.1:26657")
+    dmp.add_argument("--frequency", type=float, default=30.0)
+    dmp.add_argument("--iterations", type=int, default=0,
+                     help="stop after N archives (0 = forever, like the "
+                          "reference)")
+    dmp.set_defaults(fn=cmd_debug_dump)
+    kil = dbg.add_parser("kill",
+                         help="archive node state, then SIGABRT the pid")
+    kil.add_argument("pid", type=int)
+    kil.add_argument("out_file")
+    kil.add_argument("--rpc-laddr", dest="rpc_laddr",
+                     default="tcp://127.0.0.1:26657")
+    kil.set_defaults(fn=cmd_debug_kill)
+    # bare `tmtpu debug` behaves like one dump iteration (round-3 CLI)
+    sp.set_defaults(fn=cmd_debug_dump, output_dir="./debug",
+                    rpc_laddr="tcp://127.0.0.1:26657", frequency=30.0,
+                    iterations=1)
 
     sp = sub.add_parser("reindex-event",
                         help="rebuild tx/block-event indexes from stores")

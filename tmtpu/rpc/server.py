@@ -228,9 +228,14 @@ class RPCServer:
                         srv._ws_clients -= 1
 
             def do_POST(self):
-                n = int(self.headers.get("Content-Length", 0))
-                if n > srv.max_body_bytes:
-                    # http_server.go maxBodyBytes: refuse before reading
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    n = -1
+                if n < 0 or n > srv.max_body_bytes:
+                    # http_server.go maxBodyBytes: refuse before
+                    # reading; negative/garbage Content-Length would
+                    # turn rfile.read(n) into an unbounded read
                     self.close_connection = True
                     self._respond({"jsonrpc": "2.0", "id": -1, "error": {
                         "code": -32600,
@@ -321,10 +326,16 @@ class RPCServer:
                     probe.close()
                     raise OSError(
                         f"unix socket {self.unix_path!r} is in use")
-                except (ConnectionRefusedError, FileNotFoundError,
-                        _socket.timeout, TimeoutError):
+                except (ConnectionRefusedError, FileNotFoundError):
                     probe.close()
-                    os.unlink(self.unix_path)
+                    os.unlink(self.unix_path)  # genuinely stale
+                except (_socket.timeout, TimeoutError):
+                    # something IS listening, just saturated/slow —
+                    # that's "in use", not stale
+                    probe.close()
+                    raise OSError(
+                        f"unix socket {self.unix_path!r} is in use "
+                        f"(listener busy)") from None
             self._httpd = UnixHTTPServer(self.unix_path, Handler)
         elif self.tls_cert and self.tls_key:
             import ssl
