@@ -564,6 +564,31 @@ def main():
         # machine-readable degradation marker: the headline was picked
         # from a reduced structure set
         out["failed"] = failed
+    if backend == "cpu":
+        # Context for the fallback artifact: the device-graph-on-XLA:CPU
+        # number above is NOT how tmtpu verifies on a CPU-only box — the
+        # consensus path's CPU backend is the serial OpenSSL verifier
+        # (crypto/batch.py CPUBatchVerifier), which sits at the Go-serial
+        # baseline. Measure it so the line carries the framework's real
+        # CPU capability alongside the (slow) emulated device graph.
+        try:
+            from tmtpu.crypto.batch import CPUBatchVerifier
+            from tmtpu.crypto.ed25519 import PubKeyEd25519
+
+            pks_b, msgs_b, sigs_b = sets[0]
+            sample = min(lanes, 2000)
+            bv = CPUBatchVerifier()
+            for i in range(sample):
+                bv.add(PubKeyEd25519(pks_b[i]), msgs_b[i], sigs_b[i])
+            t0 = time.perf_counter()
+            all_ok, _mask = bv.verify()
+            dt = time.perf_counter() - t0
+            assert all_ok
+            out["cpu_serial_backend_sig_s"] = round(sample / dt, 1)
+            out["cpu_serial_backend_vs_baseline"] = round(
+                (sample / dt) / GO_SERIAL_SIG_S, 2)
+        except Exception as e:  # noqa: BLE001
+            out["cpu_serial_backend_error"] = repr(e)
     if lanes == LANES and "sync" in structures:
         # per-batch LATENCY of one 10k VoteSet (prep -> put -> step ->
         # drain), from the measured sync structure — deliberately NOT the
