@@ -1,0 +1,16 @@
+#!/bin/sh
+# Tunnel watcher that ARMS the measurement battery: the probe loop exits
+# 0 the moment the TPU tunnel is alive, and the battery then fires
+# immediately (each step banks its results to artifacts/device_runs.jsonl
+# as it completes — see tools/device_battery.py). Run in the background
+# for the whole round so a late tunnel window is never missed.
+cd "$(dirname "$0")/.." || exit 1
+TPU_PROBE_BUDGET="${TPU_PROBE_BUDGET:-20000}" python tools/tpu_probe_loop.py
+rc=$?
+if [ "$rc" -eq 0 ]; then
+    echo "watcher: tunnel ALIVE — firing device battery" >&2
+    python tools/device_battery.py
+else
+    echo "watcher: probe budget exhausted (rc=$rc), no battery" >&2
+fi
+exit $rc
