@@ -45,12 +45,22 @@ import time
 GO_SERIAL_SIG_S = 1e6 / 55.0  # 55 µs/sig Go stdlib midpoint (BASELINE.md)
 LANES = 10_000  # MaxVotesCount (types/vote_set.go:18)
 PROBE_TIMEOUT_S = float(os.environ.get("TMTPU_BENCH_PROBE_TIMEOUT", "180"))
-# Total wall-clock budget for winning a device backend. Tunnel wedges on
-# this box are transient but LONG (rounds 1-3 all ended against one), so
-# the default keeps trying for ~35 minutes before conceding to the CPU
-# fallback — the cached-device merge then still carries any mid-round
-# on-chip evidence into the emitted line (VERDICT r3 #1).
-PROBE_BUDGET_S = float(os.environ.get("TMTPU_BENCH_PROBE_BUDGET", "2100"))
+# Total wall-clock budget for winning a device backend. VERDICT r4 weak
+# #1: round 4's 2100 s budget (plus a 1200 s CPU child) overran the
+# driver's kill window and the process died having printed NOTHING. The
+# budget is now sized so probe + CPU fallback + emit always fits inside
+# WALL_CAP_S — and a provisional JSON line is printed BEFORE any probing,
+# so even a kill mid-probe leaves a parseable artifact (the driver reads
+# the last JSON line; each later emission supersedes the provisional).
+# Hard cap on the parent's total wall time when the tunnel is wedged.
+# Round 3's ~1500 s total survived the driver window; round 4's 2100+
+# did not — stay at or under the proven figure plus emission slack.
+WALL_CAP_S = float(os.environ.get("TMTPU_BENCH_WALL_CAP", "1680"))
+# Clamped so a stale env override (round 4 shipped 2100) can never defeat
+# the wall cap: probing must always leave room for a CPU child + emit.
+PROBE_BUDGET_S = min(
+    float(os.environ.get("TMTPU_BENCH_PROBE_BUDGET", "600")),
+    WALL_CAP_S - 600)
 
 # provenance for the output JSON: every probe attempt's outcome
 _probe_log: list = []
@@ -170,7 +180,7 @@ def _emit_with_provenance(json_line: str, parent_attempts) -> None:
             out = _attach_cached_extras(out)
         except Exception as e:  # noqa: BLE001
             out["cache_error"] = repr(e)
-        print(json.dumps(out))
+        print(json.dumps(out), flush=True)
         return
     # Live run fell back to CPU (wedged tunnel — rounds 1-3 all ended
     # here and the driver artifact erased every mid-round on-chip
@@ -182,7 +192,7 @@ def _emit_with_provenance(json_line: str, parent_attempts) -> None:
     except Exception as e:  # noqa: BLE001
         out["source"] = "live-cpu"
         out["cache_error"] = repr(e)
-    print(json.dumps(out))
+    print(json.dumps(out), flush=True)
 
 
 def _cache_views():
@@ -272,6 +282,84 @@ def _merge_cached_device(cpu_out: dict) -> dict:
     return _attach_cached_extras(merged, views)
 
 
+def _quick_serial_floor(n: int = 1000):
+    """Raw serial ed25519 verify throughput on the host, via the OpenSSL
+    binding only — no jax, no tmtpu imports, seconds of wall. This is the
+    floor number the provisional line carries when the device cache is
+    empty; it is the same primitive the Go baseline serializes
+    (crypto/ed25519/ed25519.go Verify), measured here one call at a time."""
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+
+    sks = [Ed25519PrivateKey.from_private_bytes(
+        i.to_bytes(32, "little")) for i in range(64)]
+    pks = [k.public_key() for k in sks]
+    msgs = [b"provisional-floor-%06d" % i for i in range(n)]
+    sigs = [sks[i % 64].sign(msgs[i]) for i in range(n)]
+    t0 = time.perf_counter()
+    for i in range(n):
+        pks[i % 64].verify(sigs[i], msgs[i])
+    return n / (time.perf_counter() - t0)
+
+
+_floor_cache: list = []  # the serial floor is measured once per run
+
+
+def _provisional_out() -> dict:
+    """Shared body of both provisional emissions: cached device evidence
+    when the cache has any, else a (once-measured) serial-CPU floor."""
+    if not _floor_cache:
+        try:
+            _floor_cache.append(_quick_serial_floor())
+        except Exception:  # noqa: BLE001
+            _floor_cache.append(0.0)
+    sig_s = _floor_cache[0]
+    base = {
+        "metric": "ed25519_batch_verify_10k_voteset_e2e",
+        "value": round(sig_s, 1),
+        "unit": "sig/s",
+        "vs_baseline": round(sig_s / GO_SERIAL_SIG_S, 2),
+        "backend": "cpu",
+        "source": "provisional-serial-floor",
+    }
+    try:
+        out = _merge_cached_device(base)
+    except Exception as e:  # noqa: BLE001
+        out = base
+        out["cache_error"] = repr(e)
+    if out.get("source") == "live-cpu":  # empty cache: keep the honest tag
+        out["source"] = "provisional-serial-floor"
+    return out
+
+
+def _emit_provisional() -> None:
+    """Print a parseable JSON result line BEFORE any probing (VERDICT r4
+    next-step #1a). The driver parses the LAST JSON line, so every later
+    (better-informed) emission supersedes this one — but a kill at any
+    point after this prints leaves `parsed` non-null."""
+    out = _provisional_out()
+    out["provisional"] = True
+    if not out.get("probe"):
+        out["probe"] = {"attempts": 0, "log": [],
+                        "budget_s": PROBE_BUDGET_S}
+    out["note"] = ("emitted before device probing; a later line "
+                   "supersedes this one")
+    print(json.dumps(out), flush=True)
+
+
+def _emit_provisional_final(attempts) -> None:
+    """Terminal emission when no child produced a result: the provisional
+    content again, now carrying the full probe log and the parent's
+    fallback history. This is the line the driver parses in the
+    worst case — it must always print."""
+    out = _provisional_out()
+    out["failed"] = attempts or ["no-child-result"]
+    out["probe"] = {"attempts": len(_probe_log), "log": _probe_log[-6:],
+                    "budget_s": PROBE_BUDGET_S}
+    print(json.dumps(out), flush=True)
+
+
 def _make_votes(n: int):
     """n distinct validators, one signed precommit each — real canonical
     sign-bytes (types/vote.go:93 semantics), distinct per lane because the
@@ -347,28 +435,73 @@ def _run_child(backend: str, timeout_s: float):
     return None
 
 
+def _run_parent(t0):
+    def remaining():
+        return WALL_CAP_S - (time.perf_counter() - t0)
+
+    backend = _init_backend_probe()
+    attempts = []
+    if backend == "device" and remaining() > 390:
+        # expected device run ~12 min (compile + structures + curves);
+        # cap it so a dead-tunnel hang still leaves emission slack
+        out = _run_child("device",
+                         timeout_s=min(1500.0, max(300.0,
+                                                   remaining() - 90)))
+        if out is not None:
+            _emit_with_provenance(out, attempts)
+            return
+        attempts.append("device-child-failed")
+    elif backend == "device":
+        attempts.append("device-child-skipped-wall-cap")
+    if remaining() > 240:
+        out = _run_child(
+            "cpu", timeout_s=min(960.0, max(180.0, remaining() - 60)))
+    else:
+        out = None
+        attempts.append("cpu-child-skipped-wall-cap")
+        print("bench: skipping CPU child — wall cap nearly spent",
+              file=sys.stderr)
+    if out is None:
+        # The provisional line already stands; replace it with one that
+        # carries the full probe log and failure markers so the artifact
+        # explains itself. Never raise: a wedged tunnel must not be able
+        # to produce parsed=null again (VERDICT r4 #1).
+        _emit_provisional_final(attempts)
+    else:
+        _emit_with_provenance(out, attempts)
+    print(f"bench: total wall {time.perf_counter() - t0:.0f}s",
+          file=sys.stderr)
+
+
 def main():
     if not os.environ.get("TMTPU_BENCH_CHILD"):
-        # PARENT: no jax state; probe, then delegate to children
+        # PARENT: no jax state; emit a provisional line FIRST (a driver
+        # kill at any later point still leaves a parseable artifact),
+        # then probe and delegate to children under a total wall cap.
+        # Order matters: wall clock first, provisional line second (it
+        # touches no tunnel and needs no clean core — a driver kill must
+        # find a parseable line no matter what), lock third. The whole
+        # run is one timing window (docs/qa.md clean-measurement rule):
+        # the lock keeps the background tunnel prober off the single
+        # core — the driver's end-of-round run is NOT under the battery,
+        # and prober contention made round-4 numbers ~20% low. acquire()
+        # may wait out an in-flight probe (≤120 s), which counts against
+        # WALL_CAP_S because t0 starts before it. Lock staleness (45
+        # min) exceeds WALL_CAP_S, and a kill leaves a lock the prober
+        # ignores after that.
         t0 = time.perf_counter()
-        backend = _init_backend_probe()
-        attempts = []
-        if backend == "device":
-            # expected device run ~12 min (compile + structures + curves);
-            # 25 min cap keeps the worst case (probe budget + dead device
-            # child + CPU child) inside ~65 min of driver wall
-            out = _run_child("device", timeout_s=1500)
-            if out is not None:
-                _emit_with_provenance(out, attempts)
-                return
-            attempts.append("device-child-failed")
-        out = _run_child("cpu", timeout_s=1200)
-        if out is None:
-            raise RuntimeError(f"no bench child produced a result "
-                               f"(attempts: {attempts})")
-        _emit_with_provenance(out, attempts)
-        print(f"bench: total wall {time.perf_counter() - t0:.0f}s",
-              file=sys.stderr)
+        _emit_provisional()
+        try:
+            from tools import measure_lock
+
+            measure_lock.acquire("bench.py")
+        except Exception:  # noqa: BLE001 — lock is advisory, never fatal
+            measure_lock = None
+        try:
+            _run_parent(t0)
+        finally:
+            if measure_lock is not None:
+                measure_lock.release()
         return
 
     backend = os.environ["TMTPU_BENCH_CHILD"]
@@ -587,6 +720,18 @@ def main():
             out["cpu_serial_backend_sig_s"] = round(sample / dt, 1)
             out["cpu_serial_backend_vs_baseline"] = round(
                 (sample / dt) / GO_SERIAL_SIG_S, 2)
+            if sample / dt > sig_s:
+                # The framework's actual CPU-backend verify path (serial
+                # OpenSSL) beats the device graph emulated on XLA:CPU —
+                # the headline should carry what the framework really
+                # does on this backend, with the emulated-graph numbers
+                # kept above for transparency.
+                out["value"] = out["cpu_serial_backend_sig_s"]
+                out["vs_baseline"] = out["cpu_serial_backend_vs_baseline"]
+                out["pipeline"] = "serial-openssl-backend"
+                # the headline now comes from a serial sample, not the
+                # `lanes`-wide emulated graph kept above in `structures`
+                out["serial_sample_n"] = sample
         except Exception as e:  # noqa: BLE001
             out["cpu_serial_backend_error"] = repr(e)
     if lanes == LANES and "sync" in structures:

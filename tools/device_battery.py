@@ -126,7 +126,12 @@ def main():
                 return
             time.sleep(60)
         dead = 0
+        # no battery-level lock: each step's tool holds the measurement
+        # lock for its own timing windows (bench.py, curve_bench,
+        # k1_sweep, tpu_live_round all self-lock), which avoids nested
+        # holds on the shared lockfile
         results.append(run_step(name, cmd, timeout, env_extra))
+        _commit_artifacts(f"battery step {name} banked")
     _emit(results, aborted=False)
 
 
@@ -141,6 +146,37 @@ def _emit(results, aborted):
     with open(path, "w") as f:
         json.dump(summary, f, indent=1)
     print(f"battery: summary -> {path}", file=sys.stderr)
+    _commit_artifacts("bank on-chip battery results")
+
+
+def _commit_artifacts(msg: str) -> None:
+    """Commit the device-run cache the moment evidence lands (VERDICT r4
+    #2: the cache only counts if the file is committed — a later crash or
+    round-end race must not lose banked on-chip numbers). Never raises."""
+    try:
+        subprocess.run(["git", "-C", REPO, "add", "artifacts"],
+                       timeout=30, capture_output=True)
+        diff = subprocess.run(
+            ["git", "-C", REPO, "diff", "--cached", "--quiet",
+             "--", "artifacts"], timeout=30)
+        if diff.returncode == 0:
+            return  # nothing new banked
+        # pathspec-limited commit: the battery runs unattended in the
+        # background and must never sweep up unrelated staged work
+        cp = subprocess.run(
+            ["git", "-C", REPO, "commit", "-m", msg, "-m",
+             "No-Verification-Needed: measurement artifacts only",
+             "--", "artifacts"],
+            timeout=30, capture_output=True, text=True)
+        if cp.returncode == 0:
+            print(f"battery: committed artifacts ({msg})", file=sys.stderr)
+        else:
+            # evidence is still banked in the working tree; say loudly
+            # that the commit did NOT happen so it can be retried
+            print(f"battery: artifact commit FAILED rc={cp.returncode}: "
+                  f"{(cp.stderr or cp.stdout)[-300:]}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001
+        print(f"battery: artifact commit failed: {e!r}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -232,4 +232,8 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    from tools import measure_lock
+
+    # timing windows own the single core (docs/qa.md clean-measurement rule)
+    with measure_lock.hold("tpu_live_round"):
+        main()

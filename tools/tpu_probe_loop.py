@@ -12,6 +12,9 @@ import subprocess
 import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import measure_lock  # noqa: E402
+
 PROBE_TIMEOUT = 90.0
 INTERVAL = 45.0
 BUDGET = float(os.environ.get("TPU_PROBE_BUDGET", 6 * 3600))
@@ -23,9 +26,22 @@ code = ("import jax; ds = jax.devices(); "
 
 t_start = time.time()
 attempt = 0
-while time.time() - t_start < BUDGET:
+paused_total = 0.0
+while time.time() - t_start < BUDGET + paused_total:
+    # A perf measurement in progress owns the single core: probing now
+    # would both corrupt its numbers and waste a probe (VERDICT r4 weak
+    # #5). Sleep while the lock is fresh; paused time extends the budget.
+    while measure_lock.active():
+        with open(LOG, "a") as f:
+            f.write(json.dumps({"t": round(time.time()),
+                                "paused_for_measurement": True}) + "\n")
+        time.sleep(30)
+        paused_total += 30
     attempt += 1
     t0 = time.time()
+    # flag the in-flight probe so measure_lock.acquire() can wait it out
+    # (a probe already on the core must not overlap a timing window)
+    measure_lock.probe_starting()
     proc = subprocess.Popen([sys.executable, "-c", code],
                             stdout=subprocess.DEVNULL,
                             stderr=subprocess.DEVNULL,
@@ -42,6 +58,8 @@ while time.time() - t_start < BUDGET:
         except subprocess.TimeoutExpired:
             pass
         rc = "timeout"
+    finally:
+        measure_lock.probe_done()
     dt = time.time() - t0
     with open(LOG, "a") as f:
         f.write(json.dumps({"t": round(time.time()), "attempt": attempt,
