@@ -245,3 +245,36 @@ def test_measure_lock_release_is_pid_checked(tmp_path, monkeypatch):
         _json.dump({"pid": 999999999, "note": "other", "t": 0}, f)
     measure_lock.release()  # not ours: must be a no-op
     assert measure_lock._fresh(measure_lock.LOCK_PATH, 1e9)
+
+
+def test_measure_lock_inherited_from_ancestor(tmp_path, monkeypatch):
+    """A child re-acquiring under a parent holder must inherit, and its
+    release must leave the ancestor's lock in place (battery step →
+    bench.py nesting)."""
+    import json as _json
+    import os
+
+    from tools import measure_lock
+
+    monkeypatch.setattr(measure_lock, "LOCK_PATH", str(tmp_path / "m"))
+    monkeypatch.setattr(measure_lock, "INFLIGHT_PATH",
+                        str(tmp_path / "inflight"))
+    monkeypatch.setattr(measure_lock, "_inherited", False)
+    parent_pid = os.getppid()  # a real ancestor of this test process
+    with open(measure_lock.LOCK_PATH, "w") as f:
+        _json.dump({"pid": parent_pid, "note": "parent",
+                    "t": __import__("time").time()}, f)
+    measure_lock.acquire("child")
+    holder = _json.load(open(measure_lock.LOCK_PATH))
+    assert holder["pid"] == parent_pid  # not overwritten
+    measure_lock.release()
+    assert os.path.exists(measure_lock.LOCK_PATH)  # parent still covered
+    # a FOREIGN (non-ancestor) fresh holder IS overwritten: concurrent
+    # measurements are a methodology bug and last-writer-wins applies
+    with open(measure_lock.LOCK_PATH, "w") as f:
+        _json.dump({"pid": 999999999, "note": "foreign",
+                    "t": __import__("time").time()}, f)
+    measure_lock.acquire("me")
+    assert _json.load(open(measure_lock.LOCK_PATH))["pid"] == os.getpid()
+    measure_lock.release()
+    assert not os.path.exists(measure_lock.LOCK_PATH)

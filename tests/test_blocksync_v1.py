@@ -1,0 +1,252 @@
+"""Fast sync v1 (tmtpu/blocksync/v1/ — reference blockchain/v1/): the
+FSM+pool is a pure state machine, so the reference's transition table
+(reactor_fsm.go) is asserted event-by-event with no network; then a
+real late node joins a live 4-validator TCP net with
+``block_sync.version = "v1"`` and catches up through the
+pair-at-a-time processing path."""
+
+import time
+
+import pytest
+
+from tmtpu.blocksync.v1.fsm import (
+    ERR_BAD_DATA, ERR_DUPLICATE_BLOCK, ERR_NO_TALLER_PEER,
+    ERR_PEER_LOWERS_HEIGHT, FSM, BlockRequest,
+    PeerError, SendStatusRequest, SyncFinished,
+)
+
+
+def _reqs(events):
+    return [(e.peer_id, e.height) for e in events
+            if isinstance(e, BlockRequest)]
+
+
+def _errs(events):
+    return [(e.peer_id, e.reason) for e in events
+            if isinstance(e, PeerError)]
+
+
+def test_fsm_start_broadcasts_status_and_waits_for_peer():
+    f = FSM(1)
+    out = f.start()
+    assert any(isinstance(e, SendStatusRequest) for e in out)
+    assert f.state == "wait_for_peer"
+    assert f.timeout_s == 3.0  # waitForPeerTimeout
+    assert f.start() == []  # startFSMEv is only valid in unknown
+
+
+def test_fsm_wait_for_peer_timeout_fails_sync():
+    f = FSM(1)
+    f.start()
+    out = f.state_timeout("wait_for_peer")
+    fin = [e for e in out if isinstance(e, SyncFinished)]
+    assert fin and fin[0].failed and fin[0].reason == ERR_NO_TALLER_PEER
+    assert f.state == "finished"
+
+
+def test_fsm_stale_timeout_ignored():
+    f = FSM(1)
+    f.start()
+    f.status_response("p1", 1, 5, now=0.0)
+    assert f.state == "wait_for_block"
+    # a queued timeout for the PREVIOUS state must not fire
+    assert f.state_timeout("wait_for_peer") == []
+    assert f.state == "wait_for_block"
+
+
+def test_fsm_short_peer_rejected_taller_accepted():
+    f = FSM(10)
+    f.start()
+    out = f.status_response("short", 1, 5, now=0.0)
+    assert _errs(out) == []  # not disconnected, just not added
+    assert f.state == "wait_for_peer" and not f.pool.peers
+    f.status_response("tall", 1, 20, now=0.0)
+    assert f.state == "wait_for_block"
+    assert f.pool.max_peer_height == 20
+
+
+def test_fsm_happy_path_two_blocks(height_blocks=None):
+    """Blocks at (h, h+1) arrive, h processes, the window slides, and
+    covering the max peer height finishes the sync."""
+    f = FSM(1)
+    f.start()
+    f.status_response("p1", 1, 3, now=0.0)
+    reqs = _reqs(f.make_requests(now=0.1))
+    assert reqs == [("p1", 1), ("p1", 2), ("p1", 3)]
+    assert f.make_requests(now=0.2) == []  # no duplicate requests
+    for h in (1, 2, 3):
+        assert f.block_response("p1", h, f"B{h}", now=0.3) == []
+    assert f.pool.first_two_blocks() == ("B1", "p1", "B2", "p1")
+    assert f.processed_block(None) == []
+    assert f.pool.height == 2
+    assert f.pool.first_two_blocks() == ("B2", "p1", "B3", "p1")
+    out = f.processed_block(None)
+    # height 3 == max peer height: the tip cannot be verified without
+    # its successor — sync is done (pool.go ReachedMaxHeight)
+    assert any(isinstance(e, SyncFinished) and not e.failed for e in out)
+    assert f.state == "finished"
+
+
+def test_fsm_unsolicited_and_duplicate_blocks_remove_peer():
+    f = FSM(1)
+    f.start()
+    f.status_response("a", 1, 5, now=0.0)
+    f.status_response("liar", 1, 5, now=0.0)
+    f.make_requests(now=0.1)
+    # height 1 was assigned to "a" (fewest pending first = insertion
+    # order); a block for it from "liar" is unsolicited
+    victim = f.pool.blocks[1]
+    other = "liar" if victim == "a" else "a"
+    out = f.block_response(other, 1, "B1", now=0.2)
+    assert _errs(out) == [(other, ERR_BAD_DATA)]
+    assert other not in f.pool.peers
+    # duplicate from the assigned peer
+    assert f.block_response(victim, 1, "B1", now=0.3) == []
+    out = f.block_response(victim, 1, "B1", now=0.4)
+    assert _errs(out) == [(victim, ERR_DUPLICATE_BLOCK)]
+    assert f.state == "wait_for_peer"  # no peers left
+
+
+def test_fsm_peer_lowering_height_removed_and_heights_rescheduled():
+    f = FSM(1)
+    f.start()
+    f.status_response("p1", 1, 10, now=0.0)
+    f.make_requests(now=0.1)
+    assert 1 in f.pool.blocks
+    out = f.status_response("p1", 1, 4, now=1.0)  # height regression
+    assert _errs(out) == [("p1", ERR_PEER_LOWERS_HEIGHT)]
+    assert f.state == "wait_for_peer"
+    # its in-flight heights went back to planned for the next peer
+    f.status_response("p2", 1, 10, now=2.0)
+    assert ("p2", 1) in _reqs(f.make_requests(now=2.1))
+
+
+def test_fsm_verification_failure_invalidates_both_suppliers():
+    f = FSM(1)
+    f.start()
+    f.status_response("a", 1, 6, now=0.0)
+    f.status_response("b", 1, 6, now=0.0)
+    f.make_requests(now=0.1)
+    pid1, pid2 = f.pool.blocks[1], f.pool.blocks[2]
+    f.block_response(pid1, 1, "bad", now=0.2)
+    f.block_response(pid2, 2, "B2", now=0.2)
+    out = f.processed_block("verification failed")
+    punished = {pid for pid, _ in _errs(out)}
+    assert punished == {pid1, pid2}
+    assert pid1 not in f.pool.peers and pid2 not in f.pool.peers
+
+
+def test_fsm_block_timeout_drops_assigned_peers():
+    f = FSM(1)
+    f.start()
+    f.status_response("stuck", 1, 5, now=0.0)
+    f.make_requests(now=0.1)
+    out = f.state_timeout("wait_for_block")
+    assert [r for _, r in _errs(out)]  # the starving peer is dropped
+    assert "stuck" not in f.pool.peers
+    assert f.state == "wait_for_peer"
+
+
+def test_fsm_block_timeout_keeps_delivering_peers():
+    f = FSM(1)
+    f.start()
+    f.status_response("good", 1, 5, now=0.0)
+    f.make_requests(now=0.1)
+    f.block_response("good", 1, "B1", now=0.2)
+    f.block_response("good", 2, "B2", now=0.2)
+    gen = f.timer_generation
+    out = f.state_timeout("wait_for_block")
+    # blocks at current heights WERE delivered: nobody is punished and
+    # the timer restarts
+    assert _errs(out) == []
+    assert "good" in f.pool.peers
+    assert f.timer_generation > gen
+
+
+def test_fsm_status_response_can_finish_sync():
+    """A caught-up node (store already at every peer's height) finishes
+    from a status in wait_for_block (reactor_fsm.go statusResponseEv →
+    ReachedMaxHeight)."""
+    f = FSM(8)
+    f.start()
+    f.status_response("p", 1, 8, now=0.0)
+    assert f.state == "wait_for_block"  # waitForPeer doesn't check max
+    out = f.status_response("p2", 1, 8, now=0.1)
+    assert any(isinstance(e, SyncFinished) for e in out)
+    assert f.state == "finished"
+
+
+def test_fsm_peer_remove_returns_to_wait_for_peer():
+    f = FSM(1)
+    f.start()
+    f.status_response("only", 1, 5, now=0.0)
+    assert f.state == "wait_for_block"
+    f.peer_remove("only")
+    assert f.state == "wait_for_peer"
+
+
+@pytest.mark.slow
+def test_late_node_v1_fast_syncs_and_joins_consensus(tmp_path):
+    """The live half: same harness as the v0/v2 joiner tests, but the
+    joiner runs block_sync.version=v1 — FSM-driven requests over real
+    TCP, pair-at-a-time verification, handover to live consensus."""
+    from tmtpu.blocksync.v1 import BlocksyncReactorV1
+    from tmtpu.config.config import Config
+    from tmtpu.node.node import Node
+    from tmtpu.privval.file_pv import FilePV
+    from tests.test_p2p import _mk_net_nodes
+
+    nodes = _mk_net_nodes(4, tmp_path)
+    joiner = None
+    try:
+        for nd in nodes:
+            nd.start()
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and \
+                any(nd.switch.num_peers() < 3 for nd in nodes):
+            time.sleep(0.1)
+        for nd in nodes:
+            assert nd.consensus.wait_for_height(15, timeout=180), \
+                f"stuck at {nd.consensus.rs.height_round_step()}"
+
+        home = tmp_path / "joiner-v1"
+        (home / "config").mkdir(parents=True)
+        (home / "data").mkdir(parents=True)
+        cfg = Config.test_config()
+        cfg.base.home = str(home)
+        cfg.base.crypto_backend = "cpu"
+        cfg.block_sync.version = "v1"
+        cfg.rpc.laddr = ""
+        FilePV.load_or_generate(
+            cfg.rooted(cfg.base.priv_validator_key_file),
+            cfg.rooted(cfg.base.priv_validator_state_file))
+        nodes[0].genesis_doc.save_as(cfg.genesis_path)
+        joiner = Node(cfg)
+        assert isinstance(joiner.blocksync_reactor, BlocksyncReactorV1)
+        assert joiner.fast_sync
+        joiner.switch.set_persistent_peers(
+            [f"{nd.node_id}@127.0.0.1:{nd.p2p_port}" for nd in nodes])
+        joiner.start()
+
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and \
+                joiner.blocksync_reactor.blocks_synced < 14:
+            time.sleep(0.25)
+        assert joiner.blocksync_reactor.blocks_synced >= 14, (
+            f"v1 joiner only reached {joiner.block_store.height()} "
+            f"(fsm state={joiner.blocksync_reactor.fsm.state}, "
+            f"h={joiner.blocksync_reactor.fsm.pool.height}, "
+            f"maxpeer={joiner.blocksync_reactor.fsm.pool.max_peer_height})")
+        b10 = joiner.block_store.load_block(10)
+        assert b10.hash() == nodes[0].block_store.load_block(10).hash()
+
+        target = joiner.block_store.height() + 2
+        assert joiner.consensus.wait_for_height(target, timeout=60), \
+            "v1 joiner did not switch to live consensus"
+        assert joiner.consensus.state.app_hash in {
+            nd.consensus.state.app_hash for nd in nodes}
+    finally:
+        if joiner is not None:
+            joiner.stop()
+        for nd in nodes:
+            nd.stop()

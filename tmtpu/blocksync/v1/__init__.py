@@ -1,0 +1,18 @@
+"""Fast sync v1 (reference: blockchain/v1/): an event-driven reactor
+built around an explicit four-state FSM (unknown → waitForPeer →
+waitForBlock → finished) and a block pool that assigns heights to peers
+and retrieves blocks two at a time (block h is verified with block
+h+1's LastCommit before being applied).
+
+Like v2 here, the machine is PURE — `fsm.py` has no I/O, threads, or
+wall clock (callers pass ``now`` in); the reactor pumps switch events
+through it and performs the block I/O. The wire protocol and channel
+are identical to v0/v2 (the reference's three fast-sync versions all
+speak the same blockchain channel messages), so a v1 node syncs from
+and serves v0/v2 peers. Selected by ``block_sync.version = "v1"``
+(node.go:450 picks the blockchain reactor by config the same way).
+"""
+
+from tmtpu.blocksync.v1.reactor import BlocksyncReactorV1
+
+__all__ = ["BlocksyncReactorV1"]

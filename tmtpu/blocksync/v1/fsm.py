@@ -1,0 +1,372 @@
+"""v1 fast-sync FSM + block pool as one pure state machine
+(reference: blockchain/v1/reactor_fsm.go + pool.go + peer.go).
+
+Inputs are methods named after the reference's bReactorEvent values
+(startFSMEv, statusResponseEv, blockResponseEv, noBlockResponseEv,
+processedBlockEv, makeRequestsEv, stateTimeoutEv, peerRemoveEv,
+stopFSMEv); outputs are lists of event dataclasses the reactor turns
+into sends. No I/O, no threads, no wall clock — callers pass ``now``,
+and run the state timer themselves off ``state`` / ``timeout_s``
+(reactor_fsm.go resetStateTimer), so every transition in the
+reference's table is unit-testable deterministically.
+
+The pool half (pool.go) assigns planned heights to peers round-robin
+with a per-peer in-flight cap and yields blocks in (first, second)
+pairs: first is applied only after its successor's LastCommit verifies
+it (reactor.go processBlock).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+# reactor_fsm.go timeouts
+WAIT_FOR_PEER_TIMEOUT_S = 3.0
+WAIT_FOR_BLOCK_TIMEOUT_S = 10.0
+# pool.go / reactor.go request discipline
+MAX_PENDING_PER_PEER = 20      # peer.go maxRequestsPerPeer
+MAX_NUM_REQUESTS = 64          # reactor.go maxNumRequests
+
+# error strings (reactor_fsm.go errors; values the reactor reports in
+# PeerError / uses to decide whether sync failed)
+ERR_PEER_TOO_SHORT = "peer height too low"
+ERR_PEER_LOWERS_HEIGHT = "peer reports a height lower than previous"
+ERR_DUPLICATE_BLOCK = "duplicate block from peer"
+ERR_BAD_DATA = "block from wrong peer or block is bad"
+ERR_MISSING_BLOCK = "missing blocks"
+ERR_NO_TALLER_PEER = "timed out waiting for a taller peer"
+ERR_NO_PEER_RESPONSE_CURRENT = "no peer response for current heights"
+ERR_SLOW_PEER = "peer is not sending us data fast enough"
+
+
+# -- output events ----------------------------------------------------------
+
+
+@dataclass
+class SendStatusRequest:
+    pass
+
+
+@dataclass
+class BlockRequest:
+    peer_id: str
+    height: int
+
+
+@dataclass
+class PeerError:
+    peer_id: str
+    reason: str
+
+
+@dataclass
+class SyncFinished:
+    reason: str
+    failed: bool = False
+
+
+@dataclass
+class _Peer:
+    """pool.go BpPeer (the timer/monitor lives in the FSM's state
+    timeout rather than per-peer goroutines)."""
+    peer_id: str
+    base: int = 0
+    height: int = 0
+    blocks: Dict[int, Optional[object]] = field(default_factory=dict)
+    # height -> Block or None while the request is in flight
+    last_touch: float = 0.0
+
+    @property
+    def num_pending(self) -> int:
+        return sum(1 for b in self.blocks.values() if b is None)
+
+
+class BlockPool:
+    """pool.go BlockPool: peers, height→peer assignments, planned
+    requests, and the first/second block window at ``height``."""
+
+    def __init__(self, height: int):
+        self.height = height              # next height to execute
+        self.peers: Dict[str, _Peer] = {}
+        self.blocks: Dict[int, str] = {}  # height -> assigned peer
+        self.planned: set = set()
+        self.next_request_height = height
+        self.max_peer_height = 0
+
+    # -- peers (pool.go UpdatePeer / RemovePeer) ---------------------------
+
+    def update_peer(self, peer_id: str, base: int, height: int,
+                    now: float) -> List[object]:
+        p = self.peers.get(peer_id)
+        if p is None:
+            if height < self.height:
+                # pool.go UpdatePeer errPeerTooShort: simply not added —
+                # a lagging peer is healthy, never disconnected for it
+                return []
+            self.peers[peer_id] = _Peer(peer_id, base, height,
+                                        last_touch=now)
+        else:
+            if height < p.height:
+                out = self.remove_peer(peer_id)
+                return [PeerError(peer_id, ERR_PEER_LOWERS_HEIGHT)] + out
+            p.base, p.height, p.last_touch = base, height, now
+        self._update_max_height()
+        return []
+
+    def remove_peer(self, peer_id: str) -> List[object]:
+        """Reschedule the peer's heights and delete it
+        (pool.go RemovePeer)."""
+        p = self.peers.pop(peer_id, None)
+        if p is None:
+            return []
+        for h in list(p.blocks):
+            self.planned.add(h)
+            self.blocks.pop(h, None)
+        self._update_max_height()
+        self._remove_short_peers()
+        return []
+
+    def _remove_short_peers(self) -> None:
+        # pool.go removeShortPeers: execution advanced past their tip
+        for pid in [pid for pid, p in self.peers.items()
+                    if p.height < self.height]:
+            self.remove_peer(pid)
+
+    def _update_max_height(self) -> None:
+        self.max_peer_height = max(
+            (p.height for p in self.peers.values()), default=0)
+
+    # -- requests (pool.go MakeNextRequests / sendRequest) -----------------
+
+    def make_next_requests(self, max_num: int, now: float) -> List[object]:
+        out: List[object] = []
+        # extend the planned window up to the request budget
+        window = [h for h in self.planned if h < self.height + max_num]
+        h = self.next_request_height
+        while len(window) < max_num and h <= self.max_peer_height:
+            if h not in self.blocks and h not in self.planned:
+                self.planned.add(h)
+                window.append(h)
+            h += 1
+            self.next_request_height = h
+        for h in sorted(self.planned):
+            p = self._pick_peer(h)
+            if p is None:
+                continue  # no peer can serve h right now
+            p.blocks[h] = None
+            p.last_touch = now
+            self.blocks[h] = p.peer_id
+            self.planned.discard(h)
+            out.append(BlockRequest(p.peer_id, h))
+        return out
+
+    def _pick_peer(self, height: int) -> Optional[_Peer]:
+        best = None
+        for p in self.peers.values():
+            if (p.base <= height <= p.height
+                    and p.num_pending < MAX_PENDING_PER_PEER):
+                if best is None or p.num_pending < best.num_pending:
+                    best = p
+        return best
+
+    # -- blocks (pool.go AddBlock / FirstTwoBlocksAndPeers) ----------------
+
+    def add_block(self, peer_id: str, height: int, block,
+                  now: float) -> List[object]:
+        """Any AddBlock error removes the peer (reactor_fsm.go
+        blockResponseEv: unsolicited / wrong peer / duplicate)."""
+        p = self.peers.get(peer_id)
+        if p is None or self.blocks.get(height) != peer_id:
+            out = self.remove_peer(peer_id)
+            return [PeerError(peer_id, ERR_BAD_DATA)] + out
+        if p.blocks.get(height) is not None:
+            out = self.remove_peer(peer_id)
+            return [PeerError(peer_id, ERR_DUPLICATE_BLOCK)] + out
+        p.blocks[height] = block
+        p.last_touch = now
+        return []
+
+    def first_two_blocks(self) -> Optional[Tuple[object, str, object, str]]:
+        """(first, its peer, second, its peer) at (height, height+1), or
+        None while either is missing (pool.go FirstTwoBlocksAndPeers)."""
+        got = []
+        for h in (self.height, self.height + 1):
+            pid = self.blocks.get(h)
+            p = self.peers.get(pid) if pid else None
+            blk = p.blocks.get(h) if p else None
+            if blk is None:
+                return None
+            got += [blk, pid]
+        return tuple(got)
+
+    def invalidate_first_two(self) -> List[object]:
+        """Verification failed: both suppliers are suspect
+        (pool.go InvalidateFirstTwoBlocks)."""
+        out: List[object] = []
+        for h in (self.height, self.height + 1):
+            pid = self.blocks.get(h)
+            if pid is not None:
+                out.append(PeerError(pid, ERR_BAD_DATA))
+                out += self.remove_peer(pid)
+        return out
+
+    def processed_current_height(self) -> None:
+        h = self.height
+        pid = self.blocks.pop(h, None)
+        if pid in self.peers:
+            self.peers[pid].blocks.pop(h, None)
+        self.planned.discard(h)
+        self.height = h + 1
+        self._remove_short_peers()
+
+    def remove_peers_at_current_heights(self) -> List[object]:
+        """No response at (height, height+1) inside the state timeout:
+        drop whoever was assigned them (pool.go
+        RemovePeerAtCurrentHeights)."""
+        out: List[object] = []
+        for h in (self.height, self.height + 1):
+            pid = self.blocks.get(h)
+            if pid is not None and pid in self.peers \
+                    and self.peers[pid].blocks.get(h) is None:
+                out.append(PeerError(pid, ERR_NO_PEER_RESPONSE_CURRENT))
+                out += self.remove_peer(pid)
+        return out
+
+    def needs_blocks(self) -> bool:
+        return bool(self.peers) and not self.reached_max_height()
+
+    def reached_max_height(self) -> bool:
+        return bool(self.peers) and self.height >= self.max_peer_height
+
+
+class FSM:
+    """reactor_fsm.go BcReactorFSM. ``state`` ∈ {"unknown",
+    "wait_for_peer", "wait_for_block", "finished"}; ``timeout_s`` is the
+    current state's timer (None = no timer). The caller restarts its
+    timer whenever ``state`` or ``timer_generation`` changes and feeds
+    expiry back via ``state_timeout``."""
+
+    def __init__(self, start_height: int):
+        self.pool = BlockPool(start_height)
+        self.state = "unknown"
+        self.timer_generation = 0  # bumped on every resetStateTimer
+        self.failed: Optional[str] = None
+
+    @property
+    def timeout_s(self) -> Optional[float]:
+        return {"wait_for_peer": WAIT_FOR_PEER_TIMEOUT_S,
+                "wait_for_block": WAIT_FOR_BLOCK_TIMEOUT_S}.get(self.state)
+
+    def _to(self, state: str) -> None:
+        if self.state != state:
+            self.state = state
+        self.timer_generation += 1
+
+    # -- events (one method per bReactorEvent) -----------------------------
+
+    def start(self) -> List[object]:
+        if self.state != "unknown":
+            return []
+        self._to("wait_for_peer")
+        return [SendStatusRequest()]
+
+    def stop(self) -> List[object]:
+        if self.state == "finished":
+            return []
+        self._to("finished")
+        return [SyncFinished("stopped", failed=self.failed is not None)]
+
+    def status_response(self, peer_id: str, base: int, height: int,
+                        now: float) -> List[object]:
+        if self.state not in ("wait_for_peer", "wait_for_block"):
+            return []
+        out = self.pool.update_peer(peer_id, base, height, now)
+        if self.state == "wait_for_peer":
+            if self.pool.peers:
+                self._to("wait_for_block")
+            return out
+        # wait_for_block (reactor_fsm.go statusResponseEv): losing every
+        # peer sends us back to waiting; covering the max height ends it
+        if not self.pool.peers:
+            self._to("wait_for_peer")
+        elif self.pool.reached_max_height():
+            self._to("finished")
+            out = out + [SyncFinished("caught up")]
+        return out
+
+    def block_response(self, peer_id: str, height: int, block,
+                       now: float) -> List[object]:
+        if self.state != "wait_for_block":
+            return []
+        out = self.pool.add_block(peer_id, height, block, now)
+        if not self.pool.peers:
+            self._to("wait_for_peer")
+        return out
+
+    def no_block_response(self, peer_id: str, height: int) -> List[object]:
+        """reactor_fsm.go treats this as informational; the peer stays
+        (its state timer will catch real starvation)."""
+        return []
+
+    def processed_block(self, err: Optional[str]) -> List[object]:
+        """reactor_fsm.go processedBlockEv: invalidate-and-punish on a
+        verification error, advance and reset the state timer on
+        success; either path may land on the max height."""
+        if self.state != "wait_for_block":
+            return []
+        if err is not None:
+            out = self.pool.invalidate_first_two()
+        else:
+            out = []
+            self.pool.processed_current_height()
+            self._to(self.state)  # progress: reset the block timer
+        if self.pool.reached_max_height():
+            self._to("finished")
+            return out + [SyncFinished("caught up")]
+        if not self.pool.peers:
+            self._to("wait_for_peer")
+        return out
+
+    def make_requests(self, now: float,
+                      max_num: int = MAX_NUM_REQUESTS) -> List[object]:
+        if self.state != "wait_for_block":
+            return []
+        return self.pool.make_next_requests(max_num, now)
+
+    def peer_remove(self, peer_id: str) -> List[object]:
+        """peerRemoveEv (sent by the switch for disconnected/errored
+        peers)."""
+        out = self.pool.remove_peer(peer_id)
+        if self.state != "wait_for_block":
+            return out
+        if not self.pool.peers:
+            self._to("wait_for_peer")
+        elif self.pool.reached_max_height():
+            self._to("finished")
+            out = out + [SyncFinished("caught up")]
+        return out
+
+    def state_timeout(self, state_name: str) -> List[object]:
+        """stateTimeoutEv: ignored when stale (for a different state
+        than the current one — errTimeoutEventWrongState)."""
+        if state_name != self.state:
+            return []
+        if self.state == "wait_for_peer":
+            # no taller peer ever reported in: fast sync failed
+            self.failed = ERR_NO_TALLER_PEER
+            self._to("finished")
+            return [SyncFinished(ERR_NO_TALLER_PEER, failed=True)]
+        if self.state == "wait_for_block":
+            # the blocks at (height, height+1) never arrived: drop the
+            # peers assigned to them and keep waiting
+            out = self.pool.remove_peers_at_current_heights()
+            if not self.pool.peers:
+                self._to("wait_for_peer")
+            elif self.pool.reached_max_height():
+                self._to("finished")
+                out = out + [SyncFinished("caught up")]
+            else:
+                self._to(self.state)  # resetStateTimer
+            return out
+        return []

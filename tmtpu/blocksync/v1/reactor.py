@@ -1,0 +1,234 @@
+"""v1 fast-sync reactor: pumps switch events through the pure FSM and
+performs block I/O (reference: blockchain/v1/reactor.go).
+
+The reference runs a poolRoutine demuxing message/error/timeout
+channels plus tickers (trySync 10 ms, statusUpdate 10 s) into FSM
+events; here one pump thread does the same serially. Block processing
+follows the v1 shape — the pair (h, h+1) from the pool, h verified with
+h+1's LastCommit, then applied — through the shared batched verifier
+(a 1-block run), and the FSM's state timer is emulated off
+``fsm.timeout_s`` / ``fsm.timer_generation``.
+
+Wire protocol and channel are identical to v0/v2, so a v1 node syncs
+from either and serves both.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Optional
+
+from tmtpu.blocksync.common import (
+    BLOCKCHAIN_CHANNEL, BlockServingMixin, verify_block_run,
+)
+from tmtpu.blocksync.msgs import BlockRequestPB, BlocksyncMessagePB
+from tmtpu.blocksync.v1 import fsm as fsm_mod
+from tmtpu.p2p.conn.connection import ChannelDescriptor
+from tmtpu.p2p.switch import Peer, Reactor
+from tmtpu.types.block import Block
+
+STATUS_UPDATE_INTERVAL_S = 10.0
+TICK_S = 0.02
+
+
+class BlocksyncReactorV1(BlockServingMixin, Reactor):
+    """Selected by ``block_sync.version = "v1"`` (node.go:450 picks the
+    blockchain reactor by config the same way)."""
+
+    def __init__(self, state, block_exec, block_store, fast_sync: bool,
+                 consensus_reactor=None,
+                 verify_backend: Optional[str] = None):
+        super().__init__("BLOCKSYNC")
+        if state.last_block_height != block_store.height():
+            raise ValueError(
+                f"state ({state.last_block_height}) and store "
+                f"({block_store.height()}) height mismatch")
+        self.state = state
+        self.block_exec = block_exec
+        self.store = block_store
+        self.fast_sync = fast_sync
+        self.consensus_reactor = consensus_reactor
+        self.verify_backend = verify_backend
+        start = block_store.height() + 1
+        if start == 1:
+            start = state.initial_height
+        self.fsm = fsm_mod.FSM(start)
+        self.blocks_synced = 0
+        self._events: "queue.Queue" = queue.Queue(maxsize=10_000)
+        self._pump_alive = False
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- reactor interface --------------------------------------------------
+
+    def get_channels(self):
+        return [ChannelDescriptor(BLOCKCHAIN_CHANNEL, priority=5,
+                                  send_queue_capacity=1000)]
+
+    def on_start(self) -> None:
+        if self.fast_sync:
+            self._start_pump(state_synced=False)
+
+    def _start_pump(self, state_synced: bool) -> None:
+        # alive BEFORE start(): the switch can deliver add_peer/status
+        # for already-connected peers before the thread is scheduled
+        self._pump_alive = True
+        self._thread = threading.Thread(
+            target=self._pump, args=(state_synced,), daemon=True,
+            name="blocksync-v1")
+        self._thread.start()
+
+    def on_stop(self) -> None:
+        self._stopped.set()
+
+    def _enqueue(self, ev) -> None:
+        if not self._pump_alive:
+            return
+        try:
+            self._events.put_nowait(ev)
+        except queue.Full:
+            pass
+
+    def add_peer(self, peer: Peer) -> None:
+        peer.send(BLOCKCHAIN_CHANNEL, self._status_msg())
+
+    def remove_peer(self, peer: Peer, reason) -> None:
+        self._enqueue(("remove_peer", peer.node_id))
+
+    def receive(self, channel_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        msg = BlocksyncMessagePB.decode(msg_bytes)
+        if msg.block_request is not None:
+            self._respond_to_peer(msg.block_request.height, peer)
+        elif msg.status_request is not None:
+            peer.try_send(BLOCKCHAIN_CHANNEL, self._status_msg())
+        elif msg.block_response is not None:
+            block = Block.from_proto(msg.block_response.block)
+            self._enqueue(("block", peer.node_id, block))
+        elif msg.status_response is not None:
+            self._enqueue(("status", peer.node_id,
+                           msg.status_response.base,
+                           msg.status_response.height))
+        elif msg.no_block_response is not None:
+            self._enqueue(
+                ("no_block", peer.node_id, msg.no_block_response.height))
+
+    # -- the pump (reactor.go poolRoutine) ----------------------------------
+
+    def _pump(self, state_synced: bool) -> None:
+        try:
+            self._pump_loop(state_synced)
+        except Exception:  # noqa: BLE001 — a dead pump must be loud
+            import traceback
+
+            traceback.print_exc()
+            raise
+        finally:
+            self._pump_alive = False
+
+    def _pump_loop(self, state_synced: bool) -> None:
+        fsm = self.fsm
+        self._emit(fsm.start())
+        last_status = 0.0
+        timer_gen = fsm.timer_generation
+        timer_deadline = (time.monotonic() + fsm.timeout_s
+                          if fsm.timeout_s else None)
+        while not self._stopped.is_set():
+            now = time.monotonic()
+            if now - last_status > STATUS_UPDATE_INTERVAL_S:
+                last_status = now
+                self.broadcast_status_request()
+            drained = False
+            try:
+                while True:
+                    ev = self._events.get_nowait()
+                    drained = True
+                    self._dispatch(fsm, ev, time.monotonic())
+            except queue.Empty:
+                pass
+            # state timer (reactor_fsm.go resetStateTimer semantics:
+            # restart whenever the FSM bumps timer_generation)
+            if fsm.timer_generation != timer_gen:
+                timer_gen = fsm.timer_generation
+                timer_deadline = (time.monotonic() + fsm.timeout_s
+                                  if fsm.timeout_s else None)
+            elif timer_deadline is not None and now > timer_deadline:
+                self._emit(fsm.state_timeout(fsm.state))
+                timer_gen = fsm.timer_generation
+                timer_deadline = (time.monotonic() + fsm.timeout_s
+                                  if fsm.timeout_s else None)
+            self._emit(fsm.make_requests(time.monotonic()))
+            if self._try_process(fsm):
+                drained = True
+            if fsm.state == "finished":
+                if fsm.failed:
+                    # reference behaviour on errNoTallerPeer: switch to
+                    # consensus anyway — a lone (or fully caught-up)
+                    # node must start proposing
+                    pass
+                self._switch_to_consensus(state_synced)
+                return
+            if not drained:
+                self._stopped.wait(TICK_S)
+
+    def _dispatch(self, fsm, ev, now: float) -> None:
+        kind = ev[0]
+        if kind == "remove_peer":
+            self._emit(fsm.peer_remove(ev[1]))
+        elif kind == "status":
+            self._emit(fsm.status_response(ev[1], ev[2], ev[3], now))
+        elif kind == "block":
+            _, peer_id, block = ev
+            self._emit(fsm.block_response(
+                peer_id, block.header.height, block, now))
+        elif kind == "no_block":
+            self._emit(fsm.no_block_response(ev[1], ev[2]))
+
+    def _emit(self, events) -> None:
+        for e in events:
+            if isinstance(e, fsm_mod.SendStatusRequest):
+                self.broadcast_status_request()
+            elif isinstance(e, fsm_mod.BlockRequest):
+                peer = (self.switch.peers.get(e.peer_id)
+                        if self.switch else None)
+                if peer is not None:
+                    peer.try_send(
+                        BLOCKCHAIN_CHANNEL,
+                        BlocksyncMessagePB(block_request=BlockRequestPB(
+                            height=e.height)).encode())
+            elif isinstance(e, fsm_mod.PeerError):
+                self._stop_peer(e.peer_id, e.reason)
+            # SyncFinished is read via fsm.state in the pump loop
+
+    # -- processing (reactor.go processBlock) -------------------------------
+
+    def _try_process(self, fsm) -> bool:
+        pair = fsm.pool.first_two_blocks()
+        if pair is None:
+            return False
+        first, _pid1, second, _pid2 = pair
+        results, parts_bids = verify_block_run(
+            self.state, [first], [second], self.verify_backend)
+        err, (parts, bid) = results[0], parts_bids[0]
+        if err is None:
+            try:
+                self.block_exec.validate_block(self.state, first)
+            except Exception as e:  # noqa: BLE001
+                err = e
+        if err is not None:
+            self._emit(fsm.processed_block(str(err)))
+            return True
+        self.store.save_block(first, parts, second.last_commit)
+        self.state, _ = self.block_exec.apply_block(self.state, bid, first)
+        self.blocks_synced += 1
+        self._emit(fsm.processed_block(None))
+        return True
+
+    # -- statesync handoff --------------------------------------------------
+
+    def switch_to_fast_sync(self, state) -> None:
+        self.state = state
+        self.fast_sync = True
+        self.fsm = fsm_mod.FSM(state.last_block_height + 1)
+        self._start_pump(state_synced=True)

@@ -234,6 +234,9 @@ class Node(BaseService):
             if config.block_sync.version == "v2":
                 from tmtpu.blocksync.v2 import BlocksyncReactorV2 \
                     as blocksync_cls
+            elif config.block_sync.version == "v1":
+                from tmtpu.blocksync.v1 import BlocksyncReactorV1 \
+                    as blocksync_cls
             else:
                 from tmtpu.blocksync.reactor import BlocksyncReactor \
                     as blocksync_cls

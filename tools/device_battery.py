@@ -55,6 +55,18 @@ STEPS = {
 
 
 def probe_alive(timeout=60.0) -> bool:
+    """Inter-step tunnel probe, wired into the measurement-lock protocol
+    like tpu_probe_loop's (a concurrent timing window must be able to
+    wait this jax subprocess out via the in-flight flag, and a held lock
+    pauses us)."""
+    from tools import measure_lock
+
+    measure_lock.probe_starting()
+    if measure_lock.active():
+        measure_lock.probe_done()
+        while measure_lock.active():
+            time.sleep(15)
+        measure_lock.probe_starting()
     code = ("import jax; ds = jax.devices(); "
             "import sys; sys.exit(0 if ds and ds[0].platform != 'cpu' "
             "else 3)")
@@ -73,6 +85,8 @@ def probe_alive(timeout=60.0) -> bool:
         except subprocess.TimeoutExpired:
             pass
         return False
+    finally:
+        measure_lock.probe_done()
 
 
 def run_step(name, cmd, timeout, env_extra) -> dict:

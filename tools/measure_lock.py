@@ -44,12 +44,49 @@ def _fresh(path: str, stale_s: float) -> bool:
     return (time.time() - st.st_mtime) < stale_s
 
 
+# True while this process runs under an ancestor that already holds the
+# lock — its own acquire/release must then leave the ancestor's lock be
+_inherited = False
+
+
+def _ancestors() -> set:
+    """Pids of this process's ancestors (Linux /proc walk)."""
+    out, pid = set(), os.getpid()
+    for _ in range(64):
+        try:
+            with open(f"/proc/{pid}/status") as f:
+                ppid = next(int(ln.split()[1]) for ln in f
+                            if ln.startswith("PPid:"))
+        except (OSError, StopIteration, ValueError):
+            break
+        if ppid <= 1:
+            break
+        out.add(ppid)
+        pid = ppid
+    return out
+
+
 def acquire(note: str, wait_inflight_s: float = 120.0) -> None:
     """Take (or refresh) the lock, first waiting out any probe subprocess
     already on the core — otherwise a 90 s probe launched moments before
     the lock overlaps the start of the timing window it protects.
-    Concurrent measurements on a single-core box are already a
-    methodology bug, so the lock only records the latest holder."""
+
+    A lock already held by an ANCESTOR process (battery step running
+    bench.py, which acquires again) is inherited, not overwritten: the
+    child's release must not strip the parent's protection for the rest
+    of the parent's window. Beyond that, concurrent measurements on a
+    single-core box are already a methodology bug, so the lock records
+    the latest holder."""
+    global _inherited
+    try:
+        with open(LOCK_PATH) as f:
+            holder = json.load(f)
+        if _fresh(LOCK_PATH, STALE_S) and holder.get("pid") in _ancestors():
+            _inherited = True
+            return
+    except (OSError, ValueError):
+        pass
+    _inherited = False
     t0 = time.time()
     while _fresh(INFLIGHT_PATH, INFLIGHT_STALE_S):
         if time.time() - t0 > wait_inflight_s:
@@ -60,10 +97,12 @@ def acquire(note: str, wait_inflight_s: float = 120.0) -> None:
 
 
 def release() -> None:
-    """Unlink the lock — but only if THIS process wrote it. A tool that
-    runs under a parent holding the lock (battery step, bench child)
-    re-acquires the same path; its release must not strip the parent's
-    protection for the rest of the parent's window."""
+    """Unlink the lock — but only if THIS process wrote it (an inherited
+    ancestor lock, or a foreign holder's, is left untouched)."""
+    global _inherited
+    if _inherited:
+        _inherited = False
+        return
     try:
         with open(LOCK_PATH) as f:
             holder = json.load(f)

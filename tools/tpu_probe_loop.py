@@ -24,28 +24,56 @@ FLAG = "/tmp/tpu_alive"
 code = ("import jax; ds = jax.devices(); "
         "import sys; sys.exit(0 if ds and ds[0].platform != 'cpu' else 3)")
 
+_active_probe = None
+
+
+def _kill_active_probe(signum=None, frame=None):
+    """A prober killed mid-probe must not orphan its jax subprocess: a
+    probe against a wedged tunnel never exits on its own (jax.devices()
+    hangs indefinitely) and an orphan burns the single core through
+    PJRT's import/retry work, corrupting any measurement that follows
+    (observed: a 21-minute orphan during the round-5 bisect)."""
+    if _active_probe is not None:
+        try:
+            os.killpg(_active_probe.pid, signal.SIGKILL)
+        except OSError:
+            pass
+    if signum is not None:
+        sys.exit(128 + signum)
+
+
+signal.signal(signal.SIGTERM, _kill_active_probe)
+signal.signal(signal.SIGINT, _kill_active_probe)
+
 t_start = time.time()
 attempt = 0
 paused_total = 0.0
 while time.time() - t_start < BUDGET + paused_total:
     # A perf measurement in progress owns the single core: probing now
     # would both corrupt its numbers and waste a probe (VERDICT r4 weak
-    # #5). Sleep while the lock is fresh; paused time extends the budget.
-    while measure_lock.active():
+    # #5). The in-flight flag is claimed BEFORE the lock check so a
+    # measurement acquiring in between either sees our flag (and waits
+    # it out) or its lock pauses us — no window where both proceed.
+    measure_lock.probe_starting()
+    if measure_lock.active():
+        measure_lock.probe_done()
+        pause_t0 = time.time()
+        while measure_lock.active():
+            time.sleep(30)
+        paused = time.time() - pause_t0
+        paused_total += paused
         with open(LOG, "a") as f:
             f.write(json.dumps({"t": round(time.time()),
-                                "paused_for_measurement": True}) + "\n")
-        time.sleep(30)
-        paused_total += 30
+                                "paused_for_measurement_s":
+                                round(paused)}) + "\n")
+        measure_lock.probe_starting()
     attempt += 1
     t0 = time.time()
-    # flag the in-flight probe so measure_lock.acquire() can wait it out
-    # (a probe already on the core must not overlap a timing window)
-    measure_lock.probe_starting()
     proc = subprocess.Popen([sys.executable, "-c", code],
                             stdout=subprocess.DEVNULL,
                             stderr=subprocess.DEVNULL,
                             start_new_session=True)
+    _active_probe = proc
     try:
         rc = proc.wait(timeout=PROBE_TIMEOUT)
     except subprocess.TimeoutExpired:
@@ -59,6 +87,7 @@ while time.time() - t_start < BUDGET + paused_total:
             pass
         rc = "timeout"
     finally:
+        _active_probe = None
         measure_lock.probe_done()
     dt = time.time() - t0
     with open(LOG, "a") as f:
