@@ -250,3 +250,17 @@ def test_late_node_v1_fast_syncs_and_joins_consensus(tmp_path):
             joiner.stop()
         for nd in nodes:
             nd.stop()
+
+
+def test_fsm_outstanding_work_is_capped():
+    """The planned set + in-flight assignments never exceed the request
+    budget, even against a distant peer tip (maxNumRequests semantics —
+    an uncapped planned set would grow every pump tick)."""
+    f = FSM(1)
+    f.start()
+    f.status_response("p1", 1, 100_000, now=0.0)
+    for i in range(50):
+        f.make_requests(now=0.1 * i, max_num=64)
+    pool = f.pool
+    assert len(pool.planned) + len(pool.blocks) <= 64
+    assert pool.next_request_height <= 1 + 64 + 1

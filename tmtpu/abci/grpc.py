@@ -56,7 +56,13 @@ class GRPCClient(Client):
     """ABCI client over gRPC (grpc_client.go semantics: unary call per
     request, one connection, calls serialized — the reference client also
     forces ordered delivery via grpc.WithBlock + per-call sync). Drop-in
-    for SocketClient."""
+    for SocketClient.
+
+    ``service`` parametrizes the :path prefix so other gRPC services in
+    this codebase (rpc/grpc_api.py BroadcastAPI) reuse the unary
+    machinery by subclassing."""
+
+    service = SERVICE
 
     def __init__(self, addr: str):
         self.addr = addr
@@ -122,7 +128,7 @@ class GRPCClient(Client):
             self._next_stream += 2
             conn.send_headers(stream_id, [
                 (":method", "POST"), (":scheme", "http"),
-                (":path", f"/{SERVICE}/{method}"),
+                (":path", f"/{self.service}/{method}"),
                 (":authority", self.addr),
                 ("content-type", "application/grpc"),
                 ("te", "trailers"),

@@ -141,13 +141,16 @@ class BlockPool:
 
     def make_next_requests(self, max_num: int, now: float) -> List[object]:
         out: List[object] = []
-        # extend the planned window up to the request budget
-        window = [h for h in self.planned if h < self.height + max_num]
+        # extend the planned window, capping TOTAL outstanding work
+        # (in-flight assignments + planned) at max_num — the reference's
+        # maxNumRequests bounds outstanding requests, and an uncapped
+        # planned set would grow by ~20 heights per pump tick against a
+        # distant peer tip
         h = self.next_request_height
-        while len(window) < max_num and h <= self.max_peer_height:
+        while (len(self.blocks) + len(self.planned) < max_num
+               and h <= self.max_peer_height):
             if h not in self.blocks and h not in self.planned:
                 self.planned.add(h)
-                window.append(h)
             h += 1
             self.next_request_height = h
         for h in sorted(self.planned):

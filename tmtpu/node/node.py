@@ -316,6 +316,17 @@ class Node(BaseService):
                 max_subscriptions_per_client=
                 rc.max_subscriptions_per_client)
 
+        # --- gRPC broadcast API (node.go startRPC: served on
+        # rpc.grpc_laddr when set; deprecated upstream but shipped) ---
+        self.grpc_api_server = None
+        if config.rpc.grpc_laddr:
+            from tmtpu.rpc import core as rpc_core
+            from tmtpu.rpc.grpc_api import BroadcastAPIServer
+
+            routes = rpc_core.build_routes(rpc_core.Environment(self))
+            self.grpc_api_server = BroadcastAPIServer(
+                config.rpc.grpc_laddr, routes["broadcast_tx_commit"])
+
         # --- pprof (node.go:894-900: gated on RPC.PprofListenAddress) ---
         self.pprof_server = None
         if config.rpc.pprof_laddr:
@@ -410,12 +421,16 @@ class Node(BaseService):
             self.consensus.start()
         if self.rpc_server is not None:
             self.rpc_server.start()
+        if self.grpc_api_server is not None:
+            self.grpc_api_server.start()
         if self.pprof_server is not None:
             self.pprof_server.start()
 
     def on_stop(self) -> None:
         if self.pprof_server is not None:
             self.pprof_server.stop()
+        if self.grpc_api_server is not None:
+            self.grpc_api_server.stop()
         if self.rpc_server is not None:
             self.rpc_server.stop()
         self.consensus.stop()

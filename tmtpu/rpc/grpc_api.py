@@ -1,0 +1,118 @@
+"""gRPC broadcast API (reference: rpc/grpc/api.go + types.pb.go —
+service ``tendermint.rpc.grpc.BroadcastAPI`` with ``Ping`` and
+``BroadcastTx``; started on ``rpc.grpc_laddr`` by node.go startRPC).
+
+The reference deprecates this API in favour of the JSON-RPC endpoints
+but ships and serves it; same here. It rides the from-scratch h2c/gRPC
+stack (tmtpu/libs/h2.py) that already carries the ABCI gRPC transport:
+the server subclasses that transport's connection machinery and only
+swaps the dispatch table, and the client reuses its unary call path
+with a different service prefix.
+
+``BroadcastTx`` has BroadcastTxCommit semantics (api.go:20 routes into
+core.BroadcastTxCommit): CheckTx, then wait for the tx to land in a
+committed block, returning both results.
+"""
+
+from __future__ import annotations
+
+import base64
+
+from tmtpu.abci import types as abci
+from tmtpu.abci.grpc import GRPCClient, GRPCServer
+from tmtpu.libs.h2 import H2Conn, grpc_frame, grpc_unframe
+from tmtpu.types.pb import ProtoMessage
+
+SERVICE = "tendermint.rpc.grpc.BroadcastAPI"
+
+
+class RequestPing(ProtoMessage):
+    FIELDS = []
+
+
+class ResponsePing(ProtoMessage):
+    FIELDS = []
+
+
+class RequestBroadcastTx(ProtoMessage):
+    FIELDS = [(1, "tx", "bytes")]
+
+
+class ResponseBroadcastTx(ProtoMessage):
+    FIELDS = [(1, "check_tx", ("msg!", abci.ResponseCheckTx)),
+              (2, "deliver_tx", ("msg!", abci.ResponseDeliverTx))]
+
+
+def _res_from_json(cls, d: dict):
+    """rpc/core returns JSON-shaped results (code, base64 data, log);
+    fold one back into the proto response the wire carries."""
+    data = d.get("data")
+    return cls(code=int(d.get("code") or 0),
+               data=base64.b64decode(data) if data else b"",
+               log=d.get("log") or "")
+
+
+class BroadcastAPIServer(GRPCServer):
+    """Serves Ping/BroadcastTx over h2c gRPC. ``broadcast_fn`` is the
+    node's JSON-RPC ``broadcast_tx_commit`` route (api.go calls
+    core.BroadcastTxCommit the same way)."""
+
+    def __init__(self, addr: str, broadcast_fn):
+        super().__init__(addr, app=None)
+        self._broadcast = broadcast_fn
+
+    def _respond(self, conn: H2Conn, sid: int, stream: dict) -> None:
+        path = stream["headers"].get(":path", "")
+        method = path.rsplit("/", 1)[-1]
+        try:
+            if method == "Ping":
+                body = grpc_frame(ResponsePing().encode())
+            elif method == "BroadcastTx":
+                req = RequestBroadcastTx.decode(
+                    grpc_unframe(stream["data"]))
+                # 0x-hex, not base64: _decode_tx (rpc/core.py) treats a
+                # leading "0x" as hex, and ~1/4096 of base64 encodings
+                # start with exactly that — hex is unambiguous
+                res = self._broadcast("0x" + (req.tx or b"").hex())
+                body = grpc_frame(ResponseBroadcastTx(
+                    check_tx=_res_from_json(
+                        abci.ResponseCheckTx, res["check_tx"]),
+                    deliver_tx=_res_from_json(
+                        abci.ResponseDeliverTx, res["deliver_tx"]),
+                ).encode())
+            else:
+                conn.send_headers(sid, [
+                    (":status", "200"),
+                    ("content-type", "application/grpc"),
+                    ("grpc-status", "12"),  # UNIMPLEMENTED
+                    ("grpc-message", f"unknown method {method!r}"),
+                ], end_stream=True)
+                return
+        except Exception as e:  # noqa: BLE001 — bad payload, mempool
+            # rejection, or commit timeout: INTERNAL on this stream only
+            conn.send_headers(sid, [
+                (":status", "200"), ("content-type", "application/grpc"),
+                ("grpc-status", "13"),  # INTERNAL
+                ("grpc-message", repr(e)),
+            ], end_stream=True)
+            return
+        conn.send_headers(sid, [
+            (":status", "200"), ("content-type", "application/grpc"),
+        ], end_stream=False)
+        conn.send_data(sid, body, end_stream=False)
+        conn.send_headers(sid, [("grpc-status", "0")], end_stream=True)
+
+
+class BroadcastAPIClient(GRPCClient):
+    """client_server.go StartGRPCClient analogue."""
+
+    service = SERVICE
+
+    def ping(self) -> ResponsePing:
+        return ResponsePing.decode(
+            self._unary("Ping", RequestPing().encode()))
+
+    def broadcast_tx(self, tx: bytes) -> ResponseBroadcastTx:
+        return ResponseBroadcastTx.decode(
+            self._unary("BroadcastTx",
+                        RequestBroadcastTx(tx=tx).encode()))

@@ -54,25 +54,54 @@ STEPS = {
 }
 
 
+# the battery's own in-flight probe, killed by the signal handler so a
+# mid-probe SIGTERM cannot orphan a jax subprocess against a wedged
+# tunnel (tpu_probe_loop.py has the same discipline)
+_active_probe = None
+
+
+def _kill_active_probe(signum=None, frame=None):
+    if _active_probe is not None:
+        try:
+            os.killpg(_active_probe.pid, signal.SIGKILL)
+        except OSError:
+            pass
+    from tools import measure_lock
+
+    measure_lock.probe_done()
+    if signum is not None:
+        sys.exit(128 + signum)
+
+
 def probe_alive(timeout=60.0) -> bool:
     """Inter-step tunnel probe, wired into the measurement-lock protocol
     like tpu_probe_loop's (a concurrent timing window must be able to
     wait this jax subprocess out via the in-flight flag, and a held lock
-    pauses us)."""
+    pauses us — re-checked after every pause)."""
+    global _active_probe
     from tools import measure_lock
 
-    measure_lock.probe_starting()
-    if measure_lock.active():
+    while True:
+        measure_lock.probe_starting()
+        if not measure_lock.active():
+            break
         measure_lock.probe_done()
         while measure_lock.active():
             time.sleep(15)
-        measure_lock.probe_starting()
     code = ("import jax; ds = jax.devices(); "
             "import sys; sys.exit(0 if ds and ds[0].platform != 'cpu' "
             "else 3)")
-    proc = subprocess.Popen([PY, "-c", code], stdout=subprocess.DEVNULL,
-                            stderr=subprocess.DEVNULL,
-                            start_new_session=True)
+    signal.pthread_sigmask(signal.SIG_BLOCK,
+                           {signal.SIGTERM, signal.SIGINT})
+    try:
+        proc = subprocess.Popen([PY, "-c", code],
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL,
+                                start_new_session=True)
+        _active_probe = proc
+    finally:
+        signal.pthread_sigmask(signal.SIG_UNBLOCK,
+                               {signal.SIGTERM, signal.SIGINT})
     try:
         return proc.wait(timeout=timeout) == 0
     except subprocess.TimeoutExpired:
@@ -86,6 +115,7 @@ def probe_alive(timeout=60.0) -> bool:
             pass
         return False
     finally:
+        _active_probe = None
         measure_lock.probe_done()
 
 
@@ -114,6 +144,8 @@ def run_step(name, cmd, timeout, env_extra) -> dict:
 
 
 def main():
+    signal.signal(signal.SIGTERM, _kill_active_probe)
+    signal.signal(signal.SIGINT, _kill_active_probe)
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", default="1,2,3,4,5,6")
     ap.add_argument("--probe-grace", type=int, default=3,

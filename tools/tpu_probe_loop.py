@@ -66,14 +66,25 @@ while time.time() - t_start < BUDGET + paused_total:
             f.write(json.dumps({"t": round(time.time()),
                                 "paused_for_measurement_s":
                                 round(paused)}) + "\n")
-        measure_lock.probe_starting()
+        continue  # loop back: re-claim the flag, re-check the lock —
+        #           a lock acquired during the log write above must not
+        #           overlap the probe we were about to launch
     attempt += 1
     t0 = time.time()
-    proc = subprocess.Popen([sys.executable, "-c", code],
-                            stdout=subprocess.DEVNULL,
-                            stderr=subprocess.DEVNULL,
-                            start_new_session=True)
-    _active_probe = proc
+    # SIGTERM must not land between fork and the _active_probe
+    # assignment — the handler would then miss the fresh subprocess and
+    # orphan it (the same leak the handler exists to prevent)
+    signal.pthread_sigmask(signal.SIG_BLOCK,
+                           {signal.SIGTERM, signal.SIGINT})
+    try:
+        proc = subprocess.Popen([sys.executable, "-c", code],
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL,
+                                start_new_session=True)
+        _active_probe = proc
+    finally:
+        signal.pthread_sigmask(signal.SIG_UNBLOCK,
+                               {signal.SIGTERM, signal.SIGINT})
     try:
         rc = proc.wait(timeout=PROBE_TIMEOUT)
     except subprocess.TimeoutExpired:
