@@ -180,3 +180,48 @@ def test_native_hostprep_differential():
         want = (int.from_bytes(d.digest(), "little") % L)
         assert h[i].tobytes() == want.to_bytes(32, "little"), i
         assert sok[i] == (int.from_bytes(s[i].tobytes(), "little") < L), i
+
+
+def test_step_transitions_observe_durations():
+    """RoundState.step transitions feed the per-step duration
+    histograms (consensus/metrics.go StepDurationSeconds analogue) —
+    every assignment site gets the breakdown for free."""
+    from tmtpu.consensus.types import (
+        STEP_COMMIT, STEP_NEW_ROUND, STEP_PROPOSE, RoundState,
+    )
+    from tmtpu.libs import metrics
+
+    def counts():
+        return {name: metrics.consensus_step_duration.totals(step=name)[0]
+                for name in ("NewHeight", "NewRound", "Propose", "Commit")}
+
+    before = counts()
+    rs = RoundState()
+    rs.step = STEP_NEW_ROUND   # leaves NewHeight
+    rs.step = STEP_PROPOSE     # leaves NewRound
+    rs.step = STEP_PROPOSE     # no transition: no observation
+    rs.step = STEP_COMMIT      # leaves Propose
+    after = counts()
+    assert after["NewHeight"] == before["NewHeight"] + 1
+    assert after["NewRound"] == before["NewRound"] + 1
+    assert after["Propose"] == before["Propose"] + 1
+    assert after["Commit"] == before["Commit"]
+    assert rs.step == STEP_COMMIT and rs.step_name() == "Commit"
+
+
+def test_replay_speed_steps_do_not_pollute_histograms():
+    from tmtpu.consensus.types import (
+        STEP_COMMIT, STEP_PROPOSE, RoundState,
+    )
+    from tmtpu.libs import metrics
+
+    rs = RoundState()
+    before = metrics.consensus_step_duration.totals(step="NewHeight")[0]
+    rs.metrics_paused = True  # what catchup_replay sets
+    rs.step = STEP_PROPOSE
+    rs.step = STEP_COMMIT
+    assert metrics.consensus_step_duration.totals(
+        step="NewHeight")[0] == before
+    rs.metrics_paused = False
+    rs.step = STEP_PROPOSE  # leaves Commit, live again
+    assert metrics.consensus_step_duration.totals(step="Commit")[0] >= 1

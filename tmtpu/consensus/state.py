@@ -199,6 +199,7 @@ class ConsensusState(BaseService):
         if not found_marker and any(m.end_height is not None for m in msgs):
             return  # markers exist but not height-1: nothing to catch up
         self.replay_mode = True
+        self.rs.metrics_paused = True  # replay-speed steps aren't real
         try:
             for m in msgs[start:]:
                 if on_msg is not None:
@@ -212,6 +213,7 @@ class ConsensusState(BaseService):
                             m.timeout.round, m.timeout.step))
         finally:
             self.replay_mode = False
+            self.rs.metrics_paused = False
         if not live_redrive:
             return
         # Liveness after a mid-round crash: replay may have advanced the

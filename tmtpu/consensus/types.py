@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional
 
 from tmtpu.types.validator import ValidatorSet
@@ -29,12 +30,23 @@ STEP_NAMES = {
 
 class RoundState:
     """consensus/types/round_state.go:65 — the full mutable round state the
-    state machine carries (snapshotted for gossip/RPC)."""
+    state machine carries (snapshotted for gossip/RPC).
+
+    ``step`` is a property: every transition records the wall time spent
+    in the step being left into the per-step duration histograms
+    (consensus/metrics.go StepDurationSeconds in later reference
+    releases), giving the latency breakdown behind the block-interval
+    metric for free at every assignment site."""
 
     def __init__(self):
         self.height = 0
         self.round = 0
-        self.step = STEP_NEW_HEIGHT
+        self._step = STEP_NEW_HEIGHT
+        self._step_since = time.perf_counter()
+        # WAL replay re-executes transitions at replay speed; its
+        # microsecond "durations" must not pollute the live histograms
+        # (ConsensusState.catchup_replay sets this around the replay)
+        self.metrics_paused = False
         self.start_time = 0  # unix nanos
         self.commit_time = 0
         self.validators: Optional[ValidatorSet] = None
@@ -52,6 +64,25 @@ class RoundState:
         self.last_commit = None  # VoteSet of last height's precommits
         self.last_validators: Optional[ValidatorSet] = None
         self.triggered_timeout_precommit = False
+
+    @property
+    def step(self) -> int:
+        return self._step
+
+    @step.setter
+    def step(self, new: int) -> None:
+        if new != self._step:
+            now = time.perf_counter()
+            if not self.metrics_paused:
+                try:
+                    from tmtpu.libs import metrics
+
+                    metrics.observe_step_duration(self._step,
+                                                  now - self._step_since)
+                except Exception:  # noqa: BLE001 — never break consensus
+                    pass
+            self._step_since = now
+        self._step = new
 
     def step_name(self) -> str:
         return STEP_NAMES.get(self.step, "?")

@@ -85,6 +85,15 @@ class Histogram(_Metric):
             counts[-1] += 1  # +Inf
             self._sums[k] = self._sums.get(k, 0.0) + value
 
+    def totals(self, **labels) -> Tuple[int, float]:
+        """(observation count, sum) for one label combination — the
+        public read used by tools/tests instead of poking _counts."""
+        k = self._key(labels)
+        with self._lock:
+            counts = self._counts.get(k)
+            return ((counts[-1] if counts else 0),
+                    self._sums.get(k, 0.0))
+
     def render(self, kind: str) -> List[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} histogram"]
@@ -171,6 +180,26 @@ consensus_total_txs = DEFAULT.counter("consensus", "total_txs",
                                       "Total txs committed")
 consensus_block_size = DEFAULT.gauge("consensus", "block_size_bytes",
                                      "Size of the latest block")
+# Per-step latency breakdown (consensus/metrics.go StepDurationSeconds
+# in later reference releases: ONE histogram with a step label): time
+# spent in each round step, observed on every step transition by
+# RoundState.step's setter. Fine buckets — steps run ~1-100 ms on a
+# localnet.
+consensus_step_duration = DEFAULT.histogram(
+    "consensus", "step_duration_seconds",
+    "Time spent per consensus round step", labels=("step",),
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             0.5, 1.0, 2.5))
+
+
+def observe_step_duration(step: int, seconds: float) -> None:
+    from tmtpu.consensus.types import STEP_NAMES
+
+    name = STEP_NAMES.get(step)
+    if name is not None:
+        consensus_step_duration.observe(seconds, step=name)
+
+
 p2p_peers = DEFAULT.gauge("p2p", "peers", "Number of connected peers")
 mempool_size = DEFAULT.gauge("mempool", "size",
                              "Number of uncommitted txs")
