@@ -225,3 +225,55 @@ def test_replay_speed_steps_do_not_pollute_histograms():
     rs.metrics_paused = False
     rs.step = STEP_PROPOSE  # leaves Commit, live again
     assert metrics.consensus_step_duration.totals(step="Commit")[0] >= 1
+
+
+@pytest.mark.slow
+def test_node_with_psql_indexer_records_txs(tmp_path):
+    """tx_index.indexer="psql" wires the SQL event sink into the node
+    (node.go EventSinksFromConfig): a committed tx lands in the
+    relational tables, and tx_search reports the sink unqueryable the
+    way the reference's psql sink does."""
+    import time as _time
+
+    from tmtpu.config.config import Config
+    from tmtpu.node.node import Node
+    from tmtpu.privval.file_pv import FilePV
+    from tmtpu.state.sink_sql import SQLTxIndexer
+    from tmtpu.types.genesis import GenesisDoc, GenesisValidator
+
+    home = tmp_path / "h"
+    (home / "config").mkdir(parents=True)
+    (home / "data").mkdir(parents=True)
+    cfg = Config.test_config()
+    cfg.base.home = str(home)
+    cfg.base.crypto_backend = "cpu"
+    cfg.rpc.laddr = ""
+    cfg.tx_index.indexer = "psql"
+    pv = FilePV.load_or_generate(
+        cfg.rooted(cfg.base.priv_validator_key_file),
+        cfg.rooted(cfg.base.priv_validator_state_file))
+    gen = GenesisDoc(chain_id="psql-chain", genesis_time=_time.time_ns(),
+                     validators=[GenesisValidator(pv.get_pub_key(), 10)])
+    gen.save_as(cfg.genesis_path)
+    n = Node(cfg)
+    assert isinstance(n.tx_indexer, SQLTxIndexer)
+    n.start()
+    try:
+        assert n.consensus.wait_for_height(1, timeout=60)
+        n.mempool.check_tx(b"sink-key=sink-val")
+        deadline = _time.monotonic() + 60
+        while _time.monotonic() < deadline and \
+                n.tx_indexer.sink.tx_count() < 1:
+            _time.sleep(0.2)
+        assert n.tx_indexer.sink.tx_count() >= 1
+        with pytest.raises(RuntimeError, match="not supported"):
+            n.tx_indexer.search("tx.height=1")
+        with pytest.raises(RuntimeError, match="not supported"):
+            n.tx_indexer.get(b"\x00" * 32)
+        # reindex over the same sink must not trip the blocks UNIQUE
+        from tmtpu.state.txindex import reindex_events
+
+        reindex_events(n.block_store, n.state_store, n.tx_indexer,
+                       block_indexer=n.block_indexer)
+    finally:
+        n.stop()

@@ -137,7 +137,11 @@ class StorageConfig:
 
 @dataclass
 class TxIndexConfig:
-    indexer: str = "kv"  # "null" | "kv"
+    indexer: str = "kv"  # "null" | "kv" | "psql" (SQL event sink)
+    # DB-API target for the psql sink: postgres:// URL (needs psycopg2)
+    # or a sqlite path; empty = data/tx_index_sql.db (config.toml
+    # psql-conn analogue)
+    psql_conn: str = ""
 
 
 @dataclass

@@ -90,6 +90,19 @@ class Node(BaseService):
             self.tx_indexer = KVTxIndexer(_make_db(config, "txindex"))
             self.block_indexer = KVBlockIndexer(
                 _make_db(config, "blockindex"))
+        elif config.tx_index.indexer == "psql":
+            # SQL event sink (node.go EventSinksFromConfig "psql")
+            from tmtpu.state.sink_sql import (
+                SQLBlockIndexer, SQLSink, SQLTxIndexer,
+                open_sink_connection,
+            )
+
+            sink = SQLSink(
+                open_sink_connection(config.tx_index.psql_conn,
+                                     config.rooted(config.base.db_dir)),
+                self.genesis_doc.chain_id)
+            self.tx_indexer = SQLTxIndexer(sink)
+            self.block_indexer = SQLBlockIndexer(sink)
         else:
             self.tx_indexer = NullTxIndexer()
             self.block_indexer = None
