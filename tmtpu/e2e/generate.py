@@ -28,6 +28,10 @@ TOPOLOGIES = ("single", "quad", "large")
 # weighted axes (generate.go nodeMempools / nodePerturbations analogues)
 _CURVES = ["ed25519", "ed25519", "sr25519", "secp256k1"]
 _MEMPOOLS = ["v0", "v1"]
+# all three fast-sync implementations, weighted toward the default —
+# the reference's nightly matrices mix fast-sync versions the same way
+# (test/e2e/generator: testnets mix FastSync versions)
+_BLOCKSYNCS = ["v0", "v0", "v1", "v2"]
 _PERTURBATIONS = {"kill": 0.1, "restart": 0.1, "pause": 0.1}
 
 
@@ -99,6 +103,7 @@ def _node_config(rng: random.Random) -> dict:
     cfg = {"mempool.version": rng.choice(_MEMPOOLS)}
     if rng.random() < 0.3:
         cfg["mempool.recheck"] = False
+    cfg["block_sync.version"] = rng.choice(_BLOCKSYNCS)
     return cfg
 
 
