@@ -45,6 +45,11 @@ class EvidencePool:
         # evidence actually arrives (the reference uses a clist waitChan)
         self._gen = 0
         self._new_ev = threading.Condition()
+        # consensus-reported equivocations whose height has no committed
+        # block yet (the usual case: the double vote happens mid-round);
+        # materialized into evidence on update(), when the height's
+        # block time is known (pool.go consensusBuffer)
+        self._consensus_buffer: list = []
 
     # -- ingestion ----------------------------------------------------------
 
@@ -58,19 +63,47 @@ class EvidencePool:
                 (vote_a.height, vote_a.round, vote_a.type) != \
                 (vote_b.height, vote_b.round, vote_b.type):
             return
+        with self._lock:
+            self._consensus_buffer.append((vote_a, vote_b))
+        # materialize immediately when the vote height's block already
+        # exists (a report about a PAST height); the common mid-round
+        # case waits for update() after the height commits
+        if self._materialize_buffer():
+            self._notify()
+
+    def _materialize_buffer(self) -> bool:
+        """Turn buffered consensus reports into pending evidence once
+        their height's block time is known (pool.go
+        processConsensusBuffer). Evidence carries the block time AT THE
+        VOTE HEIGHT — a now-timestamp would defeat the age window and
+        keep expired equivocations gossipable forever. Returns True if
+        anything new landed."""
         state = self._state or self.state_store.load()
         if state is None:
-            return
-        vals = self.state_store.load_validators(vote_a.height) \
-            or state.validators
-        ev = DuplicateVoteEvidence.new(
-            vote_a, vote_b, block_time=state.last_block_time, val_set=vals)
+            return False
+        added = False
         with self._lock:
-            if self._is_pending(ev) or self._is_committed(ev):
-                return
-            self.db.set(_k_pending(ev.height(), ev.hash()),
-                        evidence_to_proto(ev).encode())
-        self._notify()
+            remaining = []
+            for vote_a, vote_b in self._consensus_buffer:
+                meta = self.block_store.load_block_meta(vote_a.height)
+                if meta is None:
+                    if vote_a.height > state.last_block_height:
+                        remaining.append((vote_a, vote_b))  # not yet
+                    # else: block pruned — the evidence window has moved
+                    # past it anyway; drop the report
+                    continue
+                vals = self.state_store.load_validators(vote_a.height) \
+                    or state.validators
+                ev = DuplicateVoteEvidence.new(
+                    vote_a, vote_b, block_time=meta.header.time,
+                    val_set=vals)
+                if self._is_pending(ev) or self._is_committed(ev):
+                    continue
+                self.db.set(_k_pending(ev.height(), ev.hash()),
+                            evidence_to_proto(ev).encode())
+                added = True
+            self._consensus_buffer = remaining
+        return added
 
     def add_evidence(self, ev) -> None:
         """pool.go AddEvidence — gossiped evidence must be verified."""
@@ -103,8 +136,22 @@ class EvidencePool:
         if state is None:
             raise EvidenceError("no state to verify evidence against")
         params = state.consensus_params
+        # The age window must be computed from OUR block time at the
+        # evidence height, not the gossiper's claimed timestamp — a
+        # forged fresh timestamp would otherwise keep expired
+        # equivocations alive forever (verify.go reads the local block
+        # meta and rejects a mismatched evidence time the same way).
+        meta = self.block_store.load_block_meta(ev.height())
+        if meta is not None:
+            if ev.time() != meta.header.time:
+                raise EvidenceError(
+                    f"evidence time {ev.time()} differs from block time "
+                    f"{meta.header.time} at height {ev.height()}")
+            ev_time = meta.header.time
+        else:
+            ev_time = ev.time()  # pruned store: claimed time is all we have
         age_blocks = state.last_block_height - ev.height()
-        age_ns = state.last_block_time - ev.time()
+        age_ns = state.last_block_time - ev_time
         if age_blocks > params.evidence_max_age_num_blocks and \
                 age_ns > params.evidence_max_age_duration_ns:
             raise EvidenceError(
@@ -173,9 +220,13 @@ class EvidencePool:
         return out
 
     def update(self, state, block_evidence: List) -> None:
-        """pool.go Update — mark committed, prune expired."""
+        """pool.go Update — materialize buffered consensus reports (their
+        height's block time exists now), mark committed, prune expired."""
         with self._lock:
             self._state = state
+        if self._materialize_buffer():
+            self._notify()
+        with self._lock:
             for ev in block_evidence:
                 self.db.set(_k_committed(ev.height(), ev.hash()), b"\x01")
                 self.db.delete(_k_pending(ev.height(), ev.hash()))

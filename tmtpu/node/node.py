@@ -45,6 +45,12 @@ class Node(BaseService):
         super().__init__("Node")
         self.config = config
         crypto_batch.set_default_backend(config.base.crypto_backend)
+        # warm the native helper library now: its lazy first load may
+        # COMPILE hostprep.c (seconds), which must never land inside the
+        # consensus verify hot path on first use
+        from tmtpu import native as _native
+
+        _native.load()
 
         # --- DBs + state (node.go initDBs / LoadStateFromDBOrGenesis) ---
         self.block_store = BlockStore(_make_db(config, "blockstore"))
