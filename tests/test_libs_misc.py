@@ -350,3 +350,42 @@ def test_wal_corruption_handling(tmp_path):
     heights = [m.end_height.height
                for m in WAL.iter_messages(path) if m.end_height]
     assert heights == [1, 2, 3, 4]
+
+
+def test_priority_mempool_ttl_num_blocks():
+    """v1 TTL by block age (mempool.go:742, mempool_test.go
+    TestTxMempool_ExpiredTxs_NumBlocks): txs older than ttl_num_blocks
+    heights purge on update and become resubmittable."""
+    mp = PriorityMempool(_PriorityApp(), ttl_num_blocks=2)
+    mp.update(10, [], [])  # height context
+    mp.check_tx(bytes([5]) + b"old")
+    assert mp.size() == 1
+    mp.update(11, [], [])  # age 1: kept
+    mp.update(12, [], [])  # age 2: kept (purge is strictly >)
+    assert mp.size() == 1
+    mp.update(13, [], [])  # age 3 > 2: purged
+    assert mp.size() == 0
+    mp.check_tx(bytes([5]) + b"old")  # cache was released
+    assert mp.size() == 1
+
+
+def test_priority_mempool_ttl_duration():
+    """v1 TTL by wall age (mempool.go:746, mempool_test.go
+    TestTxMempool_ExpiredTxs_Timestamp)."""
+    import time
+
+    mp = PriorityMempool(_PriorityApp(), ttl_duration_ns=30_000_000)
+    mp.check_tx(bytes([5]) + b"x")
+    mp.update(1, [], [])  # fresh: kept
+    assert mp.size() == 1
+    time.sleep(0.05)
+    mp.update(2, [], [])  # 50 ms > 30 ms: purged
+    assert mp.size() == 0
+
+
+def test_priority_mempool_ttl_disabled_by_default():
+    mp = PriorityMempool(_PriorityApp())
+    mp.check_tx(bytes([5]) + b"x")
+    for h in range(1, 50):
+        mp.update(h, [], [])
+    assert mp.size() == 1

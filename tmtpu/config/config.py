@@ -65,6 +65,10 @@ class MempoolConfig:
     max_tx_bytes: int = 1048576
     recheck: bool = True
     broadcast: bool = True
+    # v1-only TTLs (config.go ttl-num-blocks / ttl-duration): a tx older
+    # than EITHER axis is purged on update; 0 disables
+    ttl_num_blocks: int = 0
+    ttl_duration_ns: int = 0
 
 
 @dataclass

@@ -142,14 +142,19 @@ class EvidencePool:
         # equivocations alive forever (verify.go reads the local block
         # meta and rejects a mismatched evidence time the same way).
         meta = self.block_store.load_block_meta(ev.height())
-        if meta is not None:
-            if ev.time() != meta.header.time:
-                raise EvidenceError(
-                    f"evidence time {ev.time()} differs from block time "
-                    f"{meta.header.time} at height {ev.height()}")
-            ev_time = meta.header.time
-        else:
-            ev_time = ev.time()  # pruned store: claimed time is all we have
+        if meta is None:
+            # pruned/bootstrapped store: without the canonical block time
+            # the claimed timestamp is unverifiable, and trusting it
+            # would reopen the forged-timestamp bypass — reject, like
+            # the reference's blockMeta==nil error (verify.go:58)
+            raise EvidenceError(
+                f"no block meta at evidence height {ev.height()} "
+                "(pruned?) — cannot validate evidence time")
+        if ev.time() != meta.header.time:
+            raise EvidenceError(
+                f"evidence time {ev.time()} differs from block time "
+                f"{meta.header.time} at height {ev.height()}")
+        ev_time = meta.header.time
         age_blocks = state.last_block_height - ev.height()
         age_ns = state.last_block_time - ev_time
         if age_blocks > params.evidence_max_age_num_blocks and \

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from typing import Callable, List, Optional
 
 from tmtpu.abci import types as abci
@@ -28,12 +29,16 @@ class PriorityMempool(AsyncRecheckMixin):
     def __init__(self, proxy_app, max_txs: int = 5000,
                  max_txs_bytes: int = 1 << 30, cache_size: int = 10000,
                  keep_invalid_txs_in_cache: bool = False,
-                 pre_check: Optional[Callable] = None):
+                 pre_check: Optional[Callable] = None,
+                 ttl_num_blocks: int = 0, ttl_duration_ns: int = 0):
         self.proxy_app = proxy_app
         self.max_txs = max_txs
         self.max_txs_bytes = max_txs_bytes
         self.keep_invalid_txs_in_cache = keep_invalid_txs_in_cache
         self.pre_check = pre_check
+        # v1 TTLs (mempool.go:730 purgeExpiredTxs): 0 disables each axis
+        self.ttl_num_blocks = int(ttl_num_blocks)
+        self.ttl_duration_ns = int(ttl_duration_ns)
         self.cache = TxCache(cache_size)
         self._txs: dict = {}  # hash -> info
         self._list = CList()  # arrival order, for cursor-based gossip
@@ -89,15 +94,13 @@ class PriorityMempool(AsyncRecheckMixin):
                     raise MempoolFullError(
                         f"mempool is full: {len(self._txs)} txs and no "
                         f"lower-priority tx to evict")
-                del self._txs[victim_key]
-                self._list.remove(victim["_el"])
-                self._txs_bytes -= len(victim["tx"])
                 # evicted txs must be re-submittable (they're in no block)
-                self.cache.remove(victim["tx"])
+                self._remove_tx(victim_key, drop_cache=True)
             info = {
                 "tx": tx, "priority": res.priority,
                 "gas_wanted": res.gas_wanted, "seq": next(self._seq),
                 "height": self._height,
+                "time_ns": time.time_ns(),  # for ttl_duration (tx.go:16)
                 "senders": set(filter(None, [tx_info.get("sender")])),
             }
             info["_el"] = self._list.push_back(info)
@@ -108,6 +111,18 @@ class PriorityMempool(AsyncRecheckMixin):
         from tmtpu.libs import metrics as _m
 
         _m.mempool_size.set(self.size())
+
+    def _remove_tx(self, key: bytes, drop_cache: bool) -> None:
+        """Drop one resident tx, keeping map/clist/byte-counter/cache in
+        sync — the single place that invariant lives. Caller holds
+        self._lock."""
+        info = self._txs.pop(key, None)
+        if info is None:
+            return
+        self._list.remove(info["_el"])
+        self._txs_bytes -= len(info["tx"])
+        if drop_cache:
+            self.cache.remove(info["tx"])
 
     def _ordered(self) -> List[dict]:
         return sorted(self._txs.values(),
@@ -148,15 +163,28 @@ class PriorityMempool(AsyncRecheckMixin):
                     self.cache.push(tx)
                 elif not self.keep_invalid_txs_in_cache:
                     self.cache.remove(tx)
-                info = self._txs.pop(tmhash.sum(tx), None)
-                if info is not None:
-                    self._list.remove(info["_el"])
-                    self._txs_bytes -= len(info["tx"])
+                self._remove_tx(tmhash.sum(tx), drop_cache=False)
+            self._purge_expired(height)
         # async recheck, same rationale as CListMempool._schedule_recheck
         self._schedule_recheck()
         from tmtpu.libs import metrics as _m
 
         _m.mempool_size.set(self.size())
+
+    def _purge_expired(self, block_height: int) -> None:
+        """mempool.go:730 purgeExpiredTxs — drop txs past either TTL
+        axis (block age, wall age). Caller holds self._lock. Purged txs
+        leave the cache so they can be resubmitted."""
+        if self.ttl_num_blocks == 0 and self.ttl_duration_ns == 0:
+            return
+        now = time.time_ns()
+        for key in list(self._txs):
+            info = self._txs[key]
+            if (self.ttl_num_blocks > 0 and
+                    block_height - info["height"] > self.ttl_num_blocks) \
+                    or (self.ttl_duration_ns > 0 and
+                        now - info["time_ns"] > self.ttl_duration_ns):
+                self._remove_tx(key, drop_cache=True)
 
     def _recheck_pass(self) -> None:
         with self._lock:
@@ -169,11 +197,9 @@ class PriorityMempool(AsyncRecheckMixin):
                 if info is None:
                     continue
                 if not res.is_ok():
-                    del self._txs[tmhash.sum(tx)]
-                    self._list.remove(info["_el"])
-                    self._txs_bytes -= len(info["tx"])
-                    if not self.keep_invalid_txs_in_cache:
-                        self.cache.remove(tx)
+                    self._remove_tx(
+                        tmhash.sum(tx),
+                        drop_cache=not self.keep_invalid_txs_in_cache)
                 else:
                     info["priority"] = res.priority
 

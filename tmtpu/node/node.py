@@ -147,19 +147,22 @@ class Node(BaseService):
         self.state = hs.state
 
         # --- mempool (node.go:368; version per config, like FastSync) ---
-        if config.mempool.version == "v1":
-            from tmtpu.mempool.priority_mempool import PriorityMempool
-
-            mempool_cls = PriorityMempool
-        else:
-            mempool_cls = CListMempool
-        self.mempool = mempool_cls(
-            self.proxy_app.mempool,
+        mp_kwargs = dict(
             max_txs=config.mempool.size,
             max_txs_bytes=config.mempool.max_txs_bytes,
             cache_size=config.mempool.cache_size,
             keep_invalid_txs_in_cache=config.mempool.keep_invalid_txs_in_cache,
         )
+        if config.mempool.version == "v1":
+            from tmtpu.mempool.priority_mempool import PriorityMempool
+
+            mempool_cls = PriorityMempool
+            mp_kwargs.update(
+                ttl_num_blocks=config.mempool.ttl_num_blocks,
+                ttl_duration_ns=config.mempool.ttl_duration_ns)
+        else:
+            mempool_cls = CListMempool
+        self.mempool = mempool_cls(self.proxy_app.mempool, **mp_kwargs)
 
         # --- evidence pool ---
         from tmtpu.evidence.pool import EvidencePool
