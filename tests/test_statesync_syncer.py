@@ -1,0 +1,157 @@
+"""Statesync syncer failure semantics (reference: statesync/syncer.go
+SyncAny/offerSnapshot/applyChunks + syncer_test.go): rejected snapshots
+are never re-offered, bogus tall snapshots can't starve syncable ones,
+missing chunks fail over across peers, a stuck app can't spin forever,
+and a restored app that disagrees with the light-client app hash fails
+the sync. All with mock app/provider — no network."""
+
+import queue
+import threading
+
+import pytest
+
+from tmtpu.abci import types as abci
+from tmtpu.statesync.syncer import (
+    ErrNoSnapshots, ErrRejected, SyncError, Syncer,
+)
+
+H = 10
+APP_HASH = b"\xaa" * 32
+
+
+class _Provider:
+    """state_provider stub: app_hash/state/commit at the snapshot
+    height; optionally failing (chain-not-there-yet) for tall heights."""
+
+    def __init__(self, max_height=H):
+        self.max_height = max_height
+
+    def app_hash(self, height):
+        from tmtpu.light.provider import ProviderError
+
+        if height > self.max_height:
+            raise ProviderError(f"no header at {height + 2}")
+        return APP_HASH
+
+    def state(self, height):
+        return f"state@{height}"
+
+    def commit(self, height):
+        return f"commit@{height}"
+
+
+class _SnapshotConn:
+    def __init__(self, offer=abci.OFFER_SNAPSHOT_ACCEPT,
+                 apply_results=None):
+        self.offer = offer
+        self.apply_results = apply_results or {}
+        self.offers = []
+
+    def offer_snapshot_sync(self, req):
+        self.offers.append(req.snapshot.height)
+        return abci.ResponseOfferSnapshot(result=self.offer)
+
+    def apply_snapshot_chunk_sync(self, req):
+        r = self.apply_results.get(req.index, abci.APPLY_CHUNK_ACCEPT)
+        return abci.ResponseApplySnapshotChunk(result=r)
+
+
+class _QueryConn:
+    def __init__(self, height=H, app_hash=APP_HASH):
+        self.height = height
+        self.app_hash = app_hash
+
+    def info_sync(self, req):
+        return abci.ResponseInfo(last_block_height=self.height,
+                                 last_block_app_hash=self.app_hash)
+
+
+class _App:
+    def __init__(self, snapshot=None, query=None):
+        self.snapshot = snapshot or _SnapshotConn()
+        self.query = query or _QueryConn()
+
+
+def _serving_syncer(app, provider=None, chunks=2, peers=("p1",),
+                    chunk_timeout_s=0.3):
+    """Syncer whose request_chunk immediately 'delivers' the chunk."""
+    s = Syncer(app, provider or _Provider(),
+               request_chunk=lambda peer, h, f, i:
+               s.add_chunk(h, f, i, b"chunk%d" % i, False),
+               chunk_timeout_s=chunk_timeout_s)
+    for p in peers:
+        s.add_snapshot(p, H, 1, chunks, b"\x01" * 32, b"")
+    return s
+
+
+def test_happy_path_restores_and_verifies():
+    app = _App()
+    s = _serving_syncer(app)
+    state, commit = s.sync_any(discovery_time_s=0.05, deadline_s=5)
+    assert state == f"state@{H}" and commit == f"commit@{H}"
+    assert app.snapshot.offers == [H]
+
+
+def test_rejected_snapshot_not_reoffered_and_next_best_used():
+    """offer REJECT blacklists the snapshot key (syncer.go errRejected +
+    add_snapshot refusing rejected keys)."""
+    app = _App(snapshot=_SnapshotConn(offer=abci.OFFER_SNAPSHOT_REJECT))
+    s = _serving_syncer(app)
+    with pytest.raises(ErrNoSnapshots):
+        s.sync_any(discovery_time_s=0.05, deadline_s=1.0)
+    assert app.snapshot.offers == [H]  # offered exactly once
+    # re-advertising the same snapshot is a no-op
+    s.add_snapshot("p2", H, 1, 2, b"\x01" * 32, b"")
+    with pytest.raises(ErrNoSnapshots):
+        s.sync_any(discovery_time_s=0.05, deadline_s=0.5)
+    assert app.snapshot.offers == [H]
+
+
+def test_bogus_tall_snapshot_cannot_starve_syncable_one():
+    """A malicious sky-high snapshot keeps winning best-snapshot until
+    its bounded ErrRetryLater budget drops it; the real one then syncs
+    (syncer.go retry bounding)."""
+    app = _App()
+    s = _serving_syncer(app)  # real snapshot at H
+    s.add_snapshot("liar", H + 1000, 1, 1, b"\x02" * 32, b"")
+    state, _ = s.sync_any(discovery_time_s=0.05, deadline_s=30)
+    assert state == f"state@{H}"
+    assert (H + 1000, 1) not in {(k[0], k[1]) for k in s._snapshots}
+
+
+def test_chunk_miss_fails_over_to_other_peer():
+    """A peer that never delivers is dropped for the snapshot and the
+    chunk re-requested elsewhere (applyChunks re-request)."""
+    app = _App()
+    delivered = []
+
+    def req(peer, h, f, i):
+        if peer == "dead":
+            return  # never delivers
+        delivered.append((peer, i))
+        s.add_chunk(h, f, i, b"chunk%d" % i, False)
+
+    s = Syncer(app, _Provider(), request_chunk=req, chunk_timeout_s=0.2)
+    # both peers advertise; make the dead one sort first deterministically
+    s.add_snapshot("dead", H, 1, 2, b"\x01" * 32, b"")
+    s.add_snapshot("live", H, 1, 2, b"\x01" * 32, b"")
+    state, _ = s.sync_any(discovery_time_s=0.05, deadline_s=20)
+    assert state == f"state@{H}"
+    assert all(p == "live" for p, _ in delivered)
+
+
+def test_app_stuck_on_retry_is_bounded():
+    app = _App(snapshot=_SnapshotConn(
+        apply_results={0: abci.APPLY_CHUNK_RETRY}))
+    s = _serving_syncer(app)
+    with pytest.raises(ErrNoSnapshots):
+        s.sync_any(discovery_time_s=0.05, deadline_s=2.0)
+
+
+def test_restored_app_hash_mismatch_fails_sync():
+    app = _App(query=_QueryConn(app_hash=b"\xbb" * 32))
+    s = _serving_syncer(app)
+    with pytest.raises(ErrNoSnapshots):
+        s.sync_any(discovery_time_s=0.05, deadline_s=1.0)
+    # and the bad snapshot was dropped, not retried forever
+    assert not s._snapshots

@@ -197,7 +197,8 @@ class Syncer:
             except queue.Empty:
                 break
         index = 0
-        misses = 0
+        misses = 0       # chunk-delivery failures (reset on delivery)
+        app_retries = 0  # consecutive app RETRYs at the current index
         while index < snap.chunks:
             peers = self._fetch_peers(snap)
             if not peers:
@@ -221,11 +222,15 @@ class Syncer:
                     index=index, chunk=chunk, sender=peer))
             if res.result == abci.APPLY_CHUNK_ACCEPT:
                 index += 1
+                app_retries = 0
             elif res.result == abci.APPLY_CHUNK_RETRY:
-                # bounded: an app stuck returning RETRY (e.g. restore state
-                # out of step) must fail the attempt, not spin forever
-                misses += 1
-                if misses > 2 * len(peers) + 3:
+                # bounded on ITS OWN counter: an app stuck returning
+                # RETRY (e.g. restore state out of step) must fail the
+                # attempt, not spin forever — the delivery-miss counter
+                # resets on every successful fetch, so it can never
+                # bound this loop
+                app_retries += 1
+                if app_retries > 5:
                     raise SyncError("app kept returning chunk RETRY")
                 continue
             elif res.result == abci.APPLY_CHUNK_RETRY_SNAPSHOT:
