@@ -49,3 +49,50 @@ def test_wal2json_json2wal_roundtrip(tmp_path, capsys):
     a = list(WAL.iter_messages(str(wal_path)))
     b = list(WAL.iter_messages(str(rebuilt)))
     assert [m.encode() for m in a] == [m.encode() for m in b]
+
+
+def test_wal_corruption_tolerated_nonstrict_raised_strict(tmp_path):
+    """Replay reads a WAL like the reference with
+    IgnoreDataCorruptionErrors: a corrupt record ends iteration (the
+    tail after a crash is untrustworthy), while strict readers
+    (wal2json --strict semantics) raise (wal.go DataCorruptionError)."""
+    import struct
+    import zlib
+
+    import pytest
+
+    from tmtpu.consensus.wal import WAL, CorruptedWALError
+
+    path = str(tmp_path / "wal")
+    w = WAL(path)
+    for h in (1, 2, 3):
+        w.write_end_height(h)
+    w.close()
+
+    msgs = list(WAL.iter_messages(path))
+    assert [m.end_height.height for m in msgs] == [1, 2, 3]
+
+    # corrupt one payload byte of the SECOND record
+    with open(path, "rb") as f:
+        data = bytearray(f.read())
+    # find record boundaries: crc(4) + uvarint len + payload
+    from tmtpu.libs import protoio
+    pos = 4
+    ln, pos = protoio.decode_uvarint(bytes(data), pos)
+    second_start = pos + ln
+    data[second_start + 5] ^= 0xFF  # inside record 2's payload
+    bad = str(tmp_path / "bad")
+    with open(bad, "wb") as f:
+        f.write(bytes(data))
+
+    assert [m.end_height.height for m in WAL.iter_messages(bad)] == [1]
+    with pytest.raises(CorruptedWALError, match="crc mismatch"):
+        list(WAL.iter_messages(bad, strict=True))
+
+    # torn tail (crash mid-write): everything before it reads fine
+    torn = str(tmp_path / "torn")
+    with open(path, "rb") as f:
+        whole = f.read()
+    with open(torn, "wb") as f:
+        f.write(whole[:-3])
+    assert [m.end_height.height for m in WAL.iter_messages(torn)] == [1, 2]
