@@ -18,6 +18,19 @@ import pytest
 
 from tmtpu.crypto import batch as crypto_batch
 from tmtpu.crypto import secp256k1 as k1
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _quiet_core():
+    """These multi-node timing tests are the suite's one proven
+    contention flake: the background tunnel prober's jax subprocess
+    sharing the single core stalls block production past the test
+    deadlines. Hold the measurement lock for the module so the prober
+    pauses (docs/qa.md clean-measurement rule)."""
+    from tools import measure_lock
+
+    with measure_lock.hold("test_mixed_curve"):
+        yield
 from tmtpu.crypto import sr25519 as sr
 from tmtpu.types.block import BlockID
 from tmtpu.types.priv_validator import MockPV
