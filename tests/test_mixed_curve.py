@@ -19,18 +19,6 @@ import pytest
 from tmtpu.crypto import batch as crypto_batch
 from tmtpu.crypto import secp256k1 as k1
 
-
-@pytest.fixture(autouse=True, scope="module")
-def _quiet_core():
-    """These multi-node timing tests are the suite's one proven
-    contention flake: the background tunnel prober's jax subprocess
-    sharing the single core stalls block production past the test
-    deadlines. Hold the measurement lock for the module so the prober
-    pauses (docs/qa.md clean-measurement rule)."""
-    from tools import measure_lock
-
-    with measure_lock.hold("test_mixed_curve"):
-        yield
 from tmtpu.crypto import sr25519 as sr
 from tmtpu.types.block import BlockID
 from tmtpu.types.priv_validator import MockPV
@@ -39,6 +27,33 @@ from tmtpu.types.vote import PRECOMMIT, PREVOTE, Vote
 from tmtpu.types.vote_set import VoteSet
 
 from tests.test_types import CHAIN_ID, mk_vote
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _quiet_core():
+    """These multi-node timing tests are the suite's one proven
+    contention flake: the background tunnel prober's jax subprocess
+    sharing the single core stalls block production past the test
+    deadlines. Hold the measurement lock for the module so the prober
+    pauses (docs/qa.md clean-measurement rule) — with a refresher
+    thread, because a module slowed past the lock's 45-min staleness
+    window would otherwise lose the guard mid-run (re-acquiring from
+    the same pid just refreshes the mtime)."""
+    import threading
+
+    from tools import measure_lock
+
+    stop = threading.Event()
+
+    def refresh():
+        while not stop.wait(600):
+            measure_lock.acquire("test_mixed_curve")
+
+    t = threading.Thread(target=refresh, daemon=True)
+    with measure_lock.hold("test_mixed_curve"):
+        t.start()
+        yield
+        stop.set()
 
 pytestmark = pytest.mark.slow
 
