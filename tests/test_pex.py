@@ -64,3 +64,30 @@ def test_net_forms_from_single_seed(tmp_path):
     finally:
         for nd in nodes:
             nd.stop()
+
+
+def test_addrbook_old_bucket_cap_demotes_stalest(tmp_path, monkeypatch):
+    """A full old bucket demotes its stalest vetted entry back to a new
+    bucket instead of growing without bound (addrbook.go moveToOld)."""
+    import tmtpu.p2p.pex.addrbook as ab
+
+    monkeypatch.setattr(ab, "BUCKET_SIZE", 4)
+    monkeypatch.setattr(ab, "OLD_BUCKET_COUNT", 1)  # force collisions
+    monkeypatch.setattr(ab, "NEW_BUCKET_COUNT", 1)
+    book = ab.AddrBook(str(tmp_path / "book.json"), our_id="me")
+    addrs = ["%040x@10.0.0.%d:26656" % (i, i + 1) for i in range(5)]
+    import time as _t
+
+    for i, a in enumerate(addrs):
+        assert book.add_address(a, src="s")
+        book.mark_good(a)
+        _t.sleep(0.01)  # distinct last_success ordering
+    old = [k for k in book._by_id.values() if k.bucket_type == "old"]
+    new = [k for k in book._by_id.values() if k.bucket_type == "new"]
+    assert len(old) == 4  # capped
+    assert len(new) == 1
+    # the demoted one is the stalest (first promoted)
+    assert new[0].addr == addrs[0]
+    # every bucket respects the cap
+    for ids in book._buckets.values():
+        assert len(ids) <= 4

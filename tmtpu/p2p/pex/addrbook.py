@@ -148,7 +148,23 @@ class AddrBook:
         if pid in self._buckets.get(old_b, []):
             self._buckets[old_b].remove(pid)
         ka.bucket_type = new_type
-        self._buckets.setdefault(self._bucket_of(ka), []).append(pid)
+        dest = self._bucket_of(ka)
+        ids = self._buckets.setdefault(dest, [])
+        if new_type == "old" and len(ids) >= BUCKET_SIZE:
+            # full old bucket: demote the stalest vetted entry back to
+            # new rather than growing without bound (addrbook.go
+            # moveToOld pushes one back into a new bucket)
+            stalest = min(ids, key=lambda i: self._by_id[i].last_success)
+            ids.remove(stalest)
+            demoted = self._by_id[stalest]
+            demoted.bucket_type = "new"
+            nids = self._buckets.setdefault(self._bucket_of(demoted), [])
+            if len(nids) >= BUCKET_SIZE:  # cascade: evict, don't overflow
+                worst = min(nids, key=lambda i: self._by_id[i].last_success)
+                nids.remove(worst)
+                del self._by_id[worst]
+            nids.append(stalest)
+        ids.append(pid)
 
     # -- selection ----------------------------------------------------------
 
