@@ -1,0 +1,155 @@
+"""Deterministic lock/unlock semantics for ONE ConsensusState, driven by
+votes injected from controlled validators (the reference's
+state_test.go signAddVotes pattern — TestStateLock*): polka locks, a
+locked node prevotes its lock in later rounds, and only a nil polka
+unlocks. The node under test holds a power supermajority... of
+proposer priority only — it proposes every round (power 10 vs 1,1,1),
+but its vote alone is far from 2/3, so every quorum is ours to grant or
+withhold."""
+
+import time
+
+import pytest
+
+from tmtpu.types.block import BlockID
+from tmtpu.types.priv_validator import MockPV
+from tmtpu.types.vote import PRECOMMIT, PREVOTE, Vote
+
+from tests.test_consensus import CHAIN_ID
+
+pytestmark = pytest.mark.slow
+
+
+def _mk_cs():
+    """One live ConsensusState (power 50) + three controlled MockPVs
+    (power 40 each, total 170): cs's power wins the round-0 proposer
+    slot, while the three controlled votes are 120 ≥ 2/3·170 — a polka
+    (or its denial) never depends on cs's own vote."""
+    from tmtpu.abci.example.kvstore import KVStoreApplication
+    from tmtpu.config.config import ConsensusConfig
+    from tmtpu.consensus.state import ConsensusState
+    from tmtpu.libs.db import MemDB
+    from tmtpu.proxy import AppConns, LocalClientCreator
+    from tmtpu.state.execution import BlockExecutor
+    from tmtpu.state.state import state_from_genesis
+    from tmtpu.state.store import StateStore
+    from tmtpu.store.block_store import BlockStore
+    from tmtpu.types.event_bus import EventBus
+    from tmtpu.types.genesis import GenesisDoc, GenesisValidator
+
+    cs_pv = MockPV()
+    others = [MockPV() for _ in range(3)]
+    gen = GenesisDoc(
+        chain_id=CHAIN_ID, genesis_time=time.time_ns(),
+        validators=[GenesisValidator(cs_pv.get_pub_key(), 50)] +
+        [GenesisValidator(pv.get_pub_key(), 40) for pv in others])
+    app = KVStoreApplication()
+    conns = AppConns(LocalClientCreator(app))
+    conns.start()
+    state_store = StateStore(MemDB())
+    block_store = BlockStore(MemDB())
+    genesis_state = state_from_genesis(gen)
+    state_store.save(genesis_state)
+    bus = EventBus()
+    exec_ = BlockExecutor(state_store, conns.consensus, event_bus=bus)
+    cs = ConsensusState(ConsensusConfig.test_config(), genesis_state,
+                        exec_, block_store, event_bus=bus,
+                        priv_validator=cs_pv)
+    vals = genesis_state.validators
+    idx_of = {pv.get_pub_key().address(): None for pv in others}
+    for i, v in enumerate(vals.validators):
+        if v.address in idx_of:
+            idx_of[v.address] = i
+    return cs, others, idx_of, vals
+
+
+def _vote(pv, idx, vtype, height, round_, block_id):
+    v = Vote(type=vtype, height=height, round=round_, block_id=block_id,
+             timestamp=time.time_ns(),
+             validator_address=pv.get_pub_key().address(),
+             validator_index=idx)
+    pv.sign_vote(CHAIN_ID, v)
+    return v
+
+
+def _wait(cond, timeout=30.0, what=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _proposal_block_id(cs, round_):
+    """Wait for cs (the proposer) to publish its proposal for round_."""
+    _wait(lambda: cs.rs.proposal_block is not None
+          and cs.rs.round == round_,
+          what=f"cs proposal in round {round_}")
+    blk = cs.rs.proposal_block
+    parts = cs.rs.proposal_block_parts
+    return BlockID(blk.hash(), parts.total, parts.hash)
+
+
+def test_polka_locks_and_only_nil_polka_unlocks():
+    cs, others, idx_of, vals = _mk_cs()
+    try:
+        cs.start()
+        bid = _proposal_block_id(cs, 0)
+
+        # round 0: grant the polka — cs must lock and precommit the block
+        for pv in others:
+            cs.add_vote_msg(_vote(pv, idx_of[pv.get_pub_key().address()],
+                                  PREVOTE, 1, 0, bid), peer_id="x")
+        _wait(lambda: cs.rs.locked_block is not None,
+              what="lock after polka")
+        assert cs.rs.locked_round == 0
+        assert cs.rs.locked_block.hash() == bid.hash
+
+        # deny the commit: everyone else precommits nil → round 1
+        nil = BlockID()
+        for pv in others:
+            cs.add_vote_msg(_vote(pv, idx_of[pv.get_pub_key().address()],
+                                  PRECOMMIT, 1, 0, nil), peer_id="x")
+        _wait(lambda: cs.rs.round >= 1, what="advance to round 1")
+
+        # round 1: the locked node must PREVOTE ITS LOCK (state.go:1252)
+        def cs_prevoted_lock():
+            pvs_r1 = cs.rs.votes.prevotes(1)
+            if pvs_r1 is None:
+                return False
+            v = pvs_r1.get_by_address(
+                cs.priv_validator.get_pub_key().address())
+            return v is not None and v.block_id.hash == bid.hash
+        _wait(cs_prevoted_lock, what="cs prevoting its locked block in r1")
+        assert cs.rs.locked_block is not None  # still locked
+
+        # round 1: nil polka → cs must UNLOCK and precommit nil
+        for pv in others:
+            cs.add_vote_msg(_vote(pv, idx_of[pv.get_pub_key().address()],
+                                  PREVOTE, 1, 1, nil), peer_id="x")
+        _wait(lambda: cs.rs.locked_block is None,
+              what="unlock after nil polka")
+        assert cs.rs.locked_round == -1
+    finally:
+        cs.stop()
+
+
+def test_commit_path_after_lock():
+    """Lock then grant precommits: the locked block commits at height 1
+    and the chain moves on (the positive half of the lock rules)."""
+    cs, others, idx_of, vals = _mk_cs()
+    try:
+        cs.start()
+        bid = _proposal_block_id(cs, 0)
+        for pv in others:
+            cs.add_vote_msg(_vote(pv, idx_of[pv.get_pub_key().address()],
+                                  PREVOTE, 1, 0, bid), peer_id="x")
+        _wait(lambda: cs.rs.locked_block is not None, what="lock")
+        for pv in others:
+            cs.add_vote_msg(_vote(pv, idx_of[pv.get_pub_key().address()],
+                                  PRECOMMIT, 1, 0, bid), peer_id="x")
+        _wait(lambda: cs.block_store.height() >= 1, what="commit")
+        assert cs.block_store.load_block(1).hash() == bid.hash
+    finally:
+        cs.stop()
