@@ -173,11 +173,15 @@ def test_4node_net_mixed_curves_commits(monkeypatch):
         vals = nodes[0].rs.validators
         want = {"ed25519", "sr25519", "secp256k1"}
         signed_curves = set()
+        # generous caps: late in a full-suite run, accumulated jax
+        # state and daemon threads stretch the pure-Python sr25519
+        # MockPV's signing latency well past a lightly-loaded box's —
+        # the property under test is curve coverage, not wall time
         h = 1
-        while signed_curves != want and h <= 12:
+        while signed_curves != want and h <= 30:
             commit = nodes[0].block_store.load_seen_commit(h)
             if commit is None:
-                assert nodes[0].wait_for_height(h, timeout=120), \
+                assert nodes[0].wait_for_height(h, timeout=240), \
                     f"stuck at {nodes[0].rs.height_round_step()}"
                 continue
             signed_curves |= {
