@@ -160,11 +160,30 @@ def _init_backend() -> str:
     return backend
 
 
+_PHASE_KEYS = ("probe", "prepare", "transfer", "compile", "execute",
+               "readback")
+
+
+def _ensure_phases(out: dict) -> dict:
+    """Guarantee every emitted line carries the six-key phase breakdown
+    (seconds). The child fills prepare/transfer/compile/execute/readback
+    from its own measurements; ``probe`` is parent territory — the sum of
+    all device-probe attempt times from ``_probe_log``. A line that never
+    reached a child still reports all six keys (zeros), so the driver's
+    artifact parser can rely on the shape."""
+    phases = out.setdefault("phases", {})
+    for k in _PHASE_KEYS:
+        phases.setdefault(k, 0.0)
+    phases["probe"] = round(
+        sum(float(p.get("s", 0) or 0) for p in _probe_log), 3)
+    return out
+
+
 def _emit_with_provenance(json_line: str, parent_attempts) -> None:
     """Merge the parent's probe provenance into the child's JSON line,
     fold in cached device evidence when the live run is a CPU fallback,
     and print the single final line."""
-    out = json.loads(json_line)
+    out = _ensure_phases(json.loads(json_line))
     probe = out.setdefault("probe", {})
     probe["attempts"] = len(_probe_log)
     probe["log"] = _probe_log[-6:]
@@ -338,7 +357,7 @@ def _emit_provisional() -> None:
     next-step #1a). The driver parses the LAST JSON line, so every later
     (better-informed) emission supersedes this one — but a kill at any
     point after this prints leaves `parsed` non-null."""
-    out = _provisional_out()
+    out = _ensure_phases(_provisional_out())
     out["provisional"] = True
     if not out.get("probe"):
         out["probe"] = {"attempts": 0, "log": [],
@@ -353,7 +372,7 @@ def _emit_provisional_final(attempts) -> None:
     content again, now carrying the full probe log and the parent's
     fallback history. This is the line the driver parses in the
     worst case — it must always print."""
-    out = _provisional_out()
+    out = _ensure_phases(_provisional_out())
     out["failed"] = attempts or ["no-child-result"]
     out["probe"] = {"attempts": len(_probe_log), "log": _probe_log[-6:],
                     "budget_s": PROBE_BUDGET_S}
@@ -565,11 +584,26 @@ def main():
         assert bool(jnp.all(out[0][:lanes])), "bench lanes must verify"
         assert sh.limb_sums_to_int(out[1]) == 1000 * lanes * k
 
-    # warmup / compile (shape 1)
+    # warmup / compile (shape 1), phase-separated: host prep, the single
+    # packed-plane transfer, and the first (compiling) dispatch each get
+    # their own wall-clock number so the BENCH artifact's `phases` object
+    # explains where a slow run spent its time
+    phases = {k: 0.0 for k in ("probe", "prepare", "transfer", "compile",
+                               "execute", "readback")}
     t0 = time.perf_counter()
-    out = jax.block_until_ready(step1(jnp.asarray(prep(0)), powers1))
+    host_plane = prep(0)
+    phases["prepare"] = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    dev_plane = jax.block_until_ready(jnp.asarray(host_plane))
+    phases["transfer"] = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(step1(dev_plane, powers1))
+    warm_dt = time.perf_counter() - t0
     check(out, 1)
-    print(f"bench: compile+warmup {time.perf_counter() - t0:.1f}s "
+    t0 = time.perf_counter()
+    np.asarray(out[0])
+    phases["readback"] = time.perf_counter() - t0
+    print(f"bench: compile+warmup {warm_dt:.1f}s "
           f"on {jax.devices()[0].platform}", file=sys.stderr)
 
     # tunnel RPC latency estimate (provenance: per-RPC cost varies by the
@@ -589,6 +623,11 @@ def main():
     for _ in range(n_dev):
         out = jax.block_until_ready(step1(staged, powers1))
     dev_dt = (time.perf_counter() - t0) / n_dev
+    # steady-state dispatch = execute; compile = first dispatch minus one
+    # steady execute (jit caches on shape, so the warmup run carried the
+    # whole XLA compile)
+    phases["execute"] = dev_dt
+    phases["compile"] = max(0.0, warm_dt - dev_dt)
 
     def run_sync(n_iters, k, step, powers):
         t0 = time.perf_counter()
@@ -689,6 +728,7 @@ def main():
         "pipeline": best,
         "structures": {k: round(v, 1) for k, v in structures.items()},
         "lanes": lanes,
+        "phases": {k: round(v, 4) for k, v in phases.items()},
         "probe": {"attempts": len(_probe_log), "log": _probe_log[-6:],
                   "budget_s": PROBE_BUDGET_S,
                   "rpc_rtt_ms": round(rpc_ms, 1)},

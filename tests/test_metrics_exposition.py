@@ -1,0 +1,305 @@
+"""Prometheus exposition-format tests for tmtpu/libs/metrics.py, the
+crypto metric set, and the RPC surfaces that serve them (GET /metrics,
+the ``metrics`` JSON-RPC method, and the pprof server's /debug/traces
+drain)."""
+
+import json
+import math
+import re
+import threading
+import urllib.request
+
+import pytest
+
+from tmtpu.libs import metrics, trace
+
+# --- value formatting ------------------------------------------------------
+
+
+def test_fmt_special_values():
+    assert metrics._fmt(float("inf")) == "+Inf"
+    assert metrics._fmt(float("-inf")) == "-Inf"
+    assert metrics._fmt(float("nan")) == "NaN"
+    assert metrics._fmt(3.0) == "3"
+    assert metrics._fmt(0.25) == "0.25"
+
+
+def test_gauge_renders_special_values():
+    g = metrics.Gauge("tendermint_test_special", "h", ())
+    g.set(float("inf"))
+    line = [ln for ln in g.render("gauge") if not ln.startswith("#")][0]
+    assert line == "tendermint_test_special +Inf"
+    g.set(float("nan"))
+    line = [ln for ln in g.render("gauge") if not ln.startswith("#")][0]
+    assert line == "tendermint_test_special NaN"
+
+
+def test_label_and_help_escaping():
+    c = metrics.Counter("tendermint_test_esc", 'he"lp\\line\nnext',
+                        ("who",))
+    c.inc(who='a"b\\c\nd')
+    text = "\n".join(c.render("counter"))
+    # HELP escapes backslash + newline (quotes stay literal)
+    assert '# HELP tendermint_test_esc he"lp\\\\line\\nnext' in text
+    # label values escape all three
+    assert 'who="a\\"b\\\\c\\nd"' in text
+    assert "\nnext" not in text.replace("\\n", "")
+
+
+# --- histogram semantics ---------------------------------------------------
+
+
+def _parse_exposition(text):
+    """{series_name{sorted-labels}: float value} for every sample line."""
+    out = {}
+    for ln in text.splitlines():
+        if not ln or ln.startswith("#"):
+            continue
+        m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})? (\S+)$", ln)
+        assert m, f"unparseable exposition line: {ln!r}"
+        name, lbl, val = m.group(1), m.group(2) or "", m.group(3)
+        v = float(val.replace("+Inf", "inf").replace("-Inf", "-inf")
+                  .replace("NaN", "nan"))
+        out[name + lbl] = v
+    return out
+
+
+def test_histogram_cumulative_bucket_invariants():
+    h = metrics.Histogram("tendermint_test_hist", "h", ("curve",),
+                          buckets=(0.1, 1, 10))
+    for v in (0.05, 0.5, 5, 50):
+        h.observe(v, curve="ed25519")
+    samples = _parse_exposition("\n".join(h.render("histogram")))
+    buckets = [(k, v) for k, v in samples.items() if "_bucket" in k]
+    # le-ordering == render order; counts must be monotone nondecreasing
+    counts = [v for _k, v in buckets]
+    assert counts == sorted(counts)
+    assert samples['tendermint_test_hist_bucket{curve="ed25519",le="0.1"}'] \
+        == 1
+    assert samples['tendermint_test_hist_bucket{curve="ed25519",le="+Inf"}'] \
+        == 4
+    assert samples['tendermint_test_hist_count{curve="ed25519"}'] == 4
+    assert samples['tendermint_test_hist_sum{curve="ed25519"}'] == \
+        pytest.approx(55.55)
+    assert h.totals(curve="ed25519") == (4, pytest.approx(55.55))
+
+
+def test_concurrent_observe_and_render():
+    """Render while 8 threads hammer observe(): no exceptions, and the
+    final exposition is internally consistent (count == +Inf bucket)."""
+    h = metrics.Histogram("tendermint_test_race", "h", ("t",),
+                          buckets=(0.5,))
+    errs = []
+    stop = threading.Event()
+
+    def observe(tid):
+        try:
+            for i in range(500):
+                h.observe(i % 2, t=str(tid % 2))
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    def render():
+        try:
+            while not stop.is_set():
+                _parse_exposition("\n".join(h.render("histogram")))
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    workers = [threading.Thread(target=observe, args=(t,))
+               for t in range(8)]
+    renderer = threading.Thread(target=render)
+    renderer.start()
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join()
+    stop.set()
+    renderer.join()
+    assert not errs
+    samples = _parse_exposition("\n".join(h.render("histogram")))
+    for t in ("0", "1"):
+        assert samples[f'tendermint_test_race_bucket{{t="{t}",le="+Inf"}}'] \
+            == samples[f'tendermint_test_race_count{{t="{t}"}}'] == 2000
+
+
+def test_full_registry_round_trip_parses():
+    """Every line the process-global registry emits must parse — the same
+    property a real Prometheus scraper enforces."""
+    # make sure at least one of each kind has data, incl. special floats
+    metrics.crypto_tpu_backend_up.set(0.0)
+    metrics.observe_crypto_batch("ed25519", "cpu", "serial", 3, 0, 0.001)
+    samples = _parse_exposition(metrics.render_prometheus())
+    assert any(k.startswith("tendermint_crypto_") for k in samples)
+    assert any(k.startswith("tendermint_consensus_") for k in samples)
+
+
+# --- metric registrations exercised by the seed satellites -----------------
+
+
+def test_unknown_step_id_counts_instead_of_dropping():
+    base = metrics.consensus_step_unknown._values.get((), 0.0)
+    metrics.observe_step_duration(999, 0.01)
+    assert metrics.consensus_step_unknown._values.get((), 0.0) == base + 1
+    # known steps still land in the per-step histogram
+    n0, _ = metrics.consensus_step_duration.totals(step="Propose")
+    metrics.observe_step_duration(3, 0.01)  # STEP_PROPOSE
+    n1, _ = metrics.consensus_step_duration.totals(step="Propose")
+    assert n1 == n0 + 1
+
+
+def test_block_interval_and_mempool_size_registered():
+    reg = metrics.DEFAULT._metrics
+    assert "tendermint_consensus_block_interval_seconds" in reg
+    assert reg["tendermint_consensus_block_interval_seconds"][0] \
+        == "histogram"
+    assert "tendermint_mempool_size" in reg
+    assert reg["tendermint_mempool_size"][0] == "gauge"
+
+
+def test_observe_crypto_batch_fans_out():
+    pre_n, _ = metrics.crypto_batch_size.totals(curve="sr25519",
+                                                backend="tpu")
+    pre_pad, _ = metrics.crypto_pad_ratio.totals(curve="sr25519")
+    metrics.observe_crypto_batch("sr25519", "tpu", "pallas", 100, 128,
+                                 0.5)
+    n, _ = metrics.crypto_batch_size.totals(curve="sr25519", backend="tpu")
+    assert n == pre_n + 1
+    npad, s = metrics.crypto_pad_ratio.totals(curve="sr25519")
+    assert npad == pre_pad + 1
+    nlat, _ = metrics.crypto_verify_latency.totals(
+        curve="sr25519", backend="tpu", impl="pallas")
+    assert nlat >= 1
+    # same (curve, impl, padded) shape again = compile-cache hit
+    hits0 = metrics.crypto_compile_cache_hits._values.get(("sr25519",), 0)
+    metrics.observe_crypto_batch("sr25519", "tpu", "pallas", 90, 128, 0.1)
+    hits1 = metrics.crypto_compile_cache_hits._values.get(("sr25519",), 0)
+    assert hits1 == hits0 + 1
+
+
+# --- mixed-curve verify -> /metrics scrape (ISSUE acceptance) --------------
+
+
+def _mixed_cpu_verify():
+    """Run a mixed-curve batch through the CPU batch verifier (ed25519 via
+    the pure-python ref fallback + sr25519; secp256k1 joins when
+    libcrypto is importable)."""
+    import numpy as np
+
+    from tmtpu.crypto import ed25519_ref as ref
+    from tmtpu.crypto.batch import CPUBatchVerifier
+    from tmtpu.crypto.ed25519 import PubKeyEd25519
+    from tmtpu.crypto import sr25519 as sr
+
+    rng = np.random.default_rng(5)
+    bv = CPUBatchVerifier()
+    for i in range(3):
+        seed = bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+        msg = b"scrape-ed-%d" % i
+        bv.add(PubKeyEd25519(ref.public_key(seed)), msg,
+               ref.sign(seed, msg))
+    for i in range(2):
+        priv = sr.gen_priv_key_from_secret(b"scrape-sr-%d" % i)
+        msg = b"scrape-sr-%d" % i
+        bv.add(priv.pub_key(), msg, priv.sign(msg))
+    try:
+        import hashlib
+
+        from tmtpu.crypto import secp256k1 as k1
+
+        v = int.from_bytes(hashlib.sha256(b"scrape-k1").digest(), "big")
+        priv = k1.PrivKeySecp256k1((v % (k1.N - 1) + 1).to_bytes(32, "big"))
+        msg = b"scrape-k1"
+        bv.add(priv.pub_key(), msg, priv.sign(msg))
+    except ImportError:
+        pass
+    all_ok, mask = bv.verify()
+    assert all_ok, mask
+
+
+def test_metrics_scrape_has_crypto_series_with_labels():
+    """ISSUE acceptance: after a mixed-curve verify, GET /metrics on the
+    RPC server exposes tendermint_crypto_* series carrying curve and
+    backend labels, with the exposition content type."""
+    from tmtpu.rpc.server import RPCServer
+
+    _mixed_cpu_verify()
+    srv = RPCServer("tcp://127.0.0.1:0", routes={"ping": lambda: {}})
+    srv.start()
+    try:
+        r = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=10)
+        assert r.status == 200
+        assert r.headers["Content-Type"] == "text/plain; version=0.0.4"
+        text = r.read().decode()
+    finally:
+        srv.stop()
+    samples = _parse_exposition(text)
+    for curve in ("ed25519", "sr25519"):
+        key = (f'tendermint_crypto_batch_size_count'
+               f'{{curve="{curve}",backend="cpu"}}')
+        assert key in samples and samples[key] >= 1, sorted(
+            k for k in samples if k.startswith("tendermint_crypto"))[:20]
+        assert any(f'curve="{curve}"' in k and 'impl=' in k
+                   for k in samples
+                   if k.startswith("tendermint_crypto_verify_latency"))
+
+
+def test_metrics_jsonrpc_method():
+    """The ``metrics`` JSON-RPC method returns the registry + span-ring
+    summaries (the JSON twin of the text exposition)."""
+    from tmtpu.rpc.core import Environment, build_routes
+
+    routes = build_routes(Environment(node=None))
+    assert "metrics" in routes
+    with trace.span("jsonrpc.test"):
+        pass
+    out = routes["metrics"]()
+    assert "tendermint_crypto_batch_size" in out["metrics"]
+    assert out["metrics"]["tendermint_crypto_batch_size"]["kind"] \
+        == "histogram"
+    assert out["traces"]["spans"]["jsonrpc.test"]["count"] >= 1
+    json.dumps(out)  # JSON-RPC payload must serialize
+
+
+def test_pprof_debug_traces_drains():
+    """/debug/traces serves the span ring as Chrome trace JSON and drains
+    it; ?format=jsonl and ?keep=1 variants behave as documented."""
+    from tmtpu.rpc.pprof import PprofServer
+
+    srv = PprofServer("tcp://127.0.0.1:0")
+    srv.start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        trace.drain()
+        with trace.span("pprof.roundtrip", lanes=4):
+            pass
+        # keep=1 snapshots without draining
+        r = urllib.request.urlopen(f"{base}/debug/traces?keep=1",
+                                   timeout=10)
+        assert r.headers["Content-Type"] == "application/json"
+        doc = json.loads(r.read())
+        names = [e["name"] for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert "pprof.roundtrip" in names
+        # jsonl drain returns the span and clears the ring
+        r = urllib.request.urlopen(
+            f"{base}/debug/traces?format=jsonl", timeout=10)
+        assert r.headers["Content-Type"] == "application/x-ndjson"
+        rows = [json.loads(ln) for ln in r.read().decode().splitlines()]
+        assert any(row["name"] == "pprof.roundtrip" for row in rows)
+        # drained: next chrome-format read is empty of X events
+        r = urllib.request.urlopen(f"{base}/debug/traces", timeout=10)
+        doc = json.loads(r.read())
+        assert not [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        # the index mentions the endpoint
+        r = urllib.request.urlopen(f"{base}/debug/pprof/", timeout=10)
+        assert b"/debug/traces" in r.read()
+    finally:
+        srv.stop()
+
+
+def test_tracer_summary_survives_nan_free():
+    """summary() math stays finite even with zero-duration spans."""
+    s = trace.Tracer().summary()
+    assert s["spans"] == {} and s["buffered"] == 0
+    assert not math.isnan(s["dropped"])

@@ -72,6 +72,7 @@ def test_cors_disabled_by_default():
 
 
 def _self_signed(tmp_path):
+    pytest.importorskip("cryptography")
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import rsa
@@ -237,6 +238,7 @@ def test_ws_subscription_limits_live(tmp_path):
     REAL node."""
     import time
 
+    pytest.importorskip("cryptography")
     from tests.test_rpc_ws import WSClient
     from tmtpu.config.config import Config
     from tmtpu.node.node import Node

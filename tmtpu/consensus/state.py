@@ -31,6 +31,7 @@ from tmtpu.consensus.types import (
 from tmtpu.consensus.wal import (
     EndHeightPB, EventRoundStatePB, MsgInfoPB, TimeoutInfoPB, WAL,
 )
+from tmtpu.libs import trace
 from tmtpu.libs.service import BaseService
 from tmtpu.types import pb
 from tmtpu.types.block import BlockID, Commit
@@ -504,6 +505,7 @@ class ConsensusState(BaseService):
 
     # ------------------------------------------------------ step functions
 
+    @trace.traced("consensus.enter_new_round")
     def _enter_new_round(self, height: int, round: int) -> None:
         """state.go:976."""
         rs = self.rs
@@ -540,6 +542,7 @@ class ConsensusState(BaseService):
         else:
             self._enter_propose(height, round)
 
+    @trace.traced("consensus.enter_propose")
     def _enter_propose(self, height: int, round: int) -> None:
         """state.go:1060."""
         rs = self.rs
@@ -617,6 +620,7 @@ class ConsensusState(BaseService):
         prevotes = rs.votes.prevotes(rs.proposal.pol_round)
         return prevotes is not None and prevotes.has_two_thirds_majority()
 
+    @trace.traced("consensus.enter_prevote")
     def _enter_prevote(self, height: int, round: int) -> None:
         """state.go:1226."""
         rs = self.rs
@@ -655,6 +659,7 @@ class ConsensusState(BaseService):
             self.config.prevote_timeout(round), height, round,
             STEP_PREVOTE_WAIT))
 
+    @trace.traced("consensus.enter_precommit")
     def _enter_precommit(self, height: int, round: int) -> None:
         """state.go:1322."""
         rs = self.rs
@@ -735,6 +740,7 @@ class ConsensusState(BaseService):
             self.config.precommit_timeout(round), height, round,
             STEP_PRECOMMIT_WAIT))
 
+    @trace.traced("consensus.enter_commit")
     def _enter_commit(self, height: int, commit_round: int) -> None:
         """state.go:1476."""
         rs = self.rs
@@ -784,6 +790,7 @@ class ConsensusState(BaseService):
             return
         self._finalize_commit(height)
 
+    @trace.traced("consensus.finalize_commit")
     def _finalize_commit(self, height: int) -> None:
         """state.go:1567 — fail points mirror the reference's crash
         injection sites around commit (state.go:1605-1685)."""

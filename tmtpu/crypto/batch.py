@@ -61,11 +61,16 @@ _PROBE_TIMEOUT_S = float(os.environ.get("TMTPU_TPU_PROBE_TIMEOUT", "10"))
 def _tpu_available() -> bool:
     """Probe for a usable jax device ONCE, with a hard timeout: a wedged
     PJRT plugin/tunnel can hang backend init indefinitely, and consensus
-    must degrade to the CPU path rather than stall."""
+    must degrade to the CPU path rather than stall. Each probe attempt,
+    timeout, and the resulting up/down verdict land in the crypto metric
+    set (docs/OBSERVABILITY.md) — a node silently degraded to CPU shows
+    as tendermint_crypto_tpu_backend_up 0."""
     global _tpu_usable
     if _tpu_usable is None:
         with _probe_lock:
             if _tpu_usable is None:
+                from tmtpu.libs import metrics as _m
+
                 result = {}
 
                 def probe():
@@ -76,10 +81,17 @@ def _tpu_available() -> bool:
                     except Exception:
                         result["ok"] = False
 
+                _m.crypto_device_probe_attempts.inc()
                 t = threading.Thread(target=probe, daemon=True)
                 t.start()
                 t.join(_PROBE_TIMEOUT_S)
+                if "ok" not in result:
+                    _m.crypto_device_probe_timeouts.inc()
                 _tpu_usable = result.get("ok", False)
+                _m.crypto_tpu_backend_up.set(1.0 if _tpu_usable else 0.0)
+                if not _tpu_usable:
+                    _m.crypto_cpu_fallback.inc(curve="any",
+                                               reason="probe-failed")
     return _tpu_usable
 
 
@@ -117,27 +129,45 @@ class CPUBatchVerifier(BatchVerifier):
         per-call overhead roughly halves the serial rate); everything
         else, and any lane when the native library is unavailable,
         verifies per item in Python."""
+        import time
+
+        from tmtpu.libs import metrics as _m
+        from tmtpu.libs import trace
+
+        t0 = time.perf_counter()
         mask = [False] * len(self._items)
         ed_idx = [i for i, (pk, _, sig, _) in enumerate(self._items)
                   if pk.type_value() == ED25519 and len(sig) == 64]
         done = set()
-        if len(ed_idx) >= 2:
-            try:
-                from tmtpu import native
+        impl = "serial"
+        with trace.span("crypto.cpu_batch_verify", lanes=len(self._items)):
+            if len(ed_idx) >= 2:
+                try:
+                    from tmtpu import native
 
-                ok = native.ed25519_verify_batch(
-                    [self._items[i][0].bytes() for i in ed_idx],
-                    [self._items[i][1] for i in ed_idx],
-                    [self._items[i][2] for i in ed_idx])
-            except Exception:  # noqa: BLE001 — never break verification
-                ok = None
-            if ok is not None:
-                for i, v in zip(ed_idx, ok):
-                    mask[i] = v
-                done = set(ed_idx)
-        for i, (pk, msg, sig, _) in enumerate(self._items):
-            if i not in done:
-                mask[i] = pk.verify_signature(msg, sig)
+                    ok = native.ed25519_verify_batch(
+                        [self._items[i][0].bytes() for i in ed_idx],
+                        [self._items[i][1] for i in ed_idx],
+                        [self._items[i][2] for i in ed_idx])
+                except Exception:  # noqa: BLE001 — never break verification
+                    ok = None
+                if ok is not None:
+                    impl = "native"
+                    for i, v in zip(ed_idx, ok):
+                        mask[i] = v
+                    done = set(ed_idx)
+            for i, (pk, msg, sig, _) in enumerate(self._items):
+                if i not in done:
+                    mask[i] = pk.verify_signature(msg, sig)
+        dt = time.perf_counter() - t0
+        by_curve: dict = {}
+        for pk, _msg, _sig, _p in self._items:
+            c = pk.type_value()
+            by_curve[c] = by_curve.get(c, 0) + 1
+        for c, n in by_curve.items():
+            _m.observe_crypto_batch(c, "cpu",
+                                    impl if c == ED25519 else "serial",
+                                    n, 0, dt)
         return all(mask), mask
 
 
@@ -176,13 +206,22 @@ class TPUBatchVerifier(BatchVerifier):
         return self._run(tally=True)
 
     def _run(self, tally: bool) -> Tuple[bool, List[bool], int]:
+        from tmtpu.libs import metrics as _m
+
         (ed_idx, ed_pks, ed_msgs, ed_sigs, ed_powers,
          sr_idx, k1_idx, cpu_idx) = self._split()
+        if cpu_idx:
+            _m.crypto_cpu_fallback.inc(len(cpu_idx), curve="other",
+                                       reason="unsupported")
         if sr_idx and len(sr_idx) < _TPU_MIN_BATCH:
             cpu_idx += sr_idx  # below dispatch threshold: serial path
+            _m.crypto_cpu_fallback.inc(len(sr_idx), curve=SR25519,
+                                       reason="small-batch")
             sr_idx = []
         if k1_idx and len(k1_idx) < _TPU_MIN_BATCH:
             cpu_idx += k1_idx
+            _m.crypto_cpu_fallback.inc(len(k1_idx), curve=SECP256K1,
+                                       reason="small-batch")
             k1_idx = []
         mask: List[bool] = [False] * len(self._items)
         tallied = 0
@@ -212,6 +251,8 @@ class TPUBatchVerifier(BatchVerifier):
                     tallied += self._items[i][3]
         if ed_idx:
             if len(ed_idx) < _TPU_MIN_BATCH:
+                _m.crypto_cpu_fallback.inc(len(ed_idx), curve=ED25519,
+                                           reason="small-batch")
                 for j, i in enumerate(ed_idx):
                     mask[i] = self._items[i][0].verify_signature(
                         ed_msgs[j], ed_sigs[j]

@@ -9,6 +9,7 @@ per-metric locks.
 
 from __future__ import annotations
 
+import math
 import threading
 from typing import Dict, List, Optional, Tuple
 
@@ -27,7 +28,7 @@ class _Metric:
         return tuple(str(labels.get(k, "")) for k in self.label_names)
 
     def render(self, kind: str) -> List[str]:
-        out = [f"# HELP {self.name} {self.help}",
+        out = [f"# HELP {self.name} {_esc_help(self.help)}",
                f"# TYPE {self.name} {kind}"]
         with self._lock:
             items = sorted(self._values.items())
@@ -35,16 +36,47 @@ class _Metric:
             out.append(f"{self.name} 0")
         for key, v in items:
             if self.label_names:
-                lbl = ",".join(f'{k}="{val}"' for k, val in
+                lbl = ",".join(f'{k}="{_esc_label(val)}"' for k, val in
                                zip(self.label_names, key))
                 out.append(f"{self.name}{{{lbl}}} {_fmt(v)}")
             else:
                 out.append(f"{self.name} {_fmt(v)}")
         return out
 
+    def summary_series(self) -> Dict[str, float]:
+        """{"k=v,k=v" (or "" unlabeled): value} — the JSON form served by
+        the ``metrics`` JSON-RPC method."""
+        with self._lock:
+            items = sorted(self._values.items())
+        return {_series_key(self.label_names, k): v for k, v in items}
+
+
+def _series_key(names: Tuple[str, ...], key: Tuple[str, ...]) -> str:
+    return ",".join(f"{n}={v}" for n, v in zip(names, key))
+
 
 def _fmt(v: float) -> str:
-    return str(int(v)) if float(v).is_integer() else repr(v)
+    """Prometheus text-format value rendering, including the special
+    values the exposition format spells exactly +Inf/-Inf/NaN (repr()
+    would emit Python's 'inf'/'nan', which scrapers reject)."""
+    v = float(v)
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if math.isnan(v):
+        return "NaN"
+    return str(int(v)) if v.is_integer() else repr(v)
+
+
+def _esc_label(v: str) -> str:
+    """Label-value escaping per the text format: backslash, double quote,
+    and newline must be escaped inside the quoted value."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _esc_help(v: str) -> str:
+    """HELP-line escaping: backslash and newline only (quotes are legal)."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
 
 
 class Counter(_Metric):
@@ -95,11 +127,12 @@ class Histogram(_Metric):
                     self._sums.get(k, 0.0))
 
     def render(self, kind: str) -> List[str]:
-        out = [f"# HELP {self.name} {self.help}",
+        out = [f"# HELP {self.name} {_esc_help(self.help)}",
                f"# TYPE {self.name} histogram"]
         with self._lock:
             for k, counts in sorted(self._counts.items()):
-                lbl_base = list(zip(self.label_names, k))
+                lbl_base = [(a, _esc_label(v))
+                            for a, v in zip(self.label_names, k)]
                 for i, b in enumerate(self.buckets):
                     labels = lbl_base + [("le", _fmt(b))]
                     ls = ",".join(f'{a}="{v}"' for a, v in labels)
@@ -113,6 +146,15 @@ class Histogram(_Metric):
                            f"{_fmt(self._sums.get(k, 0.0))}")
                 out.append(f"{self.name}_count{suffix} {counts[-1]}")
         return out
+
+    def summary_series(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {
+                _series_key(self.label_names, k):
+                    {"count": counts[-1],
+                     "sum": round(self._sums.get(k, 0.0), 6)}
+                for k, counts in sorted(self._counts.items())
+            }
 
 
 class Registry:
@@ -152,12 +194,24 @@ class Registry:
             lines.extend(m.render(kind))
         return "\n".join(lines) + "\n"
 
+    def summary(self) -> Dict[str, Dict]:
+        """JSON form of every registered metric (the ``metrics`` JSON-RPC
+        method's payload; the text exposition stays on GET /metrics)."""
+        with self._lock:
+            items = sorted(self._metrics.items())
+        return {name: {"kind": kind, "series": m.summary_series()}
+                for name, (kind, m) in items}
+
 
 DEFAULT = Registry()
 
 
 def render_prometheus() -> str:
     return DEFAULT.render()
+
+
+def summary() -> Dict[str, Dict]:
+    return DEFAULT.summary()
 
 
 # --- the consensus/p2p/mempool metric set (consensus/metrics.go:18) ---------
@@ -192,14 +246,112 @@ consensus_step_duration = DEFAULT.histogram(
              0.5, 1.0, 2.5))
 
 
+# Unknown step ids were silently dropped before; count them so a new
+# step constant added without a STEP_NAMES entry is visible in /metrics
+# instead of producing a hole in the per-step breakdown.
+consensus_step_unknown = DEFAULT.counter(
+    "consensus", "step_unknown_total",
+    "Step transitions with an unrecognized step id")
+
+
+# mirror of consensus/types.py STEP_NAMES, used only when that module's
+# import chain is unavailable (it pulls the full key-type registry, which
+# needs libcrypto) — metric emission must never depend on optional deps
+_STEP_NAMES_FALLBACK = {
+    1: "NewHeight", 2: "NewRound", 3: "Propose", 4: "Prevote",
+    5: "PrevoteWait", 6: "Precommit", 7: "PrecommitWait", 8: "Commit",
+}
+
+
 def observe_step_duration(step: int, seconds: float) -> None:
-    from tmtpu.consensus.types import STEP_NAMES
+    try:
+        from tmtpu.consensus.types import STEP_NAMES
+    except ImportError:
+        STEP_NAMES = _STEP_NAMES_FALLBACK
 
     name = STEP_NAMES.get(step)
-    if name is not None:
-        consensus_step_duration.observe(seconds, step=name)
+    if name is None:
+        consensus_step_unknown.inc()
+        return
+    consensus_step_duration.observe(seconds, step=name)
 
 
 p2p_peers = DEFAULT.gauge("p2p", "peers", "Number of connected peers")
 mempool_size = DEFAULT.gauge("mempool", "size",
                              "Number of uncommitted txs")
+
+
+# --- the crypto batch-verify pipeline metric set ----------------------------
+#
+# Observed at every batch call site: the per-curve device paths
+# (tmtpu/tpu/verify.py, sr_verify.py, k1_verify.py) and the CPU batch
+# verifier (tmtpu/crypto/batch.py). Labels: curve = ed25519 | sr25519 |
+# secp256k1; backend = the jax device platform ("cpu", "tpu", ...) or
+# "cpu" for the serial path; impl = pallas | xla | serial | native.
+
+_LANE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048,
+                 4096, 8192, 16384, 40960)
+
+crypto_batch_size = DEFAULT.histogram(
+    "crypto", "batch_size",
+    "Signatures per batch-verify dispatch",
+    labels=("curve", "backend"), buckets=_LANE_BUCKETS)
+crypto_pad_ratio = DEFAULT.histogram(
+    "crypto", "pad_ratio",
+    "Padded-over-actual lane ratio per device dispatch "
+    "(bucket rounding waste)",
+    labels=("curve",),
+    buckets=(1.0, 1.05, 1.1, 1.25, 1.5, 2.0, 4.0, 8.0))
+crypto_verify_latency = DEFAULT.histogram(
+    "crypto", "verify_latency_seconds",
+    "End-to-end batch-verify latency (prep through readback)",
+    labels=("curve", "backend", "impl"),
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 0.5, 1, 2.5, 5, 10, 30, 60))
+crypto_compile_cache_hits = DEFAULT.counter(
+    "crypto", "compile_cache_hits_total",
+    "Device dispatches that reused a warm jit cache entry",
+    labels=("curve",))
+crypto_compile_cache_misses = DEFAULT.counter(
+    "crypto", "compile_cache_misses_total",
+    "Device dispatches whose padded shape forced a fresh XLA compile",
+    labels=("curve",))
+crypto_cpu_fallback = DEFAULT.counter(
+    "crypto", "cpu_fallback_total",
+    "Signatures verified on the serial CPU path instead of the device",
+    labels=("curve", "reason"))
+crypto_device_probe_attempts = DEFAULT.counter(
+    "crypto", "device_probe_attempts_total",
+    "jax device-backend probe attempts")
+crypto_device_probe_timeouts = DEFAULT.counter(
+    "crypto", "device_probe_timeouts_total",
+    "jax device-backend probes that hit the hard timeout")
+crypto_tpu_backend_up = DEFAULT.gauge(
+    "crypto", "tpu_backend_up",
+    "1 when a usable jax device backend answered the probe, else 0")
+
+# (curve, impl, padded-lanes) shapes already dispatched in this process:
+# jax.jit keys its cache on input shapes, so a new padded bucket size is
+# exactly one fresh XLA compile — tracked here rather than by poking jax
+# internals.
+_seen_jit_shapes: set = set()
+_seen_jit_lock = threading.Lock()
+
+
+def observe_crypto_batch(curve: str, backend: str, impl: str, lanes: int,
+                         padded: int, seconds: float) -> None:
+    """One call per batch-verify dispatch; fans out to the whole crypto
+    metric set. ``padded`` of 0 means no device padding (serial path)."""
+    crypto_batch_size.observe(lanes, curve=curve, backend=backend)
+    crypto_verify_latency.observe(seconds, curve=curve, backend=backend,
+                                  impl=impl)
+    if padded and lanes:
+        crypto_pad_ratio.observe(padded / lanes, curve=curve)
+        key = (curve, impl, padded)
+        with _seen_jit_lock:
+            hit = key in _seen_jit_shapes
+            _seen_jit_shapes.add(key)
+        if hit:
+            crypto_compile_cache_hits.inc(curve=curve)
+        else:
+            crypto_compile_cache_misses.inc(curve=curve)

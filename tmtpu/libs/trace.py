@@ -1,0 +1,284 @@
+"""Span-based tracing for the crypto/consensus hot path.
+
+The batch-verify pipeline spends its time in phases that wall-clock
+numbers cannot separate (host prep vs device_put vs compile vs execute vs
+readback — BENCH_r05's 35.6 s "compile+warmup" is one opaque number), so
+every hot-path stage records a Span into a process-global, thread-safe
+ring buffer:
+
+    from tmtpu.libs import trace
+
+    with trace.span("ed25519.prepare", lanes=B):
+        ...                      # nested spans record their parent
+
+    @trace.traced("consensus.enter_propose")
+    def _enter_propose(self, ...): ...
+
+Spans nest per thread (a thread-local stack carries the current parent),
+carry arbitrary JSON-able attrs, and cost ~1 µs each — cheap enough to
+leave on permanently. The ring holds the most recent ``capacity`` spans
+(default 8192, env ``TMTPU_TRACE_CAPACITY``); older spans are evicted and
+counted, never blocking the hot path.
+
+Export formats:
+- ``to_chrome_trace(spans)``: the Chrome trace-event JSON (load in
+  chrome://tracing or Perfetto) — complete "X" events, microsecond
+  timestamps on the perf_counter clock;
+- ``to_jsonl(spans)``: one JSON object per line (grep/jq-friendly).
+
+Drained over RPC at ``/debug/traces`` on the pprof server
+(tmtpu.rpc.pprof) and summarized in the ``metrics`` JSON-RPC method
+(tmtpu.rpc.core); see docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+_DEFAULT_CAPACITY = int(os.environ.get("TMTPU_TRACE_CAPACITY", "8192"))
+
+
+class Span:
+    """One completed (or in-flight) timed region. Times are
+    ``time.perf_counter()`` seconds — monotonic, comparable across spans
+    in-process; ``wall_time`` anchors the trace to the epoch clock."""
+
+    __slots__ = ("name", "span_id", "parent_id", "thread_id", "thread_name",
+                 "start_s", "end_s", "attrs")
+
+    def __init__(self, name: str, span_id: int, parent_id: Optional[int],
+                 thread_id: int, thread_name: str, start_s: float,
+                 attrs: Dict):
+        self.name = name
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.thread_id = thread_id
+        self.thread_name = thread_name
+        self.start_s = start_s
+        self.end_s: Optional[float] = None
+        self.attrs = attrs
+
+    @property
+    def duration_s(self) -> float:
+        if self.end_s is None:
+            return 0.0
+        return max(0.0, self.end_s - self.start_s)
+
+    def set(self, **attrs) -> None:
+        """Attach attrs mid-span (e.g. a batch size known only later)."""
+        self.attrs.update(attrs)
+
+    def to_dict(self) -> Dict:
+        return {
+            "name": self.name, "id": self.span_id,
+            "parent": self.parent_id, "tid": self.thread_id,
+            "thread": self.thread_name,
+            "start_s": round(self.start_s, 9),
+            "dur_s": round(self.duration_s, 9),
+            "attrs": self.attrs,
+        }
+
+    def __repr__(self):
+        return (f"Span({self.name!r}, {self.duration_s * 1e3:.3f}ms, "
+                f"attrs={self.attrs})")
+
+
+class Tracer:
+    """Thread-safe ring buffer of completed spans with per-thread parent
+    nesting. One process-global instance (``DEFAULT``) backs the module-
+    level API; tests construct their own."""
+
+    def __init__(self, capacity: int = _DEFAULT_CAPACITY):
+        self._buf: deque = deque(maxlen=max(1, capacity))
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._tls = threading.local()
+        self._enabled = True
+        self._dropped = 0
+
+    # -- control ------------------------------------------------------------
+
+    def set_enabled(self, flag: bool) -> None:
+        self._enabled = bool(flag)
+
+    def enabled(self) -> bool:
+        return self._enabled
+
+    @property
+    def dropped(self) -> int:
+        """Spans evicted by the ring since the last drain()."""
+        return self._dropped
+
+    # -- recording ----------------------------------------------------------
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    @contextmanager
+    def span(self, name: str, **attrs):
+        """Record a timed region; yields the Span so callers can ``.set``
+        attrs discovered mid-region. Exceptions propagate (the span still
+        records, flagged ``error=True``)."""
+        if not self._enabled:
+            yield _NULL_SPAN
+            return
+        t = threading.current_thread()
+        stack = self._stack()
+        sp = Span(name, next(self._ids),
+                  stack[-1].span_id if stack else None,
+                  t.ident or 0, t.name, time.perf_counter(), dict(attrs))
+        stack.append(sp)
+        try:
+            yield sp
+        except BaseException:
+            sp.attrs["error"] = True
+            raise
+        finally:
+            sp.end_s = time.perf_counter()
+            stack.pop()
+            with self._lock:
+                if len(self._buf) == self._buf.maxlen:
+                    self._dropped += 1
+                self._buf.append(sp)
+
+    def traced(self, name: Optional[str] = None):
+        """Decorator form: the whole call body becomes one span."""
+
+        def deco(fn):
+            import functools
+
+            span_name = name or fn.__qualname__
+
+            @functools.wraps(fn)
+            def wrapper(*a, **kw):
+                with self.span(span_name):
+                    return fn(*a, **kw)
+
+            return wrapper
+
+        return deco
+
+    # -- reading ------------------------------------------------------------
+
+    def snapshot(self) -> List[Span]:
+        """Current ring contents, oldest first, without clearing."""
+        with self._lock:
+            return list(self._buf)
+
+    def drain(self) -> List[Span]:
+        """Return and clear the ring (also resets the dropped counter)."""
+        with self._lock:
+            out = list(self._buf)
+            self._buf.clear()
+            self._dropped = 0
+            return out
+
+    def summary(self) -> Dict:
+        """Aggregate per span name: {name: {count, total_s, max_s}} plus
+        ring bookkeeping — the cheap form served by the ``metrics``
+        JSON-RPC method."""
+        spans = self.snapshot()
+        agg: Dict[str, Dict] = {}
+        for sp in spans:
+            a = agg.setdefault(sp.name,
+                               {"count": 0, "total_s": 0.0, "max_s": 0.0})
+            a["count"] += 1
+            d = sp.duration_s
+            a["total_s"] += d
+            if d > a["max_s"]:
+                a["max_s"] = d
+        for a in agg.values():
+            a["total_s"] = round(a["total_s"], 6)
+            a["max_s"] = round(a["max_s"], 6)
+        return {"spans": agg, "buffered": len(spans),
+                "dropped": self._dropped,
+                "capacity": self._buf.maxlen, "enabled": self._enabled}
+
+
+class _NullSpan:
+    """Yielded while tracing is disabled: absorbs .set() calls."""
+
+    __slots__ = ()
+
+    def set(self, **attrs) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+# -- export formats ---------------------------------------------------------
+
+
+def to_chrome_trace(spans: List[Span]) -> Dict:
+    """Chrome trace-event format (chrome://tracing / Perfetto): complete
+    "X" events, µs timestamps on the shared perf_counter clock, one row
+    per thread. Span ids/parents ride in args for tooling."""
+    events = []
+    for sp in spans:
+        events.append({
+            "name": sp.name, "ph": "X", "pid": os.getpid(),
+            "tid": sp.thread_id, "ts": sp.start_s * 1e6,
+            "dur": sp.duration_s * 1e6,
+            "args": dict(sp.attrs, span_id=sp.span_id,
+                         parent_id=sp.parent_id),
+        })
+        # thread name metadata rows render once per tid in the viewer;
+        # duplicates are harmless
+    seen = set()
+    for sp in spans:
+        if sp.thread_id not in seen:
+            seen.add(sp.thread_id)
+            events.append({
+                "name": "thread_name", "ph": "M", "pid": os.getpid(),
+                "tid": sp.thread_id,
+                "args": {"name": sp.thread_name},
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def to_jsonl(spans: List[Span]) -> str:
+    """One JSON object per line (jq/grep-friendly); trailing newline when
+    non-empty so concatenated drains stay line-delimited."""
+    if not spans:
+        return ""
+    return "\n".join(json.dumps(sp.to_dict()) for sp in spans) + "\n"
+
+
+# -- process-global tracer + module-level API -------------------------------
+
+DEFAULT = Tracer()
+
+
+def span(name: str, **attrs):
+    return DEFAULT.span(name, **attrs)
+
+
+def traced(name: Optional[str] = None):
+    return DEFAULT.traced(name)
+
+
+def snapshot() -> List[Span]:
+    return DEFAULT.snapshot()
+
+
+def drain() -> List[Span]:
+    return DEFAULT.drain()
+
+
+def summary() -> Dict:
+    return DEFAULT.summary()
+
+
+def set_enabled(flag: bool) -> None:
+    DEFAULT.set_enabled(flag)

@@ -8,7 +8,6 @@ import time
 from typing import Optional
 
 from tmtpu.abci import types as abci
-from tmtpu.crypto import encoding as crypto_encoding
 from tmtpu.libs import amino_json
 from tmtpu.types.event_bus import EVENT_TX
 from tmtpu.version import TMCoreSemVer
@@ -295,6 +294,10 @@ def build_routes(env: Environment) -> dict:
         return block(height=str(b.header.height))
 
     def block_results(height=None):
+        # deferred: the key-type registry behind crypto.encoding needs
+        # libcrypto, which route construction must not require
+        from tmtpu.crypto import encoding as crypto_encoding
+
         h = int(height) if height is not None else env.block_store.height()
         res = env.state_store.load_abci_responses(h)
         if res is None:
@@ -637,8 +640,20 @@ def build_routes(env: Environment) -> dict:
             "total_count": str(len(results)),
         }
 
+    def metrics():
+        """Structured observability snapshot: every registered metric
+        series (libs/metrics.summary) plus the span-ring aggregate
+        (libs/trace.summary). The Prometheus text form stays on GET
+        /metrics; this is the JSON-RPC twin for tooling that already
+        speaks the RPC protocol."""
+        from tmtpu.libs import metrics as _m
+        from tmtpu.libs import trace as _t
+
+        return {"metrics": _m.summary(), "traces": _t.summary()}
+
     return {
         "health": health, "status": status, "genesis": genesis,
+        "metrics": metrics,
         "genesis_chunked": genesis_chunked, "check_tx": check_tx,
         "net_info": net_info, "blockchain": blockchain, "block": block,
         "block_by_hash": block_by_hash, "block_results": block_results,

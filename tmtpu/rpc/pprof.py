@@ -11,6 +11,10 @@ Python-native equivalents of the Go pprof profiles:
                              stacks at ~100 Hz for N seconds, returns
                              collapsed stacks (flamegraph.pl format)
     /debug/pprof/cmdline     process argv
+    /debug/traces            drain the span ring (libs/trace) as Chrome
+                             trace-event JSON; ?format=jsonl for line-
+                             delimited spans, ?keep=1 to snapshot without
+                             draining
 
 Started by the node when ``rpc.pprof_laddr`` is set; also used by
 `tmtpu debug dump`.
@@ -19,12 +23,25 @@ Started by the node when ``rpc.pprof_laddr`` is set; also used by
 from __future__ import annotations
 
 import collections
+import json
 import sys
 import threading
 import time
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
+
+from tmtpu.libs import trace
+
+
+def render_traces(fmt: str = "chrome", keep: bool = False):
+    """Body + content-type for /debug/traces: drains the global span ring
+    (or snapshots it with ``keep``) in the requested export format."""
+    spans = trace.snapshot() if keep else trace.drain()
+    if fmt == "jsonl":
+        return trace.to_jsonl(spans), "application/x-ndjson"
+    return (json.dumps(trace.to_chrome_trace(spans)),
+            "application/json")
 
 
 def thread_stacks() -> str:
@@ -86,10 +103,17 @@ class _Handler(BaseHTTPRequestHandler):
         url = urlparse(self.path)
         q = parse_qs(url.query)
         path = url.path.rstrip("/")
+        ctype = "text/plain; charset=utf-8"
         try:
             if path in ("", "/debug/pprof"):
                 body = ("pprof endpoints: goroutine, heap, "
-                        "profile?seconds=N, cmdline\n")
+                        "profile?seconds=N, cmdline; trace drain at "
+                        "/debug/traces[?format=jsonl][&keep=1]\n")
+            elif path == "/debug/traces":
+                body, ctype = render_traces(
+                    fmt=q.get("format", ["chrome"])[0],
+                    keep=q.get("keep", ["0"])[0] not in ("0", "", "false"),
+                )
             elif path.endswith("/goroutine"):
                 body = thread_stacks()
             elif path.endswith("/heap"):
@@ -107,7 +131,7 @@ class _Handler(BaseHTTPRequestHandler):
             return
         data = body.encode()
         self.send_response(200)
-        self.send_header("Content-Type", "text/plain; charset=utf-8")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)

@@ -29,12 +29,14 @@ Split of labor:
 from __future__ import annotations
 
 import hashlib
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from tmtpu.crypto.secp256k1 import N
+from tmtpu.libs import trace
 from tmtpu.tpu import fe_k1 as fe
 from tmtpu.tpu.verify import lt_le
 
@@ -441,16 +443,25 @@ def batch_verify_k1(pks, msgs, sigs) -> np.ndarray:
     B = len(sigs)
     if B == 0:
         return np.zeros(0, dtype=bool)
-    packed, host_ok = prepare_k1_batch_packed(pks, msgs, sigs)
+    from tmtpu.libs import metrics as _m
+
+    t0 = time.perf_counter()
+    with trace.span("secp256k1.prepare", lanes=B):
+        packed, host_ok = prepare_k1_batch_packed(pks, msgs, sigs)
     global _kernel_broken, _kernel_failures
     if not _kernel_broken and tv.use_pallas_kernel():
         from tmtpu.tpu import k1_kernel as kk
 
         padded = max(kk.DEFAULT_TILE, tv._pad_to_bucket(B))
         try:
-            mask = np.asarray(_k1_kernel_packed_jit(
-                jnp.asarray(pad_packed(packed, padded))))[:B]
+            with trace.span("secp256k1.execute", impl="pallas",
+                            lanes=B, padded=padded):
+                mask = np.asarray(_k1_kernel_packed_jit(
+                    jnp.asarray(pad_packed(packed, padded))))[:B]
             _kernel_failures = 0
+            _m.observe_crypto_batch("secp256k1", tv.backend_label(),
+                                    "pallas", B, padded,
+                                    time.perf_counter() - t0)
             return mask & host_ok
         except Exception as e:  # noqa: BLE001
             _kernel_failures += 1
@@ -463,7 +474,12 @@ def batch_verify_k1(pks, msgs, sigs) -> np.ndarray:
                 f"{'disabled' if _kernel_broken else 'failed (will retry)'}"
                 f": {e!r}",
                 file=sys.stderr)
-    packed = pad_packed(packed, tv._pad_to_bucket(B))
-    mask = np.asarray(
-        _k1_verify_packed_jit(jnp.asarray(packed), base_table_f32()))[:B]
+    padded = tv._pad_to_bucket(B)
+    with trace.span("secp256k1.execute", impl="xla", lanes=B,
+                    padded=padded):
+        packed = pad_packed(packed, padded)
+        mask = np.asarray(
+            _k1_verify_packed_jit(jnp.asarray(packed), base_table_f32()))[:B]
+    _m.observe_crypto_batch("secp256k1", tv.backend_label(), "xla",
+                            B, padded, time.perf_counter() - t0)
     return mask & host_ok

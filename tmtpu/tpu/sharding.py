@@ -233,31 +233,51 @@ def batch_verify_tally(pks, msgs, sigs, powers):
     Lanes failing the host-side checks (bad lengths, s >= L, non-canonical
     A.y) are masked out AND their power is zeroed before the device sum.
     """
+    import time
+
+    from tmtpu.libs import metrics as _m
+    from tmtpu.libs import trace
+
     B = len(sigs)
     if B == 0:
         return np.zeros(0, dtype=bool), 0
-    packed, host_ok = tv.prepare_batch_packed(pks, msgs, sigs)
-    p = np.asarray(powers, dtype=np.int64).copy()
-    assert p.shape == (B,)
-    p[~host_ok] = 0
-    use_kernel = tv.use_pallas_kernel()
-    padded = tv._pad_to_bucket(B)
-    if use_kernel:
-        from tmtpu.tpu import kernel as tk
+    t0 = time.perf_counter()
+    with trace.span("crypto.batch_verify_tally", curve="ed25519",
+                    lanes=B) as sp:
+        with trace.span("ed25519.prepare", lanes=B):
+            packed, host_ok = tv.prepare_batch_packed(pks, msgs, sigs)
+        p = np.asarray(powers, dtype=np.int64).copy()
+        assert p.shape == (B,)
+        p[~host_ok] = 0
+        use_kernel = tv.use_pallas_kernel()
+        impl = "pallas" if use_kernel else "xla"
+        padded = tv._pad_to_bucket(B)
+        if use_kernel:
+            from tmtpu.tpu import kernel as tk
 
-        padded = max(tk.DEFAULT_TILE, padded)
-    power_limbs = np.zeros((POWER_LIMBS, padded), dtype=np.int32)
-    power_limbs[:, :B] = powers_to_limbs(p)
-    packed = jnp.asarray(tv.pad_packed(packed, padded))  # ONE transfer
-    if use_kernel:
-        mask, power_sums, _bits = _fused_kernel_step()(
-            packed, jnp.asarray(power_limbs))
-    else:
-        mask, power_sums, _bits = _fused_step()(
-            packed, jnp.asarray(power_limbs), tv.base_table_f32()
-        )
-    mask = np.asarray(mask)[:B] & host_ok
-    return mask, limb_sums_to_int(power_sums)
+            padded = max(tk.DEFAULT_TILE, padded)
+        sp.set(impl=impl, padded=padded)
+        with trace.span("ed25519.pad", padded=padded):
+            power_limbs = np.zeros((POWER_LIMBS, padded), dtype=np.int32)
+            power_limbs[:, :B] = powers_to_limbs(p)
+            packed_h = tv.pad_packed(packed, padded)
+        with trace.span("ed25519.device_put"):
+            packed = jnp.asarray(packed_h)  # ONE transfer
+        with trace.span("ed25519.execute", impl=impl):
+            if use_kernel:
+                mask, power_sums, _bits = _fused_kernel_step()(
+                    packed, jnp.asarray(power_limbs))
+            else:
+                mask, power_sums, _bits = _fused_step()(
+                    packed, jnp.asarray(power_limbs), tv.base_table_f32()
+                )
+            mask = jax.block_until_ready(mask)
+        with trace.span("ed25519.readback"):
+            mask = np.asarray(mask)[:B] & host_ok
+            tallied = limb_sums_to_int(power_sums)
+    _m.observe_crypto_batch("ed25519", tv.backend_label(), impl, B, padded,
+                            time.perf_counter() - t0)
+    return mask, tallied
 
 
 def _tile(a, reps):

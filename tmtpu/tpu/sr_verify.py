@@ -32,11 +32,14 @@ canonical encodings and cosets, so no byte re-encoding is needed).
 
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from tmtpu.crypto import ed25519_ref as ref
+from tmtpu.libs import trace
 from tmtpu.crypto import ristretto
 from tmtpu.crypto.merlin import Transcript
 from tmtpu.tpu import curve, fe
@@ -289,19 +292,26 @@ def batch_verify_sr(pks, msgs, sigs) -> np.ndarray:
     B = len(sigs)
     if B == 0:
         return np.zeros(0, dtype=bool)
+    from tmtpu.libs import metrics as _m
     from tmtpu.tpu import verify as tv
     from tmtpu.tpu.verify import pad_packed
 
-    packed, host_ok = prepare_sr_batch_packed(pks, msgs, sigs)
+    t0 = time.perf_counter()
+    with trace.span("sr25519.prepare", lanes=B):
+        packed, host_ok = prepare_sr_batch_packed(pks, msgs, sigs)
     global _kernel_broken, _kernel_failures
     if not _kernel_broken and tv.use_pallas_kernel():
         from tmtpu.tpu import kernel as tk
 
         padded = max(tk.DEFAULT_TILE, tv._pad_to_bucket(B))
         try:
-            mask = np.asarray(_sr_kernel_packed_jit(
-                jnp.asarray(pad_packed(packed, padded))))[:B]
+            with trace.span("sr25519.execute", impl="pallas",
+                            lanes=B, padded=padded):
+                mask = np.asarray(_sr_kernel_packed_jit(
+                    jnp.asarray(pad_packed(packed, padded))))[:B]
             _kernel_failures = 0
+            _m.observe_crypto_batch("sr25519", tv.backend_label(), "pallas",
+                                    B, padded, time.perf_counter() - t0)
             return mask & host_ok
         except Exception as e:  # noqa: BLE001
             # Latch permanently only on deterministic compile/lowering
@@ -321,7 +331,11 @@ def batch_verify_sr(pks, msgs, sigs) -> np.ndarray:
                 file=sys.stderr)
     # attribute lookup (not an import-time binding) so tests can pin one
     # bucket via monkeypatch, same as the ed25519/secp256k1 paths
-    packed = pad_packed(packed, tv._pad_to_bucket(B))
-    mask = np.asarray(
-        _sr_verify_packed_jit(jnp.asarray(packed), base_table_f32()))[:B]
+    padded = tv._pad_to_bucket(B)
+    with trace.span("sr25519.execute", impl="xla", lanes=B, padded=padded):
+        packed = pad_packed(packed, padded)
+        mask = np.asarray(
+            _sr_verify_packed_jit(jnp.asarray(packed), base_table_f32()))[:B]
+    _m.observe_crypto_batch("sr25519", tv.backend_label(), "xla",
+                            B, padded, time.perf_counter() - t0)
     return mask & host_ok

@@ -25,12 +25,14 @@ over signatures); see tmtpu.tpu.sharding.
 from __future__ import annotations
 
 import hashlib
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from tmtpu.crypto import ed25519_ref as ref
+from tmtpu.libs import trace
 from tmtpu.tpu import curve, fe
 
 L = ref.L
@@ -352,6 +354,15 @@ def pad_args_to_bucket(args, B: int, padded: int):
     )
 
 
+def backend_label() -> str:
+    """The jax device platform for metric labels ('cpu', 'tpu', ...) —
+    only consulted after a dispatch, so the backend is already up."""
+    try:
+        return jax.devices()[0].platform
+    except Exception:  # noqa: BLE001
+        return "unknown"
+
+
 def batch_verify(pks, msgs, sigs) -> np.ndarray:
     """ed25519 batch verification: returns bool [B] per-signature validity.
 
@@ -362,15 +373,33 @@ def batch_verify(pks, msgs, sigs) -> np.ndarray:
     B = len(sigs)
     if B == 0:
         return np.zeros(0, dtype=bool)
-    packed, host_ok = prepare_batch_packed(pks, msgs, sigs)
-    if use_pallas_kernel():
-        from tmtpu.tpu import kernel as tk
+    t0 = time.perf_counter()
+    with trace.span("crypto.batch_verify", curve="ed25519", lanes=B) as sp:
+        with trace.span("ed25519.prepare", lanes=B):
+            packed, host_ok = prepare_batch_packed(pks, msgs, sigs)
+        use_kernel = use_pallas_kernel()
+        impl = "pallas" if use_kernel else "xla"
+        if use_kernel:
+            from tmtpu.tpu import kernel as tk
 
-        packed = pad_packed(packed, max(tk.DEFAULT_TILE, _pad_to_bucket(B)))
-        mask = np.asarray(_verify_packed_kernel_jit(jnp.asarray(packed)))[:B]
-    else:
-        packed = pad_packed(packed, _pad_to_bucket(B))
-        mask = np.asarray(
-            _verify_packed_jit(jnp.asarray(packed), base_table_f32())
-        )[:B]
+            padded = max(tk.DEFAULT_TILE, _pad_to_bucket(B))
+        else:
+            padded = _pad_to_bucket(B)
+        sp.set(impl=impl, padded=padded)
+        with trace.span("ed25519.pad", padded=padded):
+            packed = pad_packed(packed, padded)
+        with trace.span("ed25519.device_put"):
+            dev = jnp.asarray(packed)
+        with trace.span("ed25519.execute", impl=impl):
+            if use_kernel:
+                out = _verify_packed_kernel_jit(dev)
+            else:
+                out = _verify_packed_jit(dev, base_table_f32())
+            out = jax.block_until_ready(out)
+        with trace.span("ed25519.readback"):
+            mask = np.asarray(out)[:B]
+    from tmtpu.libs import metrics as _m
+
+    _m.observe_crypto_batch("ed25519", backend_label(), impl, B, padded,
+                            time.perf_counter() - t0)
     return mask & host_ok

@@ -25,6 +25,7 @@ from __future__ import annotations
 from typing import Optional
 
 from tmtpu.crypto import batch as crypto_batch
+from tmtpu.libs import trace
 from tmtpu.types.block import BlockID, Commit
 from tmtpu.types.validator import ValidatorSet
 
@@ -70,19 +71,22 @@ def verify_commit(vals: ValidatorSet, chain_id: str, block_id: BlockID,
     """validator_set.go:667 — all signatures must be valid; tallied power of
     BlockIDFlagCommit votes must exceed 2/3 of total."""
     _check_commit_basics(vals, commit, height, block_id)
-    bv = crypto_batch.new_batch_verifier(backend)
-    for idx, cs in enumerate(commit.signatures):
-        if cs.is_absent():
-            continue
-        # Verification is purely by index; sign bytes don't include the
-        # validator address (validator_set.go:692 does no address check).
-        # Power rides the batch so the +2/3 tally comes back fused from the
-        # device: only BlockIDFlagCommit votes count toward the threshold.
-        bv.add(vals.validators[idx].pub_key,
-               commit.vote_sign_bytes(chain_id, idx), cs.signature,
-               power=vals.validators[idx].voting_power if cs.for_block()
-               else 0)
-    all_ok, mask, tallied = bv.verify_tally()
+    with trace.span("commit_verify.verify_commit", height=height,
+                    sigs=len(commit.signatures)):
+        bv = crypto_batch.new_batch_verifier(backend)
+        for idx, cs in enumerate(commit.signatures):
+            if cs.is_absent():
+                continue
+            # Verification is purely by index; sign bytes don't include the
+            # validator address (validator_set.go:692 does no address
+            # check). Power rides the batch so the +2/3 tally comes back
+            # fused from the device: only BlockIDFlagCommit votes count
+            # toward the threshold.
+            bv.add(vals.validators[idx].pub_key,
+                   commit.vote_sign_bytes(chain_id, idx), cs.signature,
+                   power=vals.validators[idx].voting_power if cs.for_block()
+                   else 0)
+        all_ok, mask, tallied = bv.verify_tally()
     if not all_ok:
         raise VerificationError(f"wrong signature (#{mask.index(False)})")
     needed = vals.total_voting_power() * 2 // 3
@@ -96,14 +100,16 @@ def verify_commit_light(vals: ValidatorSet, chain_id: str, block_id: BlockID,
     """validator_set.go:722 — only BlockIDFlagCommit sigs count and need
     verifying; +2/3 of total power must have signed the block."""
     _check_commit_basics(vals, commit, height, block_id)
-    bv = crypto_batch.new_batch_verifier(backend)
-    for idx, cs in enumerate(commit.signatures):
-        if not cs.for_block():
-            continue
-        val = vals.validators[idx]
-        bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx),
-               cs.signature, power=val.voting_power)
-    all_ok, mask, tallied = bv.verify_tally()
+    with trace.span("commit_verify.verify_commit_light", height=height,
+                    sigs=len(commit.signatures)):
+        bv = crypto_batch.new_batch_verifier(backend)
+        for idx, cs in enumerate(commit.signatures):
+            if not cs.for_block():
+                continue
+            val = vals.validators[idx]
+            bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx),
+                   cs.signature, power=val.voting_power)
+        all_ok, mask, tallied = bv.verify_tally()
     if not all_ok:
         raise VerificationError("wrong signature in commit")
     needed = vals.total_voting_power() * 2 // 3
@@ -123,26 +129,30 @@ def verify_commit_light_trusting(vals: ValidatorSet, chain_id: str,
         raise VerificationError("trustLevel must be positive")
     if commit is None:
         raise VerificationError("nil commit")
-    bv = crypto_batch.new_batch_verifier(backend)
-    seen = set()
-    # one O(n) index instead of an O(n) scan per signature (10k x 10k
-    # address comparisons would dwarf the batch dispatch)
-    by_address = {v.address: (i, v) for i, v in enumerate(vals.validators)}
-    for idx, cs in enumerate(commit.signatures):
-        if not cs.for_block():
-            continue
-        entry = by_address.get(cs.validator_address)
-        if entry is None:
-            continue  # unknown validator: skip (not in the trusted set)
-        val_idx, val = entry
-        if val_idx in seen:
-            raise VerificationError(
-                f"double vote from validator {cs.validator_address.hex()}"
-            )
-        seen.add(val_idx)
-        bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx),
-               cs.signature, power=val.voting_power)
-    all_ok, mask, tallied = bv.verify_tally()
+    with trace.span("commit_verify.verify_commit_light_trusting",
+                    sigs=len(commit.signatures)):
+        bv = crypto_batch.new_batch_verifier(backend)
+        seen = set()
+        # one O(n) index instead of an O(n) scan per signature (10k x 10k
+        # address comparisons would dwarf the batch dispatch)
+        by_address = {v.address: (i, v)
+                      for i, v in enumerate(vals.validators)}
+        for idx, cs in enumerate(commit.signatures):
+            if not cs.for_block():
+                continue
+            entry = by_address.get(cs.validator_address)
+            if entry is None:
+                continue  # unknown validator: skip (not in the trusted set)
+            val_idx, val = entry
+            if val_idx in seen:
+                raise VerificationError(
+                    f"double vote from validator "
+                    f"{cs.validator_address.hex()}"
+                )
+            seen.add(val_idx)
+            bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx),
+                   cs.signature, power=val.voting_power)
+        all_ok, mask, tallied = bv.verify_tally()
     if not all_ok:
         raise VerificationError("wrong signature in commit")
     needed = vals.total_voting_power() * trust_num // trust_den
@@ -163,26 +173,28 @@ def verify_commits_light_batch(entries, backend=None):
     commit, or the VerificationError for that entry (so fast sync can apply
     the verified prefix and re-request exactly the failing block).
     """
-    bv = crypto_batch.new_batch_verifier(backend)
-    segments = []  # (start, count, tallied, needed, pre_err)
-    for vals, chain_id, block_id, height, commit in entries:
-        start = bv.count()
-        try:
-            _check_commit_basics(vals, commit, height, block_id)
-        except VerificationError as e:
-            segments.append((start, 0, 0, 0, e))
-            continue
-        tallied = 0
-        for idx, cs in enumerate(commit.signatures):
-            if not cs.for_block():
+    with trace.span("commit_verify.verify_commits_light_batch",
+                    commits=len(entries)):
+        bv = crypto_batch.new_batch_verifier(backend)
+        segments = []  # (start, count, tallied, needed, pre_err)
+        for vals, chain_id, block_id, height, commit in entries:
+            start = bv.count()
+            try:
+                _check_commit_basics(vals, commit, height, block_id)
+            except VerificationError as e:
+                segments.append((start, 0, 0, 0, e))
                 continue
-            val = vals.validators[idx]
-            bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx),
-                   cs.signature)
-            tallied += val.voting_power
-        segments.append((start, bv.count() - start, tallied,
-                         vals.total_voting_power() * 2 // 3, None))
-    _, mask = bv.verify()
+            tallied = 0
+            for idx, cs in enumerate(commit.signatures):
+                if not cs.for_block():
+                    continue
+                val = vals.validators[idx]
+                bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx),
+                       cs.signature)
+                tallied += val.voting_power
+            segments.append((start, bv.count() - start, tallied,
+                             vals.total_voting_power() * 2 // 3, None))
+        _, mask = bv.verify()
     out = []
     for start, count, tallied, needed, pre_err in segments:
         if pre_err is not None:

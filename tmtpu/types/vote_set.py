@@ -18,6 +18,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from tmtpu.crypto import batch as crypto_batch
+from tmtpu.libs import trace
 from tmtpu.libs.bits import BitArray
 from tmtpu.types.block import BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT, \
     BLOCK_ID_FLAG_NIL, BlockID, Commit, CommitSig
@@ -135,7 +136,9 @@ class VoteSet:
         Per-vote errors follow the reference's addVote semantics:
         structurally-bad votes raise; a conflicting (equivocation) vote
         raises ErrVoteConflictingVotes AFTER processing the rest."""
-        with self._lock:
+        with self._lock, trace.span(
+                "vote_set.add_votes", votes=len(votes),
+                height=self.height, round=self.round):
             prepared = []  # (vote, val, conflicting|None)
             results = [False] * len(votes)
             first_err: Optional[Exception] = None
