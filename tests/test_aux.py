@@ -30,12 +30,20 @@ def test_pprof_server_endpoints():
         srv.stop()
 
 
-def test_deadlock_detection_reports(capsys):
+def test_deadlock_detection_reports():
+    # the stall report goes through the structured logger (not raw
+    # stderr), so capture by swapping the default logger's stream
+    import io
+
+    from tmtpu.libs import log
     from tmtpu.libs import sync as tmsync
 
     lock = tmsync._WatchedLock("test-lock")
     old_timeout = tmsync._timeout
     tmsync._timeout = 0.3
+    buf = io.StringIO()
+    old_logger = log._default
+    log.configure(out=buf)
     try:
         holder_entered = threading.Event()
         release = threading.Event()
@@ -63,7 +71,8 @@ def test_deadlock_detection_reports(capsys):
         assert got == [True]
     finally:
         tmsync._timeout = old_timeout
-    err = capsys.readouterr().err
+        log._default = old_logger
+    err = buf.getvalue()
     assert "POSSIBLE DEADLOCK" in err and "test-lock" in err
 
 

@@ -48,6 +48,30 @@ def test_lint_detects_unknown_metric_write(tmp_path, monkeypatch):
                for f in findings)
 
 
+def test_lint_detects_unrendered_construction(tmp_path, monkeypatch):
+    """A Counter/Gauge/Histogram constructed directly (outside the
+    DEFAULT registry factories) never shows up on /metrics and must be
+    flagged — except in tests/ and libs/metrics.py itself."""
+    scratch = tmp_path / "scratch"
+    scratch.mkdir()
+    (scratch / "offender.py").write_text(
+        "from tmtpu.libs import metrics\n"
+        "orphan = metrics.Counter('tendermint_orphan_total', 'h', ())\n")
+    exempt = tmp_path / "tests"
+    exempt.mkdir()
+    (exempt / "probe.py").write_text(
+        "from tmtpu.libs.metrics import Gauge\n"
+        "g = Gauge('tendermint_throwaway', 'h', ())\n")
+    monkeypatch.setattr(check_metrics, "REPO", str(tmp_path))
+    monkeypatch.setattr(check_metrics, "_SCAN", ("scratch", "tests"))
+    findings = check_metrics.check()
+    assert any("unrendered metric" in f and "Counter" in f
+               and os.path.join("scratch", "offender.py") in f
+               for f in findings), findings
+    # the tests/ construction is exempt
+    assert not any("probe.py" in f for f in findings)
+
+
 def test_main_exit_codes(capsys):
     assert check_metrics.main() == 0
     out = capsys.readouterr().out

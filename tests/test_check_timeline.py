@@ -1,0 +1,65 @@
+"""Tier-1 wiring for the timeline/trace naming lint
+(tools/check_timeline.py): the tree must stay clean, and the lint must
+detect every divergence mode it claims to — a declared step event with
+no span twin, and a recorded step literal missing from the declared
+tuple or the span names."""
+
+import os
+
+from tools import check_timeline
+
+from tmtpu.libs import timeline
+
+
+def test_tree_is_clean():
+    """Every consensus step event in timeline.CONSENSUS_STEP_EVENTS and
+    every consensus.* record() literal has a byte-identical trace span
+    name — the invariant the 'which step stalled' diagnosis rests on."""
+    assert check_timeline.check() == []
+
+
+def test_lint_detects_declared_event_without_span(monkeypatch):
+    """Adding a step event to CONSENSUS_STEP_EVENTS without a matching
+    trace.traced/trace.span literal must be flagged."""
+    monkeypatch.setattr(
+        timeline, "CONSENSUS_STEP_EVENTS",
+        timeline.CONSENSUS_STEP_EVENTS + ("consensus.enter_bogus",))
+    findings = check_timeline.check()
+    assert any("consensus.enter_bogus" in f
+               and "no matching trace span" in f
+               for f in findings), findings
+
+
+def test_lint_detects_recorded_event_drift(tmp_path, monkeypatch):
+    """A record() call site using a consensus.* name that neither the
+    span literals nor CONSENSUS_STEP_EVENTS know must produce both
+    findings (catches a rename that missed one side)."""
+    pkg = tmp_path / "tmtpu" / "scratch"
+    pkg.mkdir(parents=True)
+    (pkg / "offender.py").write_text(
+        "from tmtpu.libs import timeline\n"
+        "timeline.record(1, 'consensus.enter_ghost')\n")
+    monkeypatch.setattr(check_timeline, "REPO", str(tmp_path))
+    # the scratch tree has no spans at all, so empty the declared tuple
+    # (its real entries would otherwise all be span-less here)
+    monkeypatch.setattr(timeline, "CONSENSUS_STEP_EVENTS", ())
+    findings = check_timeline.check()
+    rel = os.path.join("tmtpu", "scratch", "offender.py")
+    assert any("consensus.enter_ghost" in f and rel in f
+               and "no trace.traced/trace.span literal" in f
+               for f in findings), findings
+    assert any("consensus.enter_ghost" in f
+               and "missing from timeline.CONSENSUS_STEP_EVENTS" in f
+               for f in findings), findings
+    # non-consensus events (quorum.*, crypto.*) are exempt: only step
+    # names must mirror span names
+    (pkg / "offender.py").write_text(
+        "from tmtpu.libs import timeline\n"
+        "timeline.record(1, 'quorum.prevote')\n")
+    assert check_timeline.check() == []
+
+
+def test_main_exit_codes(capsys):
+    assert check_timeline.main() == 0
+    out = capsys.readouterr().out
+    assert "all span-matched" in out

@@ -1,0 +1,130 @@
+"""Pprof-server health surface tests: GET /metrics, /debug/timeline,
+/healthz, /readyz (tmtpu/rpc/pprof.py) and the readiness gating the
+node wires in (Node._readiness)."""
+
+import json
+import urllib.error
+import urllib.request
+
+from tmtpu.libs import metrics, timeline
+from tmtpu.rpc.pprof import PprofServer
+
+
+def _get(url):
+    try:
+        r = urllib.request.urlopen(url, timeout=10)
+        return r.status, r.headers["Content-Type"], r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers["Content-Type"], e.read()
+
+
+def _server(**kw):
+    srv = PprofServer("tcp://127.0.0.1:0", **kw)
+    srv.start()
+    return srv, f"http://127.0.0.1:{srv.port}"
+
+
+def test_metrics_endpoint_serves_exposition_text():
+    metrics.health_up.set(1.0)
+    srv, base = _server()
+    try:
+        status, ctype, body = _get(f"{base}/metrics")
+        assert status == 200
+        assert ctype == "text/plain; version=0.0.4; charset=utf-8"
+        text = body.decode()
+        assert "# TYPE tendermint_health_up gauge" in text
+        assert "tendermint_health_up 1" in text
+    finally:
+        srv.stop()
+
+
+def test_debug_timeline_endpoint_and_filters():
+    timeline.DEFAULT.clear()
+    srv, base = _server()
+    try:
+        timeline.record(11, "consensus.enter_propose", round=0)
+        timeline.record(12, "consensus.enter_prevote", round=1, power=30)
+        status, ctype, body = _get(f"{base}/debug/timeline")
+        assert status == 200 and ctype == "application/json"
+        doc = json.loads(body)
+        assert doc["summary"]["heights"] == 2
+        assert doc["last_event"]["event"] == "consensus.enter_prevote"
+        assert [h["height"] for h in doc["heights"]] == [11, 12]
+
+        _, _, body = _get(f"{base}/debug/timeline?height=11")
+        doc = json.loads(body)
+        assert [h["height"] for h in doc["heights"]] == [11]
+        assert doc["heights"][0]["events"][0]["event"] \
+            == "consensus.enter_propose"
+
+        _, _, body = _get(f"{base}/debug/timeline?last=1")
+        assert [h["height"] for h in json.loads(body)["heights"]] == [12]
+    finally:
+        srv.stop()
+        timeline.DEFAULT.clear()
+
+
+def test_healthz_readyz_default_to_disabled_ok():
+    srv, base = _server()
+    try:
+        status, ctype, body = _get(f"{base}/healthz")
+        assert (status, ctype) == (200, "application/json")
+        assert json.loads(body) == {"healthy": True,
+                                    "watchdog": "disabled"}
+        status, _, body = _get(f"{base}/readyz")
+        assert status == 200
+        assert json.loads(body) == {"ready": True, "watchdog": "disabled"}
+    finally:
+        srv.stop()
+
+
+def test_healthz_flips_with_the_wired_verdict():
+    state = {"ok": True}
+
+    def health():
+        return state["ok"], {"healthy": state["ok"],
+                             "reasons": [] if state["ok"] else ["stalled"]}
+
+    srv, base = _server(health=health)
+    try:
+        status, _, body = _get(f"{base}/healthz")
+        assert status == 200 and json.loads(body)["healthy"] is True
+        state["ok"] = False
+        status, _, body = _get(f"{base}/healthz")
+        assert status == 503
+        assert json.loads(body) == {"healthy": False,
+                                    "reasons": ["stalled"]}
+    finally:
+        srv.stop()
+
+
+def test_readyz_gates_on_sync_like_node_readiness():
+    """Mirror of Node._readiness: live but still syncing => not ready
+    (503) — the k8s semantics of liveness vs readiness."""
+    state = {"syncing": True}
+
+    def ready():
+        ok = not state["syncing"]
+        return ok, {"ready": ok, "syncing": state["syncing"],
+                    "reasons": []}
+
+    srv, base = _server(ready=ready)
+    try:
+        status, _, body = _get(f"{base}/readyz")
+        assert status == 503 and json.loads(body)["syncing"] is True
+        state["syncing"] = False
+        status, _, body = _get(f"{base}/readyz")
+        assert status == 200 and json.loads(body)["ready"] is True
+    finally:
+        srv.stop()
+
+
+def test_pprof_index_mentions_health_routes():
+    srv, base = _server()
+    try:
+        _, _, body = _get(f"{base}/debug/pprof/")
+        for route in (b"/debug/timeline", b"/metrics", b"/healthz",
+                      b"/readyz"):
+            assert route in body
+    finally:
+        srv.stop()

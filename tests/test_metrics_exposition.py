@@ -123,6 +123,57 @@ def test_concurrent_observe_and_render():
             == samples[f'tendermint_test_race_count{{t="{t}"}}'] == 2000
 
 
+def test_histogram_exact_counts_under_concurrent_writers():
+    """8 writers spread over 4 label series, no renderer in the way: the
+    final bucket counts and sums must be EXACTLY right — a lost update
+    under the per-metric lock would show up here."""
+    h = metrics.Histogram("tendermint_test_exact", "h", ("t",),
+                          buckets=(1, 10))
+
+    def observe(tid):
+        series = str(tid % 4)
+        for i in range(1000):
+            h.observe(0.5 if i % 2 == 0 else 5.0, t=series)
+
+    workers = [threading.Thread(target=observe, args=(t,))
+               for t in range(8)]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join()
+    samples = _parse_exposition("\n".join(h.render("histogram")))
+    for t in ("0", "1", "2", "3"):
+        # 2 writers x 1000 obs: 1000 of 0.5 (le=1) + 1000 of 5 (le=10)
+        assert samples[f'tendermint_test_exact_bucket{{t="{t}",le="1"}}'] \
+            == 1000
+        assert samples[f'tendermint_test_exact_bucket{{t="{t}",le="10"}}'] \
+            == 2000
+        assert samples[
+            f'tendermint_test_exact_bucket{{t="{t}",le="+Inf"}}'] == 2000
+        assert samples[f'tendermint_test_exact_count{{t="{t}"}}'] == 2000
+        assert samples[f'tendermint_test_exact_sum{{t="{t}"}}'] == \
+            pytest.approx(1000 * 0.5 + 1000 * 5.0)
+
+
+def test_histogram_bucket_boundary_inclusive_and_series_isolated():
+    """Prometheus ``le`` is inclusive: a value landing exactly on a
+    bucket boundary counts in that bucket. Label series never
+    cross-contaminate."""
+    h = metrics.Histogram("tendermint_test_edge", "h", ("curve",),
+                          buckets=(0.1, 1))
+    h.observe(0.1, curve="a")          # exactly on the boundary
+    h.observe(0.1000001, curve="a")    # just past it
+    h.observe(0.1, curve="b")
+    samples = _parse_exposition("\n".join(h.render("histogram")))
+    assert samples['tendermint_test_edge_bucket{curve="a",le="0.1"}'] == 1
+    assert samples['tendermint_test_edge_bucket{curve="a",le="1"}'] == 2
+    assert samples['tendermint_test_edge_bucket{curve="a",le="+Inf"}'] == 2
+    # series b saw exactly one observation, untouched by series a
+    assert samples['tendermint_test_edge_bucket{curve="b",le="0.1"}'] == 1
+    assert samples['tendermint_test_edge_count{curve="b"}'] == 1
+    assert h.totals(curve="b") == (1, pytest.approx(0.1))
+
+
 def test_full_registry_round_trip_parses():
     """Every line the process-global registry emits must parse — the same
     property a real Prometheus scraper enforces."""

@@ -1,0 +1,73 @@
+"""Lock-stall reporting tests (tmtpu/libs/sync.py): a watched lock that
+cannot be acquired within the deadlock timeout must report through the
+structured logger and count in tendermint_sync_lock_stall_total — then
+proceed to block like a normal lock (no behavior change)."""
+
+import io
+import threading
+import time
+
+from tmtpu.libs import log, metrics
+from tmtpu.libs import sync as tsync
+
+
+def test_factories_respect_detection_switch(monkeypatch):
+    monkeypatch.setattr(tsync, "_enabled", False)
+    assert isinstance(tsync.Mutex("a"), type(threading.Lock()))
+    monkeypatch.setattr(tsync, "_enabled", True)
+    assert isinstance(tsync.Mutex("a"), tsync._WatchedLock)
+    assert isinstance(tsync.RMutex("a"), tsync._WatchedLock)
+
+
+def test_stalled_acquisition_reports_and_then_proceeds(monkeypatch):
+    monkeypatch.setattr(tsync, "_timeout", 0.1)
+    buf = io.StringIO()
+    old_logger = log._default
+    log.configure(out=buf)
+    try:
+        lk = tsync._WatchedLock("stall-probe")
+        series = "lock=stall-probe"
+        base = metrics.sync_lock_stall.summary_series().get(series, 0.0)
+
+        lk.acquire()  # main thread holds; contender must stall
+        released = threading.Event()
+
+        def contend():
+            lk.acquire()
+            lk.release()
+            released.set()
+
+        t = threading.Thread(target=contend, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 5
+        while "POSSIBLE DEADLOCK" not in buf.getvalue() and \
+                time.monotonic() < deadline:
+            time.sleep(0.02)
+        out = buf.getvalue()
+        assert "POSSIBLE DEADLOCK" in out, "stall never reported"
+        # structured fields: lock name, module tag, both stack sections
+        assert "stall-probe" in out and "module=sync" in out
+        assert "blocked thread" in out and "all threads:" in out
+        assert metrics.sync_lock_stall.summary_series()[series] \
+            == base + 1
+
+        # after the report the acquire proceeds normally once released
+        lk.release()
+        assert released.wait(5), "contender never got the lock"
+        t.join(timeout=5)
+    finally:
+        log._default = old_logger
+
+
+def test_fast_acquisition_never_reports(monkeypatch):
+    monkeypatch.setattr(tsync, "_timeout", 0.5)
+    base = sum(metrics.sync_lock_stall.summary_series().values())
+    lk = tsync._WatchedLock("quiet-probe", reentrant=True)
+    with lk:
+        with lk:  # reentrant path
+            assert lk.locked()
+    assert not lk.locked()
+    # try-acquire path keeps the holder bookkeeping straight too
+    assert lk.acquire(blocking=False)
+    lk.release()
+    assert sum(metrics.sync_lock_stall.summary_series().values()) == base

@@ -1,0 +1,126 @@
+"""Unit tests for tmtpu/libs/timeline.py — the bounded per-height round
+timeline journal behind the ``timeline`` JSON-RPC method and
+GET /debug/timeline."""
+
+import threading
+
+from tmtpu.libs import timeline
+
+
+def test_record_and_snapshot_ordering():
+    tl = timeline.Timeline(capacity=8)
+    tl.record(5, "consensus.enter_new_round", round=0)
+    tl.record(5, "consensus.enter_propose", round=0)
+    tl.record(5, timeline.EVENT_PROPOSAL_RECEIVED, round=0, proposer="ab")
+    recs = tl.snapshot()
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["height"] == 5 and rec["overflow"] == 0
+    assert [e["event"] for e in rec["events"]] == [
+        "consensus.enter_new_round", "consensus.enter_propose",
+        "proposal.received"]
+    # attrs and round ride along on the event dict
+    assert rec["events"][2]["proposer"] == "ab"
+    assert all(e["round"] == 0 and e["t"] > 0 for e in rec["events"])
+
+
+def test_nonpositive_height_ignored():
+    tl = timeline.Timeline(capacity=4)
+    tl.record(0, "consensus.enter_propose")
+    tl.record(-3, "consensus.enter_propose")
+    assert tl.snapshot() == []
+    assert tl.last_event() is None
+    assert tl.current_height() == 0
+
+
+def test_fifo_height_eviction_and_dropped_count():
+    tl = timeline.Timeline(capacity=3)
+    for h in range(1, 6):
+        tl.record(h, "consensus.enter_new_round")
+    recs = tl.snapshot()
+    assert [r["height"] for r in recs] == [3, 4, 5]
+    s = tl.summary()
+    assert s["heights"] == 3 and s["dropped_heights"] == 2
+    assert s["current_height"] == 5 and s["capacity"] == 3
+
+
+def test_snapshot_single_height_and_last_window():
+    tl = timeline.Timeline(capacity=16)
+    for h in (1, 2, 3, 4):
+        tl.record(h, "consensus.enter_new_round")
+    one = tl.snapshot(height=3)
+    assert len(one) == 1 and one[0]["height"] == 3
+    assert tl.snapshot(height=99) == []
+    assert [r["height"] for r in tl.snapshot(last=2)] == [3, 4]
+
+
+def test_record_flush_lands_on_current_height():
+    tl = timeline.Timeline(capacity=8)
+    tl.record(7, "consensus.enter_prevote", round=1)
+    tl.record_flush(backend="cpu", lanes=40, ok=40)
+    rec = tl.snapshot(height=7)[0]
+    assert rec["events"][-1]["event"] == timeline.EVENT_BATCH_FLUSH
+    assert rec["events"][-1]["lanes"] == 40
+    # with no height seen yet, a flush is dropped, not crashed
+    tl2 = timeline.Timeline(capacity=8)
+    tl2.record_flush(backend="cpu", lanes=1, ok=1)
+    assert tl2.snapshot() == []
+
+
+def test_last_event_carries_age():
+    tl = timeline.Timeline(capacity=8)
+    tl.record(9, "consensus.enter_commit", round=2, txs=10)
+    last = tl.last_event()
+    assert last["height"] == 9 and last["event"] == "consensus.enter_commit"
+    assert last["txs"] == 10
+    assert 0 <= last["age_s"] < 60
+
+
+def test_per_height_event_cap_counts_overflow(monkeypatch):
+    monkeypatch.setattr(timeline, "_MAX_EVENTS_PER_HEIGHT", 4)
+    tl = timeline.Timeline(capacity=4)
+    for _ in range(7):
+        tl.record(2, "consensus.enter_prevote")
+    rec = tl.snapshot(height=2)[0]
+    assert len(rec["events"]) == 4 and rec["overflow"] == 3
+
+
+def test_disable_and_clear():
+    tl = timeline.Timeline(capacity=4)
+    tl.record(1, "consensus.enter_propose")
+    tl.set_enabled(False)
+    tl.record(2, "consensus.enter_propose")
+    assert tl.current_height() == 1
+    tl.set_enabled(True)
+    tl.clear()
+    assert tl.snapshot() == [] and tl.last_event() is None
+    assert tl.summary()["current_height"] == 0
+
+
+def test_concurrent_recording_is_consistent():
+    tl = timeline.Timeline(capacity=256)
+
+    def worker(base):
+        for i in range(200):
+            tl.record(base + (i % 10), "consensus.enter_prevote", round=i)
+
+    threads = [threading.Thread(target=worker, args=(100 * t + 1,))
+               for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = sum(len(r["events"]) for r in tl.snapshot(last=256))
+    assert total == 4 * 200
+    assert tl.summary()["dropped_heights"] == 0
+
+
+def test_module_level_default_wrappers():
+    timeline.DEFAULT.clear()
+    try:
+        timeline.record(3, "consensus.enter_precommit", round=1)
+        assert timeline.last_event()["event"] == "consensus.enter_precommit"
+        assert timeline.summary()["current_height"] == 3
+        assert timeline.snapshot(height=3)[0]["height"] == 3
+    finally:
+        timeline.DEFAULT.clear()

@@ -1,0 +1,384 @@
+"""Stall-watchdog tests (tmtpu/libs/watchdog.py) including the ISSUE
+acceptance scenarios: a scripted consensus stall (silent peers at
+prevote) and a TPU-backend-down fallback storm are each detected within
+the configured deadline, flip /healthz to 503 with a reason, and the
+``timeline`` RPC names the step that stalled."""
+
+import io
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tmtpu.libs import log, metrics, timeline, trace
+from tmtpu.libs import watchdog as wdg
+
+
+# Duck-typed stand-ins for ConsensusState / RoundState: the real classes
+# need the `cryptography` package (consensus/types.py imports the
+# secp256k1 backend), which not every environment carries. The watchdog
+# only reads height/round/step + the two name helpers.
+class _FakeRoundState:
+    def __init__(self, height=7, round_=0, step=4, name="Prevote"):
+        self.height, self.round, self.step = height, round_, step
+        self._name = name
+
+    def step_name(self):
+        return self._name
+
+    def height_round_step(self):
+        return f"{self.height}/{self.round}/{self._name}"
+
+
+class _FakeConsensus:
+    def __init__(self, rs=None):
+        self.rs = rs or _FakeRoundState()
+
+    def round_state_nolock(self):
+        return self.rs
+
+
+class _FakeMempool:
+    def __init__(self, size=0):
+        self._size = size
+
+    def size(self):
+        return self._size
+
+
+def _get(url):
+    """(status, parsed-json body) — urllib raises on 503, so catch it."""
+    try:
+        r = urllib.request.urlopen(url, timeout=10)
+        return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# --- check factories ---------------------------------------------------------
+
+
+def test_consensus_progress_check_detects_stall():
+    timeline.DEFAULT.clear()
+    try:
+        timeline.record(7, "consensus.enter_prevote", round=0)
+        cs = _FakeConsensus(_FakeRoundState(height=7, step=4))
+        check = wdg.consensus_progress_check(cs, stall_timeout_s=0.05)
+        ok, reason, _ = check()
+        assert ok and reason == ""
+        time.sleep(0.12)
+        ok, reason, details = check()
+        assert not ok
+        assert "no height/round progress" in reason and "7/0/Prevote" in reason
+        assert details["step"] == "Prevote"
+        # the verdict names the last timeline event = the stalled step
+        assert details["last_timeline_event"]["event"] \
+            == "consensus.enter_prevote"
+    finally:
+        timeline.DEFAULT.clear()
+
+
+def test_consensus_progress_resets_on_advance():
+    cs = _FakeConsensus()
+    check = wdg.consensus_progress_check(cs, stall_timeout_s=0.1)
+    check()
+    time.sleep(0.12)
+    cs.rs.round += 1  # a round bump IS progress
+    ok, _, details = check()
+    assert ok and details["stalled_for_s"] < 0.1
+
+
+def test_consensus_progress_syncing_gets_a_pass():
+    cs = _FakeConsensus()
+    check = wdg.consensus_progress_check(cs, stall_timeout_s=0.05,
+                                         is_syncing=lambda: True)
+    check()
+    time.sleep(0.12)
+    ok, _, details = check()
+    assert ok and details == {"syncing": True}
+
+
+def test_peer_count_check():
+    ok, _, details = wdg.peer_count_check(lambda: 5, 3)()
+    assert ok and details["peers"] == 5
+    ok, reason, _ = wdg.peer_count_check(lambda: 1, 3)()
+    assert not ok and "1 peers connected, need >= 3" in reason
+
+
+def test_mempool_drain_check():
+    mp = _FakeMempool(size=0)
+    check = wdg.mempool_drain_check(mp, stall_timeout_s=0.05)
+    assert check()[0]  # empty = healthy
+    mp._size = 40
+    check()
+    time.sleep(0.12)
+    ok, reason, _ = check()
+    assert not ok and "stuck at 40 txs" in reason
+    mp._size = 10  # a drain resets the stall clock
+    ok, _, _ = check()
+    assert ok
+
+
+def test_sync_status_check_always_healthy():
+    ok, reason, details = wdg.sync_status_check(lambda: True,
+                                                lambda: False)()
+    assert ok and reason == ""
+    assert details == {"block_sync": True, "state_sync": False,
+                       "caught_up": False}
+
+
+def test_tpu_fallback_storm_detected():
+    check = wdg.tpu_backend_check(window_s=30.0, storm_threshold=10)
+    ok, _, _ = check()  # baseline sample
+    assert ok
+    metrics.crypto_cpu_fallback.inc(11, curve="ed25519",
+                                    reason="backend_down")
+    ok, reason, details = check()
+    assert not ok
+    assert "cpu fallback storm" in reason and "threshold 10" in reason
+    assert details["fallbacks_in_window"] >= 11
+
+
+def test_tpu_backend_down_probe_unhealthy():
+    old = metrics.crypto_tpu_backend_up.summary_series().get("")
+    try:
+        metrics.crypto_tpu_backend_up.set(0.0)
+        ok, reason, _ = wdg.tpu_backend_check(
+            30.0, 512, expect_device=True)()
+        assert not ok and "crypto_tpu_backend_up=0" in reason
+        # without expect_device a down probe alone is not fatal
+        assert wdg.tpu_backend_check(30.0, 512)()[0]
+    finally:
+        metrics.crypto_tpu_backend_up.set(old if old is not None else 1.0)
+
+
+# --- Watchdog core -----------------------------------------------------------
+
+
+def test_check_now_verdicts_metrics_and_flip_logging():
+    buf = io.StringIO()
+    wd = wdg.Watchdog(interval_s=1, logger=log.Logger(out=buf))
+    state = {"ok": True}
+    wd.register("flappy", lambda: (state["ok"], ""
+                if state["ok"] else "down on purpose", {"n": 3}))
+    wd.check_now()
+    assert wd.healthy() == (True, [])
+    assert metrics.health_check_up.summary_series()["check=flappy"] == 1.0
+
+    base = metrics.health_stalls.summary_series().get("check=flappy", 0.0)
+    state["ok"] = False
+    wd.check_now()
+    wd.check_now()  # still down: the flip counter must not re-fire
+    ok, reasons = wd.healthy()
+    assert not ok and reasons == ["flappy: down on purpose"]
+    assert metrics.health_check_up.summary_series()["check=flappy"] == 0.0
+    assert metrics.health_stalls.summary_series()["check=flappy"] == base + 1
+    assert "watchdog check unhealthy" in buf.getvalue()
+
+    state["ok"] = True
+    wd.check_now()
+    assert wd.healthy()[0]
+    assert "watchdog check recovered" in buf.getvalue()
+    v = wd.verdicts()["flappy"]
+    assert v["healthy"] and v["details"] == {"n": 3}
+
+
+def test_raising_check_is_unhealthy_not_fatal():
+    wd = wdg.Watchdog(logger=log.NopLogger())
+
+    def boom():
+        raise RuntimeError("probe exploded")
+
+    wd.register("boom", boom)
+    verdicts = wd.check_now()
+    assert not verdicts["boom"]["healthy"]
+    assert "check raised: probe exploded" in verdicts["boom"]["reason"]
+    ok, reasons = wd.healthy()
+    assert not ok and "boom" in reasons[0]
+
+
+def test_slow_span_scan_counts_once():
+    wd = wdg.Watchdog(slow_span_threshold_s=0.005, logger=log.NopLogger())
+    with trace.span("wdtest.slow"):
+        time.sleep(0.02)
+    wd.check_now()
+    n1 = metrics.health_slow_spans.summary_series().get(
+        "span=wdtest.slow", 0.0)
+    assert n1 >= 1
+    wd.check_now()  # watermark: same span never counted twice
+    n2 = metrics.health_slow_spans.summary_series().get(
+        "span=wdtest.slow", 0.0)
+    assert n2 == n1
+
+
+def test_liveness_payload_shape():
+    wd = wdg.Watchdog(logger=log.NopLogger())
+    wd.register("a", lambda: (False, "broken", {}))
+    wd.check_now()
+    ok, payload = wd.liveness()
+    assert not ok
+    assert payload["healthy"] is False
+    assert payload["reasons"] == ["a: broken"]
+    assert payload["checks"]["a"]["reason"] == "broken"
+    json.dumps(payload)  # must be a JSON-able probe body
+
+
+# --- ISSUE acceptance: scripted stall scenarios ------------------------------
+
+
+def test_silent_peers_stall_flips_healthz_and_names_step():
+    """Scenario 1: peers go silent at prevote. The node entered Prevote
+    at height 7 and nothing has moved since. The watchdog must detect
+    it within the configured deadline, /healthz must flip to 503 with
+    the reason, and the ``timeline`` RPC must show the stalled step."""
+    from tmtpu.rpc.core import Environment, build_routes
+    from tmtpu.rpc.pprof import PprofServer
+
+    timeline.DEFAULT.clear()
+    # the per-height journal as the consensus hooks would have left it:
+    # steps ran up to enter_prevote, then the network went quiet
+    timeline.record(7, "consensus.enter_new_round", round=0)
+    timeline.record(7, "consensus.enter_propose", round=0)
+    timeline.record(7, timeline.EVENT_PROPOSAL_RECEIVED, round=0)
+    timeline.record(7, "consensus.enter_prevote", round=0)
+
+    cs = _FakeConsensus(_FakeRoundState(height=7, step=4, name="Prevote"))
+    deadline_s = 0.25
+    wd = wdg.Watchdog(interval_s=0.05, logger=log.NopLogger())
+    wd.register("consensus",
+                wdg.consensus_progress_check(cs, deadline_s))
+
+    srv = PprofServer("tcp://127.0.0.1:0", health=wd.liveness)
+    srv.start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        wd.check_now()
+        assert wd.healthy()[0]  # not stalled yet
+        status, _ = _get(f"{base}/healthz")
+        assert status == 200
+
+        wd.start()
+        t0 = time.monotonic()
+        while wd.healthy()[0] and time.monotonic() - t0 < 10 * deadline_s:
+            time.sleep(0.02)
+        elapsed = time.monotonic() - t0
+        ok, reasons = wd.healthy()
+        assert not ok, "watchdog never flagged the stall"
+        assert elapsed < 10 * deadline_s, \
+            f"detected only after {elapsed:.2f}s (deadline {deadline_s}s)"
+        assert "no height/round progress" in reasons[0]
+        assert "7/0/Prevote" in reasons[0]
+
+        # /healthz flips to 503 and carries the reason
+        status, body = _get(f"{base}/healthz")
+        assert status == 503
+        assert body["healthy"] is False
+        assert any("no height/round progress" in r
+                   for r in body["reasons"])
+
+        # the timeline RPC names the stalled step
+        class _Node:
+            watchdog = wd
+
+        routes = build_routes(Environment(_Node()))
+        tl = routes["timeline"]()
+        assert tl["last_event"]["event"] == "consensus.enter_prevote"
+        assert tl["last_event"]["height"] == 7
+        events = [e["event"] for e in tl["heights"][-1]["events"]]
+        assert events[-1] == "consensus.enter_prevote"
+
+        detail = routes["health_detail"]()
+        assert detail["healthy"] is False
+        assert "consensus" in detail["checks"]
+        assert not detail["checks"]["consensus"]["healthy"]
+    finally:
+        wd.stop()
+        srv.stop()
+        timeline.DEFAULT.clear()
+
+
+def test_tpu_backend_down_storm_flips_healthz():
+    """Scenario 2: the TPU backend dies and every verify lands on the
+    CPU fallback path. The storm check must flag it within the
+    configured deadline, flip /healthz to 503 with the reason, and
+    health_detail must carry the diagnosis."""
+    from tmtpu.rpc.core import Environment, build_routes
+    from tmtpu.rpc.pprof import PprofServer
+
+    old_up = metrics.crypto_tpu_backend_up.summary_series().get("")
+    wd = wdg.Watchdog(interval_s=0.05, logger=log.NopLogger())
+    wd.register("crypto", wdg.tpu_backend_check(
+        window_s=30.0, storm_threshold=16, expect_device=True))
+
+    srv = PprofServer("tcp://127.0.0.1:0", health=wd.liveness)
+    srv.start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        metrics.crypto_tpu_backend_up.set(1.0)
+        wd.check_now()
+        assert wd.healthy()[0]
+
+        # the backend goes down: probe gauge drops, fallback lanes storm
+        metrics.crypto_tpu_backend_up.set(0.0)
+        metrics.crypto_cpu_fallback.inc(100, curve="ed25519",
+                                        reason="backend_down")
+        wd.start()
+        t0 = time.monotonic()
+        while wd.healthy()[0] and time.monotonic() - t0 < 5:
+            time.sleep(0.02)
+        ok, reasons = wd.healthy()
+        assert not ok, "watchdog never flagged the dead backend"
+        assert time.monotonic() - t0 < 5
+        assert "tpu backend probe reports down" in reasons[0]
+
+        status, body = _get(f"{base}/healthz")
+        assert status == 503
+        assert any("tpu backend" in r for r in body["reasons"])
+
+        class _Node:
+            watchdog = wd
+
+        detail = build_routes(Environment(_Node()))["health_detail"]()
+        assert detail["healthy"] is False
+        assert not detail["checks"]["crypto"]["healthy"]
+        assert detail["checks"]["crypto"]["details"]["backend_up"] == 0.0
+    finally:
+        wd.stop()
+        srv.stop()
+        metrics.crypto_tpu_backend_up.set(
+            old_up if old_up is not None else 1.0)
+
+
+@pytest.mark.slow
+def test_real_consensus_stall_detected():
+    """Scenario 1 against a REAL ConsensusState: one of four validators
+    runs while the other three stay silent — no quorum, the node wedges
+    at Prevote, and the watchdog + timeline must say so."""
+    pytest.importorskip("cryptography")
+    from tests.test_consensus import make_network, stop_all
+
+    timeline.DEFAULT.clear()
+    nodes = make_network(4)
+    cs = nodes[0]
+    wd = wdg.Watchdog(interval_s=0.1, logger=log.NopLogger())
+    wd.register("consensus", wdg.consensus_progress_check(cs, 1.0))
+    try:
+        cs.start()  # the other three never start: silent peers
+        wd.start()
+        t0 = time.monotonic()
+        while wd.healthy()[0] and time.monotonic() - t0 < 30:
+            time.sleep(0.05)
+        ok, reasons = wd.healthy()
+        assert not ok, "real stall never detected"
+        assert "no height/round progress" in reasons[0]
+        last = timeline.last_event()
+        assert last is not None and last["height"] == 1
+        assert last["event"] in timeline.CONSENSUS_STEP_EVENTS
+        rs = cs.round_state_nolock()
+        assert rs.height == 1  # wedged, never committed
+    finally:
+        wd.stop()
+        stop_all(nodes)
+        timeline.DEFAULT.clear()

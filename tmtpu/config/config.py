@@ -156,6 +156,31 @@ class InstrumentationConfig:
 
 
 @dataclass
+class HealthConfig:
+    """Node health engine knobs (libs/watchdog + libs/timeline): the
+    stall watchdog's evaluation interval and the per-axis deadlines it
+    enforces. Surfaced at /healthz, /readyz (pprof server) and the
+    ``health_detail`` JSON-RPC method."""
+
+    enable: bool = True
+    watchdog_interval_ns: int = 1000 * MS
+    # liveness deadline: height/round must advance within this window
+    # (while not block/state syncing) or the node reports stalled
+    consensus_stall_timeout_ns: int = 30_000 * MS
+    # peer floor for the p2p check; 0 disables (single-node nets)
+    min_peers: int = 0
+    # a non-empty mempool that has not shrunk for this long is stalled
+    mempool_stall_timeout_ns: int = 60_000 * MS
+    # TPU degradation: more than this many CPU-fallback lanes inside the
+    # trailing window flags a fallback storm; 0 disables the storm check
+    fallback_storm_window_ns: int = 30_000 * MS
+    fallback_storm_threshold: int = 512
+    # spans longer than this count in tendermint_health_slow_spans_total;
+    # 0 disables the slow-span SLO scan
+    slow_span_threshold_ns: int = 1000 * MS
+
+
+@dataclass
 class BaseConfig:
     """config/config.go:158."""
 
@@ -193,6 +218,7 @@ class Config:
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     instrumentation: InstrumentationConfig = field(
         default_factory=InstrumentationConfig)
+    health: HealthConfig = field(default_factory=HealthConfig)
 
     def rooted(self, path: str) -> str:
         return os.path.join(os.path.expanduser(self.base.home), path)

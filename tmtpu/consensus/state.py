@@ -31,7 +31,7 @@ from tmtpu.consensus.types import (
 from tmtpu.consensus.wal import (
     EndHeightPB, EventRoundStatePB, MsgInfoPB, TimeoutInfoPB, WAL,
 )
-from tmtpu.libs import trace
+from tmtpu.libs import timeline, trace
 from tmtpu.libs.service import BaseService
 from tmtpu.types import pb
 from tmtpu.types.block import BlockID, Commit
@@ -520,6 +520,7 @@ class ConsensusState(BaseService):
             validators.increment_proposer_priority(round - rs.round)
         rs.round = round
         rs.step = STEP_NEW_ROUND
+        timeline.record(height, "consensus.enter_new_round", round=round)
         rs.validators = validators
         if round != 0:
             # round 0 keeps the proposal from NewHeight; later rounds reset
@@ -551,6 +552,7 @@ class ConsensusState(BaseService):
             return
         rs.round = round
         rs.step = STEP_PROPOSE
+        timeline.record(height, "consensus.enter_propose", round=round)
         self._new_step()
         # propose-step timeout -> prevote nil
         self.ticker.schedule_timeout(TimeoutInfo(
@@ -629,6 +631,7 @@ class ConsensusState(BaseService):
             return
         rs.round = round
         rs.step = STEP_PREVOTE
+        timeline.record(height, "consensus.enter_prevote", round=round)
         self._new_step()
         # sign and broadcast prevote (defaultDoPrevote :1252)
         if rs.locked_block is not None:
@@ -668,6 +671,7 @@ class ConsensusState(BaseService):
             return
         rs.round = round
         rs.step = STEP_PRECOMMIT
+        timeline.record(height, "consensus.enter_precommit", round=round)
         self._new_step()
         prevotes = rs.votes.prevotes(round)
         block_id, has_polka = (prevotes.two_thirds_majority()
@@ -750,6 +754,8 @@ class ConsensusState(BaseService):
         rs.step = STEP_COMMIT
         rs.commit_round = commit_round
         rs.commit_time = time.time_ns()
+        timeline.record(height, "consensus.enter_commit",
+                        round=commit_round)
         self._new_step()
         precommits = rs.votes.precommits(commit_round)
         block_id, ok = precommits.two_thirds_majority()
@@ -821,6 +827,8 @@ class ConsensusState(BaseService):
                 pass
         self._record_metrics(block, rs.proposal_block_parts,
                              rs.commit_round, new_state)
+        timeline.record(height, "consensus.finalize_commit",
+                        round=rs.commit_round, txs=len(block.txs))
         self.update_to_state(new_state)
         self._schedule_round0()
         self._done_first_block.set()
@@ -878,6 +886,8 @@ class ConsensusState(BaseService):
                 proposal.sign_bytes(self.state.chain_id), proposal.signature):
             raise VoteError("error invalid proposal signature")
         rs.proposal = proposal
+        timeline.record(rs.height, timeline.EVENT_PROPOSAL_RECEIVED,
+                        round=rs.round)
         if rs.proposal_block_parts is None:
             rs.proposal_block_parts = PartSet(
                 proposal.block_id.parts_total, proposal.block_id.parts_hash)

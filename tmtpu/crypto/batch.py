@@ -168,6 +168,10 @@ class CPUBatchVerifier(BatchVerifier):
             _m.observe_crypto_batch(c, "cpu",
                                     impl if c == ED25519 else "serial",
                                     n, 0, dt)
+        from tmtpu.libs import timeline as _tl
+
+        _tl.record_flush(backend="cpu", lanes=len(self._items),
+                         ok=sum(mask), seconds=round(dt, 6))
         return all(mask), mask
 
 
@@ -206,8 +210,11 @@ class TPUBatchVerifier(BatchVerifier):
         return self._run(tally=True)
 
     def _run(self, tally: bool) -> Tuple[bool, List[bool], int]:
+        import time as _time
+
         from tmtpu.libs import metrics as _m
 
+        t0 = _time.perf_counter()
         (ed_idx, ed_pks, ed_msgs, ed_sigs, ed_powers,
          sr_idx, k1_idx, cpu_idx) = self._split()
         if cpu_idx:
@@ -274,6 +281,11 @@ class TPUBatchVerifier(BatchVerifier):
                 dev_mask = tv.batch_verify(ed_pks, ed_msgs, ed_sigs)
                 for j, i in enumerate(ed_idx):
                     mask[i] = bool(dev_mask[j])
+        from tmtpu.libs import timeline as _tl
+
+        _tl.record_flush(backend="tpu", lanes=len(self._items),
+                         ok=sum(mask),
+                         seconds=round(_time.perf_counter() - t0, 6))
         return all(mask), mask, tallied
 
 

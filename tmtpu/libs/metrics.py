@@ -281,6 +281,38 @@ mempool_size = DEFAULT.gauge("mempool", "size",
                              "Number of uncommitted txs")
 
 
+# --- the node health engine metric set (libs/watchdog.py) -------------------
+#
+# Written by Watchdog.check_now on every evaluation pass; the per-check
+# gauges mirror the /healthz payload so a scraper sees the same verdict
+# an operator's curl does.
+
+health_up = DEFAULT.gauge(
+    "health", "up",
+    "1 when every watchdog check passes, 0 when any is unhealthy")
+health_check_up = DEFAULT.gauge(
+    "health", "check_up",
+    "Per-check watchdog verdict (1 healthy, 0 unhealthy)",
+    labels=("check",))
+health_stalls = DEFAULT.counter(
+    "health", "stalls_total",
+    "Watchdog checks that transitioned healthy -> unhealthy",
+    labels=("check",))
+health_watchdog_ticks = DEFAULT.counter(
+    "health", "watchdog_ticks_total", "Watchdog evaluation passes")
+health_slow_spans = DEFAULT.counter(
+    "health", "slow_spans_total",
+    "Trace spans whose duration exceeded the slow-span SLO threshold",
+    labels=("span",))
+
+# libs/sync.py deadlock-detection reports (one per acquisition that
+# blocked past the watched-lock timeout)
+sync_lock_stall = DEFAULT.counter(
+    "sync", "lock_stall_total",
+    "Lock acquisitions that exceeded the deadlock-detection timeout",
+    labels=("lock",))
+
+
 # --- the crypto batch-verify pipeline metric set ----------------------------
 #
 # Observed at every batch call site: the per-curve device paths

@@ -7,7 +7,8 @@ deadlock detection is enabled (env ``TMTPU_DEADLOCK=1`` or
 ``enable_deadlock_detection()``), in which case every acquisition is
 watched: if a lock cannot be acquired within the timeout (default 30 s,
 ``TMTPU_DEADLOCK_TIMEOUT`` seconds), a report with the blocked thread's
-and the holder's stacks goes to stderr — the same observability
+and the holder's stacks goes through the structured logger and counts
+in ``tendermint_sync_lock_stall_total`` — the same observability
 go-deadlock gives — and acquisition then proceeds to block normally.
 Zero overhead when disabled (the factory returns raw threading.Lock).
 """
@@ -67,8 +68,13 @@ class _WatchedLock:
         self._lock.release()
 
     def _report(self):
+        # structured logger + counter, not raw stderr: a stalled lock is
+        # an operational event (tendermint_sync_lock_stall_total) first
+        # and a wall of stacks second
+        from tmtpu.libs import log, metrics
+
+        metrics.sync_lock_stall.inc(lock=self.name)
         lines = [
-            f"POSSIBLE DEADLOCK: {self.name} not acquired in {_timeout}s",
             f"blocked thread {threading.current_thread().name}:",
             "".join(traceback.format_stack(limit=12)),
             f"held by thread {self._holder}; acquired at:",
@@ -79,7 +85,9 @@ class _WatchedLock:
         for tid, frame in sys._current_frames().items():
             lines.append(f"  thread {tid} [{names.get(tid, '?')}]:")
             lines.append("".join(traceback.format_stack(frame, limit=6)))
-        print("\n".join(lines), file=sys.stderr)
+        log.default_logger().with_fields(module="sync").error(
+            "POSSIBLE DEADLOCK", lock=self.name, timeout_s=_timeout,
+            holder_thread=self._holder, stacks="\n".join(lines))
 
     def __enter__(self):
         self.acquire()

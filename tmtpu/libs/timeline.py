@@ -1,0 +1,179 @@
+"""Per-height round timeline journal — the node-wide stall diagnostic.
+
+A bounded ring of per-height event records answering "which step dragged
+at height H": proposal received, prevote/precommit quorum crossings,
+batch-verify flushes, the consensus step entries, commit, and
+ApplyBlock. Fed by hooks in consensus/state.py, types/vote_set.py,
+crypto/batch.py, and state/execution.py; exported via the ``timeline``
+JSON-RPC method (rpc/core.py) and ``GET /debug/timeline`` on the pprof
+server (rpc/pprof.py).
+
+Recording is lock-guarded and allocation-light (one small dict per
+event, capped per height) — cheap enough to leave on permanently, like
+libs/trace. Unlike the span ring, which evicts by span count across the
+whole process, the timeline evicts whole heights FIFO so the most
+recent ``capacity`` heights always have their complete step breakdown.
+
+Consensus step events reuse the trace span names verbatim
+(``consensus.enter_prevote`` etc.) so a timeline record and its span
+always correlate; ``tools/check_timeline.py`` lints that every
+``consensus.*`` event name recorded here has a matching
+``trace.traced``/``trace.span`` literal in the tree.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+_DEFAULT_CAPACITY = int(os.environ.get("TMTPU_TIMELINE_CAPACITY", "128"))
+
+# events per height are capped so a byzantine flood of proposals/votes
+# cannot grow one record without bound; overflow counts, never blocks
+_MAX_EVENTS_PER_HEIGHT = 512
+
+# the consensus step entries recorded by consensus/state.py — MUST stay
+# equal to the trace span names on the @trace.traced step functions
+# (tools/check_timeline.py enforces this)
+CONSENSUS_STEP_EVENTS = (
+    "consensus.enter_new_round",
+    "consensus.enter_propose",
+    "consensus.enter_prevote",
+    "consensus.enter_precommit",
+    "consensus.enter_commit",
+    "consensus.finalize_commit",
+)
+
+# the non-step events the other hook sites record
+EVENT_PROPOSAL_RECEIVED = "proposal.received"
+EVENT_PREVOTE_QUORUM = "quorum.prevote"
+EVENT_PRECOMMIT_QUORUM = "quorum.precommit"
+EVENT_BATCH_FLUSH = "crypto.batch_flush"
+EVENT_APPLY_BLOCK = "state.apply_block"
+
+
+class Timeline:
+    """Bounded per-height event journal. All methods are thread-safe."""
+
+    def __init__(self, capacity: int = _DEFAULT_CAPACITY):
+        self.capacity = max(1, capacity)
+        self._heights: "OrderedDict[int, Dict]" = OrderedDict()
+        self._lock = threading.Lock()
+        self._current_height = 0
+        self._dropped = 0
+        self._enabled = True
+        self._last: Optional[Dict] = None  # most recent event overall
+
+    # -- recording ----------------------------------------------------------
+
+    def record(self, height: int, event: str, round: int = 0,
+               **attrs) -> None:
+        """Append one event to ``height``'s record. ``height <= 0`` is
+        silently ignored (callers that don't know the height yet)."""
+        if not self._enabled or height <= 0:
+            return
+        ev = {"event": event, "round": int(round), "t": time.time()}
+        if attrs:
+            ev.update(attrs)
+        with self._lock:
+            rec = self._heights.get(height)
+            if rec is None:
+                rec = {"height": height, "first_seen": ev["t"],
+                       "events": [], "overflow": 0}
+                self._heights[height] = rec
+                while len(self._heights) > self.capacity:
+                    self._heights.popitem(last=False)
+                    self._dropped += 1
+            if len(rec["events"]) >= _MAX_EVENTS_PER_HEIGHT:
+                rec["overflow"] += 1
+            else:
+                rec["events"].append(ev)
+            if height > self._current_height:
+                self._current_height = height
+            self._last = {"height": height, **ev}
+
+    def record_flush(self, **attrs) -> None:
+        """Batch-verify flush hook: crypto/batch.py has no height in
+        scope, so the flush lands on the timeline's current height."""
+        self.record(self._current_height, EVENT_BATCH_FLUSH, **attrs)
+
+    # -- reading ------------------------------------------------------------
+
+    def current_height(self) -> int:
+        with self._lock:
+            return self._current_height
+
+    def snapshot(self, height: Optional[int] = None,
+                 last: int = 20) -> List[Dict]:
+        """Per-height records, oldest first. ``height`` selects one
+        height; otherwise the most recent ``last`` heights."""
+        with self._lock:
+            if height is not None:
+                rec = self._heights.get(height)
+                recs = [rec] if rec is not None else []
+            else:
+                recs = list(self._heights.values())[-max(0, last):]
+            # deep-enough copy: events dicts are never mutated after append
+            return [{"height": r["height"], "first_seen": r["first_seen"],
+                     "overflow": r["overflow"],
+                     "events": list(r["events"])} for r in recs]
+
+    def last_event(self) -> Optional[Dict]:
+        """The most recent event anywhere, with its age — the watchdog's
+        'which step stalled' answer."""
+        with self._lock:
+            if self._last is None:
+                return None
+            out = dict(self._last)
+        out["age_s"] = round(max(0.0, time.time() - out["t"]), 6)
+        return out
+
+    def summary(self) -> Dict:
+        with self._lock:
+            return {"heights": len(self._heights),
+                    "current_height": self._current_height,
+                    "capacity": self.capacity,
+                    "dropped_heights": self._dropped,
+                    "enabled": self._enabled}
+
+    # -- control ------------------------------------------------------------
+
+    def set_enabled(self, enabled: bool) -> None:
+        self._enabled = bool(enabled)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._heights.clear()
+            self._current_height = 0
+            self._dropped = 0
+            self._last = None
+
+
+DEFAULT = Timeline()
+
+
+def record(height: int, event: str, round: int = 0, **attrs) -> None:
+    DEFAULT.record(height, event, round=round, **attrs)
+
+
+def record_flush(**attrs) -> None:
+    DEFAULT.record_flush(**attrs)
+
+
+def snapshot(height: Optional[int] = None, last: int = 20) -> List[Dict]:
+    return DEFAULT.snapshot(height=height, last=last)
+
+
+def last_event() -> Optional[Dict]:
+    return DEFAULT.last_event()
+
+
+def summary() -> Dict:
+    return DEFAULT.summary()
+
+
+def set_enabled(enabled: bool) -> None:
+    DEFAULT.set_enabled(enabled)

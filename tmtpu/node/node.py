@@ -349,12 +349,57 @@ class Node(BaseService):
             self.grpc_api_server = BroadcastAPIServer(
                 config.rpc.grpc_laddr, routes["broadcast_tx_commit"])
 
+        # --- health engine: stall watchdog + liveness/readiness ---
+        self.watchdog = None
+        if config.health.enable:
+            self.watchdog = self._build_watchdog(config.health)
+
         # --- pprof (node.go:894-900: gated on RPC.PprofListenAddress) ---
         self.pprof_server = None
         if config.rpc.pprof_laddr:
             from tmtpu.rpc.pprof import PprofServer
 
-            self.pprof_server = PprofServer(config.rpc.pprof_laddr)
+            self.pprof_server = PprofServer(
+                config.rpc.pprof_laddr,
+                health=self.watchdog.liveness if self.watchdog else None,
+                ready=self._readiness if self.watchdog else None)
+
+    def _build_watchdog(self, hc):
+        """Wire the libs/watchdog checks to this node's subsystems:
+        consensus progress, p2p peer floor, mempool drain,
+        blocksync/statesync status, and the TPU crypto backend."""
+        from tmtpu.libs import watchdog as wdg
+
+        wd = wdg.Watchdog(
+            interval_s=hc.watchdog_interval_ns / 1e9,
+            slow_span_threshold_s=hc.slow_span_threshold_ns / 1e9)
+        wd.register("consensus", wdg.consensus_progress_check(
+            self.consensus, hc.consensus_stall_timeout_ns / 1e9,
+            is_syncing=lambda: self.fast_sync or self.state_sync))
+        if self.switch is not None and hc.min_peers > 0:
+            wd.register("p2p", wdg.peer_count_check(
+                self.switch.num_peers, hc.min_peers))
+        if self.mempool is not None:
+            wd.register("mempool", wdg.mempool_drain_check(
+                self.mempool, hc.mempool_stall_timeout_ns / 1e9))
+        wd.register("sync", wdg.sync_status_check(
+            lambda: self.fast_sync, lambda: self.state_sync))
+        if self.config.base.crypto_backend != "cpu":
+            wd.register("crypto", wdg.tpu_backend_check(
+                hc.fallback_storm_window_ns / 1e9,
+                hc.fallback_storm_threshold,
+                expect_device=self.config.base.crypto_backend == "tpu"))
+        return wd
+
+    def _readiness(self):
+        """/readyz verdict: live AND caught up. A syncing node is
+        healthy (the watchdog gives sync a pass) but must not take
+        traffic yet."""
+        ok, reasons = self.watchdog.healthy()
+        syncing = self.fast_sync or self.state_sync
+        ready = ok and not syncing
+        return ready, {"ready": ready, "syncing": syncing,
+                       "reasons": reasons}
 
     def _make_state_provider(self):
         """stateprovider.go:48 — light client over the configured RPC
@@ -447,8 +492,12 @@ class Node(BaseService):
             self.grpc_api_server.start()
         if self.pprof_server is not None:
             self.pprof_server.start()
+        if self.watchdog is not None:
+            self.watchdog.start()
 
     def on_stop(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.stop()
         if self.pprof_server is not None:
             self.pprof_server.stop()
         if self.grpc_api_server is not None:

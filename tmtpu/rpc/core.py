@@ -651,9 +651,38 @@ def build_routes(env: Environment) -> dict:
 
         return {"metrics": _m.summary(), "traces": _t.summary()}
 
+    def timeline(height=None, last="20"):
+        """Per-height round timeline journal (libs/timeline): proposal
+        arrival, quorum crossings, batch-verify flushes, step entries,
+        commit, ApplyBlock — the 'which step dragged' diagnostic. The
+        ``last_event`` field names the most recent step anywhere, which
+        on a stalled node IS the step that stalled."""
+        from tmtpu.libs import timeline as _tl
+
+        return {
+            "summary": _tl.summary(),
+            "last_event": _tl.last_event(),
+            "heights": _tl.snapshot(
+                height=int(height) if height is not None else None,
+                last=int(last)),
+        }
+
+    def health_detail():
+        """Aggregated watchdog verdicts (libs/watchdog): consensus
+        progress, p2p peer count, mempool drain, blocksync/statesync
+        status, and the TPU crypto backend. ``health`` stays the
+        reference's empty-on-OK probe; this is the diagnosis."""
+        wd = getattr(node, "watchdog", None)
+        if wd is None:
+            return {"healthy": True, "watchdog": "disabled", "checks": {}}
+        ok, reasons = wd.healthy()
+        return {"healthy": ok, "reasons": reasons,
+                "checks": wd.verdicts()}
+
     return {
         "health": health, "status": status, "genesis": genesis,
-        "metrics": metrics,
+        "metrics": metrics, "timeline": timeline,
+        "health_detail": health_detail,
         "genesis_chunked": genesis_chunked, "check_tx": check_tx,
         "net_info": net_info, "blockchain": blockchain, "block": block,
         "block_by_hash": block_by_hash, "block_results": block_results,

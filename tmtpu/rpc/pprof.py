@@ -15,9 +15,20 @@ Python-native equivalents of the Go pprof profiles:
                              trace-event JSON; ?format=jsonl for line-
                              delimited spans, ?keep=1 to snapshot without
                              draining
+    /debug/timeline          per-height round timeline journal
+                             (libs/timeline) as JSON; ?height=H for one
+                             height, ?last=N for the trailing window
+    /metrics                 Prometheus text exposition (libs/metrics) —
+                             the scrape target standard collectors expect
+    /healthz                 liveness: 200 when every watchdog check
+                             passes, 503 + JSON reasons when stalled
+    /readyz                  readiness: 200 when live AND caught up
+                             (not block/state syncing), else 503
 
 Started by the node when ``rpc.pprof_laddr`` is set; also used by
-`tmtpu debug dump`.
+`tmtpu debug dump`. The health/ready verdicts come from callables the
+node wires in (``health=`` / ``ready=``) — without them the probes
+answer 200 with ``{"watchdog": "disabled"}``.
 """
 
 from __future__ import annotations
@@ -99,21 +110,59 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *a):  # quiet
         pass
 
+    def _probe(self, source, default_payload):
+        """(status, body) for /healthz//readyz: 200 when the wired-in
+        verdict callable passes (or none is wired), 503 with the JSON
+        reasons otherwise."""
+        if source is None:
+            return 200, json.dumps(default_payload)
+        ok, payload = source()
+        return (200 if ok else 503), json.dumps(payload)
+
     def do_GET(self):
         url = urlparse(self.path)
         q = parse_qs(url.query)
         path = url.path.rstrip("/")
         ctype = "text/plain; charset=utf-8"
+        status = 200
         try:
             if path in ("", "/debug/pprof"):
                 body = ("pprof endpoints: goroutine, heap, "
                         "profile?seconds=N, cmdline; trace drain at "
-                        "/debug/traces[?format=jsonl][&keep=1]\n")
+                        "/debug/traces[?format=jsonl][&keep=1]; timeline "
+                        "at /debug/timeline; /metrics, /healthz, /readyz\n")
             elif path == "/debug/traces":
                 body, ctype = render_traces(
                     fmt=q.get("format", ["chrome"])[0],
                     keep=q.get("keep", ["0"])[0] not in ("0", "", "false"),
                 )
+            elif path == "/debug/timeline":
+                from tmtpu.libs import timeline
+
+                h = q.get("height", [None])[0]
+                body = json.dumps({
+                    "summary": timeline.summary(),
+                    "last_event": timeline.last_event(),
+                    "heights": timeline.snapshot(
+                        height=int(h) if h is not None else None,
+                        last=int(q.get("last", ["20"])[0])),
+                })
+                ctype = "application/json"
+            elif path == "/metrics":
+                from tmtpu.libs import metrics
+
+                body = metrics.render_prometheus()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif path == "/healthz":
+                status, body = self._probe(
+                    getattr(self.server, "health_source", None),
+                    {"healthy": True, "watchdog": "disabled"})
+                ctype = "application/json"
+            elif path == "/readyz":
+                status, body = self._probe(
+                    getattr(self.server, "ready_source", None),
+                    {"ready": True, "watchdog": "disabled"})
+                ctype = "application/json"
             elif path.endswith("/goroutine"):
                 body = thread_stacks()
             elif path.endswith("/heap"):
@@ -130,7 +179,7 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_error(500, str(e))
             return
         data = body.encode()
-        self.send_response(200)
+        self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
@@ -138,11 +187,16 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class PprofServer:
-    def __init__(self, laddr: str):
+    def __init__(self, laddr: str, health=None, ready=None):
+        """``health``/``ready``: callables returning (ok, json-able
+        payload) — back /healthz and /readyz (node/node.py wires the
+        watchdog's liveness and the sync-aware readiness here)."""
         host, _, port = laddr.replace("tcp://", "").rpartition(":")
         self.httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port)),
                                          _Handler)
         self.httpd.daemon_threads = True
+        self.httpd.health_source = health
+        self.httpd.ready_source = ready
         self._thread: threading.Thread | None = None
 
     @property

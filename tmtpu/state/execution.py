@@ -81,8 +81,11 @@ class BlockExecutor:
     def apply_block(self, state: State, block_id: BlockID, block: Block
                     ) -> Tuple[State, int]:
         """execution.go:131 ApplyBlock. Returns (new_state, retain_height)."""
+        import time as _time
+
         from tmtpu.libs import fail
 
+        t0 = _time.perf_counter()
         self.validate_block(state, block)
         abci_responses = self._exec_block_on_proxy_app(state, block)
         fail.fail_point()  # execution.go:149 — after exec, before saving
@@ -116,6 +119,11 @@ class BlockExecutor:
 
         if self.event_bus:
             self._fire_events(block, block_id, abci_responses, val_updates)
+        from tmtpu.libs import timeline
+
+        timeline.record(block.header.height, timeline.EVENT_APPLY_BLOCK,
+                        txs=len(block.txs),
+                        seconds=round(_time.perf_counter() - t0, 6))
         return new_state, retain_height
 
     def _exec_block_on_proxy_app(self, state: State, block: Block

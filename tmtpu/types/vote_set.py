@@ -18,7 +18,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from tmtpu.crypto import batch as crypto_batch
-from tmtpu.libs import trace
+from tmtpu.libs import timeline, trace
 from tmtpu.libs.bits import BitArray
 from tmtpu.types.block import BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT, \
     BLOCK_ID_FLAG_NIL, BlockID, Commit, CommitSig
@@ -297,6 +297,15 @@ class VoteSet:
             self._maj23 = BlockID(vote.block_id.hash,
                                   vote.block_id.parts_total,
                                   vote.block_id.parts_hash)
+            # quorum-crossing timestamp for the per-height timeline: the
+            # prevote/precommit 2/3 instant is exactly the per-round
+            # timing the stall diagnostics need
+            timeline.record(
+                self.height,
+                timeline.EVENT_PRECOMMIT_QUORUM
+                if self.signed_msg_type == PRECOMMIT
+                else timeline.EVENT_PREVOTE_QUORUM,
+                round=self.round, power=bv.sum, quorum=quorum)
             # copy the winning block's votes over to the main array
             for i, v in enumerate(bv.votes):
                 if v is not None:

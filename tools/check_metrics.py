@@ -1,14 +1,21 @@
 #!/usr/bin/env python
 """Dead-metric lint: every metric registered in tmtpu/libs/metrics.py
 must have at least one write site (``.inc(`` / ``.set(`` / ``.add(`` /
-``.observe(``) somewhere in the tree, and every write site must name a
-metric that actually exists.
+``.observe(``) somewhere in the tree (tmtpu/, tools/, tests/, bench.py),
+and every write site must name a metric that actually exists.
 
 A registered-but-never-written metric renders as a permanent zero on
 /metrics — it looks monitored while measuring nothing, which is worse
 than absent. A write to a metric attribute that was renamed away raises
 AttributeError only on the (possibly rare) code path that hits it; this
 lint catches both statically.
+
+It also fails on metrics registered but never rendered: a Counter /
+Gauge / Histogram constructed directly (outside the DEFAULT registry's
+factory methods) accepts writes forever but never appears in
+``render_prometheus()`` — from a scraper's point of view it does not
+exist. Every tendermint metric must go through
+``DEFAULT.counter/gauge/histogram`` so /metrics serves it.
 
 Run directly (``python tools/check_metrics.py``) or through the tier-1
 suite (tests/test_check_metrics.py). Exit 0 = clean, 1 = findings.
@@ -54,6 +61,32 @@ def _iter_source_files():
                     yield os.path.join(root, f)
 
 
+# metric objects must come from the registry factories (lowercase
+# .counter/.gauge/.histogram); a direct class construction outside
+# libs/metrics.py itself (and tests, which build throwaway registries)
+# is never rendered on /metrics
+_DIRECT_CTOR = re.compile(
+    r"\b(?:metrics\.)?(Counter|Gauge|Histogram)\(\s*[\"']")
+
+_CTOR_EXEMPT = (os.path.join("tmtpu", "libs", "metrics.py"), "tests")
+
+
+def _unrendered_constructions():
+    """(file, class) pairs for metric objects built outside the DEFAULT
+    registry — registered in the author's head, never rendered."""
+    out = []
+    for path in _iter_source_files():
+        rel = os.path.relpath(path, REPO)
+        if rel.startswith(_CTOR_EXEMPT[1] + os.sep) or \
+                rel == _CTOR_EXEMPT[0]:
+            continue
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+        for m in _DIRECT_CTOR.finditer(src):
+            out.append((rel, m.group(1)))
+    return out
+
+
 def check() -> list:
     """Returns a list of human-readable findings (empty = clean)."""
     attrs = _metric_attrs()
@@ -80,6 +113,11 @@ def check() -> list:
         findings.append(
             f"unknown metric: {name} is written in {path} but not "
             f"registered in tmtpu/libs/metrics.py")
+    for rel, cls in sorted(_unrendered_constructions()):
+        findings.append(
+            f"unrendered metric: {rel} constructs a {cls} directly — it "
+            f"bypasses the DEFAULT registry and never appears on "
+            f"/metrics; use DEFAULT.{cls.lower()}(...)")
     return findings
 
 
