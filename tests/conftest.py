@@ -21,3 +21,7 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running integration tests (TPU graph on CPU)"
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection / crash-recovery tests (libs/faultinject)"
+    )

@@ -1,0 +1,76 @@
+"""Tier-1 wiring for the fault-site lint (tools/check_failpoints.py):
+the tree must stay clean — every registered site unique and exercised
+by at least one test — and the lint must actually detect the failure
+modes it claims to (mirrors tests/test_check_metrics.py)."""
+
+import os
+
+from tools import check_failpoints
+
+
+def test_tree_is_clean():
+    assert check_failpoints.check() == []
+
+
+def test_catalog_has_the_expected_sites():
+    registered, ensured = check_failpoints.collect_sites()
+    known = set(registered) | set(ensured)
+    # the tentpole's injection surface: TPU verify entries, the WAL
+    # append path, and the ABCI commit boundary must stay cataloged
+    for name in ("tpu.ed25519.batch", "tpu.sr25519.batch",
+                 "tpu.secp256k1.batch", "wal.write", "abci.commit"):
+        assert name in known, name
+
+
+def test_lint_detects_duplicate_registration(tmp_path, monkeypatch):
+    pkg = tmp_path / "tmtpu"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(
+        "from tmtpu.libs import faultinject\n"
+        "S1 = faultinject.register('dupe.site')\n")
+    (pkg / "b.py").write_text(
+        "from tmtpu.libs import faultinject\n"
+        "S2 = faultinject.register('dupe.site')\n")
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    (tests / "t.py").write_text("# exercises 'dupe.site'\n")
+    monkeypatch.setattr(check_failpoints, "REPO", str(tmp_path))
+    findings = check_failpoints.check()
+    assert any("duplicate fault site 'dupe.site'" in f for f in findings), \
+        findings
+
+
+def test_lint_detects_register_ensure_name_clash(tmp_path, monkeypatch):
+    pkg = tmp_path / "tmtpu"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(
+        "from tmtpu.libs import faultinject, fail\n"
+        "S = faultinject.register('clash.site')\n"
+        "fail.fail_point('clash.site')\n")
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    (tests / "t.py").write_text("# exercises 'clash.site'\n")
+    monkeypatch.setattr(check_failpoints, "REPO", str(tmp_path))
+    findings = check_failpoints.check()
+    assert any("clash.site" in f and "also used as" in f
+               for f in findings), findings
+
+
+def test_lint_detects_untested_site(tmp_path, monkeypatch):
+    pkg = tmp_path / "tmtpu"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(
+        "from tmtpu.libs import faultinject\n"
+        "S = faultinject.register('lonely.site')\n")
+    (tmp_path / "tests").mkdir()
+    monkeypatch.setattr(check_failpoints, "REPO", str(tmp_path))
+    findings = check_failpoints.check()
+    assert any("untested fault site 'lonely.site'" in f
+               and os.path.join("tmtpu", "a.py") in f
+               for f in findings), findings
+
+
+def test_main_exit_codes(capsys):
+    assert check_failpoints.main() == 0
+    out = capsys.readouterr().out
+    assert "all unique and tested" in out

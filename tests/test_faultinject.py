@@ -1,0 +1,209 @@
+"""libs/faultinject.py — the named-site fault framework, plus its
+integration with libs/fail.py's named fail points (the consensus
+commit-window sites swept by the classic FAIL_TEST_INDEX crash tests).
+"""
+
+import time
+
+import pytest
+
+from tmtpu.libs import fail, faultinject
+from tmtpu.libs import metrics as _m
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv(faultinject.ENV_VAR, raising=False)
+    faultinject.reset()
+    yield
+    faultinject.reset()
+
+
+def _site(name):
+    """Idempotent handle: tests re-run in one process, register() would
+    raise on the second pass."""
+    return faultinject.ensure(name)
+
+
+def test_register_duplicate_raises():
+    faultinject.register("test.fi.dup")
+    with pytest.raises(ValueError, match="registered twice"):
+        faultinject.register("test.fi.dup")
+    # ensure() on the same name is fine (that's its whole point)
+    assert faultinject.ensure("test.fi.dup").name == "test.fi.dup"
+
+
+def test_fire_without_plan_is_noop_but_counts_hits():
+    s = _site("test.fi.idle")
+    base = s.hits
+    faultinject.fire(s)
+    faultinject.fire(s)
+    assert s.hits == base + 2
+
+
+def test_error_plan_fires_count_then_heals():
+    s = _site("test.fi.err")
+    faultinject.script("test.fi.err", faultinject.ERROR, count=2)
+    for _ in range(2):
+        with pytest.raises(faultinject.FaultInjected) as ei:
+            faultinject.fire(s)
+        assert ei.value.site == "test.fi.err"
+    assert "test.fi.err" not in faultinject.active()  # exhausted: healed
+    faultinject.fire(s)  # no raise
+    series = _m.fault_injected.summary_series()
+    assert series["site=test.fi.err,mode=error"] >= 2
+
+
+def test_after_skips_leading_hits():
+    s = _site("test.fi.after")
+    faultinject.script("test.fi.after", faultinject.ERROR, count=1, after=2)
+    faultinject.fire(s)
+    faultinject.fire(s)
+    with pytest.raises(faultinject.FaultInjected):
+        faultinject.fire(s)
+
+
+def test_latency_mode_sleeps_then_continues():
+    s = _site("test.fi.lat")
+    faultinject.script("test.fi.lat", faultinject.LATENCY, ms=50, count=1)
+    t0 = time.perf_counter()
+    faultinject.fire(s)  # sleeps, does not raise
+    assert time.perf_counter() - t0 >= 0.045
+    t0 = time.perf_counter()
+    faultinject.fire(s)  # plan exhausted
+    assert time.perf_counter() - t0 < 0.045
+
+
+def test_flaky_is_seeded_deterministic():
+    def verdicts(seed):
+        faultinject.script("test.fi.flaky", faultinject.FLAKY, p=0.5,
+                           seed=seed)
+        s = _site("test.fi.flaky")
+        out = []
+        for _ in range(20):
+            try:
+                faultinject.fire(s)
+                out.append(False)
+            except faultinject.FaultInjected:
+                out.append(True)
+        faultinject.clear("test.fi.flaky")
+        return out
+
+    a, b = verdicts(42), verdicts(42)
+    assert a == b
+    assert True in a and False in a  # p=0.5 over 20 draws
+    assert verdicts(43) != a
+
+
+def test_clear_deactivates():
+    s = _site("test.fi.clear")
+    faultinject.script("test.fi.clear", faultinject.ERROR)
+    faultinject.clear("test.fi.clear")
+    faultinject.fire(s)  # no raise
+    faultinject.script("test.fi.clear", faultinject.ERROR)
+    faultinject.clear()  # clear-all form
+    faultinject.fire(s)
+
+
+def test_env_spec_parsing_and_activation(monkeypatch):
+    monkeypatch.setenv(
+        faultinject.ENV_VAR,
+        "test.fi.env=error:count=2,after=1; test.fi.env2=latency:ms=5")
+    faultinject.reset()  # re-arm lazy env parsing
+    s = _site("test.fi.env")
+    faultinject.fire(s)  # after=1: first hit passes
+    with pytest.raises(faultinject.FaultInjected):
+        faultinject.fire(s)
+    plans = faultinject.active()
+    assert plans["test.fi.env2"]["latency_s"] == 0.005
+    assert plans["test.fi.env"]["fired"] == 1
+
+
+@pytest.mark.parametrize("spec", [
+    "justasite",                      # no mode
+    "site=explode",                   # unknown mode
+    "test.x=error:count=1,bogus=3",   # unknown option
+])
+def test_env_spec_rejects_typos(spec):
+    with pytest.raises(ValueError):
+        faultinject._parse_env_spec(spec)
+
+
+# --- integration with libs/fail.py named fail points -------------------------
+#
+# Every named fail_point doubles as a faultinject site; these drive the
+# real call path (fail.fail_point -> faultinject.fire) for the commit
+# window's crash sites, so TMTPU_FAULTS can target them by name in the
+# crash/replay tests without counting FAIL_TEST_INDEX ordinals.
+
+COMMIT_WINDOW_SITES = [
+    "cs.finalize.pre_save_block",
+    "cs.finalize.post_save_block",
+    "cs.finalize.post_endheight",
+    "cs.finalize.post_apply",
+    "exec.post_exec",
+    "exec.pre_app_commit",
+    "exec.post_app_commit",
+]
+
+
+@pytest.mark.parametrize("name", COMMIT_WINDOW_SITES)
+def test_named_fail_points_honor_scripted_plans(name):
+    fail.reset()
+    fail.fail_point(name)  # no plan: passes through
+    faultinject.script(name, faultinject.ERROR, count=1)
+    with pytest.raises(faultinject.FaultInjected):
+        fail.fail_point(name)
+    fail.fail_point(name)  # healed
+
+
+def test_commit_window_sites_are_the_real_ones():
+    """The names above must match the literals compiled into
+    consensus/state.py and state/execution.py — a rename there without
+    updating the chaos tests would silently stop injecting."""
+    import tools.check_failpoints as cf
+
+    registered, ensured = cf.collect_sites()
+    known = set(registered) | set(ensured)
+    for name in COMMIT_WINDOW_SITES:
+        assert name in known, name
+
+
+def test_abci_commit_site_fires_inside_block_executor():
+    """The 'abci.commit' site sits between mempool lock and
+    proxy_app.commit_sync in BlockExecutor._commit — a scripted error
+    there must surface from _commit with the mempool unlocked again."""
+    # the execution import chain reaches crypto/secp256k1.py, which needs
+    # the optional `cryptography` package (same gate as test_replay.py)
+    pytest.importorskip("cryptography")
+    from tmtpu.state import execution
+
+    class Mempool:
+        def __init__(self):
+            self.locked = False
+
+        def lock(self):
+            self.locked = True
+
+        def unlock(self):
+            self.locked = False
+
+        def update(self, *a, **kw):
+            pass
+
+    class Block:
+        class header:
+            height = 1
+
+        txs = []
+
+    mp = Mempool()
+    ex = execution.BlockExecutor.__new__(execution.BlockExecutor)
+    ex.mempool = mp
+    ex.proxy_app = None  # must never be reached
+    faultinject.script("abci.commit", faultinject.ERROR, count=1)
+    with pytest.raises(faultinject.FaultInjected):
+        ex._commit(None, Block, [])
+    assert not mp.locked  # the finally: unlock ran

@@ -1,0 +1,232 @@
+"""Crash/corruption-hardened WAL recovery (consensus/wal.py).
+
+The contract under test (docs/RESILIENCE.md): a record extending past
+EOF is a TEAR — the crash signature — auto-truncated on open and never
+raised, even in strict mode; a COMPLETE record with a CRC mismatch,
+undecodable payload, bad length varint, or absurd length is CORRUPTION —
+left on disk by the repairer, reported through the ``status`` dict, and
+raised as CorruptedWALError by the strict replay path. The subprocess
+test injects a real crash (``TMTPU_FAULTS="wal.write=crash"``, exit 88)
+and proves a reopened node replays exactly the durable prefix.
+"""
+
+import os
+import struct
+import subprocess
+import sys
+import zlib
+
+import pytest
+
+from tmtpu.consensus.wal import WAL, CorruptedWALError, EndHeightPB
+from tmtpu.libs import faultinject, protoio
+from tmtpu.libs import metrics as _m
+
+pytestmark = pytest.mark.chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv(faultinject.ENV_VAR, raising=False)
+    faultinject.reset()
+    yield
+    faultinject.reset()
+
+
+def _write_wal(path, heights=(1, 2, 3)):
+    w = WAL(path)
+    for h in heights:
+        w.write_end_height(h)
+    w.close()
+    return os.path.getsize(path)
+
+
+def _heights(msgs):
+    return [m.end_height.height for m in msgs if m.end_height is not None]
+
+
+def _record_bytes(height=99):
+    payload = WAL.make(end_height=EndHeightPB(height=height)).encode()
+    return (struct.pack(">I", zlib.crc32(payload))
+            + protoio.encode_uvarint(len(payload)) + payload)
+
+
+# --- torn tails: repaired on open, silent in iteration ----------------------
+
+
+@pytest.mark.parametrize("tear", [
+    b"\x01\x02\x03",                                     # torn header (<5B)
+    struct.pack(">I", 0) + b"\xff",                      # torn length varint
+    lambda: _record_bytes()[:-4],                        # torn payload
+], ids=["torn-header", "torn-length", "torn-payload"])
+def test_torn_tail_truncated_on_open(tmp_path, tear):
+    path = str(tmp_path / "wal")
+    clean_size = _write_wal(path)
+    garbage = tear() if callable(tear) else tear
+    with open(path, "ab") as f:
+        f.write(garbage)
+    t0 = _m.wal_torn_tail_truncated.summary_series().get("", 0)
+
+    # opening for append repairs the tail back to the last good boundary
+    w = WAL(path)
+    assert os.path.getsize(path) == clean_size
+    assert _m.wal_torn_tail_truncated.summary_series()[""] == t0 + 1
+    # and the repaired log appends + replays normally
+    w.write_end_height(4)
+    w.close()
+    status = {}
+    msgs = list(WAL.iter_messages(path, strict=True, status=status))
+    assert _heights(msgs) == [1, 2, 3, 4]
+    assert status["clean"] and status["records"] == 4
+    assert status["skips"] == []
+
+
+def test_torn_tail_is_silent_even_in_strict_mode(tmp_path):
+    path = str(tmp_path / "wal")
+    _write_wal(path)
+    with open(path, "ab") as f:
+        f.write(_record_bytes()[:-4])
+    status = {}
+    # no repair ran (no reopen): strict iteration still must NOT raise —
+    # a tear is a crash signature, not corruption
+    msgs = list(WAL.iter_messages(path, strict=True, status=status))
+    assert _heights(msgs) == [1, 2, 3]
+    assert not status["clean"]
+    assert status["skips"][0]["reason"] == "torn-payload"
+    assert status["skipped_bytes"] > 0
+
+
+# --- corruption: never repaired, reported, strict raises --------------------
+
+
+def _corrupt_mid_file(path):
+    """Flip one payload byte of the SECOND record (file has >= 3)."""
+    with open(path, "rb") as f:
+        data = bytearray(f.read())
+    # walk to record 2's payload
+    pos = 0
+    for _ in range(1):
+        (_,) = struct.unpack_from(">I", data, pos)
+        length, pos = protoio.decode_uvarint(data, pos + 4)
+        pos += length
+    rec2 = pos
+    length, body = protoio.decode_uvarint(data, rec2 + 4)
+    data[body] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(data))
+    return rec2
+
+
+def test_mid_file_corruption_not_repaired_and_strict_raises(tmp_path):
+    path = str(tmp_path / "wal")
+    _write_wal(path)
+    size = os.path.getsize(path)
+    off = _corrupt_mid_file(path)
+
+    assert WAL.repair_torn_tail(path) == 0  # corruption is not a tear
+    assert os.path.getsize(path) == size
+
+    status = {}
+    msgs = list(WAL.iter_messages(path, status=status))
+    assert _heights(msgs) == [1]  # stops AT the corrupt record
+    assert not status["clean"]
+    assert status["records"] == 1
+    assert status["skips"] == [
+        {"file": path, "offset": off, "reason": "crc-mismatch"}]
+    assert status["skipped_bytes"] == size - off
+
+    with pytest.raises(CorruptedWALError, match="crc mismatch"):
+        list(WAL.iter_messages(path, strict=True))
+
+
+def test_oversize_length_is_corruption(tmp_path):
+    path = str(tmp_path / "wal")
+    _write_wal(path)
+    with open(path, "ab") as f:
+        f.write(struct.pack(">I", 0)
+                + protoio.encode_uvarint(64 * 1024 * 1024) + b"xx")
+    assert WAL.repair_torn_tail(path) == 0
+    status = {}
+    msgs = list(WAL.iter_messages(path, status=status))
+    assert _heights(msgs) == [1, 2, 3]
+    assert status["skips"][0]["reason"] == "oversize-length"
+    with pytest.raises(CorruptedWALError, match="absurd record length"):
+        list(WAL.iter_messages(path, strict=True))
+
+
+def test_bad_length_varint_is_corruption(tmp_path):
+    path = str(tmp_path / "wal")
+    _write_wal(path)
+    with open(path, "ab") as f:
+        # 12 continuation bytes: the varint overflows while bytes remain,
+        # so this is malformed data, not a tear
+        f.write(struct.pack(">I", 0) + b"\xff" * 12)
+    assert WAL.repair_torn_tail(path) == 0
+    status = {}
+    msgs = list(WAL.iter_messages(path, status=status))
+    assert _heights(msgs) == [1, 2, 3]
+    assert status["skips"][0]["reason"] == "bad-length-varint"
+    with pytest.raises(CorruptedWALError, match="bad length varint"):
+        list(WAL.iter_messages(path, strict=True))
+
+
+def test_empty_and_absent_files_are_clean(tmp_path):
+    path = str(tmp_path / "wal")
+    assert WAL.repair_torn_tail(path) == 0  # absent
+    status = {}
+    assert list(WAL.iter_messages(path, status=status)) == []
+    assert status["clean"] and status["records"] == 0
+    open(path, "wb").close()
+    assert WAL.repair_torn_tail(path) == 0  # empty
+    assert list(WAL.iter_messages(path, strict=True)) == []
+
+
+# --- fault injection on the append path -------------------------------------
+
+
+def test_wal_write_site_injects_and_heals(tmp_path):
+    path = str(tmp_path / "wal")
+    w = WAL(path)
+    w.write_end_height(1)
+    faultinject.script("wal.write", faultinject.ERROR, count=1)
+    with pytest.raises(faultinject.FaultInjected):
+        w.write_end_height(2)
+    w.write_end_height(2)  # healed
+    w.close()
+    assert _heights(WAL.iter_messages(path, strict=True)) == [1, 2]
+
+
+def test_crash_mid_append_subprocess_replays_durable_prefix(tmp_path):
+    """A REAL crash: the child node dies at the third append via
+    ``TMTPU_FAULTS="wal.write=crash:after=2"`` (os._exit(88), no
+    cleanup). The parent — the restarted node — must replay exactly the
+    two durable records and keep appending."""
+    path = str(tmp_path / "wal")
+    child = (
+        "import sys; sys.path.insert(0, sys.argv[1])\n"
+        "from tmtpu.consensus.wal import WAL\n"
+        "w = WAL(sys.argv[2])\n"
+        "for h in range(1, 6): w.write_end_height(h)\n"
+        "print('unreachable: crash site never fired')\n"
+    )
+    env = dict(os.environ,
+               TMTPU_FAULTS="wal.write=crash:after=2",
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", child, REPO, path],
+                          env=env, capture_output=True, text=True,
+                          timeout=120)
+    assert proc.returncode == faultinject.CRASH_EXIT_CODE, proc.stderr
+    assert "unreachable" not in proc.stdout
+
+    status = {}
+    msgs = list(WAL.iter_messages(path, strict=True, status=status))
+    assert _heights(msgs) == [1, 2]
+    assert status["records"] == 2
+
+    # restart: reopen (repairing any torn tail) and continue the log
+    w = WAL(path)
+    w.write_end_height(3)
+    w.close()
+    assert _heights(WAL.iter_messages(path, strict=True)) == [1, 2, 3]

@@ -181,6 +181,31 @@ class HealthConfig:
 
 
 @dataclass
+class CryptoConfig:
+    """Crypto-backend resilience knobs (crypto/batch.py + libs/breaker):
+    the TPU probe deadline, the per-batch device-call deadline, and the
+    circuit breaker that governs TPU→CPU fallback and recovery. The env
+    vars ``TMTPU_TPU_PROBE_TIMEOUT`` / ``TMTPU_TPU_BATCH_DEADLINE``
+    remain last-resort overrides (read at call time, not import time)."""
+
+    # availability-probe deadline: a tiny device batch must finish within
+    # this window or the probe counts as a breaker failure
+    probe_timeout_ns: int = 20_000 * MS
+    # per-batch deadline on device dispatch: a hung jax call past this
+    # falls back to CPU for that batch (and trips the breaker's failure
+    # counter). Generous because the FIRST dispatch includes XLA
+    # compilation (tens of seconds on big graphs); 0 disables.
+    batch_deadline_ns: int = 120_000 * MS
+    # consecutive failures before the breaker opens
+    breaker_failure_threshold: int = 3
+    # open-state backoff: base doubles per consecutive open, capped
+    breaker_backoff_base_ns: int = 5_000 * MS
+    breaker_backoff_max_ns: int = 300_000 * MS
+    # successful half-open probe batches required to close again
+    breaker_half_open_probes: int = 2
+
+
+@dataclass
 class BaseConfig:
     """config/config.go:158."""
 
@@ -219,6 +244,7 @@ class Config:
     instrumentation: InstrumentationConfig = field(
         default_factory=InstrumentationConfig)
     health: HealthConfig = field(default_factory=HealthConfig)
+    crypto: CryptoConfig = field(default_factory=CryptoConfig)
 
     def rooted(self, path: str) -> str:
         return os.path.join(os.path.expanduser(self.base.home), path)

@@ -12,14 +12,19 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import tomllib
 from typing import Any
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: same API under the old name
+    import tomli as tomllib
 
 from tmtpu.config.config import Config
 
 # section order mirrors the reference's template (base fields are top-level)
 _SECTIONS = ("base", "rpc", "p2p", "mempool", "consensus", "block_sync",
-             "state_sync", "storage", "tx_index", "instrumentation")
+             "state_sync", "storage", "tx_index", "instrumentation",
+             "health", "crypto")
 
 
 def _toml_value(v: Any) -> str:
@@ -141,3 +146,16 @@ def validate(cfg: Config) -> None:
             raise ValueError("state_sync requires trust_height > 0")
         if not cfg.state_sync.trust_hash:
             raise ValueError("state_sync requires trust_hash")
+    if cfg.crypto.probe_timeout_ns <= 0:
+        raise ValueError("crypto.probe_timeout_ns must be positive")
+    if cfg.crypto.batch_deadline_ns < 0:
+        raise ValueError("crypto.batch_deadline_ns cannot be negative")
+    if cfg.crypto.breaker_failure_threshold < 1:
+        raise ValueError("crypto.breaker_failure_threshold must be >= 1")
+    if cfg.crypto.breaker_half_open_probes < 1:
+        raise ValueError("crypto.breaker_half_open_probes must be >= 1")
+    if cfg.crypto.breaker_backoff_base_ns <= 0 or \
+            cfg.crypto.breaker_backoff_max_ns < \
+            cfg.crypto.breaker_backoff_base_ns:
+        raise ValueError("crypto breaker backoff must satisfy "
+                         "0 < base <= max")

@@ -809,17 +809,19 @@ class ConsensusState(BaseService):
         block_id, _ = precommits.two_thirds_majority()
         block, parts = rs.proposal_block, rs.proposal_block_parts
         self.block_exec.validate_block(self.state, block)
-        fail.fail_point()  # 0: before saving the block
+        fail.fail_point("cs.finalize.pre_save_block")  # 0
         seen_commit = precommits.make_commit()
         if self.block_store.height() < block.header.height:
             self.block_store.save_block(block, parts, seen_commit)
-        fail.fail_point()  # 1: block saved, WAL has no ENDHEIGHT yet
+        # 1: block saved, WAL has no ENDHEIGHT yet
+        fail.fail_point("cs.finalize.post_save_block")
         if self.wal is not None:
             self.wal.write_end_height(height)
-        fail.fail_point()  # 2: ENDHEIGHT written, app not yet committed
+        # 2: ENDHEIGHT written, app not yet committed
+        fail.fail_point("cs.finalize.post_endheight")
         new_state, retain_height = self.block_exec.apply_block(
             self.state, block_id, block)
-        fail.fail_point()  # 3: app committed, state saved
+        fail.fail_point("cs.finalize.post_apply")  # 3: app committed
         if retain_height > 0:
             try:
                 self.block_store.prune_blocks(retain_height)

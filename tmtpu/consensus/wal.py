@@ -5,6 +5,18 @@ processing, so a crashed node replays to exactly where it left off. Record
 format: crc32(payload) | uvarint len | payload, where payload is a
 WALMessage proto envelope. #ENDHEIGHT markers (EndHeightMessage) delimit
 heights for SearchForEndHeight (:231), like the reference.
+
+Crash hardening (docs/RESILIENCE.md): a crash mid-append leaves a *torn*
+record — one whose header or payload extends past EOF. That is the
+expected signature of power loss, never evidence of bad data, so opening
+a WAL auto-truncates a torn tail (``repair_torn_tail``, counted in
+``tendermint_wal_torn_tail_truncated_total``) and iteration stops there
+silently even in strict mode. *Corruption* — a COMPLETE record whose CRC
+mismatches, whose payload fails to decode, or whose declared length is
+absurd — can only come from bit rot or a software bug; strict mode
+(the replay path) raises ``CorruptedWALError`` for it, and non-strict
+iteration stops and reports the skip through the ``status`` dict
+(bytes counted in ``tendermint_wal_replay_skipped_bytes_total``).
 """
 
 from __future__ import annotations
@@ -16,8 +28,16 @@ import time
 import zlib
 from typing import Iterator, Optional, Tuple
 
-from tmtpu.libs import protoio
+from tmtpu.libs import faultinject, protoio
 from tmtpu.types import pb
+
+# chaos site on the append path: an injected crash here models power
+# loss mid-write, the exact scenario repair_torn_tail exists for
+_FAULT_WAL_WRITE = faultinject.register("wal.write")
+
+# a declared payload length beyond this is corruption, not a big record
+# (the WAL rotates at 10 MB, so no legitimate record approaches it)
+_MAX_RECORD_BYTES = 10 * 1024 * 1024
 
 
 class TimeoutInfoPB(pb.ProtoMessage):
@@ -87,8 +107,54 @@ class WAL:
         self.head_size_limit = head_size_limit
         self.max_group_files = max_group_files
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        # a crash mid-append leaves a torn trailing record; appending
+        # after it would bury the tear mid-file where it reads as
+        # corruption, so the tail is repaired BEFORE reopening for append
+        self.repair_torn_tail(path)
         self._f = open(path, "ab")
         self._lock = threading.Lock()
+
+    @staticmethod
+    def repair_torn_tail(path: str) -> int:
+        """Truncate an incomplete trailing record (crash mid-append).
+        Returns the number of bytes dropped (0 when the file is clean,
+        absent, or ends in real corruption — a COMPLETE record with a
+        CRC/decode problem is never touched: strict replay must still be
+        able to surface it as CorruptedWALError)."""
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            return 0
+        pos, n, good = 0, len(data), 0
+        while pos < n:
+            if n - pos < 5:
+                break  # torn header
+            (crc,) = struct.unpack_from(">I", data, pos)
+            hdr = pos + 4
+            try:
+                length, body = protoio.decode_uvarint(data, hdr)
+            except EOFError:
+                break  # torn length varint
+            except ValueError:
+                return 0  # malformed varint: corruption, not a tear
+            if length > _MAX_RECORD_BYTES:
+                return 0  # corruption (absurd length), not a tear
+            if n - body < length:
+                break  # torn payload
+            if zlib.crc32(data[body:body + length]) != crc:
+                return 0  # mid-file corruption: leave for strict replay
+            pos = body + length
+            good = pos
+        dropped = n - good
+        if dropped == 0:
+            return 0
+        with open(path, "r+b") as f:
+            f.truncate(good)
+        from tmtpu.libs import metrics as _m
+
+        _m.wal_torn_tail_truncated.inc()
+        return dropped
 
     @staticmethod
     def _group_files(path: str):
@@ -128,6 +194,7 @@ class WAL:
         self._f = open(self.path, "ab")
 
     def write(self, msg: WALMessagePB) -> None:
+        faultinject.fire(_FAULT_WAL_WRITE)
         payload = msg.encode()
         rec = struct.pack(">I", zlib.crc32(payload)) + \
             protoio.encode_uvarint(len(payload)) + payload
@@ -166,25 +233,52 @@ class WAL:
     # -- reading ------------------------------------------------------------
 
     @classmethod
-    def iter_messages(cls, path: str, strict: bool = False
+    def iter_messages(cls, path: str, strict: bool = False,
+                      status: Optional[dict] = None
                       ) -> Iterator[WALMessagePB]:
         """Decode records across the whole group (rotated files in order,
         then the head). A torn record in the HEAD terminates iteration
-        (crash tolerance); a torn record in a ROTATED file stops the whole
-        group there — yielding later files would hand replay a stream with
-        a silent gap."""
-        for p in cls._group_files(path):
-            status = {}
-            yield from cls._iter_one(p, strict, status)
-            if not status.get("clean"):
-                return
-        yield from cls._iter_one(path, strict)
+        (crash tolerance); a torn or corrupt record in a ROTATED file
+        stops the whole group there — yielding later files would hand
+        replay a stream with a silent gap.
 
-    @staticmethod
-    def _iter_one(path: str, strict: bool = False, status: dict = None
-                  ) -> Iterator[WALMessagePB]:
+        Tear vs corruption: a record extending past EOF is a TEAR (crash
+        signature — stop silently, never raise); a complete record with
+        a CRC mismatch, undecodable payload, or absurd length is
+        CORRUPTION (strict raises CorruptedWALError).
+
+        ``status``, when passed, is filled with the aggregate replay
+        report: ``records`` yielded, ``clean`` (no skip anywhere),
+        ``skipped_bytes``, and ``skips`` — a list of
+        ``{file, offset, reason}`` entries naming exactly where and why
+        iteration stopped early."""
         if status is None:
             status = {}
+        status.update(records=0, clean=True, skipped_bytes=0, skips=[])
+        for p in cls._group_files(path):
+            one: dict = {}
+            yield from cls._iter_one(p, strict, one, agg=status)
+            if not one.get("clean"):
+                return
+        yield from cls._iter_one(path, strict, agg=status)
+
+    @staticmethod
+    def _iter_one(path: str, strict: bool = False, status: dict = None,
+                  agg: dict = None) -> Iterator[WALMessagePB]:
+        if status is None:
+            status = {}
+
+        def skip(offset: int, reason: str, nbytes: int) -> None:
+            if agg is not None:
+                agg["clean"] = False
+                agg["skipped_bytes"] += nbytes
+                agg["skips"].append(
+                    {"file": path, "offset": offset, "reason": reason})
+            if nbytes > 0:
+                from tmtpu.libs import metrics as _m
+
+                _m.wal_skipped_bytes.inc(nbytes)
+
         try:
             f = open(path, "rb")
         except FileNotFoundError:
@@ -197,29 +291,50 @@ class WAL:
         while pos < n:
             start = pos
             if n - pos < 5:
-                return  # torn tail
+                skip(start, "torn-header", n - start)
+                return  # tear: never strict-raise
             (crc,) = struct.unpack_from(">I", data, pos)
             pos += 4
             try:
                 length, pos = protoio.decode_uvarint(data, pos)
-            except (EOFError, ValueError):
+            except EOFError:
+                skip(start, "torn-length", n - start)
+                return  # varint ran off EOF: tear
+            except ValueError as e:
+                # varint malformed with bytes still available:
+                # corruption, not a tear
+                skip(start, "bad-length-varint", n - start)
+                if strict:
+                    raise CorruptedWALError(
+                        f"bad length varint at offset {start}") from e
                 return
-            if length > 10 * 1024 * 1024 or n - pos < length:
-                if strict and start != n:
-                    raise CorruptedWALError(f"torn record at offset {start}")
+            if length > _MAX_RECORD_BYTES:
+                skip(start, "oversize-length", n - start)
+                if strict:
+                    raise CorruptedWALError(
+                        f"absurd record length {length} at offset {start}")
                 return
+            if n - pos < length:
+                skip(start, "torn-payload", n - start)
+                return  # tear: the record never finished hitting disk
             payload = data[pos:pos + length]
             pos += length
             if zlib.crc32(payload) != crc:
+                skip(start, "crc-mismatch", n - start)
                 if strict:
-                    raise CorruptedWALError(f"crc mismatch at offset {start}")
+                    raise CorruptedWALError(
+                        f"crc mismatch at offset {start}")
                 return
             try:
-                yield WALMessagePB.decode(payload)
+                msg = WALMessagePB.decode(payload)
             except Exception as e:
+                skip(start, "decode-error", n - start)
                 if strict:
                     raise CorruptedWALError(str(e)) from e
                 return
+            if agg is not None:
+                agg["records"] += 1
+            yield msg
         status["clean"] = True
 
     @classmethod

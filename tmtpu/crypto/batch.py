@@ -21,7 +21,10 @@ Backends:
   mask is exact for mixed valid/invalid batches.
 
 Backend selection: ``set_default_backend`` / config ``crypto.backend``;
-``auto`` probes for a usable jax device once and caches the answer.
+``auto`` probes for a usable jax device under the ``crypto.tpu`` circuit
+breaker — a transient probe failure no longer pins the node to CPU
+forever: the breaker opens after a few consecutive failures, backs off,
+and re-probes (libs/breaker.py, docs/RESILIENCE.md).
 """
 
 from __future__ import annotations
@@ -32,6 +35,7 @@ from typing import List, Optional, Tuple
 
 from tmtpu.crypto import keys
 from tmtpu.crypto.keys import PubKey
+from tmtpu.libs import breaker as _bk
 
 ED25519 = "ed25519"
 SR25519 = "sr25519"
@@ -43,7 +47,64 @@ _TPU_MIN_BATCH = int(os.environ.get("TMTPU_TPU_MIN_BATCH", "8"))
 
 _default_backend = os.environ.get("TMTPU_CRYPTO_BACKEND", "auto")
 _probe_lock = threading.Lock()
+# memo of the last SUCCESSFUL device probe (None = not yet probed /
+# last probe failed → re-probe when the breaker next allows it). Tests
+# monkeypatch this to True to force the device code path.
 _tpu_usable: Optional[bool] = None
+
+# the breaker governing every device touch from this module; one name so
+# probe failures and batch failures share the same failure budget
+BREAKER_NAME = "crypto.tpu"
+
+# defaults mirror config/config.py CryptoConfig; Node.__init__ overwrites
+# via configure() before the first verifier is built
+_probe_timeout_s = 20.0
+_batch_deadline_s = 120.0
+
+
+def _tpu_breaker() -> "_bk.CircuitBreaker":
+    return _bk.get(BREAKER_NAME)
+
+
+def configure(crypto_cfg) -> None:
+    """Apply a config/config.py ``CryptoConfig``: probe + per-batch
+    deadlines for this module, thresholds/backoff for the ``crypto.tpu``
+    breaker. Safe to call again on config reload."""
+    global _probe_timeout_s, _batch_deadline_s
+    _probe_timeout_s = crypto_cfg.probe_timeout_ns / 1e9
+    _batch_deadline_s = crypto_cfg.batch_deadline_ns / 1e9
+    _bk.configure(
+        BREAKER_NAME,
+        failure_threshold=crypto_cfg.breaker_failure_threshold,
+        backoff_base_s=crypto_cfg.breaker_backoff_base_ns / 1e9,
+        backoff_max_s=crypto_cfg.breaker_backoff_max_ns / 1e9,
+        half_open_probes=crypto_cfg.breaker_half_open_probes)
+
+
+def probe_timeout_s() -> float:
+    """The device-probe deadline. The env var is read at CALL time (it
+    was import-time before, which froze the value for the process) so
+    tests and operators can override without re-importing; config
+    (via ``configure``) provides the base value."""
+    raw = os.environ.get("TMTPU_TPU_PROBE_TIMEOUT", "")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    return _probe_timeout_s
+
+
+def batch_deadline_s() -> float:
+    """Per-batch deadline on device dispatch (<= 0 disables). Same
+    call-time env override pattern as ``probe_timeout_s``."""
+    raw = os.environ.get("TMTPU_TPU_BATCH_DEADLINE", "")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    return _batch_deadline_s
 
 
 def set_default_backend(backend: str) -> None:
@@ -55,44 +116,52 @@ def set_default_backend(backend: str) -> None:
         _tpu_usable = None
 
 
-_PROBE_TIMEOUT_S = float(os.environ.get("TMTPU_TPU_PROBE_TIMEOUT", "10"))
-
-
 def _tpu_available() -> bool:
-    """Probe for a usable jax device ONCE, with a hard timeout: a wedged
-    PJRT plugin/tunnel can hang backend init indefinitely, and consensus
-    must degrade to the CPU path rather than stall. Each probe attempt,
-    timeout, and the resulting up/down verdict land in the crypto metric
-    set (docs/OBSERVABILITY.md) — a node silently degraded to CPU shows
-    as tendermint_crypto_tpu_backend_up 0."""
+    """Probe for a usable jax device under the ``crypto.tpu`` breaker,
+    with a hard timeout: a wedged PJRT plugin/tunnel can hang backend
+    init indefinitely, and consensus must degrade to the CPU path
+    rather than stall. Unlike the old one-shot latch, only SUCCESS is
+    cached — a failed probe counts against the breaker and is retried
+    on the next call until the breaker opens, after which callers get
+    CPU immediately until the backoff elapses and a half-open probe
+    runs. Every attempt, timeout, and the up/down verdict land in the
+    crypto metric set (docs/OBSERVABILITY.md)."""
     global _tpu_usable
-    if _tpu_usable is None:
-        with _probe_lock:
-            if _tpu_usable is None:
-                from tmtpu.libs import metrics as _m
+    br = _tpu_breaker()
+    if not br.allow():
+        return False
+    if _tpu_usable:
+        return True
+    with _probe_lock:
+        if _tpu_usable:
+            return True
+        from tmtpu.libs import metrics as _m
 
-                result = {}
+        def probe() -> bool:
+            import jax
 
-                def probe():
-                    try:
-                        import jax
+            return len(jax.devices()) > 0
 
-                        result["ok"] = len(jax.devices()) > 0
-                    except Exception:
-                        result["ok"] = False
-
-                _m.crypto_device_probe_attempts.inc()
-                t = threading.Thread(target=probe, daemon=True)
-                t.start()
-                t.join(_PROBE_TIMEOUT_S)
-                if "ok" not in result:
-                    _m.crypto_device_probe_timeouts.inc()
-                _tpu_usable = result.get("ok", False)
-                _m.crypto_tpu_backend_up.set(1.0 if _tpu_usable else 0.0)
-                if not _tpu_usable:
-                    _m.crypto_cpu_fallback.inc(curve="any",
-                                               reason="probe-failed")
-    return _tpu_usable
+        _m.crypto_device_probe_attempts.inc()
+        try:
+            ok = _bk.call_with_deadline(probe, probe_timeout_s())
+            if ok:
+                br.record_success()
+            else:
+                br.record_failure(RuntimeError("no jax devices"))
+        except _bk.DeadlineExceeded as e:
+            _m.crypto_device_probe_timeouts.inc()
+            br.record_failure(e)
+            ok = False
+        except Exception as e:  # noqa: BLE001 — import/init failure
+            br.record_failure(e)
+            ok = False
+        _m.crypto_tpu_backend_up.set(1.0 if ok else 0.0)
+        if ok:
+            _tpu_usable = True
+        else:
+            _m.crypto_cpu_fallback.inc(curve="any", reason="probe-failed")
+        return ok
 
 
 class BatchVerifier(keys.BatchVerifier):
@@ -237,50 +306,90 @@ class TPUBatchVerifier(BatchVerifier):
             mask[i] = pk.verify_signature(msg, sig)
             if mask[i]:
                 tallied += power
-        curve_batches = []
+        br = _tpu_breaker()
+        deadline = batch_deadline_s()
+
+        def _serial(idx_list, curve, reason):
+            # CPU-serial fallback for lanes whose device batch failed
+            # (or was never attempted: open breaker / small batch)
+            nonlocal tallied
+            _m.crypto_cpu_fallback.inc(len(idx_list), curve=curve,
+                                       reason=reason)
+            for i in idx_list:
+                pk, msg, sig, power = self._items[i]
+                mask[i] = pk.verify_signature(msg, sig)
+                if mask[i]:
+                    tallied += power
+
+        def _dispatch(curve, idx_list, thunk, apply):
+            """One per-curve device batch under the breaker and the
+            per-batch deadline. Any failure — hung dispatch past the
+            deadline, device/runtime error — records against the
+            breaker and re-verifies exactly these lanes serially, so
+            the flush always returns an exact mask."""
+            if not br.allow():
+                _serial(idx_list, curve, "breaker-open")
+                return
+            try:
+                out = _bk.call_with_deadline(thunk, deadline)
+            except _bk.DeadlineExceeded as e:
+                _m.crypto_batch_deadline_exceeded.inc(curve=curve)
+                br.record_failure(e)
+                _serial(idx_list, curve, "deadline")
+                return
+            except Exception as e:  # noqa: BLE001 — a broken device
+                # path must never take down verification
+                br.record_failure(e)
+                _serial(idx_list, curve, "device-error")
+                return
+            br.record_success()
+            apply(out)
+
+        def _apply_mask(idx_list):
+            def apply(dev_mask):
+                nonlocal tallied
+                for j, i in enumerate(idx_list):
+                    mask[i] = bool(dev_mask[j])
+                    if mask[i]:
+                        tallied += self._items[i][3]
+            return apply
+
         if sr_idx:
             from tmtpu.tpu.sr_verify import batch_verify_sr
 
-            curve_batches.append((sr_idx, batch_verify_sr))
+            _dispatch(SR25519, sr_idx, lambda: batch_verify_sr(
+                [self._items[i][0].bytes() for i in sr_idx],
+                [self._items[i][1] for i in sr_idx],
+                [self._items[i][2] for i in sr_idx],
+            ), _apply_mask(sr_idx))
         if k1_idx:
             from tmtpu.tpu.k1_verify import batch_verify_k1
 
-            curve_batches.append((k1_idx, batch_verify_k1))
-        for idx, fn in curve_batches:
-            dev_mask = fn(
-                [self._items[i][0].bytes() for i in idx],
-                [self._items[i][1] for i in idx],
-                [self._items[i][2] for i in idx],
-            )
-            for j, i in enumerate(idx):
-                mask[i] = bool(dev_mask[j])
-                if mask[i]:
-                    tallied += self._items[i][3]
+            _dispatch(SECP256K1, k1_idx, lambda: batch_verify_k1(
+                [self._items[i][0].bytes() for i in k1_idx],
+                [self._items[i][1] for i in k1_idx],
+                [self._items[i][2] for i in k1_idx],
+            ), _apply_mask(k1_idx))
         if ed_idx:
             if len(ed_idx) < _TPU_MIN_BATCH:
-                _m.crypto_cpu_fallback.inc(len(ed_idx), curve=ED25519,
-                                           reason="small-batch")
-                for j, i in enumerate(ed_idx):
-                    mask[i] = self._items[i][0].verify_signature(
-                        ed_msgs[j], ed_sigs[j]
-                    )
-                    if mask[i]:
-                        tallied += ed_powers[j]
+                _serial(ed_idx, ED25519, "small-batch")
             elif tally:
                 from tmtpu.tpu import sharding as sh
 
-                dev_mask, dev_sum = sh.batch_verify_tally(
-                    ed_pks, ed_msgs, ed_sigs, ed_powers
-                )
-                for j, i in enumerate(ed_idx):
-                    mask[i] = bool(dev_mask[j])
-                tallied += dev_sum
+                def _apply_tally(out):
+                    nonlocal tallied
+                    dev_mask, dev_sum = out
+                    for j, i in enumerate(ed_idx):
+                        mask[i] = bool(dev_mask[j])
+                    tallied += dev_sum
+
+                _dispatch(ED25519, ed_idx, lambda: sh.batch_verify_tally(
+                    ed_pks, ed_msgs, ed_sigs, ed_powers), _apply_tally)
             else:
                 from tmtpu.tpu import verify as tv
 
-                dev_mask = tv.batch_verify(ed_pks, ed_msgs, ed_sigs)
-                for j, i in enumerate(ed_idx):
-                    mask[i] = bool(dev_mask[j])
+                _dispatch(ED25519, ed_idx, lambda: tv.batch_verify(
+                    ed_pks, ed_msgs, ed_sigs), _apply_mask(ed_idx))
         from tmtpu.libs import timeline as _tl
 
         _tl.record_flush(backend="tpu", lanes=len(self._items),

@@ -23,7 +23,12 @@ from TOML::
 from __future__ import annotations
 
 import dataclasses
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: same API under the old name
+    import tomli as tomllib
+
 from dataclasses import dataclass, field
 
 

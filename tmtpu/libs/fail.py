@@ -1,9 +1,22 @@
 """Deterministic crash injection (reference: libs/fail/fail.go:28).
 
-``fail_point()`` kills the process at the Nth call when
-``FAIL_TEST_INDEX=N`` is set — the crash/replay tests kill a node at every
-point around commit (consensus/state.go:1605-1685 has 9 such points) and
-assert WAL+handshake recovery converges.
+``fail_point(name)`` kills the process at the Nth call when
+``FAIL_TEST_INDEX=N`` is set — the crash/replay tests kill a node at
+every point around commit (consensus/state.go:1605-1685 has 9 such
+points) and assert WAL+handshake recovery converges.
+
+Each call site carries a *name* and doubles as a libs/faultinject site:
+the positional ``FAIL_TEST_INDEX`` counter is kept for the classic
+sweep-every-point tests, while ``TMTPU_FAULTS="cs.finalize.post_save_block
+=crash"`` (or any other mode) targets one site by name without counting
+call ordinals. Site names are cataloged in docs/RESILIENCE.md and
+linted by tools/check_failpoints.py.
+
+Concurrency note: the env index is read lazily and cached; both the
+cache fill and the counter step happen under one lock (the previous
+unlocked double-checked read raced ``reset()`` — a concurrent reset
+could un-cache ``_env_index`` between a reader's check and use,
+making one fail_point call observe a half-reset counter).
 """
 
 from __future__ import annotations
@@ -11,12 +24,15 @@ from __future__ import annotations
 import os
 import threading
 
+from tmtpu.libs import faultinject
+
 _lock = threading.Lock()
 _call_index = -1
 _env_index = None
 
 
-def _target() -> int:
+def _target_locked() -> int:
+    """Must be called with ``_lock`` held."""
     global _env_index
     if _env_index is None:
         raw = os.environ.get("FAIL_TEST_INDEX", "")
@@ -32,13 +48,16 @@ def reset() -> None:
         _env_index = None
 
 
-def fail_point() -> None:
+def fail_point(name: str = "") -> None:
     """fail.go Fail — exits the process hard (no cleanup, like a crash)
-    when the call counter reaches FAIL_TEST_INDEX."""
+    when the call counter reaches FAIL_TEST_INDEX; named sites
+    additionally honor any libs/faultinject plan targeting them."""
     global _call_index
-    if _target() < 0:
-        return
+    if name:
+        faultinject.fire(faultinject.ensure(name))
     with _lock:
+        if _target_locked() < 0:
+            return
         _call_index += 1
-        if _call_index == _target():
+        if _call_index == _target_locked():
             os._exit(88)

@@ -1,0 +1,262 @@
+"""Named, seeded, deterministic fault injection.
+
+Generalizes libs/fail.py (crash-only, positional ``FAIL_TEST_INDEX``
+counter) into a site catalog: every injectable point in the tree
+registers a *named* site at import time, and chaos tests (or an
+operator running a game day) activate per-site plans that script
+exactly when and how each site misbehaves —
+
+    modes:  error    raise FaultInjected at the site
+            latency  sleep ``ms`` then continue
+            flaky    raise with probability ``p`` (seeded RNG)
+            crash    os._exit(88), like libs/fail.py (no cleanup)
+
+Activation is programmatic (``script()``, the chaos-test API) or via
+the ``TMTPU_FAULTS`` env (the subprocess / game-day API):
+
+    TMTPU_FAULTS="tpu.ed25519.batch=error:count=3;wal.write=latency:ms=50"
+
+grammar: ``site=mode[:key=val[,key=val...]][;site=mode...]`` with keys
+``count`` (fire at most N times, default unlimited), ``after`` (skip
+the first N hits), ``ms`` (latency mode), ``p`` (flaky probability),
+``seed`` (flaky RNG seed — same seed, same verdict sequence).
+
+Sites are registered exactly once (duplicate names are a programming
+error and raise); ``tools/check_failpoints.py`` lints statically that
+every registered site name is unique across the tree AND exercised by
+at least one test. The full catalog lives in docs/RESILIENCE.md.
+
+Everything a plan does is deterministic given its spec: counts step
+under a lock, flaky draws come from a per-plan ``random.Random(seed)``,
+and ``hits``/``fired`` counters are readable afterwards so a chaos test
+can assert "TPU threw for exactly 3 batches then recovered".
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+ERROR = "error"
+LATENCY = "latency"
+FLAKY = "flaky"
+CRASH = "crash"
+_MODES = (ERROR, LATENCY, FLAKY, CRASH)
+
+ENV_VAR = "TMTPU_FAULTS"
+CRASH_EXIT_CODE = 88  # same as libs/fail.py — crash tests assert on it
+
+
+class FaultInjected(Exception):
+    """The scripted failure raised at an ``error``/``flaky`` site."""
+
+    def __init__(self, site: str):
+        super().__init__(f"injected fault at site {site!r}")
+        self.site = site
+
+
+class Site:
+    """One registered injection point. Identity is the name; the object
+    is what call sites hold so ``fire(SITE)`` is a dict lookup, not a
+    string parse."""
+
+    __slots__ = ("name", "hits")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.hits = 0  # total fire() calls, plan active or not
+
+    def __repr__(self) -> str:
+        return f"Site({self.name!r})"
+
+
+class _Plan:
+    """An active fault plan for one site (locked by the module lock)."""
+
+    def __init__(self, site: str, mode: str, count: Optional[int] = None,
+                 after: int = 0, latency_s: float = 0.0, p: float = 1.0,
+                 seed: int = 0):
+        if mode not in _MODES:
+            raise ValueError(f"unknown fault mode {mode!r} for {site!r}")
+        self.site = site
+        self.mode = mode
+        self.count = count          # None = unlimited
+        self.after = int(after)     # skip the first N hits
+        self.latency_s = float(latency_s)
+        self.p = float(p)
+        self.rng = random.Random(seed)
+        self.skipped = 0
+        self.fired = 0
+
+    def spec(self) -> Dict:
+        return {"site": self.site, "mode": self.mode, "count": self.count,
+                "after": self.after, "latency_s": self.latency_s,
+                "p": self.p, "skipped": self.skipped, "fired": self.fired}
+
+
+_lock = threading.Lock()
+_sites: Dict[str, Site] = {}
+_plans: Dict[str, _Plan] = {}
+_env_loaded = False
+
+
+def register(name: str) -> Site:
+    """Register a site at import time. Duplicate names raise — two call
+    sites sharing a name would make 'count=3' mean '3 across both',
+    silently, which is exactly the ambiguity named sites exist to kill.
+    """
+    with _lock:
+        if name in _sites:
+            raise ValueError(f"fault-injection site {name!r} registered "
+                             f"twice")
+        site = Site(name)
+        _sites[name] = site
+        return site
+
+
+def ensure(name: str) -> Site:
+    """Idempotent registration — for libs/fail.py's lazily-named call
+    sites, where the same ``fail_point(name)`` line may run many times.
+    Cross-file duplicate names are caught statically by
+    tools/check_failpoints.py instead."""
+    with _lock:
+        site = _sites.get(name)
+        if site is None:
+            site = Site(name)
+            _sites[name] = site
+        return site
+
+
+def sites() -> List[str]:
+    with _lock:
+        return sorted(_sites)
+
+
+def script(site: str, mode: str, count: Optional[int] = None,
+           after: int = 0, ms: float = 0.0, p: float = 1.0,
+           seed: int = 0) -> None:
+    """Activate a plan for ``site`` (replacing any existing one). The
+    chaos-test API: ``script("tpu.ed25519.batch", "error", count=3)``
+    makes the next 3 fires raise, then the site heals."""
+    plan = _Plan(site, mode, count=count, after=after, latency_s=ms / 1000.0,
+                 p=p, seed=seed)
+    with _lock:
+        _plans[site] = plan
+
+
+def clear(site: Optional[str] = None) -> None:
+    """Deactivate one plan, or all of them (``site=None``)."""
+    with _lock:
+        if site is None:
+            _plans.clear()
+        else:
+            _plans.pop(site, None)
+
+
+def active() -> Dict[str, Dict]:
+    with _lock:
+        return {s: p.spec() for s, p in _plans.items()}
+
+
+def reset() -> None:
+    """Testing hook: drop all plans and re-arm env parsing. Registered
+    sites persist (registration is import-time, process-wide)."""
+    global _env_loaded
+    with _lock:
+        _plans.clear()
+        _env_loaded = False
+        for s in _sites.values():
+            s.hits = 0
+
+
+def _parse_env_spec(raw: str) -> List[_Plan]:
+    """``site=mode[:k=v,...][;...]`` — raises ValueError on bad specs
+    (a silently-ignored typo'd chaos spec would green a game day that
+    never injected anything)."""
+    plans = []
+    for part in raw.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        site_eq, _, tail = part.partition("=")
+        site = site_eq.strip()
+        if not site or not tail:
+            raise ValueError(f"bad {ENV_VAR} entry {part!r} "
+                             f"(want site=mode[:k=v,...])")
+        mode, _, opts = tail.partition(":")
+        kw: Dict = {}
+        for opt in opts.split(","):
+            opt = opt.strip()
+            if not opt:
+                continue
+            k, _, v = opt.partition("=")
+            if k == "count":
+                kw["count"] = int(v)
+            elif k == "after":
+                kw["after"] = int(v)
+            elif k == "ms":
+                kw["latency_s"] = float(v) / 1000.0
+            elif k == "p":
+                kw["p"] = float(v)
+            elif k == "seed":
+                kw["seed"] = int(v)
+            else:
+                raise ValueError(f"unknown {ENV_VAR} option {k!r} in "
+                                 f"{part!r}")
+        plans.append(_Plan(site, mode.strip(), **kw))
+    return plans
+
+
+def load_env(force: bool = False) -> None:
+    """Parse TMTPU_FAULTS into plans (idempotent; ``fire`` calls it
+    lazily so subprocess nodes need no extra wiring)."""
+    global _env_loaded
+    with _lock:
+        if _env_loaded and not force:
+            return
+        _env_loaded = True
+        raw = os.environ.get(ENV_VAR, "")
+    if not raw:
+        return
+    plans = _parse_env_spec(raw)
+    with _lock:
+        for p in plans:
+            _plans[p.site] = p
+
+
+def fire(site: Site) -> None:
+    """The hook every injection point calls. No active plan: one dict
+    lookup and out — cheap enough for the WAL write and batch-verify
+    hot paths."""
+    if not _env_loaded:
+        load_env()
+    with _lock:
+        site.hits += 1
+        plan = _plans.get(site.name)
+        if plan is None:
+            return
+        if plan.skipped < plan.after:
+            plan.skipped += 1
+            return
+        if plan.count is not None and plan.fired >= plan.count:
+            del _plans[site.name]  # exhausted: site heals
+            return
+        if plan.mode == FLAKY and plan.rng.random() >= plan.p:
+            return
+        plan.fired += 1
+        mode = plan.mode
+        latency_s = plan.latency_s
+        if plan.count is not None and plan.fired >= plan.count:
+            del _plans[site.name]
+    from tmtpu.libs import metrics as _m
+
+    _m.fault_injected.inc(site=site.name, mode=mode)
+    if mode == CRASH:
+        os._exit(CRASH_EXIT_CODE)
+    if mode == LATENCY:
+        time.sleep(latency_s)
+        return
+    raise FaultInjected(site.name)

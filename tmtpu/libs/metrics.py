@@ -362,6 +362,50 @@ crypto_tpu_backend_up = DEFAULT.gauge(
     "crypto", "tpu_backend_up",
     "1 when a usable jax device backend answered the probe, else 0")
 
+# --- the self-healing crypto backend metric set (libs/breaker.py) -----------
+#
+# One series per registered breaker ("crypto.tpu" wraps the whole TPU
+# batch-verify path in crypto/batch.py; "pallas.<curve>" wraps each
+# fused-kernel family's compile/dispatch). State encoding follows
+# breaker.STATE_CODES: 0 closed, 1 open, 2 half-open.
+
+crypto_breaker_state = DEFAULT.gauge(
+    "crypto", "breaker_state",
+    "Circuit-breaker state: 0 closed, 1 open, 2 half-open",
+    labels=("breaker",))
+crypto_breaker_transitions = DEFAULT.counter(
+    "crypto", "breaker_transitions_total",
+    "Circuit-breaker state transitions",
+    labels=("breaker", "from", "to"))
+crypto_breaker_failures = DEFAULT.counter(
+    "crypto", "breaker_failures_total",
+    "Failures recorded against a circuit breaker (device errors, "
+    "deadline hits, probe failures)",
+    labels=("breaker",))
+crypto_batch_deadline_exceeded = DEFAULT.counter(
+    "crypto", "batch_deadline_exceeded_total",
+    "Device batch dispatches abandoned at the per-batch deadline "
+    "(the batch re-verified on the CPU path)",
+    labels=("curve",))
+
+# libs/faultinject.py: one count per scripted fault actually delivered
+# (mode = error | latency | flaky | crash) — chaos tests assert on it,
+# and a production scrape showing nonzero values means someone left
+# TMTPU_FAULTS set on a real node.
+fault_injected = DEFAULT.counter(
+    "fault", "injected_total",
+    "Faults delivered by the libs/faultinject framework",
+    labels=("site", "mode"))
+
+# consensus/wal.py crash-hardened recovery
+wal_torn_tail_truncated = DEFAULT.counter(
+    "wal", "torn_tail_truncated_total",
+    "WAL opens that truncated an incomplete (torn) trailing record")
+wal_skipped_bytes = DEFAULT.counter(
+    "wal", "replay_skipped_bytes_total",
+    "Bytes skipped by non-strict WAL iteration after a corrupt or torn "
+    "record")
+
 # (curve, impl, padded-lanes) shapes already dispatched in this process:
 # jax.jit keys its cache on input shapes, so a new padded bucket size is
 # exactly one fresh XLA compile — tracked here rather than by poking jax

@@ -53,6 +53,7 @@ EVENT_PREVOTE_QUORUM = "quorum.prevote"
 EVENT_PRECOMMIT_QUORUM = "quorum.precommit"
 EVENT_BATCH_FLUSH = "crypto.batch_flush"
 EVENT_APPLY_BLOCK = "state.apply_block"
+EVENT_BREAKER = "crypto.breaker"
 
 
 class Timeline:
@@ -99,6 +100,14 @@ class Timeline:
         """Batch-verify flush hook: crypto/batch.py has no height in
         scope, so the flush lands on the timeline's current height."""
         self.record(self._current_height, EVENT_BATCH_FLUSH, **attrs)
+
+    def record_breaker(self, **attrs) -> None:
+        """Circuit-breaker transition hook (libs/breaker.py): like
+        flushes, breakers have no height in scope — the transition
+        lands on the timeline's current height, so 'which height was
+        in flight when the TPU path opened' reads straight off the
+        journal."""
+        self.record(self._current_height, EVENT_BREAKER, **attrs)
 
     # -- reading ------------------------------------------------------------
 
@@ -161,6 +170,10 @@ def record(height: int, event: str, round: int = 0, **attrs) -> None:
 
 def record_flush(**attrs) -> None:
     DEFAULT.record_flush(**attrs)
+
+
+def record_breaker(**attrs) -> None:
+    DEFAULT.record_breaker(**attrs)
 
 
 def snapshot(height: Optional[int] = None, last: int = 20) -> List[Dict]:

@@ -266,6 +266,28 @@ def tpu_backend_check(window_s: float, storm_threshold: int,
     return check
 
 
+def breaker_check() -> CheckFn:
+    """Unhealthy while any crypto circuit breaker sits OPEN — the node
+    is alive but running degraded (CPU-serial verify), which an
+    operator must see before the backoff window quietly retries.
+    HALF_OPEN is healthy-with-detail: recovery probing in flight."""
+    from tmtpu.libs import breaker as _bk
+
+    def check() -> Tuple[bool, str, Dict]:
+        snaps = _bk.snapshot_all()
+        open_ = {n: s for n, s in snaps.items() if s["state"] == _bk.OPEN}
+        details = {"breakers": snaps}
+        if open_:
+            perm = sorted(n for n, s in open_.items() if s["permanent"])
+            reason = f"breaker open: {', '.join(sorted(open_))}"
+            if perm:
+                reason += f" (permanent: {', '.join(perm)})"
+            return False, reason, details
+        return True, "", details
+
+    return check
+
+
 def sync_status_check(is_block_syncing: Callable[[], bool],
                       is_state_syncing: Callable[[], bool]) -> CheckFn:
     """Always healthy — surfaces blocksync/statesync progress so

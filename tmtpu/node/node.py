@@ -45,6 +45,11 @@ class Node(BaseService):
         super().__init__("Node")
         self.config = config
         crypto_batch.set_default_backend(config.base.crypto_backend)
+        # resilience knobs: probe/batch deadlines + breaker thresholds
+        # ([crypto] section) flow into the shared breaker registry BEFORE
+        # the first verifier is built, so the first probe already runs
+        # under the configured deadline
+        crypto_batch.configure(config.crypto)
         # warm the native helper library now: its lazy first load may
         # COMPILE hostprep.c (seconds), which must never land inside the
         # consensus verify hot path on first use
@@ -389,6 +394,7 @@ class Node(BaseService):
                 hc.fallback_storm_window_ns / 1e9,
                 hc.fallback_storm_threshold,
                 expect_device=self.config.base.crypto_backend == "tpu"))
+            wd.register("breaker", wdg.breaker_check())
         return wd
 
     def _readiness(self):

@@ -13,6 +13,7 @@ from typing import List, Optional, Tuple
 
 from tmtpu.abci import types as abci
 from tmtpu.crypto.encoding import pubkey_from_proto
+from tmtpu.libs import faultinject
 from tmtpu.state.state import State, median_time
 from tmtpu.state.store import ABCIResponses, StateStore
 from tmtpu.state.validation import validate_block
@@ -23,6 +24,12 @@ from tmtpu.types.validator import Validator
 
 class BlockExecutionError(Exception):
     pass
+
+
+# chaos hook on the app-Commit boundary: an injected error here models a
+# crashed/hung ABCI app at the worst moment (state updated, app_hash not
+# yet durable) — the handshake/replay path must reconverge
+_FAULT_ABCI_COMMIT = faultinject.register("abci.commit")
 
 
 class BlockExecutor:
@@ -88,7 +95,8 @@ class BlockExecutor:
         t0 = _time.perf_counter()
         self.validate_block(state, block)
         abci_responses = self._exec_block_on_proxy_app(state, block)
-        fail.fail_point()  # execution.go:149 — after exec, before saving
+        # execution.go:149 — after exec, before saving
+        fail.fail_point("exec.post_exec")
         self.store.save_abci_responses(block.header.height, abci_responses)
 
         # validate validator updates per consensus params
@@ -107,11 +115,12 @@ class BlockExecutor:
         new_state = update_state(state, block_id, block.header,
                                  abci_responses, val_updates)
 
-        fail.fail_point()  # execution.go:180 — before app Commit
+        fail.fail_point("exec.pre_app_commit")  # execution.go:180
         # Commit: lock mempool, flush, app Commit, update mempool
         app_hash, retain_height = self._commit(new_state, block,
                                                abci_responses.deliver_txs)
-        fail.fail_point()  # execution.go:196 — app committed, state unsaved
+        # execution.go:196 — app committed, state unsaved
+        fail.fail_point("exec.post_app_commit")
         if self.evidence_pool:
             self.evidence_pool.update(new_state, block.evidence)
         new_state.app_hash = app_hash
@@ -198,6 +207,7 @@ class BlockExecutor:
         if self.mempool:
             self.mempool.lock()
         try:
+            faultinject.fire(_FAULT_ABCI_COMMIT)
             res = self.proxy_app.commit_sync()
             if self.mempool:
                 self.mempool.update(

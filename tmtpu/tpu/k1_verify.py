@@ -36,7 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tmtpu.crypto.secp256k1 import N
-from tmtpu.libs import trace
+from tmtpu.libs import faultinject, trace
 from tmtpu.tpu import fe_k1 as fe
 from tmtpu.tpu.verify import lt_le
 
@@ -424,11 +424,8 @@ def _k1_kernel_packed_jit(packed):
     return kk.k1_verify_compact_kernel(planes[0], parity, *planes[1:])
 
 
-# Pallas-kernel fallback latch, same policy as tmtpu.tpu.sr_verify: latch
-# permanently only on deterministic compile/lowering rejections, give
-# transient runtime faults one retry.
-_kernel_broken = False
-_kernel_failures = 0
+# chaos site on the device dispatch boundary (docs/RESILIENCE.md)
+_FAULT_K1_BATCH = faultinject.register("tpu.secp256k1.batch")
 
 
 def batch_verify_k1(pks, msgs, sigs) -> np.ndarray:
@@ -443,13 +440,16 @@ def batch_verify_k1(pks, msgs, sigs) -> np.ndarray:
     B = len(sigs)
     if B == 0:
         return np.zeros(0, dtype=bool)
+    faultinject.fire(_FAULT_K1_BATCH)
     from tmtpu.libs import metrics as _m
 
     t0 = time.perf_counter()
     with trace.span("secp256k1.prepare", lanes=B):
         packed, host_ok = prepare_k1_batch_packed(pks, msgs, sigs)
-    global _kernel_broken, _kernel_failures
-    if not _kernel_broken and tv.use_pallas_kernel():
+    # breaker replaces the old module _kernel_broken latch (policy in
+    # tmtpu.tpu.verify.note_pallas_failure, same as sr_verify)
+    pbr = tv.pallas_breaker("secp256k1")
+    if tv.use_pallas_kernel() and pbr.allow():
         from tmtpu.tpu import k1_kernel as kk
 
         padded = max(kk.DEFAULT_TILE, tv._pad_to_bucket(B))
@@ -458,21 +458,19 @@ def batch_verify_k1(pks, msgs, sigs) -> np.ndarray:
                             lanes=B, padded=padded):
                 mask = np.asarray(_k1_kernel_packed_jit(
                     jnp.asarray(pad_packed(packed, padded))))[:B]
-            _kernel_failures = 0
+            pbr.record_success()
             _m.observe_crypto_batch("secp256k1", tv.backend_label(),
                                     "pallas", B, padded,
                                     time.perf_counter() - t0)
             return mask & host_ok
         except Exception as e:  # noqa: BLE001
-            _kernel_failures += 1
-            if tv.is_compile_error(e) or _kernel_failures >= 2:
-                _kernel_broken = True
+            tv.note_pallas_failure(pbr, e)
             import sys
 
             print(
                 "k1_verify: Pallas kernel "
-                f"{'disabled' if _kernel_broken else 'failed (will retry)'}"
-                f": {e!r}",
+                f"{'disabled' if pbr.state != 'closed' else 'failed'}"
+                f" (breaker {pbr.state}): {e!r}",
                 file=sys.stderr)
     padded = tv._pad_to_bucket(B)
     with trace.span("secp256k1.execute", impl="xla", lanes=B,

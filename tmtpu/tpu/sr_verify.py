@@ -39,7 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tmtpu.crypto import ed25519_ref as ref
-from tmtpu.libs import trace
+from tmtpu.libs import faultinject, trace
 from tmtpu.crypto import ristretto
 from tmtpu.crypto.merlin import Transcript
 from tmtpu.tpu import curve, fe
@@ -275,11 +275,8 @@ def _sr_kernel_packed_jit(packed):
     return tk.sr_verify_compact_kernel(*split_packed(packed))
 
 
-# set on a Pallas compile/lowering failure (or 2 consecutive failures of
-# any kind) so later batches go straight to XLA; the shared policy lives
-# in tmtpu.tpu.verify.is_compile_error (k1_verify uses the same one)
-_kernel_broken = False
-_kernel_failures = 0
+# chaos site on the device dispatch boundary (docs/RESILIENCE.md)
+_FAULT_SR_BATCH = faultinject.register("tpu.sr25519.batch")
 
 
 def batch_verify_sr(pks, msgs, sigs) -> np.ndarray:
@@ -292,6 +289,7 @@ def batch_verify_sr(pks, msgs, sigs) -> np.ndarray:
     B = len(sigs)
     if B == 0:
         return np.zeros(0, dtype=bool)
+    faultinject.fire(_FAULT_SR_BATCH)
     from tmtpu.libs import metrics as _m
     from tmtpu.tpu import verify as tv
     from tmtpu.tpu.verify import pad_packed
@@ -299,8 +297,11 @@ def batch_verify_sr(pks, msgs, sigs) -> np.ndarray:
     t0 = time.perf_counter()
     with trace.span("sr25519.prepare", lanes=B):
         packed, host_ok = prepare_sr_batch_packed(pks, msgs, sigs)
-    global _kernel_broken, _kernel_failures
-    if not _kernel_broken and tv.use_pallas_kernel():
+    # breaker replaces the old module _kernel_broken latch: compile
+    # rejections trip permanently, transient faults re-probe after
+    # backoff (policy in tmtpu.tpu.verify.note_pallas_failure)
+    pbr = tv.pallas_breaker("sr25519")
+    if tv.use_pallas_kernel() and pbr.allow():
         from tmtpu.tpu import kernel as tk
 
         padded = max(tk.DEFAULT_TILE, tv._pad_to_bucket(B))
@@ -309,25 +310,18 @@ def batch_verify_sr(pks, msgs, sigs) -> np.ndarray:
                             lanes=B, padded=padded):
                 mask = np.asarray(_sr_kernel_packed_jit(
                     jnp.asarray(pad_packed(packed, padded))))[:B]
-            _kernel_failures = 0
+            pbr.record_success()
             _m.observe_crypto_batch("sr25519", tv.backend_label(), "pallas",
                                     B, padded, time.perf_counter() - t0)
             return mask & host_ok
         except Exception as e:  # noqa: BLE001
-            # Latch permanently only on deterministic compile/lowering
-            # rejections; a transient runtime fault (device OOM, RPC
-            # hiccup) gets one retry on the next batch before latching —
-            # ADVICE r2: one hiccup must not silently degrade the process
-            # to the XLA path forever.
-            _kernel_failures += 1
-            if tv.is_compile_error(e) or _kernel_failures >= 2:
-                _kernel_broken = True
+            tv.note_pallas_failure(pbr, e)
             import sys
 
             print(
                 "sr_verify: Pallas kernel "
-                f"{'disabled' if _kernel_broken else 'failed (will retry)'}"
-                f": {e!r}",
+                f"{'disabled' if pbr.state != 'closed' else 'failed'}"
+                f" (breaker {pbr.state}): {e!r}",
                 file=sys.stderr)
     # attribute lookup (not an import-time binding) so tests can pin one
     # bucket via monkeypatch, same as the ed25519/secp256k1 paths

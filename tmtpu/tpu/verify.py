@@ -32,8 +32,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from tmtpu.crypto import ed25519_ref as ref
-from tmtpu.libs import trace
+from tmtpu.libs import faultinject, trace
 from tmtpu.tpu import curve, fe
+
+# chaos site on the device dispatch boundary (docs/RESILIENCE.md): an
+# injected error/latency here models a failing/hung TPU batch and must
+# surface as breaker accounting + CPU fallback in crypto/batch.py
+_FAULT_ED_BATCH = faultinject.register("tpu.ed25519.batch")
 
 L = ref.L
 WINDOW = curve.WINDOW
@@ -310,6 +315,30 @@ def is_compile_error(e: Exception) -> bool:
     return any(m in s for m in _COMPILE_ERR_MARKERS)
 
 
+# Pallas-fallback breakers (one per kernel family, replacing the old
+# module-level _kernel_broken latches): a compile/lowering rejection is
+# deterministic → trip permanently; transient runtime faults open after
+# 2 consecutive failures and RE-PROBE after backoff — the old latch
+# never un-latched, so one bad minute degraded the process to XLA until
+# restart. half_open_probes=1: one good batch re-trusts the kernel.
+PALLAS_BREAKER_DEFAULTS = dict(failure_threshold=2, backoff_base_s=30.0,
+                               backoff_max_s=600.0, half_open_probes=1)
+
+
+def pallas_breaker(curve_name: str):
+    from tmtpu.libs import breaker as _bk
+
+    return _bk.get(f"pallas.{curve_name}", **PALLAS_BREAKER_DEFAULTS)
+
+
+def note_pallas_failure(br, e: Exception) -> None:
+    """Shared failure policy for a Pallas kernel dispatch exception."""
+    if is_compile_error(e):
+        br.trip_permanent(f"{type(e).__name__}: {e}")
+    else:
+        br.record_failure(e)
+
+
 @jax.jit
 def _verify_compact_jit(pk_b, r_b, s_b, h_b, table):
     return verify_core_compact(pk_b, r_b, s_b, h_b, table)
@@ -373,11 +402,13 @@ def batch_verify(pks, msgs, sigs) -> np.ndarray:
     B = len(sigs)
     if B == 0:
         return np.zeros(0, dtype=bool)
+    faultinject.fire(_FAULT_ED_BATCH)
     t0 = time.perf_counter()
     with trace.span("crypto.batch_verify", curve="ed25519", lanes=B) as sp:
         with trace.span("ed25519.prepare", lanes=B):
             packed, host_ok = prepare_batch_packed(pks, msgs, sigs)
-        use_kernel = use_pallas_kernel()
+        pbr = pallas_breaker("ed25519")
+        use_kernel = use_pallas_kernel() and pbr.allow()
         impl = "pallas" if use_kernel else "xla"
         if use_kernel:
             from tmtpu.tpu import kernel as tk
@@ -392,10 +423,20 @@ def batch_verify(pks, msgs, sigs) -> np.ndarray:
             dev = jnp.asarray(packed)
         with trace.span("ed25519.execute", impl=impl):
             if use_kernel:
-                out = _verify_packed_kernel_jit(dev)
+                try:
+                    out = jax.block_until_ready(
+                        _verify_packed_kernel_jit(dev))
+                    pbr.record_success()
+                except Exception as e:  # noqa: BLE001 — kernel fault:
+                    # breaker decides latch-vs-retry, XLA serves THIS batch
+                    note_pallas_failure(pbr, e)
+                    impl = "xla"
+                    sp.set(impl=impl)
+                    out = jax.block_until_ready(
+                        _verify_packed_jit(dev, base_table_f32()))
             else:
-                out = _verify_packed_jit(dev, base_table_f32())
-            out = jax.block_until_ready(out)
+                out = jax.block_until_ready(
+                    _verify_packed_jit(dev, base_table_f32()))
         with trace.span("ed25519.readback"):
             mask = np.asarray(out)[:B]
     from tmtpu.libs import metrics as _m
