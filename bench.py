@@ -62,6 +62,18 @@ PROBE_BUDGET_S = min(
     float(os.environ.get("TMTPU_BENCH_PROBE_BUDGET", "600")),
     WALL_CAP_S - 600)
 
+# TMTPU_BENCH_SKIP_PROBE=1: skip the device-probe budget entirely and go
+# straight to a reduced-lane CPU measurement (CI smoke / CPU-only boxes —
+# the probe retry schedule alone can burn minutes against a wedged
+# tunnel). The emitted JSON records probe.skipped=true so the artifact
+# says WHY there are zero probe attempts. Read via env (not argparse) so
+# the flag reaches the measurement child unchanged.
+SKIP_PROBE = os.environ.get("TMTPU_BENCH_SKIP_PROBE") == "1"
+# Lane count for the skip-probe CPU run: small enough that vote signing
+# (pure-python ed25519 when the OpenSSL binding is absent) plus the
+# XLA:CPU compile of the verify graph lands well inside 120 s of wall.
+SKIP_PROBE_LANES = int(os.environ.get("TMTPU_BENCH_SKIP_PROBE_LANES", "256"))
+
 # provenance for the output JSON: every probe attempt's outcome
 _probe_log: list = []
 
@@ -188,6 +200,8 @@ def _emit_with_provenance(json_line: str, parent_attempts) -> None:
     probe["attempts"] = len(_probe_log)
     probe["log"] = _probe_log[-6:]
     probe["budget_s"] = PROBE_BUDGET_S
+    if SKIP_PROBE:
+        probe["skipped"] = True
     if parent_attempts:
         probe["parent_fallbacks"] = parent_attempts
     if out.get("backend") != "cpu":
@@ -306,10 +320,15 @@ def _quick_serial_floor(n: int = 1000):
     binding only — no jax, no tmtpu imports, seconds of wall. This is the
     floor number the provisional line carries when the device cache is
     empty; it is the same primitive the Go baseline serializes
-    (crypto/ed25519/ed25519.go Verify), measured here one call at a time."""
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-        Ed25519PrivateKey,
-    )
+    (crypto/ed25519/ed25519.go Verify), measured here one call at a time.
+    Boxes without the cryptography package fall back to the repo's pure
+    reference verifier (a much lower, but still honest, floor)."""
+    try:
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PrivateKey,
+        )
+    except ImportError:
+        return _quick_serial_floor_pure(min(n, 100))
 
     sks = [Ed25519PrivateKey.from_private_bytes(
         i.to_bytes(32, "little")) for i in range(64)]
@@ -319,6 +338,25 @@ def _quick_serial_floor(n: int = 1000):
     t0 = time.perf_counter()
     for i in range(n):
         pks[i % 64].verify(sigs[i], msgs[i])
+    return n / (time.perf_counter() - t0)
+
+
+def _quick_serial_floor_pure(n: int):
+    """Serial-verify floor via tmtpu's reference ed25519 (pure python) —
+    the only ed25519 oracle available when the OpenSSL binding is not
+    installed. Orders of magnitude slower than the binding, so n stays
+    small; the rate is still the true serial capability of this box's
+    fallback verify path."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tmtpu.crypto import ed25519 as ed
+
+    ks = [ed.gen_priv_key_from_secret(b"floor-%d" % i) for i in range(8)]
+    pks = [k.pub_key() for k in ks]
+    msgs = [b"provisional-floor-%06d" % i for i in range(n)]
+    sigs = [ks[i % 8].sign(msgs[i]) for i in range(n)]
+    t0 = time.perf_counter()
+    for i in range(n):
+        assert pks[i % 8].verify_signature(msgs[i], sigs[i])
     return n / (time.perf_counter() - t0)
 
 
@@ -362,6 +400,8 @@ def _emit_provisional() -> None:
     if not out.get("probe"):
         out["probe"] = {"attempts": 0, "log": [],
                         "budget_s": PROBE_BUDGET_S}
+    if SKIP_PROBE:
+        out["probe"]["skipped"] = True
     out["note"] = ("emitted before device probing; a later line "
                    "supersedes this one")
     print(json.dumps(out), flush=True)
@@ -376,6 +416,8 @@ def _emit_provisional_final(attempts) -> None:
     out["failed"] = attempts or ["no-child-result"]
     out["probe"] = {"attempts": len(_probe_log), "log": _probe_log[-6:],
                     "budget_s": PROBE_BUDGET_S}
+    if SKIP_PROBE:
+        out["probe"]["skipped"] = True
     print(json.dumps(out), flush=True)
 
 
@@ -384,20 +426,32 @@ def _make_votes(n: int):
     sign-bytes (types/vote.go:93 semantics), distinct per lane because the
     timestamps differ (types/block.go:807)."""
     import numpy as np
-    from cryptography.hazmat.primitives import serialization
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-        Ed25519PrivateKey,
-    )
 
     from tmtpu.types.block import BlockID
     from tmtpu.types.vote import PRECOMMIT, Vote
 
     rng = np.random.default_rng(7)
     seeds = rng.integers(0, 256, (n, 32), dtype=np.uint8)
-    sks = [Ed25519PrivateKey.from_private_bytes(seeds[i].tobytes())
-           for i in range(n)]
-    raw = serialization.Encoding.Raw, serialization.PublicFormat.Raw
-    pks = [k.public_key().public_bytes(*raw) for k in sks]
+    try:
+        from cryptography.hazmat.primitives import serialization
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PrivateKey,
+        )
+
+        sks = [Ed25519PrivateKey.from_private_bytes(seeds[i].tobytes())
+               for i in range(n)]
+        raw = serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        pks = [k.public_key().public_bytes(*raw) for k in sks]
+        sign = lambda i, m: sks[i].sign(m)  # noqa: E731
+    except ImportError:
+        # no OpenSSL binding on this box: sign with the repo's reference
+        # ed25519 (pure python, ~ms per sign — fine at skip-probe lane
+        # counts, too slow for the full 10k workload)
+        from tmtpu.crypto import ed25519 as ed
+
+        sks = [ed.PrivKeyEd25519(seeds[i].tobytes()) for i in range(n)]
+        pks = [k.pub_key().bytes() for k in sks]
+        sign = lambda i, m: sks[i].sign(m)  # noqa: E731
     bid = BlockID(hash=bytes(range(32)), parts_total=1, parts_hash=bytes(32))
     base_ns = 1_700_000_000 * 10**9
     msgs = [
@@ -406,7 +460,7 @@ def _make_votes(n: int):
              validator_index=i).sign_bytes("bench-chain")
         for i in range(n)
     ]
-    sigs = [sks[i].sign(msgs[i]) for i in range(n)]
+    sigs = [sign(i, msgs[i]) for i in range(n)]
     return pks, msgs, sigs
 
 
@@ -457,6 +511,22 @@ def _run_child(backend: str, timeout_s: float):
 def _run_parent(t0):
     def remaining():
         return WALL_CAP_S - (time.perf_counter() - t0)
+
+    if SKIP_PROBE:
+        # CI smoke path: no probe subprocesses, no device child — one
+        # reduced-lane CPU measurement inside a hard 120 s envelope. The
+        # provisional line has already printed, so even a child failure
+        # leaves a parseable artifact (with probe.skipped preserved).
+        print("bench: TMTPU_BENCH_SKIP_PROBE=1 — skipping device probe, "
+              "running reduced CPU measurement", file=sys.stderr)
+        out = _run_child("cpu", timeout_s=100.0)
+        if out is None:
+            _emit_provisional_final(["skip-probe-cpu-child-failed"])
+        else:
+            _emit_with_provenance(out, [])
+        print(f"bench: total wall {time.perf_counter() - t0:.0f}s",
+              file=sys.stderr)
+        return
 
     backend = _init_backend_probe()
     attempts = []
@@ -536,7 +606,12 @@ def main():
     # CPU fallback (wedged/absent TPU): still report a real number, but at
     # a batch size the host can verify AND compile inside the driver's
     # budget — the 10k XLA:CPU graph alone costs minutes of compile.
-    lanes = LANES if backend != "cpu" else min(LANES, 2048)
+    if backend != "cpu":
+        lanes = LANES
+    elif SKIP_PROBE:
+        lanes = min(LANES, SKIP_PROBE_LANES)
+    else:
+        lanes = min(LANES, 2048)
 
     t0 = time.perf_counter()
     base = _make_votes(lanes)
@@ -733,6 +808,8 @@ def main():
                   "budget_s": PROBE_BUDGET_S,
                   "rpc_rtt_ms": round(rpc_ms, 1)},
     }
+    if SKIP_PROBE:
+        out["probe"]["skipped"] = True
     if failed:
         # machine-readable degradation marker: the headline was picked
         # from a reduced structure set

@@ -17,6 +17,9 @@ from tmtpu.tpu.compat import force_cpu_backend
 force_cpu_backend(8)
 
 
+import pytest  # noqa: E402
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running integration tests (TPU graph on CPU)"
@@ -25,3 +28,20 @@ def pytest_configure(config):
         "markers",
         "chaos: fault-injection / crash-recovery tests (libs/faultinject)"
     )
+
+
+@pytest.fixture(autouse=True)
+def _fresh_sigcache():
+    """The verified-signature cache and flush scheduler are process-wide
+    by design; tests must not see each other's verifications (or a
+    disabled cache left behind by a cache-off test)."""
+    from tmtpu.crypto import batch as crypto_batch
+    from tmtpu.crypto import sigcache
+
+    sigcache.DEFAULT.set_enabled(True)
+    sigcache.DEFAULT.invalidate_all()
+    crypto_batch.SCHEDULER.reset()
+    yield
+    sigcache.DEFAULT.set_enabled(True)
+    sigcache.DEFAULT.invalidate_all()
+    crypto_batch.SCHEDULER.reset()

@@ -242,3 +242,79 @@ def test_deadline_zero_calls_inline():
     # no thread hop: the call runs on THIS thread
     ident = bk.call_with_deadline(threading.get_ident, 0)
     assert ident == threading.get_ident()
+
+
+# --- verify-once cache interaction -------------------------------------------
+
+
+def test_half_open_not_advanced_by_sigcache_hits(monkeypatch):
+    """Verify-once regression (crypto/sigcache.py): cached lanes never
+    reach the device dispatch, so a flush served entirely from the
+    verified-signature cache must NOT count as a breaker success — only
+    a REAL device round-trip may advance half_open → closed. A wedged
+    tunnel would otherwise be declared healthy on the strength of
+    verifications it never ran."""
+    from tmtpu.config.config import CryptoConfig
+    from tmtpu.crypto import batch as crypto_batch
+    from tmtpu.crypto import ed25519 as ed
+    from tmtpu.crypto import sigcache
+    from tmtpu.tpu import verify as tv
+
+    br = bk.get(crypto_batch.BREAKER_NAME)
+    clock = FakeClock()
+    monkeypatch.setattr(br, "_clock", clock)
+    bk.configure(crypto_batch.BREAKER_NAME, failure_threshold=2,
+                 backoff_base_s=10.0, backoff_max_s=60.0,
+                 half_open_probes=1, jitter_ratio=0.0)
+    br.reset()
+    monkeypatch.setattr(crypto_batch, "_TPU_MIN_BATCH", 1)
+    monkeypatch.setattr(crypto_batch, "_tpu_usable", True)
+
+    priv = ed.gen_priv_key_from_secret(b"half-open-cache")
+    pk = priv.pub_key()
+    msg = b"cached round trip"
+    sig = priv.sign(msg)
+
+    device_calls = []
+
+    def fake_batch_verify(pks, msgs, sigs):
+        device_calls.append(len(pks))
+        return [True] * len(pks)
+
+    monkeypatch.setattr(tv, "batch_verify", fake_batch_verify)
+
+    def flush(m, s):
+        bv = crypto_batch.TPUBatchVerifier()
+        bv.add(pk, m, s)
+        return bv.verify()
+
+    try:
+        # prime the cache with a real (faked-device) verify while CLOSED
+        all_ok, _ = flush(msg, sig)
+        assert all_ok and device_calls == [1]
+        assert sigcache.DEFAULT.check("ed25519", pk.bytes(), msg, sig)
+
+        # trip the breaker, advance into the half-open window
+        br.record_failure(RuntimeError("device fell over"))
+        br.record_failure(RuntimeError("device fell over"))
+        assert br.state == bk.OPEN
+        clock.advance(11.0)
+
+        # a fully cache-served flush: zero dispatches, and the breaker
+        # must NOT close on the back of it
+        all_ok, mask = flush(msg, sig)
+        assert all_ok and mask == [True]
+        assert device_calls == [1], "cache hit must not touch the device"
+        assert br.state != bk.CLOSED, \
+            "cache hits must not advance half_open -> closed"
+
+        # a genuinely new signature forces a real half-open probe
+        # round-trip — THAT closes the breaker
+        msg2 = b"fresh round trip"
+        sig2 = priv.sign(msg2)
+        all_ok, _ = flush(msg2, sig2)
+        assert all_ok and device_calls == [1, 1]
+        assert br.state == bk.CLOSED
+    finally:
+        br.reset()
+        crypto_batch.configure(CryptoConfig())

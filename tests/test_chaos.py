@@ -76,6 +76,14 @@ def breaker_env(monkeypatch):
     br.reset()
     monkeypatch.setattr(crypto_batch, "_TPU_MIN_BATCH", 1)
     monkeypatch.setattr(crypto_batch, "_tpu_usable", True)
+    # these scenarios re-flush IDENTICAL deterministic items and assert
+    # exact device-call / fallback-lane counts — the verify-once cache
+    # would legitimately absorb the repeats, so switch it off here
+    # (breaker behavior is orthogonal; test_breaker.py covers the
+    # cache-hits-don't-close-the-breaker interaction)
+    from tmtpu.crypto import sigcache
+
+    sigcache.DEFAULT.set_enabled(False)
     faultinject.reset()
     yield br, clock
     faultinject.reset()

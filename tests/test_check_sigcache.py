@@ -1,0 +1,109 @@
+"""Tier-1 wiring for the verify-once lint (tools/check_sigcache.py): the
+tree must stay clean, and the lint must actually detect both failure
+modes it claims to — a stray serial ``verify_signature`` call in a hot
+path, and a ``verify_commit*`` implementation that stops batching."""
+
+import os
+import textwrap
+
+from tools import check_sigcache
+
+
+def test_tree_is_clean():
+    assert check_sigcache.check() == []
+
+
+def test_detects_serial_verify_in_hot_path(tmp_path, monkeypatch):
+    """A .verify_signature( call site outside the oracle/fallback
+    whitelist must be flagged with file:line."""
+    hot = tmp_path / "tmtpu" / "consensus"
+    hot.mkdir(parents=True)
+    (hot / "offender.py").write_text(textwrap.dedent("""\
+        def check_vote(pk, vote, chain_id):
+            # the exact pattern ISSUE 4 removed from the hot paths
+            return pk.verify_signature(vote.sign_bytes(chain_id),
+                                       vote.signature)
+        """))
+    # the commit-impl file must exist for rule 2's parse
+    types_dir = tmp_path / "tmtpu" / "types"
+    types_dir.mkdir(parents=True)
+    (types_dir / "commit_verify.py").write_text(textwrap.dedent("""\
+        from tmtpu.crypto.batch import new_batch_verifier
+
+        def verify_commit(*a): new_batch_verifier()
+        def verify_commit_light(*a): new_batch_verifier()
+        def verify_commit_light_trusting(*a): new_batch_verifier()
+        def verify_commits_light_batch(*a): new_batch_verifier()
+        """))
+    monkeypatch.setattr(check_sigcache, "REPO", str(tmp_path))
+    findings = check_sigcache.check()
+    assert any("serial verify in hot path" in f and
+               os.path.join("tmtpu", "consensus", "offender.py") + ":3" in f
+               for f in findings), findings
+
+
+def test_whitelist_allows_oracle_and_fallback(tmp_path, monkeypatch):
+    """The crypto key impls / batch fallback / cold paths may call
+    verify_signature directly — that IS the oracle layer."""
+    for rel in (("tmtpu", "crypto", "impl.py"),
+                ("tmtpu", "tpu", "oracle.py"),
+                ("tmtpu", "privval", "harness.py"),
+                ("tmtpu", "p2p", "conn", "secret_connection.py")):
+        p = tmp_path.joinpath(*rel)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text("def f(pk): return pk.verify_signature(b'm', b's')\n")
+    types_dir = tmp_path / "tmtpu" / "types"
+    types_dir.mkdir(parents=True)
+    (types_dir / "commit_verify.py").write_text(
+        "def verify_commit(*a):\n    from tmtpu.crypto.batch import "
+        "new_batch_verifier\n    new_batch_verifier()\n"
+        "def verify_commit_light(*a): verify_commit()\n"
+        "def verify_commit_light_trusting(*a): verify_commit()\n"
+        "def verify_commits_light_batch(*a): verify_commit()\n")
+    monkeypatch.setattr(check_sigcache, "REPO", str(tmp_path))
+    findings = check_sigcache.check()
+    assert not any("serial verify" in f for f in findings), findings
+
+
+def test_detects_unbatched_commit_verify(tmp_path, monkeypatch):
+    """A verify_commit* that quietly loops serial verifies (no
+    BatchVerifier anywhere in its body) must be flagged."""
+    types_dir = tmp_path / "tmtpu" / "types"
+    types_dir.mkdir(parents=True)
+    (types_dir / "commit_verify.py").write_text(textwrap.dedent("""\
+        from tmtpu.crypto.batch import new_batch_verifier
+
+        def verify_commit(chain_id, vals, commit):
+            ok = True
+            for sig in commit.signatures:
+                ok = ok and bool(sig)   # no batch layer in sight
+            return ok
+
+        def verify_commit_light(*a): new_batch_verifier()
+        def verify_commit_light_trusting(*a): new_batch_verifier()
+        def verify_commits_light_batch(*a): new_batch_verifier()
+        """))
+    monkeypatch.setattr(check_sigcache, "REPO", str(tmp_path))
+    findings = check_sigcache.check()
+    assert any("unbatched commit verify" in f and "verify_commit()" in f
+               for f in findings), findings
+
+
+def test_detects_stale_coverage_map(tmp_path, monkeypatch):
+    """If a commit-verify entry point disappears (renamed), the lint
+    must fail loudly instead of silently covering nothing."""
+    types_dir = tmp_path / "tmtpu" / "types"
+    types_dir.mkdir(parents=True)
+    (types_dir / "commit_verify.py").write_text(
+        "def verify_commit(*a):\n    from tmtpu.crypto.batch import "
+        "new_batch_verifier\n    new_batch_verifier()\n")
+    monkeypatch.setattr(check_sigcache, "REPO", str(tmp_path))
+    findings = check_sigcache.check()
+    assert any("missing commit verify entry point" in f
+               and "verify_commit_light" in f for f in findings), findings
+
+
+def test_main_exit_codes(capsys):
+    assert check_sigcache.main() == 0
+    out = capsys.readouterr().out
+    assert "no stray serial verifies" in out

@@ -85,6 +85,7 @@ def test_fuzz_secret_connection_handshake_garbage():
     import socket
     import threading
 
+    pytest.importorskip("cryptography")  # the real AEAD handshake
     from tmtpu.crypto import ed25519
     from tmtpu.p2p.conn.secret_connection import SecretConnection
 

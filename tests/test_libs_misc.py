@@ -192,6 +192,7 @@ def test_secret_connection_transcript_challenge():
     transcript (secret_connection.go:111-135), not just the DH secret."""
     import socket as socketlib
 
+    pytest.importorskip("cryptography")  # the real AEAD handshake
     from tmtpu.crypto import ed25519
     from tmtpu.p2p.conn.secret_connection import SecretConnection
 

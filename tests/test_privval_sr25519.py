@@ -206,6 +206,7 @@ def test_sr25519_proto_roundtrip_and_json():
 def test_mixed_curve_valset_commit_verification():
     """BASELINE config: ed25519 + sr25519 + secp256k1 in one valset; the
     batch verifier routes per-curve and the commit still verifies."""
+    pytest.importorskip("cryptography")  # secp256k1 needs the real lib
     from tmtpu.crypto import ed25519, secp256k1
 
     privs = [ed25519.gen_priv_key(), sr25519.gen_priv_key(),

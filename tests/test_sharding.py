@@ -15,6 +15,7 @@ def test_power_limbs_roundtrip():
 
 
 def test_dryrun_multichip_8():
+    pytest.importorskip("cryptography")  # dryrun's vote-gen oracle
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)

@@ -128,6 +128,12 @@ def test_100_validator_net_commits_through_device_batches(monkeypatch):
     # bursts pad to the single 128-lane bucket (one ~90 s CPU compile
     # instead of one per drain size)
     monkeypatch.setattr(tv, "_pad_to_bucket", lambda n: 128)
+    # the warmup adds one vote 16x and the asserts count raw dispatch
+    # lanes — verify-once dedup/caching would collapse both, so run this
+    # scenario cache-off (tests/test_sigcache.py covers cache-on)
+    from tmtpu.crypto import sigcache
+
+    sigcache.DEFAULT.set_enabled(False)
 
     live_pv = MockPV()
     co_pvs = [MockPV() for _ in range(99)]
@@ -166,14 +172,15 @@ def test_100_validator_net_commits_through_device_batches(monkeypatch):
     cs.verify_backend = "tpu"
 
     dispatched = []
-    real_run = crypto_batch.TPUBatchVerifier._run
+    real_run = crypto_batch.TPUBatchVerifier._verify_pending
 
-    def spy_run(self, tally):
-        if len(self) >= 16:
-            dispatched.append(len(self))
-        return real_run(self, tally)
+    def spy_run(self, items, tally):
+        if len(items) >= 16:
+            dispatched.append(len(items))
+        return real_run(self, items, tally)
 
-    monkeypatch.setattr(crypto_batch.TPUBatchVerifier, "_run", spy_run)
+    monkeypatch.setattr(crypto_batch.TPUBatchVerifier, "_verify_pending",
+                        spy_run)
 
     def on_proposal(proposal, parts):
         if proposal.height != 1:
@@ -244,6 +251,11 @@ def test_10k_validator_live_consensus_round(monkeypatch):
     # real 10k VoteSet uses (sub-16 bursts — the node's own votes — go
     # serial), so the minutes-scale XLA:CPU compile happens once, up front
     monkeypatch.setattr(tv, "_pad_to_bucket", lambda n: 10_240)
+    # identical-vote warmup + raw dispatch-lane accounting: cache-off
+    # (see test_100_validator_net note)
+    from tmtpu.crypto import sigcache
+
+    sigcache.DEFAULT.set_enabled(False)
 
     live_pv = MockPV()
     co_pvs = [MockPV() for _ in range(n_co)]
@@ -283,14 +295,15 @@ def test_10k_validator_live_consensus_round(monkeypatch):
     cs.verify_backend = "tpu"
 
     dispatched = []
-    real_run = crypto_batch.TPUBatchVerifier._run
+    real_run = crypto_batch.TPUBatchVerifier._verify_pending
 
-    def spy_run(self, tally):
-        if len(self) >= 16:
-            dispatched.append(len(self))
-        return real_run(self, tally)
+    def spy_run(self, items, tally):
+        if len(items) >= 16:
+            dispatched.append(len(items))
+        return real_run(self, items, tally)
 
-    monkeypatch.setattr(crypto_batch.TPUBatchVerifier, "_run", spy_run)
+    monkeypatch.setattr(crypto_batch.TPUBatchVerifier, "_verify_pending",
+                        spy_run)
 
     t_prop = {}
 
@@ -356,6 +369,11 @@ def test_consensus_commits_blocks_on_tpu_backend(monkeypatch):
     monkeypatch.setattr(crypto_batch, "_TPU_MIN_BATCH", 1)
     monkeypatch.setattr(crypto_batch, "_default_backend", "tpu")
     monkeypatch.setattr(crypto_batch, "_tpu_usable", True)
+    # identical-vote bucket warmups below would dedup to one lane with
+    # the verify-once cache on; run the scenario cache-off
+    from tmtpu.crypto import sigcache
+
+    sigcache.DEFAULT.set_enabled(False)
 
     # pre-warm EVERY bucket shape this net can hit (batches of 1..4 votes
     # with MIN_BATCH=1 → buckets 1/2/4, plus 8 for headroom) for both

@@ -203,6 +203,20 @@ class CryptoConfig:
     breaker_backoff_max_ns: int = 300_000 * MS
     # successful half-open probe batches required to close again
     breaker_half_open_probes: int = 2
+    # verified-signature cache (crypto/sigcache.py): a (pubkey, msg,
+    # sig) triple verified once never burns a batch lane again —
+    # ApplyBlock on a self-committed height re-checks the commit for
+    # ~zero dispatches. Entries are 32-byte digests; the default cap is
+    # a few MB. Shards stripe the lock (rounded down to a power of two).
+    sigcache_enable: bool = True
+    sigcache_max_entries: int = 131072
+    sigcache_shards: int = 16
+    # adaptive flush scheduling (crypto/batch.py SCHEDULER): gather up
+    # to flush_max_wait toward target_lanes = arrival_rate × device RTT
+    # before flushing; inert until both EWMAs have real device samples
+    adaptive_flush: bool = True
+    flush_max_wait_ns: int = 8 * MS
+    flush_max_lanes: int = 4096
 
 
 @dataclass

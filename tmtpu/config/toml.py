@@ -159,3 +159,11 @@ def validate(cfg: Config) -> None:
             cfg.crypto.breaker_backoff_base_ns:
         raise ValueError("crypto breaker backoff must satisfy "
                          "0 < base <= max")
+    if cfg.crypto.sigcache_max_entries < 1:
+        raise ValueError("crypto.sigcache_max_entries must be >= 1")
+    if cfg.crypto.sigcache_shards < 1:
+        raise ValueError("crypto.sigcache_shards must be >= 1")
+    if cfg.crypto.flush_max_wait_ns < 0:
+        raise ValueError("crypto.flush_max_wait_ns cannot be negative")
+    if cfg.crypto.flush_max_lanes < 1:
+        raise ValueError("crypto.flush_max_lanes must be >= 1")

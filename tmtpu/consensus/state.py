@@ -426,6 +426,35 @@ class ConsensusState(BaseService):
                 if mi is None:
                     return None
                 msgs.append(mi)
+        # adaptive gather (crypto/batch.py SCHEDULER): when rate×RTT data
+        # says the pending vote count is below the amortization target,
+        # linger a bounded few ms draining more — one fuller dispatch
+        # instead of two sparse ones. Inert (0.0 wait) until real device
+        # RTT samples exist, so CPU-only nodes keep the legacy window;
+        # never delays a timeout.
+        if not timeouts:
+            n_votes = sum(1 for mi in msgs
+                          if isinstance(mi.msg, VoteMessage))
+            if n_votes:
+                from tmtpu.crypto import batch as _crypto_batch
+
+                wait = _crypto_batch.SCHEDULER.gather_wait_s(n_votes)
+                if wait > 0:
+                    from tmtpu.libs import metrics as _m
+
+                    _m.crypto_flush_gather_waits.inc()
+                    deadline = time.monotonic() + wait
+                    while True:
+                        left = deadline - time.monotonic()
+                        if left <= 0:
+                            break
+                        try:
+                            mi = self.peer_msg_queue.get(timeout=left)
+                        except queue.Empty:
+                            break
+                        if mi is None:
+                            return None
+                        msgs.append(mi)
         while True:
             try:
                 timeouts.append(self._timeout_queue.get_nowait())
@@ -884,7 +913,10 @@ class ConsensusState(BaseService):
                  proposal.pol_round >= proposal.round):
             raise VoteError("error invalid proposal POL round")
         proposer = rs.validators.get_proposer()
-        if not proposer.pub_key.verify_signature(
+        from tmtpu.crypto import batch as _crypto_batch
+
+        if not _crypto_batch.verify_one(
+                proposer.pub_key,
                 proposal.sign_bytes(self.state.chain_id), proposal.signature):
             raise VoteError("error invalid proposal signature")
         rs.proposal = proposal

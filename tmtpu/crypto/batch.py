@@ -25,15 +25,36 @@ Backend selection: ``set_default_backend`` / config ``crypto.backend``;
 breaker — a transient probe failure no longer pins the node to CPU
 forever: the breaker opens after a few consecutive failures, backs off,
 and re-probes (libs/breaker.py, docs/RESILIENCE.md).
+
+Verify-once hot path (crypto/sigcache.py): before any lane is assigned,
+every (pubkey, msg, sig) triple is checked against the process-wide
+verified-signature cache — a cached triple never occupies a lane, and
+identical in-flight triples within one batch collapse onto a single
+lane (one verify, N results). Successful verifications are inserted on
+the way out, so a precommit verified at vote ingestion costs ZERO
+dispatches when verify_commit re-checks it during the next height's
+ApplyBlock, and blocksync/light-client re-verification of already-seen
+commits short-circuits the same way. Cache hits never touch the
+breaker: only real device round-trips advance ``half_open → closed``.
+
+Adaptive flush scheduling: the module-level ``SCHEDULER`` tracks lane
+arrival rate (EWMA over ``add()`` calls) and device dispatch RTT (EWMA
+over timed ``_dispatch`` round-trips) and picks a flush size between
+min-latency (dispatch what you have) and max-amortization (wait one RTT
+worth of arrivals): ``target_lanes = clamp(rate × rtt)``. The consensus
+receive loop consults ``gather_wait_s`` to decide whether a few extra
+milliseconds of draining buys a materially fuller batch; the breaker
+and per-batch deadline machinery are unchanged.
 """
 
 from __future__ import annotations
 
 import os
 import threading
-from typing import List, Optional, Tuple
+import time as _time_mod
+from typing import Dict, List, Optional, Tuple
 
-from tmtpu.crypto import keys
+from tmtpu.crypto import keys, sigcache
 from tmtpu.crypto.keys import PubKey
 from tmtpu.libs import breaker as _bk
 
@@ -66,10 +87,112 @@ def _tpu_breaker() -> "_bk.CircuitBreaker":
     return _bk.get(BREAKER_NAME)
 
 
+class AdaptiveFlushScheduler:
+    """Pick the flush size between min-latency and max-amortization.
+
+    Two EWMAs: lane ARRIVAL RATE (updated by every ``BatchVerifier.add``)
+    and device dispatch RTT (updated by every successful timed device
+    round-trip in ``_dispatch`` — serial fallbacks and cache hits do not
+    count, they carry no tunnel latency signal). The optimal batch under
+    a fixed per-dispatch cost is the number of lanes that arrive during
+    one RTT: fewer and the dispatch overhead dominates, more and queue
+    latency dominates. So ``target_lanes = clamp(rate × rtt, min, max)``
+    and ``gather_wait_s(pending)`` answers "is it worth draining a few
+    more ms before flushing?" — capped at ``max_wait_s`` so consensus
+    latency is bounded, and ZERO until both EWMAs have real samples
+    (CPU-only nodes and fresh processes keep the legacy flush-now
+    behavior)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._alpha = 0.2
+        self._rate = 0.0          # lanes/s
+        self._rtt = 0.0           # seconds per device round-trip
+        self._last_arrival: Optional[float] = None
+        self.enabled = True
+        self.min_lanes = _TPU_MIN_BATCH
+        self.max_lanes = 4096
+        self.max_wait_s = 0.008
+
+    def note_arrivals(self, n: int = 1) -> None:
+        now = _time_mod.monotonic()
+        with self._lock:
+            last, self._last_arrival = self._last_arrival, now
+            if last is None:
+                return
+            dt = now - last
+            if dt <= 0:
+                return
+            # arrivals more than ~1s apart mean an idle gap, not a rate
+            # sample — consensus rounds are sub-second; skip them so one
+            # quiet stretch does not zero the EWMA
+            if dt > 1.0:
+                return
+            inst = n / dt
+            a = self._alpha
+            self._rate = inst if self._rate <= 0 else \
+                (1 - a) * self._rate + a * inst
+
+    def note_dispatch(self, lanes: int, seconds: float) -> None:
+        if seconds <= 0:
+            return
+        # compilation outliers (first XLA trace per bucket shape) would
+        # poison the steady-state RTT; clamp the sample
+        seconds = min(seconds, 2.0)
+        with self._lock:
+            a = self._alpha
+            self._rtt = seconds if self._rtt <= 0 else \
+                (1 - a) * self._rtt + a * seconds
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {"rate_lanes_per_s": round(self._rate, 3),
+                    "rtt_s": round(self._rtt, 6),
+                    "enabled": self.enabled,
+                    "target_lanes": self._target_locked()}
+
+    def _target_locked(self) -> int:
+        if not self.enabled or self._rtt <= 0 or self._rate <= 0:
+            return self.min_lanes
+        return int(max(self.min_lanes,
+                       min(self.max_lanes, self._rate * self._rtt)))
+
+    def target_lanes(self) -> int:
+        with self._lock:
+            t = self._target_locked()
+        from tmtpu.libs import metrics as _m
+
+        _m.crypto_flush_target_lanes.set(t)
+        return t
+
+    def gather_wait_s(self, pending: int) -> float:
+        """Seconds the drain loop may linger to fill ``pending`` toward
+        the target before flushing. 0.0 when adaptive data is absent,
+        the target is already met, or the scheduler is disabled."""
+        with self._lock:
+            if (not self.enabled or self._rtt <= 0 or self._rate <= 0):
+                return 0.0
+            target = self._target_locked()
+            rate = self._rate
+        if pending >= target:
+            return 0.0
+        return min((target - pending) / rate, self.max_wait_s)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._rate = 0.0
+            self._rtt = 0.0
+            self._last_arrival = None
+
+
+SCHEDULER = AdaptiveFlushScheduler()
+
+
 def configure(crypto_cfg) -> None:
     """Apply a config/config.py ``CryptoConfig``: probe + per-batch
     deadlines for this module, thresholds/backoff for the ``crypto.tpu``
-    breaker. Safe to call again on config reload."""
+    breaker, ``sigcache_*`` knobs for the verified-signature cache, and
+    the adaptive flush window. Safe to call again on config reload."""
     global _probe_timeout_s, _batch_deadline_s
     _probe_timeout_s = crypto_cfg.probe_timeout_ns / 1e9
     _batch_deadline_s = crypto_cfg.batch_deadline_ns / 1e9
@@ -79,6 +202,15 @@ def configure(crypto_cfg) -> None:
         backoff_base_s=crypto_cfg.breaker_backoff_base_ns / 1e9,
         backoff_max_s=crypto_cfg.breaker_backoff_max_ns / 1e9,
         half_open_probes=crypto_cfg.breaker_half_open_probes)
+    sigcache.configure(
+        getattr(crypto_cfg, "sigcache_max_entries",
+                sigcache.DEFAULT_MAX_ENTRIES),
+        getattr(crypto_cfg, "sigcache_shards", sigcache.DEFAULT_SHARDS),
+        getattr(crypto_cfg, "sigcache_enable", True))
+    SCHEDULER.enabled = getattr(crypto_cfg, "adaptive_flush", True)
+    SCHEDULER.max_wait_s = getattr(
+        crypto_cfg, "flush_max_wait_ns", 8_000_000) / 1e9
+    SCHEDULER.max_lanes = getattr(crypto_cfg, "flush_max_lanes", 4096)
 
 
 def probe_timeout_s() -> float:
@@ -165,14 +297,26 @@ def _tpu_available() -> bool:
 
 
 class BatchVerifier(keys.BatchVerifier):
-    """Accumulate (pubkey, msg, sig[, power]) items, then verify at once."""
+    """Accumulate (pubkey, msg, sig[, power]) items, then verify at once.
+
+    ``verify``/``verify_tally`` run the verify-once resolve: every lane
+    is checked against the process-wide sigcache first (a hit costs no
+    lane), identical in-flight triples collapse onto one lane with their
+    powers folded so the fused device tally still counts every member,
+    and only the deduped miss list reaches the backend hook
+    ``_verify_pending``. Successful lanes are inserted into the cache on
+    the way out. ``self.cache_stats`` carries the per-flush breakdown
+    (lanes/hits/dedup/dispatched) for callers and the timeline."""
 
     def __init__(self):
         self._items: List[Tuple[PubKey, bytes, bytes, int]] = []
+        self.cache_stats: Dict = {"lanes": 0, "hits": 0, "dedup": 0,
+                                  "dispatched": 0}
 
     def add(self, pub_key: PubKey, msg: bytes, sig: bytes,
             power: int = 0) -> None:
         self._items.append((pub_key, bytes(msg), bytes(sig), int(power)))
+        SCHEDULER.note_arrivals(1)
 
     def count(self) -> int:
         return len(self._items)
@@ -180,19 +324,87 @@ class BatchVerifier(keys.BatchVerifier):
     def __len__(self) -> int:
         return len(self._items)
 
-    def verify(self) -> Tuple[bool, List[bool]]:
+    def _verify_pending(self, items: List[Tuple[PubKey, bytes, bytes, int]],
+                        tally: bool) -> Tuple[List[bool], int]:
+        """Backend hook: verify the deduped cache-miss lanes. Returns
+        (mask over ``items``, tallied power of valid lanes)."""
         raise NotImplementedError
 
+    def _resolve(self, tally: bool) -> Tuple[bool, List[bool], int]:
+        items = self._items
+        n = len(items)
+        cache = sigcache.DEFAULT
+        if not cache.enabled():
+            # cache off: no keys, no dedup — byte-for-byte the legacy
+            # behavior (tests that count device calls rely on this)
+            mask, tallied = self._verify_pending(items, tally)
+            self.cache_stats = {"lanes": n, "hits": 0, "dedup": 0,
+                                "dispatched": n}
+            return all(mask), mask, tallied
+        mask = [False] * n
+        tallied = 0
+        hits = 0
+        dedup = 0
+        ks = [sigcache.cache_key(pk.type_value(), pk.bytes(), msg, sig)
+              for pk, msg, sig, _p in items]
+        group_of: Dict[bytes, int] = {}
+        pending: List[int] = []       # representative index per unique miss
+        members: List[List[int]] = []  # all indices sharing that triple
+        for i, k in enumerate(ks):
+            if cache.contains(k):
+                mask[i] = True
+                tallied += items[i][3]
+                hits += 1
+                continue
+            pos = group_of.get(k)
+            if pos is None:
+                group_of[k] = len(pending)
+                pending.append(i)
+                members.append([i])
+            else:
+                members[pos].append(i)
+                dedup += 1
+        if pending:
+            sub_items = []
+            for pos, i in enumerate(pending):
+                pk, msg, sig, _p = items[i]
+                # fold dup-group powers into the unique lane so the
+                # fused device tally counts every member exactly once
+                sub_items.append((pk, msg, sig,
+                                  sum(items[j][3] for j in members[pos])))
+            sub_mask, sub_tallied = self._verify_pending(sub_items, tally)
+            tallied += sub_tallied
+            for pos, ok in enumerate(sub_mask):
+                if ok:
+                    cache.add(ks[pending[pos]])
+                for j in members[pos]:
+                    mask[j] = bool(ok)
+        if dedup:
+            from tmtpu.libs import metrics as _m
+
+            _m.crypto_sigcache_dedup_lanes.inc(dedup)
+        self.cache_stats = {"lanes": n, "hits": hits, "dedup": dedup,
+                            "dispatched": len(pending)}
+        if n and (hits or dedup):
+            from tmtpu.libs import timeline as _tl
+
+            _tl.record_sigcache(lanes=n, hits=hits, dedup=dedup,
+                                dispatched=len(pending))
+        return all(mask), mask, tallied
+
+    def verify(self) -> Tuple[bool, List[bool]]:
+        all_ok, mask, _ = self._resolve(tally=False)
+        return all_ok, mask
+
     def verify_tally(self) -> Tuple[bool, List[bool], int]:
-        all_ok, mask = self.verify()
-        tallied = sum(
-            it[3] for it, ok in zip(self._items, mask) if ok
-        )
-        return all_ok, mask, tallied
+        """Fused verify + power tally. Cache hits contribute their power
+        host-side; the device sum covers only dispatched lanes, so the
+        total still equals the sum over every valid input lane."""
+        return self._resolve(tally=True)
 
 
 class CPUBatchVerifier(BatchVerifier):
-    def verify(self) -> Tuple[bool, List[bool]]:
+    def _verify_pending(self, items, tally) -> Tuple[List[bool], int]:
         """ed25519 lanes go through ONE native batched-libcrypto call
         (tmtpu/native ed25519_verify_batch — python-cryptography's
         per-call overhead roughly halves the serial rate); everything
@@ -204,20 +416,20 @@ class CPUBatchVerifier(BatchVerifier):
         from tmtpu.libs import trace
 
         t0 = time.perf_counter()
-        mask = [False] * len(self._items)
-        ed_idx = [i for i, (pk, _, sig, _) in enumerate(self._items)
+        mask = [False] * len(items)
+        ed_idx = [i for i, (pk, _, sig, _) in enumerate(items)
                   if pk.type_value() == ED25519 and len(sig) == 64]
         done = set()
         impl = "serial"
-        with trace.span("crypto.cpu_batch_verify", lanes=len(self._items)):
+        with trace.span("crypto.cpu_batch_verify", lanes=len(items)):
             if len(ed_idx) >= 2:
                 try:
                     from tmtpu import native
 
                     ok = native.ed25519_verify_batch(
-                        [self._items[i][0].bytes() for i in ed_idx],
-                        [self._items[i][1] for i in ed_idx],
-                        [self._items[i][2] for i in ed_idx])
+                        [items[i][0].bytes() for i in ed_idx],
+                        [items[i][1] for i in ed_idx],
+                        [items[i][2] for i in ed_idx])
                 except Exception:  # noqa: BLE001 — never break verification
                     ok = None
                 if ok is not None:
@@ -225,12 +437,12 @@ class CPUBatchVerifier(BatchVerifier):
                     for i, v in zip(ed_idx, ok):
                         mask[i] = v
                     done = set(ed_idx)
-            for i, (pk, msg, sig, _) in enumerate(self._items):
+            for i, (pk, msg, sig, _) in enumerate(items):
                 if i not in done:
                     mask[i] = pk.verify_signature(msg, sig)
         dt = time.perf_counter() - t0
         by_curve: dict = {}
-        for pk, _msg, _sig, _p in self._items:
+        for pk, _msg, _sig, _p in items:
             c = pk.type_value()
             by_curve[c] = by_curve.get(c, 0) + 1
         for c, n in by_curve.items():
@@ -239,18 +451,20 @@ class CPUBatchVerifier(BatchVerifier):
                                     n, 0, dt)
         from tmtpu.libs import timeline as _tl
 
-        _tl.record_flush(backend="cpu", lanes=len(self._items),
+        _tl.record_flush(backend="cpu", lanes=len(items),
                          ok=sum(mask), seconds=round(dt, 6))
-        return all(mask), mask
+        tallied = sum(it[3] for it, ok in zip(items, mask) if ok)
+        return mask, tallied
 
 
 class TPUBatchVerifier(BatchVerifier):
-    def _split(self):
+    @staticmethod
+    def _split(items):
         """Partition items into per-curve device-eligible lanes and CPU
         lanes (mixed-curve valsets dispatch one device batch per curve)."""
         ed_idx, ed_pks, ed_msgs, ed_sigs, ed_powers = [], [], [], [], []
         sr_idx, k1_idx, cpu_idx = [], [], []
-        for i, (pk, msg, sig, power) in enumerate(self._items):
+        for i, (pk, msg, sig, power) in enumerate(items):
             if pk.type_value() == ED25519 and len(sig) == 64:
                 ed_idx.append(i)
                 ed_pks.append(pk.bytes())
@@ -266,26 +480,20 @@ class TPUBatchVerifier(BatchVerifier):
         return (ed_idx, ed_pks, ed_msgs, ed_sigs, ed_powers,
                 sr_idx, k1_idx, cpu_idx)
 
-    def verify(self) -> Tuple[bool, List[bool]]:
-        all_ok, mask, _ = self._run(tally=False)
-        return all_ok, mask
-
-    def verify_tally(self) -> Tuple[bool, List[bool], int]:
-        """Fused verify + power tally: ed25519 lanes get ONE device dispatch
-        that returns both the validity mask and the psum of valid lanes'
-        powers (tmtpu.tpu.sharding.verify_tally_step_compact); sr25519 and
-        secp256k1 lanes get their own device dispatches (mask only —
+    def _verify_pending(self, items, tally) -> Tuple[List[bool], int]:
+        """Fused verify + power tally over the deduped miss lanes:
+        ed25519 lanes get ONE device dispatch that (for ``tally``)
+        returns both the validity mask and the psum of valid lanes'
+        powers (tmtpu.tpu.sharding.verify_tally_step_compact); sr25519
+        and secp256k1 lanes get their own device dispatches (mask only —
         powers summed on host); sub-threshold groups verify serially."""
-        return self._run(tally=True)
-
-    def _run(self, tally: bool) -> Tuple[bool, List[bool], int]:
         import time as _time
 
         from tmtpu.libs import metrics as _m
 
         t0 = _time.perf_counter()
         (ed_idx, ed_pks, ed_msgs, ed_sigs, ed_powers,
-         sr_idx, k1_idx, cpu_idx) = self._split()
+         sr_idx, k1_idx, cpu_idx) = self._split(items)
         if cpu_idx:
             _m.crypto_cpu_fallback.inc(len(cpu_idx), curve="other",
                                        reason="unsupported")
@@ -299,10 +507,10 @@ class TPUBatchVerifier(BatchVerifier):
             _m.crypto_cpu_fallback.inc(len(k1_idx), curve=SECP256K1,
                                        reason="small-batch")
             k1_idx = []
-        mask: List[bool] = [False] * len(self._items)
+        mask: List[bool] = [False] * len(items)
         tallied = 0
         for i in cpu_idx:
-            pk, msg, sig, power = self._items[i]
+            pk, msg, sig, power = items[i]
             mask[i] = pk.verify_signature(msg, sig)
             if mask[i]:
                 tallied += power
@@ -316,7 +524,7 @@ class TPUBatchVerifier(BatchVerifier):
             _m.crypto_cpu_fallback.inc(len(idx_list), curve=curve,
                                        reason=reason)
             for i in idx_list:
-                pk, msg, sig, power = self._items[i]
+                pk, msg, sig, power = items[i]
                 mask[i] = pk.verify_signature(msg, sig)
                 if mask[i]:
                     tallied += power
@@ -326,10 +534,13 @@ class TPUBatchVerifier(BatchVerifier):
             per-batch deadline. Any failure — hung dispatch past the
             deadline, device/runtime error — records against the
             breaker and re-verifies exactly these lanes serially, so
-            the flush always returns an exact mask."""
+            the flush always returns an exact mask. Successful
+            round-trips feed the adaptive flush scheduler's RTT
+            estimate (cache hits and serial fallbacks never do)."""
             if not br.allow():
                 _serial(idx_list, curve, "breaker-open")
                 return
+            d0 = _time.perf_counter()
             try:
                 out = _bk.call_with_deadline(thunk, deadline)
             except _bk.DeadlineExceeded as e:
@@ -343,6 +554,8 @@ class TPUBatchVerifier(BatchVerifier):
                 _serial(idx_list, curve, "device-error")
                 return
             br.record_success()
+            SCHEDULER.note_dispatch(len(idx_list),
+                                    _time.perf_counter() - d0)
             apply(out)
 
         def _apply_mask(idx_list):
@@ -351,24 +564,24 @@ class TPUBatchVerifier(BatchVerifier):
                 for j, i in enumerate(idx_list):
                     mask[i] = bool(dev_mask[j])
                     if mask[i]:
-                        tallied += self._items[i][3]
+                        tallied += items[i][3]
             return apply
 
         if sr_idx:
             from tmtpu.tpu.sr_verify import batch_verify_sr
 
             _dispatch(SR25519, sr_idx, lambda: batch_verify_sr(
-                [self._items[i][0].bytes() for i in sr_idx],
-                [self._items[i][1] for i in sr_idx],
-                [self._items[i][2] for i in sr_idx],
+                [items[i][0].bytes() for i in sr_idx],
+                [items[i][1] for i in sr_idx],
+                [items[i][2] for i in sr_idx],
             ), _apply_mask(sr_idx))
         if k1_idx:
             from tmtpu.tpu.k1_verify import batch_verify_k1
 
             _dispatch(SECP256K1, k1_idx, lambda: batch_verify_k1(
-                [self._items[i][0].bytes() for i in k1_idx],
-                [self._items[i][1] for i in k1_idx],
-                [self._items[i][2] for i in k1_idx],
+                [items[i][0].bytes() for i in k1_idx],
+                [items[i][1] for i in k1_idx],
+                [items[i][2] for i in k1_idx],
             ), _apply_mask(k1_idx))
         if ed_idx:
             if len(ed_idx) < _TPU_MIN_BATCH:
@@ -392,10 +605,10 @@ class TPUBatchVerifier(BatchVerifier):
                     ed_pks, ed_msgs, ed_sigs), _apply_mask(ed_idx))
         from tmtpu.libs import timeline as _tl
 
-        _tl.record_flush(backend="tpu", lanes=len(self._items),
+        _tl.record_flush(backend="tpu", lanes=len(items),
                          ok=sum(mask),
                          seconds=round(_time.perf_counter() - t0, 6))
-        return all(mask), mask, tallied
+        return mask, tallied
 
 
 def new_batch_verifier(backend: Optional[str] = None) -> BatchVerifier:
@@ -412,3 +625,21 @@ def batch_verify_items(items, backend: Optional[str] = None):
     for pk, msg, sig in items:
         bv.add(pk, msg, sig)
     return bv.verify()
+
+
+def verify_one(pub_key: PubKey, msg: bytes, sig: bytes) -> bool:
+    """Cache-aware single-signature verify for paths that cannot batch
+    (proposal signature, Vote.verify, privval handshakes): consults the
+    verified-signature cache before the serial PubKey verify and records
+    successes, so e.g. a proposal re-checked after a WAL replay, or a
+    vote object verified outside a VoteSet, rides the verify-once path."""
+    cache = sigcache.DEFAULT
+    if not cache.enabled():
+        return pub_key.verify_signature(msg, sig)
+    k = sigcache.cache_key(pub_key.type_value(), pub_key.bytes(), msg, sig)
+    if cache.contains(k):
+        return True
+    ok = pub_key.verify_signature(msg, sig)
+    if ok:
+        cache.add(k)
+    return ok

@@ -352,6 +352,42 @@ crypto_cpu_fallback = DEFAULT.counter(
     "crypto", "cpu_fallback_total",
     "Signatures verified on the serial CPU path instead of the device",
     labels=("curve", "reason"))
+# --- the verify-once hot path metric set (crypto/sigcache.py) ---------------
+#
+# Written by the process-wide verified-signature cache and the batch
+# dedup/adaptive-flush layer in crypto/batch.py. The ApplyBlock
+# "self-committed height" acceptance reads hit/miss straight off these:
+# a healthy validator shows hits_total ≈ commit lane count per height.
+
+crypto_sigcache_hits = DEFAULT.counter(
+    "crypto", "sigcache_hits_total",
+    "Batch-verify lanes answered by the verified-signature cache "
+    "(no dispatch, no CPU verify)")
+crypto_sigcache_misses = DEFAULT.counter(
+    "crypto", "sigcache_misses_total",
+    "Batch-verify lanes not found in the verified-signature cache")
+crypto_sigcache_inserts = DEFAULT.counter(
+    "crypto", "sigcache_inserts_total",
+    "Verified signatures inserted into the cache")
+crypto_sigcache_evictions = DEFAULT.counter(
+    "crypto", "sigcache_evictions_total",
+    "Cache entries evicted by the per-shard LRU bound")
+crypto_sigcache_entries = DEFAULT.gauge(
+    "crypto", "sigcache_entries",
+    "Verified-signature cache entries currently resident")
+crypto_sigcache_dedup_lanes = DEFAULT.counter(
+    "crypto", "sigcache_dedup_lanes_total",
+    "Batch lanes collapsed onto an identical in-flight lane in the "
+    "same batch (one verify, N results)")
+crypto_flush_target_lanes = DEFAULT.gauge(
+    "crypto", "flush_target_lanes",
+    "Adaptive flush scheduler's current target batch size "
+    "(arrival rate x device RTT, clamped)")
+crypto_flush_gather_waits = DEFAULT.counter(
+    "crypto", "flush_gather_waits_total",
+    "Consensus receive-loop waits taken to gather a fuller verify "
+    "batch (adaptive flush scheduling)")
+
 crypto_device_probe_attempts = DEFAULT.counter(
     "crypto", "device_probe_attempts_total",
     "jax device-backend probe attempts")

@@ -54,6 +54,7 @@ EVENT_PRECOMMIT_QUORUM = "quorum.precommit"
 EVENT_BATCH_FLUSH = "crypto.batch_flush"
 EVENT_APPLY_BLOCK = "state.apply_block"
 EVENT_BREAKER = "crypto.breaker"
+EVENT_SIGCACHE = "crypto.sigcache"
 
 
 class Timeline:
@@ -108,6 +109,13 @@ class Timeline:
         in flight when the TPU path opened' reads straight off the
         journal."""
         self.record(self._current_height, EVENT_BREAKER, **attrs)
+
+    def record_sigcache(self, **attrs) -> None:
+        """Verified-signature-cache activity hook (crypto/batch.py):
+        one event per flush that had cache hits or in-batch dedup, on
+        the timeline's current height — 'how many of this height's
+        lanes were verify-once eliminations' reads off the journal."""
+        self.record(self._current_height, EVENT_SIGCACHE, **attrs)
 
     # -- reading ------------------------------------------------------------
 
@@ -174,6 +182,10 @@ def record_flush(**attrs) -> None:
 
 def record_breaker(**attrs) -> None:
     DEFAULT.record_breaker(**attrs)
+
+
+def record_sigcache(**attrs) -> None:
+    DEFAULT.record_sigcache(**attrs)
 
 
 def snapshot(height: Optional[int] = None, last: int = 20) -> List[Dict]:

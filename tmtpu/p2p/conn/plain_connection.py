@@ -1,0 +1,112 @@
+"""Authenticated-plaintext peer connection — DEV/CI FALLBACK ONLY.
+
+SecretConnection (the real wire protocol: X25519 ECDH + ChaCha20Poly1305
+frames, p2p/conn/secret_connection.go) hard-requires the `cryptography`
+package and has no pure-python equivalent fast enough for a live net.
+On boxes without that package the whole p2p stack — and every tool that
+builds a localnet — becomes unimportable, so transport.py falls back to
+this class: the same handshake SHAPE (exchange identities, prove key
+ownership by signing the peer's challenge) over an UNENCRYPTED stream.
+
+Ed25519 signing/verification rides tmtpu's pure-python reference
+implementation, so this path needs nothing beyond the stdlib.
+
+Security properties: peers are mutually AUTHENTICATED (a peer must hold
+the private key for the node id it claims — transport.py's wire-identity
+check still works), but traffic is neither encrypted nor MITM-bound (no
+DH, so the challenge signatures do not pin the channel). Never use it
+across a real network; it exists so single-host localnets and CI smoke
+runs work where the AEAD stack is absent. The fallback is selected only
+by ImportError — an environment with `cryptography` installed can never
+silently downgrade.
+
+Duck-types the SecretConnection surface the Transport/MConnection drive:
+``write`` / ``read`` / ``read_exact`` / ``close`` / ``remote_pub_key``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from tmtpu.crypto.keys import KEY_TYPES
+
+_MAGIC = b"TMPLAIN1"  # never a valid SecretConnection ephemeral-key frame
+_CHALLENGE_SIZE = 32
+_SIG_SIZE = 64
+_AUTH_CONTEXT = b"TMTPU-PLAIN-AUTH:"
+
+
+class PlainConnectionError(Exception):
+    pass
+
+
+class PlainAuthConnection:
+    def __init__(self, sock, local_priv_key):
+        """Performs the full handshake on construction (blocking socket)."""
+        self._sock = sock
+        self._send_lock = threading.Lock()
+        self._recv_lock = threading.Lock()
+
+        local_pub = local_priv_key.pub_key().bytes()
+        challenge = os.urandom(_CHALLENGE_SIZE)
+        self._sock.sendall(_MAGIC + local_pub + challenge)
+        hello = self._read_exact_raw(
+            len(_MAGIC) + len(local_pub) + _CHALLENGE_SIZE)
+        if not hello.startswith(_MAGIC):
+            raise PlainConnectionError(
+                "peer is not speaking the plaintext fallback protocol "
+                "(mixed-stack net? the real SecretConnection cannot "
+                "interoperate with this dev fallback)")
+        remote_pub = hello[len(_MAGIC):len(_MAGIC) + 32]
+        remote_challenge = hello[len(_MAGIC) + 32:]
+        if remote_pub == local_pub:
+            raise PlainConnectionError("identity key reflected")
+
+        # prove ownership of the claimed identity: sign the challenge the
+        # PEER issued, verify the peer's signature over ours
+        self._sock.sendall(
+            local_priv_key.sign(_AUTH_CONTEXT + remote_challenge))
+        remote_sig = self._read_exact_raw(_SIG_SIZE)
+        entry = KEY_TYPES["ed25519"]
+        self.remote_pub_key = entry[0](remote_pub)
+        if not self.remote_pub_key.verify_signature(
+                _AUTH_CONTEXT + challenge, remote_sig):
+            raise PlainConnectionError("challenge verification failed")
+
+    def _read_exact_raw(self, n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            chunk = self._sock.recv(n - len(out))
+            if not chunk:
+                raise PlainConnectionError("connection closed")
+            out += chunk
+        return out
+
+    def write(self, data: bytes) -> int:
+        with self._send_lock:
+            self._sock.sendall(data)
+        return len(data)
+
+    def read(self, n: int = 65536) -> bytes:
+        with self._recv_lock:
+            chunk = self._sock.recv(n)
+        if not chunk:
+            raise PlainConnectionError("connection closed")
+        return chunk
+
+    def read_exact(self, n: int) -> bytes:
+        with self._recv_lock:
+            out = b""
+            while len(out) < n:
+                chunk = self._sock.recv(n - len(out))
+                if not chunk:
+                    raise PlainConnectionError("connection closed")
+                out += chunk
+            return out
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

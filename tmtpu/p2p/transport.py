@@ -11,7 +11,21 @@ import threading
 from typing import List, Optional, Tuple
 
 from tmtpu.libs.protoio import ProtoMessage, encode_uvarint, decode_uvarint
-from tmtpu.p2p.conn.secret_connection import SecretConnection
+
+try:
+    from tmtpu.p2p.conn.secret_connection import SecretConnection
+except ImportError:  # no `cryptography` package on this box: fall back to
+    # the authenticated-plaintext dev connection (same handshake shape and
+    # duck-typed surface; see plain_connection.py for the security caveats)
+    import warnings
+
+    from tmtpu.p2p.conn.plain_connection import PlainAuthConnection as \
+        SecretConnection  # noqa: N814
+
+    warnings.warn(
+        "tmtpu.p2p: `cryptography` not installed — peer connections are "
+        "AUTHENTICATED PLAINTEXT (dev/CI fallback, single-host use only)",
+        RuntimeWarning, stacklevel=2)
 from tmtpu.p2p.key import NodeKey
 
 

@@ -185,7 +185,9 @@ class SignerListenerEndpoint:
         secret = None
         if self._kind == "tcp":
             from tmtpu.crypto import ed25519
-            from tmtpu.p2p.conn.secret_connection import SecretConnection
+            # via transport's gate: plaintext dev fallback when
+            # `cryptography` is absent (see plain_connection.py)
+            from tmtpu.p2p.transport import SecretConnection
 
             secret = SecretConnection(
                 sock, self.node_priv_key or ed25519.gen_priv_key())
@@ -369,7 +371,9 @@ class SignerServer:
                     return _Conn(sock)
                 sock = socket.create_connection(target, timeout=10)
                 from tmtpu.crypto import ed25519
-                from tmtpu.p2p.conn.secret_connection import SecretConnection
+                # via transport's gate: plaintext dev fallback when
+                # `cryptography` is absent (see plain_connection.py)
+                from tmtpu.p2p.transport import SecretConnection
 
                 secret = SecretConnection(
                     sock, self.dial_priv_key or ed25519.gen_priv_key())

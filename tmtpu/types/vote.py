@@ -61,10 +61,13 @@ class Vote:
 
     def verify(self, chain_id: str, pub_key) -> None:
         """types/vote.go:147 — the serial hot call (the batch path goes
-        through crypto.BatchVerifier instead)."""
+        through crypto.BatchVerifier instead). Cache-aware: a vote the
+        batch path already verified costs no crypto here."""
+        from tmtpu.crypto import batch as _crypto_batch
+
         if pub_key.address() != self.validator_address:
             raise VoteError("invalid validator address")
-        if not pub_key.verify_signature(self.sign_bytes(chain_id),
+        if not _crypto_batch.verify_one(pub_key, self.sign_bytes(chain_id),
                                         self.signature):
             raise VoteError("invalid signature")
 

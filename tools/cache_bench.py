@@ -1,0 +1,132 @@
+"""Verified-signature cache microbench (ISSUE 4 acceptance): repeat-verify
+throughput with the cache ON vs OFF, plus the first-pass (all-miss)
+overhead the key hashing adds and the in-batch dedup win.
+
+The repeat-verify workload models the hot production shape: a commit's
+signatures verified at vote ingestion are re-verified by verify_commit
+during the next height's ApplyBlock, and blocksync re-verifies commits
+the node already tallied. Cache ON must show >= 2x throughput on that
+workload (acceptance criterion), because a hit is one sha256 + one
+striped-dict probe instead of an ed25519 verify.
+
+Prints one JSON line:
+
+    {"metric": "sigcache_repeat_verify", "lanes": ..., "repeats": ...,
+     "cache_off_sig_s": ..., "cache_on_sig_s": ..., "speedup": ...,
+     "first_pass_overhead_pct": ..., "dedup_sig_s": ...,
+     "hit_rate": ..., "timeline_events": ...}
+
+Usage: python tools/cache_bench.py [--lanes 256] [--repeats 8]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _gen(n):
+    from tmtpu.crypto import ed25519 as ed
+
+    keys = [ed.gen_priv_key_from_secret(b"cache-bench-%d" % i)
+            for i in range(n)]
+    msgs = [b"cache-bench-msg-%d" % i for i in range(n)]
+    return ([k.pub_key() for k in keys], msgs,
+            [k.sign(m) for k, m in zip(keys, msgs)])
+
+
+def _verify_all(pks, msgs, sigs, repeats):
+    """`repeats` full passes over the workload through the cache-aware
+    CPU batch path (one BatchVerifier per pass, like one flush per
+    ApplyBlock). Returns sigs/s."""
+    from tmtpu.crypto import batch as crypto_batch
+
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        bv = crypto_batch.CPUBatchVerifier()
+        for pk, m, s in zip(pks, msgs, sigs):
+            bv.add(pk, m, s, power=1)
+        all_ok, _, _ = bv.verify_tally()
+        assert all_ok
+    return len(pks) * repeats / (time.perf_counter() - t0)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--lanes", type=int, default=256,
+                    help="distinct signatures in the workload")
+    ap.add_argument("--repeats", type=int, default=8,
+                    help="verify passes over the same workload")
+    args = ap.parse_args()
+
+    from tmtpu.crypto import sigcache
+    from tmtpu.libs import timeline as _tl
+
+    t0 = time.perf_counter()
+    pks, msgs, sigs = _gen(args.lanes)
+    print(f"cache_bench: generated {args.lanes} sigs in "
+          f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    # --- cache OFF: every pass re-verifies every signature ------------------
+    sigcache.DEFAULT.set_enabled(False)
+    off_rate = _verify_all(pks, msgs, sigs, args.repeats)
+
+    # --- cache ON: pass 1 misses (measured separately as the overhead
+    # of key hashing on an all-miss flush), passes 2..N all hit ---------------
+    sigcache.DEFAULT.set_enabled(True)
+    sigcache.DEFAULT.invalidate_all()
+    _tl.DEFAULT.clear()
+    _tl.record(1, "consensus.enter_new_round")  # events need a height
+    first_rate = _verify_all(pks, msgs, sigs, 1)
+    on_rate = _verify_all(pks, msgs, sigs, args.repeats)
+    st = sigcache.stats()
+
+    # --- in-batch dedup: one flush carrying N copies of each triple ---------
+    sigcache.DEFAULT.invalidate_all()
+    from tmtpu.crypto import batch as crypto_batch
+
+    dup = 8
+    t0 = time.perf_counter()
+    bv = crypto_batch.CPUBatchVerifier()
+    for pk, m, s in zip(pks, msgs, sigs):
+        for _ in range(dup):
+            bv.add(pk, m, s, power=1)
+    all_ok, _, tallied = bv.verify_tally()
+    assert all_ok and tallied == args.lanes * dup
+    dedup_rate = args.lanes * dup / (time.perf_counter() - t0)
+    assert bv.cache_stats["dedup"] == args.lanes * (dup - 1)
+
+    # cache-off baseline for one pass (first-pass overhead comparison)
+    sigcache.DEFAULT.set_enabled(False)
+    off_single = _verify_all(pks, msgs, sigs, 1)
+    sigcache.DEFAULT.set_enabled(True)
+
+    ev = sum(sum(1 for e in rec["events"]
+                 if e["event"] == _tl.EVENT_SIGCACHE)
+             for rec in _tl.snapshot())
+    out = {
+        "metric": "sigcache_repeat_verify",
+        "lanes": args.lanes,
+        "repeats": args.repeats,
+        "cache_off_sig_s": round(off_rate, 1),
+        "cache_on_sig_s": round(on_rate, 1),
+        "speedup": round(on_rate / off_rate, 2),
+        "first_pass_overhead_pct": round(
+            (off_single - first_rate) / off_single * 100, 1),
+        "dedup_sig_s": round(dedup_rate, 1),
+        "hit_rate": st["hit_rate"],
+        "timeline_events": ev,
+    }
+    print(json.dumps(out))
+    if out["speedup"] < 2.0:
+        print(f"cache_bench: FAIL speedup {out['speedup']} < 2.0",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
