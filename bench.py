@@ -140,6 +140,13 @@ def _init_backend_probe() -> str:
             print("bench: backend is deterministically CPU-only — "
                   "skipping retry budget", file=sys.stderr)
             break
+        # a probe that had to be SIGKILLed after PROBE_TIMEOUT_S is a
+        # wedged tunnel, and BENCH_r05 showed those stay wedged for the
+        # whole budget: one attempt, not 3×180 s of retries
+        if any(p["rc"] == "timeout" for p in _probe_log):
+            print("bench: probe hit the hard timeout (wedged tunnel) — "
+                  "one attempt only, skipping retry budget", file=sys.stderr)
+            break
         elapsed = time.perf_counter() - t0
         remaining = PROBE_BUDGET_S - elapsed
         if remaining <= 0:
@@ -152,6 +159,41 @@ def _init_backend_probe() -> str:
     print(f"bench: no device backend after {attempt} attempts / "
           f"{PROBE_BUDGET_S:.0f}s — falling back to CPU", file=sys.stderr)
     return "cpu"
+
+
+def _try_sidecar_attach():
+    """If TMTPU_SIDECAR_ADDR names a live verification sidecar, attach to
+    it instead of probing an in-process device tunnel. The daemon already
+    owns the device and compiled its kernels, so a successful ping makes
+    the whole probe budget unnecessary. Returns the address or None;
+    attempts are recorded in ``_probe_log`` either way."""
+    addr = os.environ.get("TMTPU_SIDECAR_ADDR", "")
+    if not addr:
+        return None
+    t0 = time.perf_counter()
+    try:
+        from tmtpu.sidecar.client import SidecarClient
+
+        client = SidecarClient(addr, client_id="bench-probe",
+                               connect_timeout_s=5.0)
+        try:
+            pong = client.ping(deadline_s=10.0)
+        finally:
+            client.close()
+        dt = time.perf_counter() - t0
+        _probe_log.append({"rc": "sidecar", "s": round(dt, 1),
+                           "backend": pong.backend})
+        print(f"bench: attached to sidecar at {addr} "
+              f"(daemon backend={pong.backend}, "
+              f"up {pong.uptime_ms / 1e3:.0f}s) in {dt:.1f}s",
+              file=sys.stderr)
+        return addr
+    except Exception as e:  # noqa: BLE001 — fall back to the device probe
+        dt = time.perf_counter() - t0
+        _probe_log.append({"rc": "sidecar-fail", "s": round(dt, 1)})
+        print(f"bench: TMTPU_SIDECAR_ADDR={addr} set but unreachable "
+              f"({e!r}) — falling back to device probe", file=sys.stderr)
+        return None
 
 
 def _force_cpu() -> None:
@@ -464,6 +506,101 @@ def _make_votes(n: int):
     return pks, msgs, sigs
 
 
+def _run_sidecar_child() -> None:
+    """Measurement pinned to an attached sidecar daemon: every batch
+    ships over the socket (prep + framing + daemon dispatch + reply), so
+    the number is the end-to-end rate a NODE would see with
+    crypto.backend=sidecar — not the daemon's device-only rate. This
+    process never touches a tunnel; the daemon owns the device."""
+    _force_cpu()
+    from tmtpu.sidecar.client import SidecarClient, default_addr
+
+    addr = default_addr()
+    lanes = min(LANES,
+                int(os.environ.get("TMTPU_BENCH_SIDECAR_LANES", "1024")))
+    t0 = time.perf_counter()
+    pks, msgs, sigs = _make_votes(lanes)
+    prep_dt = time.perf_counter() - t0
+    print(f"bench: generated {lanes} votes in {prep_dt:.1f}s",
+          file=sys.stderr)
+    req = [(pks[i], msgs[i], sigs[i], 1000) for i in range(lanes)]
+    client = SidecarClient(addr, client_id="bench")
+    pong = client.ping(deadline_s=10.0)
+    # warmup: daemon kernels compiled at startup; this primes the
+    # connection and this request shape
+    mask, tallied, _ = client.verify("ed25519", req, tally=True,
+                                     deadline_s=120.0)
+    assert all(mask) and tallied == 1000 * lanes, "bench lanes must verify"
+
+    def run_sync(n_iters):
+        t0 = time.perf_counter()
+        info = None
+        for _ in range(n_iters):
+            mask, _t, info = client.verify("ed25519", req, tally=True,
+                                           deadline_s=120.0)
+            assert all(mask)
+        return lanes * n_iters / (time.perf_counter() - t0), info
+
+    def run_threads(n_iters_each, nthreads):
+        """Concurrent submitters over ONE connection — in-flight requests
+        land in the daemon's cross-client coalescer together."""
+        results = queue.Queue()
+
+        def work():
+            try:
+                info = None
+                for _ in range(n_iters_each):
+                    mask, _t, info = client.verify(
+                        "ed25519", req, tally=True, deadline_s=120.0)
+                    assert all(mask)
+                results.put(info)
+            except Exception as e:  # noqa: BLE001 — report via queue
+                results.put(e)
+
+        ts = [threading.Thread(target=work) for _ in range(nthreads)]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        dt = time.perf_counter() - t0
+        outs = [results.get_nowait() for _ in ts]
+        for o in outs:
+            if isinstance(o, Exception):
+                raise o
+        return lanes * n_iters_each * nthreads / dt, outs[0]
+
+    structures = {}
+    last_info = None
+    for name, fn, args in (("sync", run_sync, (4,)),
+                           ("threads2", run_threads, (2, 2))):
+        try:
+            structures[name], last_info = fn(*args)
+            print(f"bench: {name}: {structures[name]:,.0f} sig/s",
+                  file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — let the others report
+            print(f"bench: {name} FAILED: {e!r}", file=sys.stderr)
+    client.close()
+    if not structures:
+        raise RuntimeError("every sidecar structure failed")
+    best = max(structures, key=structures.get)
+    sig_s = structures[best]
+    out = {
+        "metric": "ed25519_batch_verify_10k_voteset_e2e",
+        "value": round(sig_s, 1),
+        "unit": "sig/s",
+        "vs_baseline": round(sig_s / GO_SERIAL_SIG_S, 2),
+        "backend": "sidecar",
+        "sidecar": {"addr": addr, "daemon_backend": pong.backend,
+                    "last_dispatch": last_info},
+        "pipeline": best,
+        "structures": {k: round(v, 1) for k, v in structures.items()},
+        "lanes": lanes,
+        "phases": {"prepare": round(prep_dt, 4)},
+    }
+    print(json.dumps(out), flush=True)
+
+
 def _run_child(backend: str, timeout_s: float):
     """Run the measurement in a CHILD process pinned to ``backend``.
 
@@ -528,8 +665,20 @@ def _run_parent(t0):
               file=sys.stderr)
         return
 
-    backend = _init_backend_probe()
     attempts = []
+    # an already-running sidecar beats any in-process tunnel: warm
+    # kernels, no probe budget, no wedge exposure in THIS process
+    if _try_sidecar_attach() is not None and remaining() > 240:
+        out = _run_child("sidecar",
+                         timeout_s=min(900.0, max(240.0, remaining() - 90)))
+        if out is not None:
+            _emit_with_provenance(out, attempts)
+            print(f"bench: total wall {time.perf_counter() - t0:.0f}s",
+                  file=sys.stderr)
+            return
+        attempts.append("sidecar-child-failed")
+
+    backend = _init_backend_probe()
     if backend == "device" and remaining() > 390:
         # expected device run ~12 min (compile + structures + curves);
         # cap it so a dead-tunnel hang still leaves emission slack
@@ -594,6 +743,9 @@ def main():
         return
 
     backend = os.environ["TMTPU_BENCH_CHILD"]
+    if backend == "sidecar":
+        _run_sidecar_child()
+        return
     if backend == "cpu":
         _force_cpu()
     import jax

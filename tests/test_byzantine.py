@@ -23,6 +23,9 @@ def test_parse_schedule():
         parse_schedule("equivocate-everything@2")
 
 
+@pytest.mark.slow  # up to 90s waiting for evidence to commit — the
+# window is timing-sensitive under full-suite load; tier-1 evidence
+# coverage rides test_evidence_gossip
 def test_double_prevote_produces_committed_evidence(tmp_path):
     nodes = _mk_net_nodes(4, tmp_path)
     # node 3 equivocates in prevote at height 3

@@ -12,7 +12,12 @@ from tmtpu.config.config import Config
 from tmtpu.crypto import ed25519
 from tmtpu.node.node import Node
 from tmtpu.p2p.conn.connection import ChannelDescriptor, MConnection
-from tmtpu.p2p.conn.secret_connection import SecretConnection
+from tmtpu.p2p.conn.secret_connection import HAVE_CRYPTO, SecretConnection
+
+# the real SecretConnection needs X25519/ChaCha20 from `cryptography`;
+# the network tests below still run on the plaintext dev fallback.
+needs_crypto = pytest.mark.skipif(
+    not HAVE_CRYPTO, reason="`cryptography` package not installed")
 from tmtpu.privval.file_pv import FilePV
 from tmtpu.types.genesis import GenesisDoc, GenesisValidator
 
@@ -22,6 +27,7 @@ def _sock_pair():
     return a, b
 
 
+@needs_crypto
 def test_secret_connection_handshake_and_data():
     k1, k2 = ed25519.gen_priv_key(), ed25519.gen_priv_key()
     a, b = _sock_pair()
@@ -46,6 +52,7 @@ def test_secret_connection_handshake_and_data():
     assert sc1.read_exact(5) == b"reply"
 
 
+@needs_crypto
 def test_mconnection_channels_and_chunking():
     k1, k2 = ed25519.gen_priv_key(), ed25519.gen_priv_key()
     a, b = _sock_pair()

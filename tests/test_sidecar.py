@@ -1,0 +1,281 @@
+"""Verification-sidecar behavior: single-client verify/tally through a
+live daemon, cross-client coalescing into ONE joint device dispatch with
+exact per-lane masks, admission-control overload replies, and the
+daemon-kill chaos scenario (breaker → in-process fallback → zero wrong
+results)."""
+
+import threading
+import time
+
+import pytest
+
+from tmtpu.config.config import SidecarConfig
+from tmtpu.crypto import batch as crypto_batch
+from tmtpu.crypto import ed25519 as ed
+from tmtpu.libs import breaker as _bk
+from tmtpu.libs import metrics as _m
+from tmtpu.sidecar.client import (
+    SidecarClient,
+    SidecarOverloaded,
+    SidecarUnavailable,
+)
+from tmtpu.sidecar.server import SidecarServer
+
+
+def _lanes(n, bad=(), tag=b"sc", power=1000):
+    """n raw (pk_bytes, msg, sig, power) lanes; indices in ``bad`` get a
+    corrupted signature."""
+    out = []
+    for i in range(n):
+        priv = ed.gen_priv_key_from_secret(b"%s-%d" % (tag, i))
+        msg = b"%s msg %d" % (tag, i)
+        sig = priv.sign(msg)
+        if i in bad:
+            flip = bytearray(sig)
+            flip[0] ^= 0xFF
+            sig = bytes(flip)
+        out.append((priv.pub_key().bytes(), msg, sig, power))
+    return out
+
+
+def _items(n, bad=(), tag=b"sc", power=1000):
+    """Same lanes, as the (PubKey, msg, sig) tuples BatchVerifier.add
+    takes."""
+    return [(ed.PubKeyEd25519(pk), msg, sig, power)
+            for pk, msg, sig, power in _lanes(n, bad, tag, power)]
+
+
+@pytest.fixture
+def server(tmp_path):
+    srv = SidecarServer(f"unix://{tmp_path}/sc.sock", backend="cpu")
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_verify_and_tally_exact_mask(server):
+    client = SidecarClient(server.addr, client_id="t1")
+    try:
+        lanes = _lanes(6, bad={2, 5})
+        mask, tallied, info = client.verify("ed25519", lanes, tally=True)
+        assert mask == [True, True, False, True, True, False]
+        assert tallied == 4 * 1000
+        assert info["dispatch_lanes"] >= 6
+        assert info["dispatch_id"] > 0
+        # verify-only path (no tally)
+        mask, tallied, _ = client.verify("ed25519", _lanes(3))
+        assert mask == [True, True, True] and tallied == 0
+    finally:
+        client.close()
+
+
+def test_bad_request_rejected(server):
+    client = SidecarClient(server.addr, client_id="t2")
+    try:
+        with pytest.raises(SidecarUnavailable, match="unknown curve"):
+            client.verify("curve448", _lanes(1))
+        # connection survives a bad request
+        mask, _, _ = client.verify("ed25519", _lanes(2))
+        assert mask == [True, True]
+    finally:
+        client.close()
+
+
+def test_two_clients_coalesce_into_one_dispatch(server):
+    """THE acceptance scenario: two concurrent clients' lanes land in
+    ONE joint device dispatch, and each client gets back exactly the
+    mask slice for its own lanes."""
+    # deterministic gather window: the dispatcher waits long enough for
+    # both clients' requests to be queued before cutting a batch
+    server.coalescer.scheduler.gather_wait_s = lambda pending: 0.5
+
+    lanes_a = _lanes(5, bad={1}, tag=b"client-a")
+    lanes_b = _lanes(7, bad={2, 3}, tag=b"client-b")
+    results = {}
+    barrier = threading.Barrier(2)
+
+    def run(name, lanes):
+        client = SidecarClient(server.addr, client_id=name)
+        try:
+            barrier.wait(timeout=10)
+            results[name] = client.verify("ed25519", lanes, tally=True)
+        finally:
+            client.close()
+
+    ts = [threading.Thread(target=run, args=("a", lanes_a)),
+          threading.Thread(target=run, args=("b", lanes_b))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert set(results) == {"a", "b"}
+
+    mask_a, tallied_a, info_a = results["a"]
+    mask_b, tallied_b, info_b = results["b"]
+    # exact per-lane masks, sliced back out of the joint dispatch
+    assert mask_a == [True, False, True, True, True]
+    assert mask_b == [True, True, False, False, True, True, True]
+    assert tallied_a == 4 * 1000
+    assert tallied_b == 5 * 1000
+    # one joint dispatch carried both clients
+    assert info_a["dispatch_id"] == info_b["dispatch_id"]
+    assert info_a["dispatch_lanes"] == 12
+    assert info_a["dispatch_clients"] == 2
+    assert info_b["dispatch_clients"] == 2
+
+
+def test_overload_reply_and_recovery(tmp_path):
+    """Admission control: a full queue answers OVERLOADED immediately
+    (explicit backpressure, not silence), and the queued request still
+    completes correctly."""
+    srv = SidecarServer(f"unix://{tmp_path}/sc.sock", backend="cpu",
+                        max_queue_lanes=4)
+    srv.start()
+    try:
+        # park arrivals in the queue so it can actually fill up
+        srv.coalescer.scheduler.gather_wait_s = lambda pending: 30.0
+        c1 = SidecarClient(srv.addr, client_id="full-1")
+        c2 = SidecarClient(srv.addr, client_id="full-2")
+        try:
+            first = {}
+            t = threading.Thread(
+                target=lambda: first.update(
+                    r=c1.verify("ed25519", _lanes(3), deadline_s=30.0)))
+            t.start()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and \
+                    srv.coalescer.queued_lanes() < 3:
+                time.sleep(0.02)
+            assert srv.coalescer.queued_lanes() == 3
+            with pytest.raises(SidecarOverloaded):
+                c2.verify("ed25519", _lanes(3, tag=b"ovl"))
+            # reopen the gather window; the next arrival re-evaluates it
+            srv.coalescer.scheduler.gather_wait_s = lambda pending: 0.0
+            mask, _, _ = c2.verify("ed25519", _lanes(1, tag=b"nudge"))
+            assert mask == [True]
+            t.join(timeout=20)
+            assert first["r"][0] == [True, True, True]
+        finally:
+            c1.close()
+            c2.close()
+    finally:
+        srv.stop()
+
+
+def test_oversized_request_rejected(tmp_path):
+    srv = SidecarServer(f"unix://{tmp_path}/sc.sock", backend="cpu",
+                        max_lanes_per_dispatch=4)
+    srv.start()
+    try:
+        client = SidecarClient(srv.addr, client_id="big")
+        try:
+            with pytest.raises(SidecarOverloaded):
+                client.verify("ed25519", _lanes(5))
+        finally:
+            client.close()
+    finally:
+        srv.stop()
+
+
+def test_stats_and_ping(server):
+    client = SidecarClient(server.addr, client_id="introspect")
+    try:
+        pong = client.ping()
+        assert pong.backend == "cpu"
+        client.verify("ed25519", _lanes(2))
+        stats = client.stats()
+        assert stats["server_id"] == server.server_id
+        assert stats["backend"] == "cpu"
+        assert stats["coalescer"]["dispatches"] >= 1
+        assert stats["connections"] >= 1
+    finally:
+        client.close()
+
+
+# --- crypto.backend=sidecar through the batch-verifier stack ----------------
+
+
+@pytest.fixture
+def sidecar_backend(tmp_path):
+    """A live daemon wired into crypto/batch.py exactly the way node.py
+    does it: configure_sidecar + crypto.backend=sidecar, fast breaker,
+    full teardown."""
+    srv = SidecarServer(f"unix://{tmp_path}/sc.sock", backend="cpu")
+    srv.start()
+    prev_backend = crypto_batch._default_backend
+    cfg = SidecarConfig(addr=srv.addr, breaker_failure_threshold=2,
+                        connect_timeout_ns=2_000_000_000,
+                        request_deadline_ns=10_000_000_000,
+                        retry_backoff_ns=0)
+    crypto_batch.configure_sidecar(cfg)
+    crypto_batch.set_default_backend("sidecar")
+    br = _bk.get(crypto_batch.SIDECAR_BREAKER_NAME)
+    br.reset()
+    yield srv
+    srv.stop()
+    crypto_batch.set_default_backend(prev_backend)
+    crypto_batch.configure_sidecar(SidecarConfig())
+    crypto_batch.reset_sidecar_client()
+    br.reset()
+
+
+def _flush(items, tally=False):
+    bv = crypto_batch.new_batch_verifier()
+    for pk, msg, sig, power in items:
+        bv.add(pk, msg, sig, power)
+    return bv.verify_tally() if tally else bv.verify()
+
+
+def test_sidecar_batch_verifier_routes_to_daemon(sidecar_backend):
+    bv = crypto_batch.new_batch_verifier()
+    assert isinstance(bv, crypto_batch.SidecarBatchVerifier)
+    all_ok, mask, tallied = _flush(_items(5, bad={3}, tag=b"route"),
+                                   tally=True)
+    assert not all_ok
+    assert mask == [True, True, True, False, True]
+    assert tallied == 4 * 1000
+    assert sidecar_backend.coalescer.snapshot()["dispatches"] >= 1
+
+
+@pytest.mark.chaos
+def test_daemon_kill_breaker_fallback_zero_wrong_results(sidecar_backend):
+    """THE chaos acceptance scenario: kill the daemon mid-run; every
+    flush afterwards rides the breaker into the in-process fallback and
+    still returns the exact mask — zero wrong results, and the breaker
+    is open (watchdog-visible) after its failure threshold."""
+    srv = sidecar_backend
+    before = sum(_m.sidecar_client_fallback.summary_series().values())
+
+    # round 0: daemon alive, flush goes over the socket
+    all_ok, mask = _flush(_items(4, tag=b"alive"))
+    assert all_ok and mask == [True] * 4
+    assert srv.coalescer.snapshot()["dispatches"] >= 1
+
+    srv.stop()  # kill mid-run
+
+    br = _bk.lookup(crypto_batch.SIDECAR_BREAKER_NAME)
+    masks = []
+    for rnd in range(4):
+        _, mask = _flush(_items(4, bad={rnd}, tag=b"dead-%d" % rnd))
+        masks.append(mask)
+    # zero wrong results: every mask exact despite the dead daemon
+    assert masks == [[i != r for i in range(4)] for r in range(4)]
+    # the breaker opened at its threshold (2), so later rounds skipped
+    # the socket entirely
+    assert br.state == _bk.OPEN
+    after = sum(_m.sidecar_client_fallback.summary_series().values())
+    assert after >= before + 16  # 4 rounds × 4 lanes rode the fallback
+
+
+def test_no_addr_falls_back_in_process():
+    """crypto.backend=sidecar with no resolvable address must quietly
+    verify in-process (fresh checkout, daemon not launched yet)."""
+    prev_backend = crypto_batch._default_backend
+    crypto_batch.configure_sidecar(SidecarConfig())
+    crypto_batch.reset_sidecar_client()
+    crypto_batch.set_default_backend("sidecar")
+    try:
+        all_ok, mask = _flush(_items(3, bad={1}, tag=b"noaddr"))
+        assert not all_ok and mask == [True, False, True]
+    finally:
+        crypto_batch.set_default_backend(prev_backend)

@@ -27,6 +27,7 @@ def _val(limbs_col):
     return fe.int_of_limbs(np.asarray(limbs_col)[:, 0])
 
 
+@pytest.mark.slow
 def test_fe_k1_mul_sub_freeze_random():
     rng = random.Random(11)
     for _ in range(12):
@@ -40,6 +41,7 @@ def test_fe_k1_mul_sub_freeze_random():
         assert _val(fe.freeze(fe.mul_small(ca, 21))) == a * 21 % P
 
 
+@pytest.mark.slow
 def test_fe_k1_adversarial_values():
     # worst-case-ish operands: p-1, values with max limbs, tiny values
     cases = [P - 1, P - 2**200, 2**255 - 1, (1 << 256) % P, 1, 0,
@@ -65,6 +67,7 @@ def test_fe_k1_loose_chains_stay_correct():
     assert _val(fe.freeze(cb)) == vb
 
 
+@pytest.mark.slow
 def test_fe_k1_sqrt_chain():
     rng = random.Random(7)
     for _ in range(4):
@@ -104,6 +107,7 @@ def _proj_val(pt):
     return (X * zi % P, Y * zi % P)
 
 
+@pytest.mark.slow
 def test_k1_complete_add_against_oracle():
     g = (kv.GX, kv.GY)
     gp = (_col(kv.GX), _col(kv.GY), _col(1))

@@ -221,6 +221,57 @@ def cmd_start(args) -> int:
     return 0
 
 
+def cmd_sidecar(args) -> int:
+    """sidecar — run the standalone verification daemon: one process
+    owns the JAX device and serves batched verify (+ on-device tally)
+    to every node on the host; nodes select it with
+    ``crypto_backend=sidecar``. Address resolution: --addr flag,
+    [sidecar] addr, TMTPU_SIDECAR_ADDR, then <home>/data/sidecar.sock."""
+    from tmtpu.sidecar.client import default_addr
+    from tmtpu.sidecar.server import SidecarServer
+
+    cfg = _load_config(args.home)
+    addr = (args.addr or cfg.sidecar.addr or
+            default_addr(os.path.expanduser(args.home)))
+    if args.backend:
+        cfg.sidecar.backend = args.backend
+    os.makedirs(os.path.join(os.path.expanduser(args.home), "data"),
+                exist_ok=True)
+    # the daemon's engine shares crypto/batch.py with a node process, so
+    # the [crypto] resilience knobs (breaker, deadlines, sigcache) apply
+    from tmtpu.crypto import batch as crypto_batch
+
+    crypto_batch.configure(cfg.crypto)
+    server = SidecarServer(
+        addr,
+        backend=cfg.sidecar.backend,
+        max_queue_lanes=cfg.sidecar.max_queue_lanes,
+        max_lanes_per_dispatch=cfg.sidecar.max_lanes_per_dispatch,
+        max_frame_bytes=cfg.sidecar.max_frame_bytes,
+        request_deadline_s=cfg.sidecar.request_deadline_ns / 1e9,
+        health_laddr=args.health_laddr or cfg.sidecar.health_laddr)
+    warm = cfg.sidecar.warm_on_start and not args.no_warm
+    server.start()
+    if warm:
+        print("Warming verify kernels (one-time compile)...",
+              flush=True)
+        warm_s = server.warm()
+        print(f"Warm-up done in {warm_s:.1f}s "
+              f"(backend={server.backend_name()})")
+    print(f"Sidecar listening on {server.addr} "
+          f"backend={server.backend_name()} id={server.server_id}")
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        print("Stopping sidecar...")
+        server.stop()
+    return 0
+
+
 def cmd_version(args) -> int:
     print(ver.TMCoreSemVer)
     return 0
@@ -715,11 +766,26 @@ def main(argv=None) -> int:
     sp.add_argument("--proxy-app", default="")
     sp.add_argument("--rpc-laddr", dest="rpc_laddr", default="")
     sp.add_argument("--crypto-backend", default="",
-                    choices=["", "auto", "cpu", "tpu"])
+                    choices=["", "auto", "cpu", "tpu", "sidecar"])
     sp.add_argument("--misbehaviors", default="",
                     help="maverick-style schedule 'double-prevote@3,...' "
                          "(byzantine test nets only)")
     sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("sidecar",
+                        help="run the shared batch-verify daemon")
+    sp.add_argument("--addr", default="",
+                    help="listen address (unix:///path.sock or "
+                         "tcp://host:port); default [sidecar] addr / "
+                         "TMTPU_SIDECAR_ADDR / <home>/data/sidecar.sock")
+    sp.add_argument("--backend", default="",
+                    choices=["", "auto", "cpu", "tpu"],
+                    help="daemon-side verify engine")
+    sp.add_argument("--health-laddr", dest="health_laddr", default="",
+                    help="HTTP host:port for /healthz + /metrics")
+    sp.add_argument("--no-warm", action="store_true",
+                    help="skip the startup kernel warm-up compile")
+    sp.set_defaults(fn=cmd_sidecar)
 
     sp = sub.add_parser("version")
     sp.set_defaults(fn=cmd_version)

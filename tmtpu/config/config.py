@@ -220,6 +220,38 @@ class CryptoConfig:
 
 
 @dataclass
+class SidecarConfig:
+    """Verification-sidecar knobs (tmtpu/sidecar/): one daemon process
+    owns the JAX device and serves batched verification to every node
+    on the host. Client side is selected by ``base.crypto_backend =
+    "sidecar"``; server side is ``python -m tmtpu sidecar``. Both read
+    this section, so one config file describes a whole deployment."""
+
+    # where the daemon listens / clients connect: unix:///path/to.sock
+    # or tcp://host:port. Empty resolves TMTPU_SIDECAR_ADDR, then the
+    # conventional <home>/data/sidecar.sock.
+    addr: str = ""
+    # DAEMON-side verify engine ("auto" | "cpu" | "tpu"; never "sidecar")
+    backend: str = "auto"
+    # client connection management
+    connect_timeout_ns: int = 2000 * MS
+    request_deadline_ns: int = 10_000 * MS
+    retry_backoff_ns: int = 1000 * MS
+    # client-side breaker: consecutive failed round-trips before verify
+    # stops trying the daemon and rides in-process; half-open re-probes
+    # after the backoff (shares CryptoConfig's breaker backoff knobs)
+    breaker_failure_threshold: int = 3
+    # daemon admission control + coalescing bounds
+    max_queue_lanes: int = 65536
+    max_lanes_per_dispatch: int = 40960
+    max_frame_bytes: int = 8 * 1024 * 1024
+    # compile kernels at daemon startup instead of on first request
+    warm_on_start: bool = True
+    # optional HTTP host:port for /healthz + /metrics ("" disables)
+    health_laddr: str = ""
+
+
+@dataclass
 class BaseConfig:
     """config/config.go:158."""
 
@@ -237,8 +269,9 @@ class BaseConfig:
     priv_validator_laddr: str = ""
     node_key_file: str = "config/node_key.json"
     filter_peers: bool = False
-    # the new crypto backend switch (BASELINE.json: crypto.backend=tpu)
-    crypto_backend: str = "auto"  # "auto" | "cpu" | "tpu"
+    # the new crypto backend switch (BASELINE.json: crypto.backend=tpu);
+    # "sidecar" ships batches to the shared verification daemon
+    crypto_backend: str = "auto"  # "auto" | "cpu" | "tpu" | "sidecar"
     # maverick-style byzantine schedule "name@height,..." (test nets only;
     # tmtpu/consensus/misbehavior.py)
     misbehaviors: str = ""
@@ -259,6 +292,7 @@ class Config:
         default_factory=InstrumentationConfig)
     health: HealthConfig = field(default_factory=HealthConfig)
     crypto: CryptoConfig = field(default_factory=CryptoConfig)
+    sidecar: SidecarConfig = field(default_factory=SidecarConfig)
 
     def rooted(self, path: str) -> str:
         return os.path.join(os.path.expanduser(self.base.home), path)

@@ -24,7 +24,7 @@ from tmtpu.config.config import Config
 # section order mirrors the reference's template (base fields are top-level)
 _SECTIONS = ("base", "rpc", "p2p", "mempool", "consensus", "block_sync",
              "state_sync", "storage", "tx_index", "instrumentation",
-             "health", "crypto")
+             "health", "crypto", "sidecar")
 
 
 def _toml_value(v: Any) -> str:
@@ -120,7 +120,7 @@ def validate(cfg: Config) -> None:
     """config.go ValidateBasic — the checks that catch real footguns."""
     if cfg.base.db_backend not in ("sqlite", "mem"):
         raise ValueError(f"unknown db_backend {cfg.base.db_backend!r}")
-    if cfg.base.crypto_backend not in ("auto", "cpu", "tpu"):
+    if cfg.base.crypto_backend not in ("auto", "cpu", "tpu", "sidecar"):
         raise ValueError(
             f"unknown crypto_backend {cfg.base.crypto_backend!r}")
     if cfg.base.abci not in ("socket", "grpc", "local"):
@@ -167,3 +167,32 @@ def validate(cfg: Config) -> None:
         raise ValueError("crypto.flush_max_wait_ns cannot be negative")
     if cfg.crypto.flush_max_lanes < 1:
         raise ValueError("crypto.flush_max_lanes must be >= 1")
+    if cfg.sidecar.backend not in ("auto", "cpu", "tpu"):
+        # a daemon whose engine is "sidecar" would dial itself
+        raise ValueError(
+            f"sidecar.backend must be auto/cpu/tpu, got "
+            f"{cfg.sidecar.backend!r}")
+    if cfg.sidecar.addr and not (
+            cfg.sidecar.addr.startswith("unix://") or
+            cfg.sidecar.addr.startswith("tcp://")):
+        raise ValueError(
+            f"sidecar.addr must be unix:// or tcp://, got "
+            f"{cfg.sidecar.addr!r}")
+    if cfg.sidecar.connect_timeout_ns <= 0 or \
+            cfg.sidecar.request_deadline_ns <= 0:
+        raise ValueError("sidecar timeouts must be positive")
+    if cfg.sidecar.retry_backoff_ns < 0:
+        raise ValueError("sidecar.retry_backoff_ns cannot be negative")
+    if cfg.sidecar.breaker_failure_threshold < 1:
+        raise ValueError("sidecar.breaker_failure_threshold must be >= 1")
+    if cfg.sidecar.max_queue_lanes < 1 or \
+            cfg.sidecar.max_lanes_per_dispatch < 1:
+        raise ValueError("sidecar lane caps must be >= 1")
+    if cfg.sidecar.max_frame_bytes < 4096:
+        raise ValueError("sidecar.max_frame_bytes must be >= 4096")
+    if cfg.base.crypto_backend == "sidecar" and \
+            cfg.sidecar.max_frame_bytes < 1 << 16:
+        # a verify frame carries ~210B/lane; anything tinier than 64 KiB
+        # cannot even fit one consensus commit's worth of lanes
+        raise ValueError("sidecar.max_frame_bytes too small for "
+                         "crypto_backend=sidecar (needs >= 65536)")

@@ -77,6 +77,29 @@ _tpu_usable: Optional[bool] = None
 # probe failures and batch failures share the same failure budget
 BREAKER_NAME = "crypto.tpu"
 
+# the breaker governing the sidecar round-trip path: connection failures,
+# request deadlines, and hard daemon errors share one failure budget, so
+# a dead daemon costs a few failed round-trips and then every batch rides
+# in-process until the backoff elapses and a half-open probe reconnects.
+# Overload backpressure (an explicitly HEALTHY daemon saying "not now")
+# never counts against it.
+SIDECAR_BREAKER_NAME = "crypto.sidecar"
+
+# sidecar client wiring: configure_sidecar() fills this from config (and
+# Node.__init__ calls it before the first verifier is built); the client
+# object is built lazily on first use so importing this module never
+# touches a socket. Tests monkeypatch "addr" / reset "client".
+_sidecar_lock = threading.Lock()
+_sidecar_state: Dict = {
+    "addr": "",
+    "home": "",
+    "client": None,
+    "connect_timeout_s": 2.0,
+    "request_deadline_s": 10.0,
+    "retry_backoff_s": 1.0,
+    "max_frame_bytes": 8 * 1024 * 1024,
+}
+
 # defaults mirror config/config.py CryptoConfig; Node.__init__ overwrites
 # via configure() before the first verifier is built
 _probe_timeout_s = 20.0
@@ -241,11 +264,65 @@ def batch_deadline_s() -> float:
 
 def set_default_backend(backend: str) -> None:
     global _default_backend, _tpu_usable
-    if backend not in ("auto", "cpu", "tpu"):
+    if backend not in ("auto", "cpu", "tpu", "sidecar"):
         raise ValueError(f"unknown crypto backend {backend!r}")
     _default_backend = backend
     if backend != "auto":
         _tpu_usable = None
+
+
+def configure_sidecar(sidecar_cfg, home: str = "") -> None:
+    """Apply a config/config.py ``SidecarConfig`` to the client side:
+    address resolution inputs, connection/request timeouts, and the
+    ``crypto.sidecar`` breaker thresholds (backoff shape is shared with
+    the crypto breaker config via ``configure``). Drops any existing
+    client so a config reload reconnects with the new parameters."""
+    with _sidecar_lock:
+        old = _sidecar_state.get("client")
+        _sidecar_state.update(
+            addr=sidecar_cfg.addr,
+            home=home,
+            client=None,
+            connect_timeout_s=sidecar_cfg.connect_timeout_ns / 1e9,
+            request_deadline_s=sidecar_cfg.request_deadline_ns / 1e9,
+            retry_backoff_s=sidecar_cfg.retry_backoff_ns / 1e9,
+            max_frame_bytes=sidecar_cfg.max_frame_bytes)
+    if old is not None:
+        old.close()
+    _bk.configure(
+        SIDECAR_BREAKER_NAME,
+        failure_threshold=sidecar_cfg.breaker_failure_threshold)
+
+
+def _sidecar_client():
+    """The process-wide sidecar client, built lazily from the configured
+    (or env/home-derived) address; None when no address resolves."""
+    from tmtpu.sidecar import client as _sc
+
+    with _sidecar_lock:
+        c = _sidecar_state["client"]
+        if c is not None:
+            return c
+        addr = _sidecar_state["addr"] or _sc.default_addr(
+            _sidecar_state["home"])
+        if not addr:
+            return None
+        c = _sc.SidecarClient(
+            addr,
+            connect_timeout_s=_sidecar_state["connect_timeout_s"],
+            request_deadline_s=_sidecar_state["request_deadline_s"],
+            retry_backoff_s=_sidecar_state["retry_backoff_s"],
+            max_frame_bytes=_sidecar_state["max_frame_bytes"])
+        _sidecar_state["client"] = c
+        return c
+
+
+def reset_sidecar_client() -> None:
+    """Drop the cached client (tests; config/addr changes)."""
+    with _sidecar_lock:
+        old, _sidecar_state["client"] = _sidecar_state["client"], None
+    if old is not None:
+        old.close()
 
 
 def _tpu_available() -> bool:
@@ -611,10 +688,106 @@ class TPUBatchVerifier(BatchVerifier):
         return mask, tallied
 
 
+class SidecarBatchVerifier(BatchVerifier):
+    """Ship the deduped miss lanes to the shared verification daemon.
+
+    Slots UNDER the sigcache→dedup layer exactly like the other
+    backends: ``_verify_pending`` only ever sees lanes the cache could
+    not answer. Per curve present, one sidecar round-trip under the
+    ``crypto.sidecar`` breaker; the daemon coalesces concurrent clients'
+    lanes into joint device dispatches and returns this request's exact
+    mask slice.
+
+    Degradation ladder (never a wrong result, only a slower one):
+
+    1. breaker open / no address → in-process verify immediately;
+    2. overload backpressure → in-process verify, NO breaker penalty
+       (the daemon is healthy and explicitly shedding load);
+    3. connect failure / request deadline / hard error → breaker
+       failure + in-process verify;
+    4. the in-process fallback is TPU when a local device answers the
+       probe, else CPU — and the TPU path carries its own serial
+       fallback, so the ladder bottoms out at exact serial verify.
+    """
+
+    def _fallback_pending(self, sub_items, tally, reason):
+        from tmtpu.libs import metrics as _m
+
+        _m.sidecar_client_fallback.inc(len(sub_items), reason=reason)
+        fb = TPUBatchVerifier() if _tpu_available() else CPUBatchVerifier()
+        return fb._verify_pending(sub_items, tally)
+
+    def _verify_pending(self, items, tally) -> Tuple[List[bool], int]:
+        import time as _time
+
+        from tmtpu.libs import timeline as _tl
+        from tmtpu.sidecar import client as _sc
+
+        mask: List[bool] = [False] * len(items)
+        tallied = 0
+        by_curve: Dict[str, List[int]] = {}
+        for i, (pk, _msg, _sig, _p) in enumerate(items):
+            by_curve.setdefault(pk.type_value(), []).append(i)
+        br = _bk.get(SIDECAR_BREAKER_NAME)
+        client = _sidecar_client()
+
+        def _apply(idx_list, sub_mask):
+            nonlocal tallied
+            for j, i in enumerate(idx_list):
+                mask[i] = bool(sub_mask[j])
+                if mask[i]:
+                    tallied += items[i][3]
+
+        for curve, idx in by_curve.items():
+            sub_items = [items[i] for i in idx]
+            if client is None:
+                sub_mask, _t = self._fallback_pending(
+                    sub_items, tally, "no-addr")
+                _apply(idx, sub_mask)
+                continue
+            if not br.allow():
+                sub_mask, _t = self._fallback_pending(
+                    sub_items, tally, "breaker-open")
+                _apply(idx, sub_mask)
+                continue
+            lanes = [(pk.bytes(), msg, sig, power)
+                     for pk, msg, sig, power in sub_items]
+            t0 = _time.perf_counter()
+            try:
+                sub_mask, _stallied, info = client.verify(
+                    curve, lanes, tally=tally,
+                    deadline_s=_sidecar_state["request_deadline_s"])
+            except _sc.SidecarOverloaded:
+                sub_mask, _t = self._fallback_pending(
+                    sub_items, tally, "overloaded")
+                _apply(idx, sub_mask)
+                continue
+            except _sc.SidecarUnavailable as e:
+                br.record_failure(e)
+                sub_mask, _t = self._fallback_pending(
+                    sub_items, tally, "unavailable")
+                _apply(idx, sub_mask)
+                continue
+            dt = _time.perf_counter() - t0
+            br.record_success()
+            # a sidecar round-trip IS this process's verify RTT: feed
+            # the adaptive gather window exactly like a device dispatch
+            SCHEDULER.note_dispatch(len(idx), dt)
+            _tl.record_sidecar(
+                role="client", curve=curve, lanes=len(idx),
+                dispatch_lanes=info["dispatch_lanes"],
+                dispatch_clients=info["dispatch_clients"],
+                seconds=round(dt, 6))
+            _apply(idx, sub_mask)
+        return mask, tallied
+
+
 def new_batch_verifier(backend: Optional[str] = None) -> BatchVerifier:
     b = backend or _default_backend
     if b == "auto":
         b = "tpu" if _tpu_available() else "cpu"
+    if b == "sidecar":
+        return SidecarBatchVerifier()
     if b == "tpu":
         return TPUBatchVerifier()
     return CPUBatchVerifier()

@@ -9,16 +9,11 @@ from __future__ import annotations
 from tmtpu.crypto.keys import KEY_TYPES, PubKey
 from tmtpu.types import pb
 
-# ensure curve modules have registered themselves. secp256k1 is the one
-# curve with a hard dependency on the `cryptography` package (no pure
-# fallback); on boxes without it the import is skipped and a secp key in
-# a proto surfaces as the "not registered" ValueError below instead of
-# breaking every import of the node/consensus stack.
+# ensure curve modules have registered themselves. All three import
+# unconditionally: secp256k1 falls back to the pure-Python engine in
+# crypto/secp256k1_ref.py when the `cryptography` package is absent.
 from tmtpu.crypto import ed25519 as _ed  # noqa: F401
-try:
-    from tmtpu.crypto import secp256k1 as _secp  # noqa: F401
-except ImportError:  # pragma: no cover — env without `cryptography`
-    _secp = None
+from tmtpu.crypto import secp256k1 as _secp  # noqa: F401
 from tmtpu.crypto import sr25519 as _sr  # noqa: F401
 
 

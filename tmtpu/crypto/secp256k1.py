@@ -3,6 +3,12 @@
 Signatures are 64-byte R||S with low-S normalization over SHA-256(msg);
 addresses are Bitcoin-style RIPEMD160(SHA-256(compressed pubkey))
 (crypto/secp256k1/secp256k1.go:11-12,141-152,195-197).
+
+Two engines, one wire format: OpenSSL via the ``cryptography`` package
+when it is importable, else the pure-Python curve math in
+``secp256k1_ref``. The consensus rules (SHA-256 digest, low-S reject on
+verify, low-S normalize on sign, compressed 33-byte pubkeys) live here
+so both engines produce byte-identical artifacts.
 """
 
 from __future__ import annotations
@@ -10,24 +16,30 @@ from __future__ import annotations
 import hashlib
 import os
 
+from tmtpu.crypto import secp256k1_ref as _ref
 from tmtpu.crypto.keys import PrivKey, PubKey, register_key_type
 from tmtpu.crypto.ripemd160 import ripemd160
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives import serialization
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.asymmetric.utils import (
-    decode_dss_signature,
-    encode_dss_signature,
-)
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature,
+        encode_dss_signature,
+    )
+
+    _CURVE = ec.SECP256K1()
+    HAVE_NATIVE = True
+except ImportError:  # pragma: no cover - depends on environment
+    HAVE_NATIVE = False
 
 KEY_TYPE = "secp256k1"
 PUB_KEY_SIZE = 33  # compressed
 PRIV_KEY_SIZE = 32
 SIG_SIZE = 64
 
-_CURVE = ec.SECP256K1()
 # group order
 N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
 HALF_N = N // 2
@@ -56,12 +68,21 @@ class PubKeySecp256k1(PubKey):
             return False
         if r == 0 or s == 0 or r >= N or s >= N:
             return False
-        try:
-            pub = ec.EllipticCurvePublicKey.from_encoded_point(_CURVE, self._bytes)
-            pub.verify(encode_dss_signature(r, s), msg, ec.ECDSA(hashes.SHA256()))
-            return True
-        except (InvalidSignature, ValueError):
+        if HAVE_NATIVE:
+            try:
+                pub = ec.EllipticCurvePublicKey.from_encoded_point(
+                    _CURVE, self._bytes
+                )
+                pub.verify(
+                    encode_dss_signature(r, s), msg, ec.ECDSA(hashes.SHA256())
+                )
+                return True
+            except (InvalidSignature, ValueError):
+                return False
+        pt = _ref.decompress(self._bytes)
+        if pt is None:
             return False
+        return _ref.verify_digest(pt, hashlib.sha256(msg).digest(), r, s)
 
     def type_value(self) -> str:
         return KEY_TYPE
@@ -74,25 +95,37 @@ class PrivKeySecp256k1(PrivKey):
         if len(key_bytes) != PRIV_KEY_SIZE:
             raise ValueError(f"secp256k1 privkey must be {PRIV_KEY_SIZE} bytes")
         self._bytes = bytes(key_bytes)
-        self._key = ec.derive_private_key(
-            int.from_bytes(key_bytes, "big"), _CURVE
-        )
+        scalar = int.from_bytes(key_bytes, "big")
+        if not 0 < scalar < N:
+            raise ValueError("secp256k1 privkey scalar out of range")
+        if HAVE_NATIVE:
+            self._key = ec.derive_private_key(scalar, _CURVE)
+        else:
+            self._key = None
 
     def bytes(self) -> bytes:
         return self._bytes
 
     def sign(self, msg: bytes) -> bytes:
-        der = self._key.sign(msg, ec.ECDSA(hashes.SHA256()))
-        r, s = decode_dss_signature(der)
+        if HAVE_NATIVE:
+            der = self._key.sign(msg, ec.ECDSA(hashes.SHA256()))
+            r, s = decode_dss_signature(der)
+        else:
+            scalar = int.from_bytes(self._bytes, "big")
+            r, s = _ref.sign_digest(scalar, hashlib.sha256(msg).digest())
         if s > HALF_N:
             s = N - s
         return r.to_bytes(32, "big") + s.to_bytes(32, "big")
 
     def pub_key(self) -> PubKey:
-        raw = self._key.public_key().public_bytes(
-            encoding=serialization.Encoding.X962,
-            format=serialization.PublicFormat.CompressedPoint,
-        )
+        if HAVE_NATIVE:
+            raw = self._key.public_key().public_bytes(
+                encoding=serialization.Encoding.X962,
+                format=serialization.PublicFormat.CompressedPoint,
+            )
+        else:
+            scalar = int.from_bytes(self._bytes, "big")
+            raw = _ref.compress(_ref.scalar_mult(scalar))
         return PubKeySecp256k1(raw)
 
     def type_value(self) -> str:

@@ -442,6 +442,70 @@ wal_skipped_bytes = DEFAULT.counter(
     "Bytes skipped by non-strict WAL iteration after a corrupt or torn "
     "record")
 
+# --- the verification-sidecar metric set (tmtpu/sidecar/) -------------------
+#
+# Server set: written by the daemon (sidecar/server.py connection loop,
+# sidecar/coalescer.py dispatcher). The coalescing acceptance reads
+# straight off dispatch_clients: a shared daemon under multi-node load
+# shows observations > 1, per-process verify never can.
+
+sidecar_server_connections = DEFAULT.gauge(
+    "sidecar", "server_connections",
+    "Client connections currently held by the sidecar daemon")
+sidecar_server_requests = DEFAULT.counter(
+    "sidecar", "server_requests_total",
+    "Protocol messages handled by the sidecar daemon",
+    labels=("type",))
+sidecar_server_dispatches_total = DEFAULT.counter(
+    "sidecar", "server_dispatches_total",
+    "Joint device dispatches issued by the cross-client coalescer",
+    labels=("curve",))
+sidecar_server_dispatch_lanes = DEFAULT.histogram(
+    "sidecar", "server_dispatch_lanes",
+    "Lanes per joint coalesced dispatch",
+    labels=("curve",), buckets=_LANE_BUCKETS)
+sidecar_server_dispatch_clients = DEFAULT.histogram(
+    "sidecar", "server_dispatch_clients",
+    "Distinct clients whose lanes shared one coalesced dispatch",
+    buckets=(1, 2, 3, 4, 6, 8, 12, 16, 32))
+sidecar_server_queue_lanes = DEFAULT.gauge(
+    "sidecar", "server_queue_lanes",
+    "Lanes currently queued in the coalescer awaiting dispatch")
+sidecar_server_overloads_total = DEFAULT.counter(
+    "sidecar", "server_overloads_total",
+    "Verify requests rejected by admission control (queue full)")
+sidecar_server_protocol_errors = DEFAULT.counter(
+    "sidecar", "server_protocol_errors_total",
+    "Malformed frames / bad sequencing / version mismatches rejected "
+    "by the sidecar daemon",
+    labels=("kind",))
+
+# Client set: written by crypto/batch.py SidecarBatchVerifier and
+# sidecar/client.py. fallback_total{reason} is the degradation story:
+# no-addr / breaker-open / overloaded / unavailable each count the
+# lanes that rode the in-process path instead of the daemon.
+
+sidecar_client_requests = DEFAULT.counter(
+    "sidecar", "client_requests_total",
+    "Verify requests sent to the sidecar daemon",
+    labels=("curve", "status"))
+sidecar_client_request_latency = DEFAULT.histogram(
+    "sidecar", "client_request_latency_seconds",
+    "Round-trip latency of sidecar verify requests",
+    labels=("curve",),
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 0.5, 1, 2.5, 5, 10, 30))
+sidecar_client_reconnects = DEFAULT.counter(
+    "sidecar", "client_reconnects_total",
+    "Sidecar connection (re)establishment attempts")
+sidecar_client_fallback = DEFAULT.counter(
+    "sidecar", "client_fallback_total",
+    "Lanes verified in-process because the sidecar was unusable",
+    labels=("reason",))
+sidecar_client_up = DEFAULT.gauge(
+    "sidecar", "client_up",
+    "1 when this process holds a live sidecar connection, else 0")
+
 # (curve, impl, padded-lanes) shapes already dispatched in this process:
 # jax.jit keys its cache on input shapes, so a new padded bucket size is
 # exactly one fresh XLA compile — tracked here rather than by poking jax

@@ -55,6 +55,7 @@ EVENT_BATCH_FLUSH = "crypto.batch_flush"
 EVENT_APPLY_BLOCK = "state.apply_block"
 EVENT_BREAKER = "crypto.breaker"
 EVENT_SIGCACHE = "crypto.sigcache"
+EVENT_SIDECAR = "crypto.sidecar"
 
 
 class Timeline:
@@ -116,6 +117,15 @@ class Timeline:
         the timeline's current height — 'how many of this height's
         lanes were verify-once eliminations' reads off the journal."""
         self.record(self._current_height, EVENT_SIGCACHE, **attrs)
+
+    def record_sidecar(self, **attrs) -> None:
+        """Verification-sidecar activity hook: client-side round-trips
+        and fallbacks (crypto/batch.py SidecarBatchVerifier, attrs carry
+        ``role="client"``) and server-side joint dispatches
+        (sidecar/coalescer.py, ``role="server"``), on the timeline's
+        current height — 'did this height's verifies ride the daemon or
+        fall back in-process' reads off the journal."""
+        self.record(self._current_height, EVENT_SIDECAR, **attrs)
 
     # -- reading ------------------------------------------------------------
 
@@ -186,6 +196,10 @@ def record_breaker(**attrs) -> None:
 
 def record_sigcache(**attrs) -> None:
     DEFAULT.record_sigcache(**attrs)
+
+
+def record_sidecar(**attrs) -> None:
+    DEFAULT.record_sidecar(**attrs)
 
 
 def snapshot(height: Optional[int] = None, last: int = 20) -> List[Dict]:

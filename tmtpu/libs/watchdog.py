@@ -288,6 +288,50 @@ def breaker_check() -> CheckFn:
     return check
 
 
+def sidecar_check(window_s: float = 30.0,
+                  fallback_threshold: int = 256) -> CheckFn:
+    """For nodes running ``crypto_backend=sidecar``: unhealthy while the
+    ``crypto.sidecar`` breaker sits OPEN (every batch is riding the
+    in-process fallback — correct but without cross-process coalescing)
+    or when sidecar fallback lanes exceed ``fallback_threshold`` within
+    the trailing window while the breaker still thinks the daemon is
+    fine. ``sidecar_client_up`` rides along in the details so /healthz
+    names the dead connection."""
+    from tmtpu.libs import breaker as _bk
+    from tmtpu.libs import metrics as _m
+
+    samples: List[Tuple[float, float]] = []  # (t, cumulative fallbacks)
+
+    def _fallback_total() -> float:
+        return sum(_m.sidecar_client_fallback.summary_series().values())
+
+    def check() -> Tuple[bool, str, Dict]:
+        from tmtpu.crypto.batch import SIDECAR_BREAKER_NAME
+
+        now = time.monotonic()
+        total = _fallback_total()
+        samples.append((now, total))
+        while samples and samples[0][0] < now - window_s:
+            samples.pop(0)
+        delta = total - samples[0][1]
+        br = _bk.lookup(SIDECAR_BREAKER_NAME)
+        state = br.state if br is not None else "unregistered"
+        up = _m.sidecar_client_up.summary_series().get("")
+        details = {"breaker_state": state, "client_up": up,
+                   "fallbacks_in_window": delta, "window_s": window_s}
+        if state == _bk.OPEN:
+            return (False, "sidecar breaker open: batches riding the "
+                           "in-process fallback", details)
+        if fallback_threshold > 0 and delta > fallback_threshold:
+            return (False,
+                    f"sidecar fallback storm: {delta:.0f} lanes in "
+                    f"{window_s:.0f}s (threshold {fallback_threshold})",
+                    details)
+        return True, "", details
+
+    return check
+
+
 def sync_status_check(is_block_syncing: Callable[[], bool],
                       is_state_syncing: Callable[[], bool]) -> CheckFn:
     """Always healthy — surfaces blocksync/statesync progress so

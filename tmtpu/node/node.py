@@ -50,6 +50,12 @@ class Node(BaseService):
         # the first verifier is built, so the first probe already runs
         # under the configured deadline
         crypto_batch.configure(config.crypto)
+        # sidecar client wiring ([sidecar] section): always applied so a
+        # node can flip to crypto_backend=sidecar via env without a
+        # config rewrite; without an address the backend falls back
+        # in-process on first use
+        crypto_batch.configure_sidecar(
+            config.sidecar, home=os.path.expanduser(config.base.home))
         # warm the native helper library now: its lazy first load may
         # COMPILE hostprep.c (seconds), which must never land inside the
         # consensus verify hot path on first use
@@ -395,6 +401,10 @@ class Node(BaseService):
                 hc.fallback_storm_threshold,
                 expect_device=self.config.base.crypto_backend == "tpu"))
             wd.register("breaker", wdg.breaker_check())
+        if self.config.base.crypto_backend == "sidecar":
+            wd.register("sidecar", wdg.sidecar_check(
+                hc.fallback_storm_window_ns / 1e9,
+                hc.fallback_storm_threshold))
         return wd
 
     def _readiness(self):

@@ -19,12 +19,17 @@ import struct
 import threading
 from typing import Optional
 
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey, X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+try:  # X25519 + ChaCha20-Poly1305 have no pure-Python fallback here
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey, X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+    HAVE_CRYPTO = True
+except ImportError:  # pragma: no cover - depends on environment
+    HAVE_CRYPTO = False
 
 from tmtpu.crypto.keys import KEY_TYPES
 from tmtpu.libs.protoio import ProtoMessage, encode_uvarint, decode_uvarint
@@ -49,6 +54,10 @@ class SecretConnectionError(Exception):
 class SecretConnection:
     def __init__(self, sock, local_priv_key):
         """Performs the full handshake on construction (blocking socket)."""
+        if not HAVE_CRYPTO:
+            raise SecretConnectionError(
+                "SecretConnection requires the `cryptography` package "
+                "(X25519/ChaCha20-Poly1305); use a plaintext transport")
         self._sock = sock
         self._send_lock = threading.Lock()
         self._recv_lock = threading.Lock()

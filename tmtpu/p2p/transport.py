@@ -12,10 +12,12 @@ from typing import List, Optional, Tuple
 
 from tmtpu.libs.protoio import ProtoMessage, encode_uvarint, decode_uvarint
 
-try:
-    from tmtpu.p2p.conn.secret_connection import SecretConnection
-except ImportError:  # no `cryptography` package on this box: fall back to
-    # the authenticated-plaintext dev connection (same handshake shape and
+from tmtpu.p2p.conn import secret_connection as _sc
+
+if _sc.HAVE_CRYPTO:
+    SecretConnection = _sc.SecretConnection
+else:  # no `cryptography` package on this box: fall back to the
+    # authenticated-plaintext dev connection (same handshake shape and
     # duck-typed surface; see plain_connection.py for the security caveats)
     import warnings
 

@@ -1,0 +1,297 @@
+"""Sidecar client: one multiplexed connection, many in-flight requests.
+
+``SidecarClient`` is deliberately dumb about crypto — it moves raw
+(pk_bytes, msg, sig, power) lanes over the wire and returns the
+daemon's mask. All fallback POLICY (breaker, in-process retry, serial
+CPU) lives in :class:`tmtpu.crypto.batch.SidecarBatchVerifier`; the
+client only distinguishes the failure KINDS the policy needs:
+
+- :class:`SidecarUnavailable` — can't connect, connection died
+  mid-request, per-request deadline hit, or the daemon answered a
+  non-OK status other than overload. Counts against the
+  ``crypto.sidecar`` breaker.
+- :class:`SidecarOverloaded` — explicit admission-control backpressure.
+  The daemon is HEALTHY and saying "not now"; the caller verifies this
+  batch in-process but does not penalize the breaker for it.
+
+One background reader thread demultiplexes responses to waiters by
+request id; callers block on their own event with their own deadline,
+so a slow joint dispatch never heads-of-line-blocks a Ping. Reconnects
+are lazy (next request attempts) with a flat backoff window so a dead
+daemon costs one failed ``connect()`` per window, not one per verify.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from tmtpu.sidecar import protocol as proto
+
+ENV_ADDR = "TMTPU_SIDECAR_ADDR"
+
+
+def default_addr(home: str = "") -> str:
+    """Resolution order: explicit config addr (caller passes it through),
+    ``TMTPU_SIDECAR_ADDR`` env, then the conventional per-home unix
+    socket path."""
+    env = os.environ.get(ENV_ADDR, "")
+    if env:
+        return env
+    if home:
+        return f"unix://{os.path.join(home, 'data', 'sidecar.sock')}"
+    return ""
+
+
+class SidecarError(Exception):
+    pass
+
+
+class SidecarUnavailable(SidecarError):
+    """Daemon unreachable / dead connection / deadline / hard error."""
+
+
+class SidecarOverloaded(SidecarError):
+    """Explicit backpressure: daemon healthy but queues are full."""
+
+
+class _Waiter:
+    __slots__ = ("event", "reply", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.reply = None
+        self.error: Optional[Exception] = None
+
+
+class SidecarClient:
+    def __init__(self, addr: str, *,
+                 client_id: str = "",
+                 connect_timeout_s: float = 2.0,
+                 request_deadline_s: float = 10.0,
+                 retry_backoff_s: float = 1.0,
+                 max_frame_bytes: int = proto.DEFAULT_MAX_FRAME_BYTES):
+        self.addr = addr
+        self.client_id = client_id or f"pid-{os.getpid()}"
+        self._connect_timeout_s = connect_timeout_s
+        self._request_deadline_s = request_deadline_s
+        self._retry_backoff_s = retry_backoff_s
+        self._max_frame_bytes = max_frame_bytes
+        self._sock: Optional[socket.socket] = None
+        self._rfile = None
+        self._wlock = threading.Lock()
+        self._conn_lock = threading.Lock()
+        self._waiters: Dict[int, _Waiter] = {}
+        self._waiters_lock = threading.Lock()
+        self._seq = itertools.count(1)
+        self._last_connect_fail = 0.0
+        self.hello_ack: Optional[proto.HelloAck] = None
+
+    # --- connection management ---
+
+    def connected(self) -> bool:
+        return self._sock is not None
+
+    def _ensure_connected(self) -> None:
+        if self._sock is not None:
+            return
+        with self._conn_lock:
+            if self._sock is not None:
+                return
+            now = time.monotonic()
+            if now - self._last_connect_fail < self._retry_backoff_s:
+                raise SidecarUnavailable(
+                    f"sidecar {self.addr}: in connect backoff")
+            try:
+                self._connect_locked()
+            except (OSError, proto.ProtocolError, EOFError,
+                    ValueError) as exc:
+                self._last_connect_fail = time.monotonic()
+                raise SidecarUnavailable(
+                    f"sidecar {self.addr}: {exc}") from exc
+
+    def _connect_locked(self) -> None:
+        from tmtpu.libs import metrics as _m
+
+        _m.sidecar_client_reconnects.inc()
+        kind, target = proto.parse_addr(self.addr)
+        if kind == "unix":
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        else:
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.settimeout(self._connect_timeout_s)
+        sock.connect(target)
+        rfile = sock.makefile("rb")
+        reader = proto.FrameReader(rfile, self._max_frame_bytes)
+        sock.sendall(proto.encode_frame(proto.Hello(
+            version=proto.PROTOCOL_VERSION, client_id=self.client_id,
+            features=["verify", "tally"])))
+        ack = reader.read_msg()
+        if isinstance(ack, proto.ErrorReply):
+            raise SidecarUnavailable(
+                f"sidecar rejected handshake (code {ack.code}): "
+                f"{ack.message}")
+        if not isinstance(ack, proto.HelloAck):
+            raise proto.ProtocolError(
+                f"expected HelloAck, got {type(ack).__name__}")
+        sock.settimeout(None)  # reader thread blocks; waiters time out
+        self.hello_ack = ack
+        self._sock = sock
+        self._rfile = rfile
+        _m.sidecar_client_up.set(1.0)
+        threading.Thread(target=self._read_loop, args=(reader, sock),
+                         name="sidecar-client-read",
+                         daemon=True).start()
+
+    def close(self) -> None:
+        with self._conn_lock:
+            self._teardown(SidecarUnavailable("client closed"))
+
+    def _teardown(self, err: Exception) -> None:
+        from tmtpu.libs import metrics as _m
+
+        sock, self._sock = self._sock, None
+        self._rfile = None
+        self.hello_ack = None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            _m.sidecar_client_up.set(0.0)
+        with self._waiters_lock:
+            waiters, self._waiters = self._waiters, {}
+        for w in waiters.values():
+            w.error = err
+            w.event.set()
+
+    def _read_loop(self, reader: proto.FrameReader,
+                   sock: socket.socket) -> None:
+        try:
+            while True:
+                msg = reader.read_msg()
+                rid = getattr(msg, "request_id",
+                              getattr(msg, "nonce", 0))
+                if isinstance(msg, proto.ErrorReply) and rid == 0:
+                    raise SidecarUnavailable(
+                        f"sidecar connection error {msg.code}: "
+                        f"{msg.message}")
+                with self._waiters_lock:
+                    w = self._waiters.pop(rid, None)
+                if w is not None:
+                    w.reply = msg
+                    w.event.set()
+                # unmatched reply: waiter already timed out — drop it
+        except (EOFError, OSError, proto.ProtocolError,
+                SidecarUnavailable) as exc:
+            with self._conn_lock:
+                if self._sock is sock:
+                    self._teardown(SidecarUnavailable(
+                        f"sidecar connection lost: {exc}"))
+
+    # --- request primitives ---
+
+    def _roundtrip(self, rid: int, msg, deadline_s: float):
+        w = _Waiter()
+        with self._waiters_lock:
+            self._waiters[rid] = w
+        try:
+            data = proto.encode_frame(msg)
+            sock = self._sock
+            if sock is None:
+                raise SidecarUnavailable("sidecar not connected")
+            with self._wlock:
+                sock.sendall(data)
+        except OSError as exc:
+            with self._waiters_lock:
+                self._waiters.pop(rid, None)
+            with self._conn_lock:
+                if self._sock is sock:
+                    self._teardown(SidecarUnavailable(str(exc)))
+            raise SidecarUnavailable(
+                f"sidecar send failed: {exc}") from exc
+        if not w.event.wait(deadline_s):
+            with self._waiters_lock:
+                self._waiters.pop(rid, None)
+            raise SidecarUnavailable(
+                f"sidecar request deadline ({deadline_s:.3f}s) exceeded")
+        if w.error is not None:
+            raise SidecarUnavailable(str(w.error)) from w.error
+        return w.reply
+
+    # --- public API ---
+
+    def verify(self, curve: str, lanes: List[Tuple[bytes, bytes, bytes,
+                                                   int]],
+               tally: bool = False,
+               deadline_s: Optional[float] = None) -> Tuple[List[bool],
+                                                            int, Dict]:
+        """Ship lanes to the daemon; returns (mask, tallied, dispatch
+        info). Raises :class:`SidecarOverloaded` on backpressure and
+        :class:`SidecarUnavailable` on everything else non-OK."""
+        from tmtpu.libs import metrics as _m
+
+        deadline_s = deadline_s or self._request_deadline_s
+        self._ensure_connected()
+        rid = next(self._seq)
+        req = proto.VerifyRequest(
+            request_id=rid, curve=curve, tally=tally,
+            deadline_ms=int(deadline_s * 1000),
+            lanes=[proto.Lane(pub_key=pk, msg=m, sig=s, power=p)
+                   for pk, m, s, p in lanes])
+        t0 = time.perf_counter()
+        try:
+            reply = self._roundtrip(rid, req, deadline_s)
+        except SidecarUnavailable:
+            _m.sidecar_client_requests.inc(curve=curve, status="error")
+            raise
+        _m.sidecar_client_request_latency.observe(
+            time.perf_counter() - t0, curve=curve)
+        if not isinstance(reply, proto.VerifyResponse):
+            _m.sidecar_client_requests.inc(curve=curve, status="error")
+            raise SidecarUnavailable(
+                f"unexpected reply {type(reply).__name__}")
+        status = proto.STATUS_NAMES.get(reply.status,
+                                        str(reply.status))
+        _m.sidecar_client_requests.inc(curve=curve, status=status)
+        if reply.status == proto.STATUS_OVERLOADED:
+            raise SidecarOverloaded(reply.error or "overloaded")
+        if reply.status != proto.STATUS_OK:
+            raise SidecarUnavailable(
+                f"sidecar status {status}: {reply.error}")
+        if reply.lane_count != len(lanes):
+            raise SidecarUnavailable(
+                f"sidecar answered {reply.lane_count} lanes "
+                f"for {len(lanes)}")
+        mask = proto.unpack_mask(reply.mask, reply.lane_count)
+        info = {"dispatch_id": reply.dispatch_id,
+                "dispatch_lanes": reply.dispatch_lanes,
+                "dispatch_clients": reply.dispatch_clients}
+        return mask, reply.tallied, info
+
+    def ping(self, deadline_s: Optional[float] = None) -> proto.Pong:
+        self._ensure_connected()
+        nonce = next(self._seq)
+        reply = self._roundtrip(nonce, proto.Ping(nonce=nonce),
+                                deadline_s or self._request_deadline_s)
+        if not isinstance(reply, proto.Pong):
+            raise SidecarUnavailable(
+                f"unexpected reply {type(reply).__name__}")
+        return reply
+
+    def stats(self, deadline_s: Optional[float] = None) -> Dict:
+        """Daemon introspection snapshot. StatsResponse carries no id,
+        so stats calls serialize on request id 0 — fine for a debug
+        endpoint."""
+        self._ensure_connected()
+        reply = self._roundtrip(0, proto.StatsRequest(),
+                                deadline_s or self._request_deadline_s)
+        if not isinstance(reply, proto.StatsResponse):
+            raise SidecarUnavailable(
+                f"unexpected reply {type(reply).__name__}")
+        return json.loads(reply.stats_json.decode())
