@@ -57,10 +57,17 @@ PROBE_TIMEOUT_S = float(os.environ.get("TMTPU_BENCH_PROBE_TIMEOUT", "180"))
 # did not — stay at or under the proven figure plus emission slack.
 WALL_CAP_S = float(os.environ.get("TMTPU_BENCH_WALL_CAP", "1680"))
 # Clamped so a stale env override (round 4 shipped 2100) can never defeat
-# the wall cap: probing must always leave room for a CPU child + emit.
-PROBE_BUDGET_S = min(
+# the wall cap: probing must always leave room for a CPU child + emit —
+# and floored at 0 so a small WALL_CAP_S (CI smoke runs) yields "probe
+# once, no retry budget" instead of a NEGATIVE budget, which the retry
+# loop's remaining-time arithmetic would read as "already expired" on
+# attempt 1 yet other consumers would treat as truthy.
+PROBE_BUDGET_S = max(0.0, min(
     float(os.environ.get("TMTPU_BENCH_PROBE_BUDGET", "600")),
-    WALL_CAP_S - 600)
+    WALL_CAP_S - 600))
+if PROBE_BUDGET_S == 0.0:
+    print("bench: wall cap forces probe budget to 0 — one probe attempt, "
+          "no retries", file=sys.stderr)
 
 # TMTPU_BENCH_SKIP_PROBE=1: skip the device-probe budget entirely and go
 # straight to a reduced-lane CPU measurement (CI smoke / CPU-only boxes —
@@ -146,6 +153,18 @@ def _init_backend_probe() -> str:
         if any(p["rc"] == "timeout" for p in _probe_log):
             print("bench: probe hit the hard timeout (wedged tunnel) — "
                   "one attempt only, skipping retry budget", file=sys.stderr)
+            break
+        # twice the same instant crash (plugin import error, dead PJRT
+        # socket refusing fast) is as deterministic as rc=3 — retrying
+        # it for the full budget reproduces the r03–r05 600 s burn with
+        # a different failure mode
+        fast = [p["rc"] for p in _probe_log
+                if isinstance(p["rc"], int) and p["rc"] != 0
+                and p["s"] < 10.0]
+        if len(fast) >= 2 and fast[-1] == fast[-2]:
+            print(f"bench: probe failed fast twice with rc={fast[-1]} — "
+                  "deterministic failure, skipping retry budget",
+                  file=sys.stderr)
             break
         elapsed = time.perf_counter() - t0
         remaining = PROBE_BUDGET_S - elapsed
@@ -601,6 +620,122 @@ def _run_sidecar_child() -> None:
     print(json.dumps(out), flush=True)
 
 
+def _next_multichip_slot() -> str:
+    """Next free MULTICHIP_rNN.json (the measurement slot the driver
+    reads), or the TMTPU_MULTICHIP_OUT override verbatim."""
+    override = os.environ.get("TMTPU_MULTICHIP_OUT", "")
+    if override:
+        return override
+    here = os.path.dirname(os.path.abspath(__file__))
+    n = 1
+    while os.path.exists(os.path.join(here, f"MULTICHIP_r{n:02d}.json")):
+        n += 1
+    return os.path.join(here, f"MULTICHIP_r{n:02d}.json")
+
+
+def _run_flood_child() -> None:
+    """TMTPU_BENCH_CHILD=flood: the 100k-vote flood verified + tallied
+    across every chip on the host via tpu/mesh_dispatch.py, vs the
+    single-device 10k reference — REAL numbers (not a dry run) into the
+    MULTICHIP measurement slot. On a forced CPU mesh
+    (TMTPU_BENCH_FLOOD_FORCE_CPU=1) lane counts shrink so vote signing
+    + XLA:CPU compiles fit the budget; the artifact records the mesh's
+    actual platform so a CPU-mesh line can never masquerade as chip
+    evidence."""
+    force_cpu = os.environ.get("TMTPU_BENCH_FLOOD_FORCE_CPU") == "1"
+    if force_cpu:
+        from tmtpu.tpu.compat import force_cpu_backend
+
+        force_cpu_backend(
+            int(os.environ.get("TMTPU_BENCH_FLOOD_CPU_DEVICES", "8")))
+    import jax
+    import numpy as np
+
+    from tmtpu.tpu import mesh_dispatch as md
+    from tmtpu.tpu import sharding as sh
+
+    # flood past every routing threshold regardless of config defaults
+    os.environ.setdefault("TMTPU_SHARD_MIN_LANES", "1")
+    default_lanes = "2048" if force_cpu else "100000"
+    lanes = int(os.environ.get("TMTPU_BENCH_FLOOD_LANES", default_lanes))
+    ref_lanes = min(lanes, 512 if force_cpu else LANES)
+    t0 = time.perf_counter()
+    pks, msgs, sigs = _make_votes(lanes)
+    powers = [1000] * lanes
+    prep_dt = time.perf_counter() - t0
+    print(f"bench: flood generated {lanes} votes in {prep_dt:.1f}s",
+          file=sys.stderr)
+    # compile warm-up at the EXACT padded shapes, then the timed passes
+    md.batch_verify_tally_mesh(pks, msgs, sigs, powers)
+    t0 = time.perf_counter()
+    mask, tallied = md.batch_verify_tally_mesh(pks, msgs, sigs, powers)
+    flood_dt = time.perf_counter() - t0
+    assert bool(np.all(mask)) and tallied == 1000 * lanes, \
+        "flood lanes must verify"
+    sh.batch_verify_tally(pks[:ref_lanes], msgs[:ref_lanes],
+                          sigs[:ref_lanes], powers[:ref_lanes])
+    t0 = time.perf_counter()
+    _m2, t2 = sh.batch_verify_tally(pks[:ref_lanes], msgs[:ref_lanes],
+                                    sigs[:ref_lanes], powers[:ref_lanes])
+    ref_dt = time.perf_counter() - t0
+    assert t2 == 1000 * ref_lanes
+    snap = md.snapshot()
+    out = {
+        "metric": "multichip_flood_verify_tally",
+        "value": round(lanes / flood_dt, 1),
+        "unit": "sig/s",
+        "lanes": lanes,
+        "wall_s": round(flood_dt, 4),
+        "n_devices": snap["devices"],
+        "platform": jax.devices()[0].platform,
+        "dry_run": False,
+        "per_chip_occupancy": snap["occupancy_lanes"],
+        "pad_ratio": round(snap["last"]["padded"] / lanes, 4),
+        "shard_lanes": snap["last"]["shard_lanes"],
+        "single_device_ref": {
+            "lanes": ref_lanes,
+            "wall_s": round(ref_dt, 4),
+            "sig_s": round(ref_lanes / ref_dt, 1),
+        },
+        # the ISSUE target in one bool: 100k on the mesh within the
+        # single-device 10k wall (only meaningful at full lane counts)
+        "meets_target": bool(lanes >= 10 * ref_lanes
+                             and flood_dt <= ref_dt),
+        "phases": {"prepare": round(prep_dt, 4)},
+        "vs_baseline": round((lanes / flood_dt) / GO_SERIAL_SIG_S, 2),
+    }
+    slot = _next_multichip_slot()
+    with open(slot, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"bench: flood wrote {slot}", file=sys.stderr)
+    print(json.dumps(out), flush=True)
+
+
+def _run_flood_parent(t0) -> None:
+    """Parent side of TMTPU_BENCH_FLOOD=1: probe (no jax in-process),
+    then run the flood child on the device mesh, or a forced CPU mesh
+    when no device answers."""
+    backend = "cpu" if SKIP_PROBE else _init_backend_probe()
+    if backend != "device":
+        os.environ["TMTPU_BENCH_FLOOD_FORCE_CPU"] = "1"
+    remaining = WALL_CAP_S - (time.perf_counter() - t0)
+    line = _run_child("flood",
+                      timeout_s=min(1500.0, max(240.0, remaining - 90)))
+    if line is None:
+        _emit_provisional_final(["flood-child-failed"])
+    else:
+        # NOT _emit_with_provenance: its CPU-fallback branch would swap
+        # the flood metric for a cached ed25519_e2e headline — a
+        # different metric entirely. Provenance rides alongside instead.
+        out = _ensure_phases(json.loads(line))
+        out["probe"] = {"attempts": len(_probe_log),
+                        "log": _probe_log[-6:],
+                        "budget_s": PROBE_BUDGET_S}
+        print(json.dumps(out), flush=True)
+    print(f"bench: total wall {time.perf_counter() - t0:.0f}s",
+          file=sys.stderr)
+
+
 def _run_child(backend: str, timeout_s: float):
     """Run the measurement in a CHILD process pinned to ``backend``.
 
@@ -736,13 +871,19 @@ def main():
         except Exception:  # noqa: BLE001 — lock is advisory, never fatal
             measure_lock = None
         try:
-            _run_parent(t0)
+            if os.environ.get("TMTPU_BENCH_FLOOD") == "1":
+                _run_flood_parent(t0)
+            else:
+                _run_parent(t0)
         finally:
             if measure_lock is not None:
                 measure_lock.release()
         return
 
     backend = os.environ["TMTPU_BENCH_CHILD"]
+    if backend == "flood":
+        _run_flood_child()
+        return
     if backend == "sidecar":
         _run_sidecar_child()
         return
@@ -986,21 +1127,15 @@ def main():
             all_ok, _mask = bv.verify()
             dt = time.perf_counter() - t0
             assert all_ok
+            # The serial number stays under its OWN keys — never
+            # promoted into out["value"]. The headline metric must mean
+            # the same pipeline every round, or the driver's
+            # round-over-round comparison silently mixes a 2000-lane
+            # serial sample with the 10k-lane e2e graph (ADVICE r5).
             out["cpu_serial_backend_sig_s"] = round(sample / dt, 1)
             out["cpu_serial_backend_vs_baseline"] = round(
                 (sample / dt) / GO_SERIAL_SIG_S, 2)
-            if sample / dt > sig_s:
-                # The framework's actual CPU-backend verify path (serial
-                # OpenSSL) beats the device graph emulated on XLA:CPU —
-                # the headline should carry what the framework really
-                # does on this backend, with the emulated-graph numbers
-                # kept above for transparency.
-                out["value"] = out["cpu_serial_backend_sig_s"]
-                out["vs_baseline"] = out["cpu_serial_backend_vs_baseline"]
-                out["pipeline"] = "serial-openssl-backend"
-                # the headline now comes from a serial sample, not the
-                # `lanes`-wide emulated graph kept above in `structures`
-                out["serial_sample_n"] = sample
+            out["cpu_serial_sample_n"] = sample
         except Exception as e:  # noqa: BLE001
             out["cpu_serial_backend_error"] = repr(e)
     if lanes == LANES and "sync" in structures:

@@ -21,6 +21,23 @@ def test_dryrun_multichip_8():
     ge.dryrun_multichip(8)
 
 
+def test_entry_shape():
+    """entry() hands the driver a jittable (fn, args) pair with coherent
+    lane shapes — checked WITHOUT compiling (the ~100s XLA:CPU compile
+    plus full numeric run is the slow twin below, and the driver's own
+    dryrun_multichip certifies the same entry at >=1k lanes against CPU
+    oracles on every round)."""
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    assert callable(fn)
+    pk_b, r_b, s_b, h_b, powers, table = args
+    lanes = pk_b.shape[-1]
+    assert r_b.shape[-1] == s_b.shape[-1] == h_b.shape[-1] == lanes
+    assert powers.shape == (5, lanes)
+
+
+@pytest.mark.slow  # one fresh XLA:CPU compile of the tally entry (~100s)
 def test_entry_compiles():
     import jax
 

@@ -57,6 +57,7 @@ class BlocksyncReactorV1(BlockServingMixin, Reactor):
         self.fsm = fsm_mod.FSM(start)
         self.blocks_synced = 0
         self._events: "queue.Queue" = queue.Queue(maxsize=10_000)
+        self.event_drops: dict = {}  # kind -> count (queue-full drops)
         self._pump_alive = False
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -88,8 +89,30 @@ class BlocksyncReactorV1(BlockServingMixin, Reactor):
             return
         try:
             self._events.put_nowait(ev)
+            return
         except queue.Full:
             pass
+        dropped = ev
+        if ev[0] == "block":
+            # a full queue prefers dropping a queued STATUS update over
+            # this block: statuses refresh for free every 10s, a dropped
+            # block costs a request timeout + re-request round trip
+            with self._events.mutex:
+                q = self._events.queue
+                for i, queued in enumerate(q):
+                    if queued[0] == "status":
+                        dropped = queued
+                        del q[i]
+                        q.append(ev)
+                        break
+        self.event_drops[dropped[0]] = \
+            self.event_drops.get(dropped[0], 0) + 1
+        from tmtpu.libs import log
+
+        log.default_logger().error(
+            "blocksync event queue full, dropped event",
+            module="blocksync", kind=dropped[0],
+            drops=self.event_drops[dropped[0]])
 
     def add_peer(self, peer: Peer) -> None:
         peer.send(BLOCKCHAIN_CHANNEL, self._status_msg())

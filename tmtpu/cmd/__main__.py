@@ -249,7 +249,9 @@ def cmd_sidecar(args) -> int:
         max_lanes_per_dispatch=cfg.sidecar.max_lanes_per_dispatch,
         max_frame_bytes=cfg.sidecar.max_frame_bytes,
         request_deadline_s=cfg.sidecar.request_deadline_ns / 1e9,
-        health_laddr=args.health_laddr or cfg.sidecar.health_laddr)
+        health_laddr=args.health_laddr or cfg.sidecar.health_laddr,
+        mesh_devices=cfg.sidecar.mesh_devices,
+        shard_min_lanes=cfg.sidecar.shard_min_lanes)
     warm = cfg.sidecar.warm_on_start and not args.no_warm
     server.start()
     if warm:

@@ -217,6 +217,13 @@ class CryptoConfig:
     adaptive_flush: bool = True
     flush_max_wait_ns: int = 8 * MS
     flush_max_lanes: int = 4096
+    # mesh dispatch (tpu/mesh_dispatch.py): flushes of at least
+    # shard_min_lanes lanes shard across mesh_devices chips with the
+    # vote-power tally psum-reduced on device. mesh_devices 0 = every
+    # visible device, 1 = mesh off. Below the threshold (or on failure,
+    # via the crypto.mesh breaker) flushes ride the single-device path.
+    mesh_devices: int = 0
+    shard_min_lanes: int = 2048
 
 
 @dataclass
@@ -249,6 +256,11 @@ class SidecarConfig:
     warm_on_start: bool = True
     # optional HTTP host:port for /healthz + /metrics ("" disables)
     health_laddr: str = ""
+    # daemon-side mesh dispatch overrides (same semantics as the
+    # [crypto] pair; the daemon is the natural multi-chip owner, so its
+    # coalesced joint dispatches usually deserve a lower threshold)
+    mesh_devices: int = 0
+    shard_min_lanes: int = 2048
 
 
 @dataclass

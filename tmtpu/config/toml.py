@@ -167,6 +167,16 @@ def validate(cfg: Config) -> None:
         raise ValueError("crypto.flush_max_wait_ns cannot be negative")
     if cfg.crypto.flush_max_lanes < 1:
         raise ValueError("crypto.flush_max_lanes must be >= 1")
+    if cfg.crypto.mesh_devices < 0:
+        raise ValueError("crypto.mesh_devices cannot be negative "
+                         "(0 = all visible devices)")
+    if cfg.crypto.shard_min_lanes < 1:
+        raise ValueError("crypto.shard_min_lanes must be >= 1")
+    if cfg.sidecar.mesh_devices < 0:
+        raise ValueError("sidecar.mesh_devices cannot be negative "
+                         "(0 = all visible devices)")
+    if cfg.sidecar.shard_min_lanes < 1:
+        raise ValueError("sidecar.shard_min_lanes must be >= 1")
     if cfg.sidecar.backend not in ("auto", "cpu", "tpu"):
         # a daemon whose engine is "sidecar" would dial itself
         raise ValueError(

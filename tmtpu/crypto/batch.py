@@ -234,6 +234,9 @@ def configure(crypto_cfg) -> None:
     SCHEDULER.max_wait_s = getattr(
         crypto_cfg, "flush_max_wait_ns", 8_000_000) / 1e9
     SCHEDULER.max_lanes = getattr(crypto_cfg, "flush_max_lanes", 4096)
+    from tmtpu.tpu import mesh_dispatch as _mesh
+
+    _mesh.configure(crypto_cfg)
 
 
 def probe_timeout_s() -> float:
@@ -644,21 +647,49 @@ class TPUBatchVerifier(BatchVerifier):
                         tallied += items[i][3]
             return apply
 
+        from tmtpu.tpu import mesh_dispatch as _mesh
+
+        def _mesh_first(curve, n_lanes, mesh_thunk, single_thunk):
+            """Thunk combinator for _dispatch: flushes past the
+            shard_min_lanes threshold try the multi-chip mesh first. A
+            mesh failure records against the ``crypto.mesh`` breaker —
+            never ``crypto.tpu``, whose single-device path may be
+            perfectly healthy — and the SAME flush falls through to the
+            single-device call inside the same deadline window, so the
+            degradation ladder is mesh → single-device → CPU-serial."""
+            def thunk():
+                if _mesh.route(curve, n_lanes):
+                    try:
+                        return mesh_thunk()
+                    except Exception as e:  # noqa: BLE001 — broken
+                        # collectives must not take down verification
+                        _mesh.note_failure(curve, n_lanes, e)
+                return single_thunk()
+            return thunk
+
         if sr_idx:
             from tmtpu.tpu.sr_verify import batch_verify_sr
 
-            _dispatch(SR25519, sr_idx, lambda: batch_verify_sr(
-                [items[i][0].bytes() for i in sr_idx],
-                [items[i][1] for i in sr_idx],
-                [items[i][2] for i in sr_idx],
+            sr_pks = [items[i][0].bytes() for i in sr_idx]
+            sr_msgs = [items[i][1] for i in sr_idx]
+            sr_sigs = [items[i][2] for i in sr_idx]
+            _dispatch(SR25519, sr_idx, _mesh_first(
+                SR25519, len(sr_idx),
+                lambda: _mesh.batch_verify_mesh(
+                    SR25519, sr_pks, sr_msgs, sr_sigs),
+                lambda: batch_verify_sr(sr_pks, sr_msgs, sr_sigs),
             ), _apply_mask(sr_idx))
         if k1_idx:
             from tmtpu.tpu.k1_verify import batch_verify_k1
 
-            _dispatch(SECP256K1, k1_idx, lambda: batch_verify_k1(
-                [items[i][0].bytes() for i in k1_idx],
-                [items[i][1] for i in k1_idx],
-                [items[i][2] for i in k1_idx],
+            k1_pks = [items[i][0].bytes() for i in k1_idx]
+            k1_msgs = [items[i][1] for i in k1_idx]
+            k1_sigs = [items[i][2] for i in k1_idx]
+            _dispatch(SECP256K1, k1_idx, _mesh_first(
+                SECP256K1, len(k1_idx),
+                lambda: _mesh.batch_verify_mesh(
+                    SECP256K1, k1_pks, k1_msgs, k1_sigs),
+                lambda: batch_verify_k1(k1_pks, k1_msgs, k1_sigs),
             ), _apply_mask(k1_idx))
         if ed_idx:
             if len(ed_idx) < _TPU_MIN_BATCH:
@@ -673,13 +704,22 @@ class TPUBatchVerifier(BatchVerifier):
                         mask[i] = bool(dev_mask[j])
                     tallied += dev_sum
 
-                _dispatch(ED25519, ed_idx, lambda: sh.batch_verify_tally(
-                    ed_pks, ed_msgs, ed_sigs, ed_powers), _apply_tally)
+                _dispatch(ED25519, ed_idx, _mesh_first(
+                    ED25519, len(ed_idx),
+                    lambda: _mesh.batch_verify_tally_mesh(
+                        ed_pks, ed_msgs, ed_sigs, ed_powers),
+                    lambda: sh.batch_verify_tally(
+                        ed_pks, ed_msgs, ed_sigs, ed_powers),
+                ), _apply_tally)
             else:
                 from tmtpu.tpu import verify as tv
 
-                _dispatch(ED25519, ed_idx, lambda: tv.batch_verify(
-                    ed_pks, ed_msgs, ed_sigs), _apply_mask(ed_idx))
+                _dispatch(ED25519, ed_idx, _mesh_first(
+                    ED25519, len(ed_idx),
+                    lambda: _mesh.batch_verify_mesh(
+                        ED25519, ed_pks, ed_msgs, ed_sigs),
+                    lambda: tv.batch_verify(ed_pks, ed_msgs, ed_sigs),
+                ), _apply_mask(ed_idx))
         from tmtpu.libs import timeline as _tl
 
         _tl.record_flush(backend="tpu", lanes=len(items),

@@ -424,6 +424,42 @@ crypto_batch_deadline_exceeded = DEFAULT.counter(
     "(the batch re-verified on the CPU path)",
     labels=("curve",))
 
+# --- the mesh-dispatch metric set (tpu/mesh_dispatch.py) --------------------
+#
+# Written when a flush rides the sharded multi-chip path instead of one
+# device. fallback_total{reason} is the degradation story: breaker-open
+# counts lanes skipped while crypto.mesh is open, device-error counts
+# lanes that re-rode the single-device path after a mesh failure.
+
+crypto_mesh_devices = DEFAULT.gauge(
+    "crypto", "mesh_devices",
+    "Devices in the cached verify mesh (0 until the first sharded "
+    "dispatch builds it)")
+crypto_mesh_dispatches_total = DEFAULT.counter(
+    "crypto", "mesh_dispatches_total",
+    "Batch-verify flushes dispatched across the device mesh",
+    labels=("curve",))
+crypto_mesh_shard_lanes = DEFAULT.histogram(
+    "crypto", "mesh_shard_lanes",
+    "Padded lanes per device shard in a mesh dispatch",
+    labels=("curve",), buckets=_LANE_BUCKETS)
+crypto_mesh_pad_ratio = DEFAULT.histogram(
+    "crypto", "mesh_pad_ratio",
+    "Padded-over-actual lane ratio per mesh dispatch (bucket plus "
+    "32 x n_devices quantum rounding)",
+    labels=("curve",),
+    buckets=(1.0, 1.05, 1.1, 1.25, 1.5, 2.0, 4.0, 8.0))
+crypto_mesh_psum_seconds = DEFAULT.histogram(
+    "crypto", "mesh_psum_seconds",
+    "Host readback time of the psum-reduced vote-power limb sums "
+    "after the packed mask is ready",
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+             0.025, 0.05, 0.1, 0.25, 1))
+crypto_mesh_fallback_total = DEFAULT.counter(
+    "crypto", "mesh_fallback_total",
+    "Lanes that skipped or fell back off the mesh path",
+    labels=("curve", "reason"))
+
 # libs/faultinject.py: one count per scripted fault actually delivered
 # (mode = error | latency | flaky | crash) — chaos tests assert on it,
 # and a production scrape showing nonzero values means someone left
@@ -479,6 +515,15 @@ sidecar_server_protocol_errors = DEFAULT.counter(
     "Malformed frames / bad sequencing / version mismatches rejected "
     "by the sidecar daemon",
     labels=("kind",))
+sidecar_server_mesh_dispatches = DEFAULT.counter(
+    "sidecar", "server_mesh_dispatches_total",
+    "Joint coalesced dispatches that rode the multi-chip mesh path",
+    labels=("curve",))
+sidecar_server_mesh_occupancy_lanes = DEFAULT.gauge(
+    "sidecar", "server_mesh_occupancy_lanes",
+    "Cumulative sharded lanes dispatched to each mesh device by this "
+    "daemon",
+    labels=("device",))
 
 # Client set: written by crypto/batch.py SidecarBatchVerifier and
 # sidecar/client.py. fallback_total{reason} is the degradation story:

@@ -87,6 +87,7 @@ class Coalescer:
         self._queues: Dict[str, List[PendingRequest]] = {}
         self._queued_lanes = 0
         self._dispatch_seq = 0
+        self._mesh_dispatches = 0
         self._running = False
         self._thread: Optional[threading.Thread] = None
 
@@ -158,6 +159,7 @@ class Coalescer:
             return {"queued_lanes": self._queued_lanes,
                     "queued_by_curve": per_curve,
                     "dispatches": self._dispatch_seq,
+                    "mesh_dispatches": self._mesh_dispatches,
                     "scheduler": self.scheduler.snapshot()}
 
     # --- dispatcher ---
@@ -238,6 +240,9 @@ class Coalescer:
             joint.extend(req.items)
         clients = len({req.client_id for req in live})
         tally = any(req.tally for req in live)
+        from tmtpu.tpu import mesh_dispatch as _mesh
+
+        mesh_before = _mesh.dispatch_count()
         t0 = time.perf_counter()
         try:
             mask, _tallied = self._verify_fn(curve, joint, tally)
@@ -253,8 +258,23 @@ class Coalescer:
         _m.sidecar_server_dispatches_total.inc(curve=curve)
         _m.sidecar_server_dispatch_lanes.observe(len(joint), curve=curve)
         _m.sidecar_server_dispatch_clients.observe(clients)
+        # did the engine shard this joint dispatch across the mesh? The
+        # verify path (crypto/batch.py → tpu/mesh_dispatch.py) decides;
+        # here we account for it: per-chip occupancy in Stats + metrics
+        meshed = _mesh.dispatch_count() - mesh_before
+        shards = 0
+        if meshed:
+            snap = _mesh.snapshot()
+            shards = snap["devices"]
+            with self._lock:
+                self._mesh_dispatches += meshed
+            _m.sidecar_server_mesh_dispatches.inc(meshed, curve=curve)
+            for dev, lanes in snap["occupancy_lanes"].items():
+                _m.sidecar_server_mesh_occupancy_lanes.set(
+                    lanes, device=dev)
         _tl.record_sidecar(role="server", curve=curve, lanes=len(joint),
                            clients=clients, requests=len(live),
+                           mesh_shards=shards,
                            seconds=round(dt, 6))
         if len(mask) != len(joint):
             for req in live:

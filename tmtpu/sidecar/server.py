@@ -58,7 +58,9 @@ class SidecarServer:
                  max_frame_bytes: int = proto.DEFAULT_MAX_FRAME_BYTES,
                  request_deadline_s: float = 30.0,
                  health_laddr: str = "",
-                 server_id: str = ""):
+                 server_id: str = "",
+                 mesh_devices: Optional[int] = None,
+                 shard_min_lanes: Optional[int] = None):
         self.addr = addr
         self._kind, self._target = proto.parse_addr(addr)
         if backend not in ("auto", "cpu", "tpu"):
@@ -66,6 +68,10 @@ class SidecarServer:
                 f"sidecar daemon backend must be auto/cpu/tpu, got "
                 f"{backend!r} (a daemon serving 'sidecar' would recurse)")
         self._backend = backend
+        # daemon-side mesh knobs: the daemon owns every chip on the
+        # host, so its [sidecar] overrides win over [crypto] here
+        self._mesh_devices = mesh_devices
+        self._shard_min_lanes = shard_min_lanes
         self._max_lanes_per_dispatch = max_lanes_per_dispatch
         self._max_frame_bytes = max_frame_bytes
         self._default_deadline_s = request_deadline_s
@@ -157,6 +163,12 @@ class SidecarServer:
         self._listener = sock
         self._running = True
         self._started_at = time.monotonic()
+        if self._mesh_devices is not None or \
+                self._shard_min_lanes is not None:
+            from tmtpu.tpu import mesh_dispatch as _mesh
+
+            _mesh.set_overrides(mesh_devices=self._mesh_devices,
+                                shard_min_lanes=self._shard_min_lanes)
         self.coalescer.start()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="sidecar-accept", daemon=True)
@@ -219,6 +231,9 @@ class SidecarServer:
                                   self._started_at), 3),
             "connections": n_conns,
             "coalescer": self.coalescer.snapshot(),
+            "mesh": __import__(
+                "tmtpu.tpu.mesh_dispatch",
+                fromlist=["snapshot"]).snapshot(),
             "breakers": _bk.snapshot_all(),
             "sigcache": __import__(
                 "tmtpu.crypto.sigcache", fromlist=["stats"]).stats(),

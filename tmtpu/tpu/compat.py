@@ -31,3 +31,23 @@ def force_cpu_backend(n_devices: int = 8) -> None:
     # "tpu" a known lowering platform, and removing it breaks importing
     # jax.experimental.pallas (checkify registers a tpu lowering rule).
     jax.config.update("jax_platforms", "cpu")
+
+    # XLA:CPU compiles of the big curve graphs run 1-2 minutes EACH;
+    # every forced-CPU consumer (the test suite, the driver's multichip
+    # dry-run, bench children, the A/B harnesses) repeats them from
+    # scratch per process. The persistent compilation cache turns every
+    # repeat into a ~15s deserialization. Scoped to this dev/CI path on
+    # purpose — production TPU processes never come through here.
+    # TMTPU_NO_COMPILE_CACHE=1 opts out (e.g. timing fresh compiles).
+    if os.environ.get("TMTPU_NO_COMPILE_CACHE") != "1":
+        cache_dir = os.environ.get("TMTPU_COMPILE_CACHE_DIR") or \
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))), ".jax_cache")
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 2.0)
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", 0)
+        except Exception:  # noqa: BLE001 — older jax without the knobs
+            pass

@@ -167,6 +167,61 @@ def sharded_verify_tally_kernel(mesh: Mesh, *, tile: int | None = None,
     ))
 
 
+def sharded_verify_tally_packed(mesh: Mesh):
+    """Packed-input twin of :func:`sharded_verify_tally_compact` — the
+    production mesh-dispatch entry (tpu/mesh_dispatch.py). ONE [128, B]
+    uint8 plane rides host->device, shards on its lane dimension, and is
+    split shard-locally; the power tally crosses devices as the only
+    collective. B must be a multiple of 32 x n_devices (the packed
+    bitarray output shards one uint32 word per 32 lanes)."""
+    lane = NamedSharding(mesh, P(None, "sig"))
+    flat = NamedSharding(mesh, P("sig"))
+    repl = NamedSharding(mesh, P())
+    return jax.jit(
+        verify_tally_packed_compact,
+        in_shardings=(lane, lane, repl),
+        out_shardings=(flat, repl, flat),
+    )
+
+
+def sharded_verify_tally_packed_kernel(mesh: Mesh, *,
+                                       tile: int | None = None,
+                                       interpret: bool | None = None):
+    """Packed-input twin of :func:`sharded_verify_tally_kernel`: the
+    fused Pallas kernel under shard_map with a single [128, B] transfer.
+    Each shard's lane count must be a multiple of the kernel tile."""
+    try:
+        from jax import shard_map
+
+        rep_kw = {"check_vma": False}
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+        rep_kw = {"check_rep": False}
+
+    from tmtpu.tpu import kernel as tk
+
+    kw = {}
+    if tile is not None:
+        kw["tile"] = tile
+    if interpret is not None:
+        kw["interpret"] = interpret
+
+    def local_step(packed, power_limbs):
+        mask = tk.verify_compact_kernel(*tv.split_packed(packed), **kw)
+        local = jnp.sum(power_limbs * mask[None].astype(jnp.int32), axis=1)
+        power_sums = jax.lax.psum(local, "sig")
+        return mask, power_sums, pack_bitarray(mask)
+
+    return jax.jit(shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(None, "sig"), P(None, "sig")),
+        out_specs=(P("sig"), P(), P("sig")),
+        **rep_kw,
+    ))
+
+
 def sharded_verify_sr(mesh: Mesh):
     """Lane-sharded sr25519 batch verify over ``mesh``: the [128, B]
     packed plane (pk|r|s|k — sr_verify.prepare_sr_batch_packed) shards on

@@ -359,12 +359,16 @@ def _verify_packed_kernel_jit(packed):
 def _pad_to_bucket(n: int) -> int:
     """Round the batch up to a small set of sizes so jit caches stay warm
     (recompiling per odd batch size would dwarf the verify itself).
-    Powers of two up to 4096, then multiples of 2048 (a 10k VoteSet pads to
-    10240 instead of 16384 — padding waste matters more than cache entries
-    at commit-verify scale)."""
+    The floor is 64: every consensus-sized flush (a vote burst, a commit
+    slice) shares ONE compiled shape instead of churning 8/16/32 variants
+    — the pad lanes are microseconds of device time while each extra
+    shape is a fresh multi-second XLA compile. Above that, powers of two
+    up to 4096, then multiples of 2048 (a 10k VoteSet pads to 10240
+    instead of 16384 — padding waste matters more than cache entries at
+    commit-verify scale)."""
     if n > 4096:
         return (n + 2047) // 2048 * 2048
-    b = 8
+    b = 64
     while b < n:
         b *= 2
     return b

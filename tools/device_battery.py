@@ -61,13 +61,17 @@ _active_probe = None
 
 
 def _kill_active_probe(signum=None, frame=None):
-    if _active_probe is not None:
+    # only signal a probe we have NOT reaped: poll() is None guarantees
+    # the child is still ours (a zombie pins its pid), so the process
+    # group id cannot have been recycled to some innocent process
+    if _active_probe is not None and _active_probe.poll() is None:
         try:
             os.killpg(_active_probe.pid, signal.SIGKILL)
         except OSError:
             pass
     from tools import measure_lock
 
+    # probe_done() is pid-guarded: it only unlinks OUR inflight flag
     measure_lock.probe_done()
     if signum is not None:
         sys.exit(128 + signum)

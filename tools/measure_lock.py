@@ -131,6 +131,16 @@ def probe_starting() -> None:
 
 
 def probe_done() -> None:
+    """Unlink the inflight flag — but only if THIS process wrote it
+    (mirrors release(): a concurrent prober's flag, or one inherited
+    from an ancestor, is left for its owner)."""
+    try:
+        with open(INFLIGHT_PATH) as f:
+            holder = json.load(f)
+        if holder.get("pid") != os.getpid():
+            return
+    except (OSError, ValueError):
+        return
     try:
         os.unlink(INFLIGHT_PATH)
     except OSError:
