@@ -94,35 +94,63 @@ def _probe_device_backend() -> bool:
     )
     # Popen + process-group kill rather than subprocess.run: a wedged PJRT
     # plugin can fork helpers that inherit the output pipes, and run()'s
-    # post-timeout communicate() would then block forever on the pipe drain.
+    # post-timeout communicate() would then block forever on the pipe
+    # drain. Probe stderr goes to a TEMP FILE for the same reason — a
+    # pipe would be inherited by those helpers and block, a file can be
+    # read after the kill regardless. The tail rides into _probe_log so
+    # the emitted JSON says WHAT the tunnel printed before it wedged
+    # (BENCH r03-r05 were indistinguishable from plain CPU rounds).
     import signal
+    import tempfile
 
     t0 = time.perf_counter()
-    proc = subprocess.Popen(
-        [sys.executable, "-c", code],
-        stdout=subprocess.DEVNULL,
-        stderr=subprocess.DEVNULL,
-        start_new_session=True,
-    )
-    try:
-        rc = proc.wait(timeout=PROBE_TIMEOUT_S)
-        dt = time.perf_counter() - t0
-        _probe_log.append({"rc": rc, "s": round(dt, 1)})
-        if rc == 0:
-            print(f"bench: device probe ok in {dt:.1f}s", file=sys.stderr)
-            return True
-        print(f"bench: device probe rc={rc} after {dt:.1f}s",
-              file=sys.stderr)
-        return False
-    except subprocess.TimeoutExpired:
+    with tempfile.TemporaryFile(mode="w+", prefix="tmtpu-probe-") as errf:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code],
+            stdout=subprocess.DEVNULL,
+            stderr=errf,
+            start_new_session=True,
+        )
+
+        def _stderr_tail(limit: int = 400) -> str:
+            try:
+                errf.flush()
+                errf.seek(0, os.SEEK_END)
+                size = errf.tell()
+                errf.seek(max(0, size - 4096))
+                return errf.read()[-limit:].strip()
+            except OSError:
+                return ""
+
         try:
-            os.killpg(proc.pid, signal.SIGKILL)
-        except OSError:
-            pass
-        _probe_log.append({"rc": "timeout", "s": PROBE_TIMEOUT_S})
-        print(f"bench: device probe timed out after {PROBE_TIMEOUT_S}s "
-              "(wedged TPU tunnel?)", file=sys.stderr)
-        return False
+            rc = proc.wait(timeout=PROBE_TIMEOUT_S)
+            dt = time.perf_counter() - t0
+            entry = {"rc": rc, "s": round(dt, 1)}
+            if rc not in (0, 3):
+                tail = _stderr_tail()
+                if tail:
+                    entry["stderr_tail"] = tail
+            _probe_log.append(entry)
+            if rc == 0:
+                print(f"bench: device probe ok in {dt:.1f}s",
+                      file=sys.stderr)
+                return True
+            print(f"bench: device probe rc={rc} after {dt:.1f}s",
+                  file=sys.stderr)
+            return False
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            entry = {"rc": "timeout", "s": PROBE_TIMEOUT_S}
+            tail = _stderr_tail()
+            if tail:
+                entry["stderr_tail"] = tail
+            _probe_log.append(entry)
+            print(f"bench: device probe timed out after {PROBE_TIMEOUT_S}s "
+                  "(wedged TPU tunnel?)", file=sys.stderr)
+            return False
 
 
 def _init_backend_probe() -> str:
@@ -258,11 +286,7 @@ def _emit_with_provenance(json_line: str, parent_attempts) -> None:
     and print the single final line."""
     out = _ensure_phases(json.loads(json_line))
     probe = out.setdefault("probe", {})
-    probe["attempts"] = len(_probe_log)
-    probe["log"] = _probe_log[-6:]
-    probe["budget_s"] = PROBE_BUDGET_S
-    if SKIP_PROBE:
-        probe["skipped"] = True
+    probe.update(_probe_dict())
     if parent_attempts:
         probe["parent_fallbacks"] = parent_attempts
     if out.get("backend") != "cpu":
@@ -468,6 +492,24 @@ def _emit_provisional() -> None:
     print(json.dumps(out), flush=True)
 
 
+def _probe_dict() -> dict:
+    """Probe provenance for the emitted JSON. ``wedged=true`` marks a
+    probe that had to be SIGKILLed (hung PJRT tunnel) — the round's
+    numbers are CPU FALLBACK, not a perf regression; the stderr tail
+    says what the tunnel printed before it hung."""
+    probe = {"attempts": len(_probe_log), "log": _probe_log[-6:],
+             "budget_s": PROBE_BUDGET_S}
+    if SKIP_PROBE:
+        probe["skipped"] = True
+    if any(p.get("rc") == "timeout" for p in _probe_log):
+        probe["wedged"] = True
+        tail = next((p["stderr_tail"] for p in reversed(_probe_log)
+                     if p.get("stderr_tail")), "")
+        if tail:
+            probe["stderr_tail"] = tail
+    return probe
+
+
 def _emit_provisional_final(attempts) -> None:
     """Terminal emission when no child produced a result: the provisional
     content again, now carrying the full probe log and the parent's
@@ -475,10 +517,7 @@ def _emit_provisional_final(attempts) -> None:
     worst case — it must always print."""
     out = _ensure_phases(_provisional_out())
     out["failed"] = attempts or ["no-child-result"]
-    out["probe"] = {"attempts": len(_probe_log), "log": _probe_log[-6:],
-                    "budget_s": PROBE_BUDGET_S}
-    if SKIP_PROBE:
-        out["probe"]["skipped"] = True
+    out["probe"] = _probe_dict()
     print(json.dumps(out), flush=True)
 
 
@@ -728,9 +767,7 @@ def _run_flood_parent(t0) -> None:
         # the flood metric for a cached ed25519_e2e headline — a
         # different metric entirely. Provenance rides alongside instead.
         out = _ensure_phases(json.loads(line))
-        out["probe"] = {"attempts": len(_probe_log),
-                        "log": _probe_log[-6:],
-                        "budget_s": PROBE_BUDGET_S}
+        out["probe"] = _probe_dict()
         print(json.dumps(out), flush=True)
     print(f"bench: total wall {time.perf_counter() - t0:.0f}s",
           file=sys.stderr)

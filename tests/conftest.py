@@ -28,6 +28,12 @@ def pytest_configure(config):
         "markers",
         "chaos: fault-injection / crash-recovery tests (libs/faultinject)"
     )
+    config.addinivalue_line(
+        "markers",
+        "scenarios: declarative adversarial scenarios (tmtpu/scenario); "
+        "tier-1 runs the FAST pair, the full library runs via "
+        "tools/scenario_run.py"
+    )
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,78 @@
+"""Tier-1 wiring for the scenario lint (tools/check_scenarios.py): the
+library must stay clean — every oracle resolvable, every inject site
+registered, every metric/timeline reference real — and the lint must
+actually detect the failure modes it claims to (mirrors
+tests/test_check_failpoints.py)."""
+
+from tools import check_scenarios
+
+from tmtpu.scenario import library
+from tmtpu.scenario.spec import FaultAction, OracleSpec
+
+
+def test_tree_is_clean():
+    assert check_scenarios.check() == []
+
+
+def test_catalogs_are_nonempty():
+    assert "wal.write" in check_scenarios.registered_fault_sites()
+    assert "tendermint_consensus_invalid_votes_total" in \
+        check_scenarios.known_metrics()
+    assert "crypto.sidecar" in check_scenarios.known_timeline_events()
+    assert "consensus.enter_prevote" in \
+        check_scenarios.known_timeline_events()
+
+
+def _with_broken_spec(monkeypatch, mutate):
+    spec = library.get("crash_restart_wal")
+    mutate(spec)
+    monkeypatch.setitem(library.SCENARIOS, "broken", lambda: spec)
+    findings = check_scenarios.check()
+    return [f for f in findings if "'broken'" in f]
+
+
+def test_lint_detects_unknown_oracle(monkeypatch):
+    found = _with_broken_spec(
+        monkeypatch,
+        lambda s: s.oracles.append(OracleSpec("no_such_oracle")))
+    assert any("unknown oracle" in f for f in found), found
+
+
+def test_lint_detects_unbindable_oracle_params(monkeypatch):
+    found = _with_broken_spec(
+        monkeypatch,
+        lambda s: s.oracles.append(
+            OracleSpec("height_min", {"mnimum": 3})))
+    assert any("do not bind" in f for f in found), found
+
+
+def test_lint_detects_unregistered_inject_site(monkeypatch):
+    found = _with_broken_spec(
+        monkeypatch,
+        lambda s: s.faults.append(FaultAction(
+            1.0, "inject", node="v00",
+            params={"site": "no.such.site", "mode": "error"})))
+    assert any("unregistered fault site" in f for f in found), found
+
+
+def test_lint_detects_phantom_metric(monkeypatch):
+    found = _with_broken_spec(
+        monkeypatch,
+        lambda s: s.oracles.append(OracleSpec(
+            "metric_min",
+            {"name": "tendermint_nope_total", "min": 1})))
+    assert any("never" in f and "tendermint_nope_total" in f
+               for f in found), found
+
+
+def test_lint_detects_phantom_timeline_event(monkeypatch):
+    found = _with_broken_spec(
+        monkeypatch,
+        lambda s: s.oracles.append(OracleSpec(
+            "timeline_saw", {"event": "no.such_event"})))
+    assert any("no code path records" in f for f in found), found
+
+
+def test_main_exit_code(capsys):
+    assert check_scenarios.main() == 0
+    assert "all resolvable" in capsys.readouterr().out

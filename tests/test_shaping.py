@@ -1,0 +1,197 @@
+"""Unit tests for the per-link WAN emulation (tmtpu/p2p/shaping.py):
+spec parsing, the pipelined delayed-delivery queue, retransmission-style
+drop penalties, and — the load-bearing part — partition semantics.
+Partitioned writes must STALL (TCP backpressure), never report success
+for bytes the peer will not see: swallowed-but-acknowledged writes mark
+gossip as delivered in PeerState and wedge the healed minority forever
+(the split_brain scenario caught exactly that)."""
+
+import threading
+import time
+
+import pytest
+
+from tmtpu.p2p import shaping
+from tmtpu.p2p.shaping import (
+    LinkShaper, LinkSpec, ShapedConnection, parse_links, render_links,
+)
+
+
+class _FakeConn:
+    def __init__(self):
+        self.chunks = []
+        self.stamps = []
+        self.closed = False
+
+    def write(self, data):
+        self.chunks.append(bytes(data))
+        self.stamps.append(time.monotonic())
+        return len(data)
+
+    def read_exact(self, n):
+        return b"x" * n
+
+    def close(self):
+        self.closed = True
+
+
+def _wrapped(links=None, partition=(), seed=7):
+    shaper = LinkShaper(links or {}, seed=seed)
+    shaper.set_partition(partition)
+    conn = _FakeConn()
+    return shaper, conn, ShapedConnection(conn, shaper, "peerA")
+
+
+# --- spec parsing ------------------------------------------------------------
+
+
+def test_parse_render_round_trip():
+    table = parse_links(
+        "*:latency_ms=200,jitter_ms=40,drop=0.05;"
+        "peerB:bw_kbps=512")
+    assert table["*"].latency_ms == 200
+    assert table["*"].drop == 0.05
+    assert table["peerB"].bw_kbps == 512
+    assert parse_links(render_links(table)).keys() == table.keys()
+    assert parse_links("") == {}
+
+
+@pytest.mark.parametrize("bad", [
+    "nocolon", "peer:latency_ms", "peer:latency_ms=abc",
+    ":latency_ms=1", "peer:drop=1.0", "peer:latency_ms=-5",
+    "peer:nonsense=1",
+])
+def test_parse_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        parse_links(bad)
+
+
+def test_spec_for_falls_back_to_star():
+    shaper = LinkShaper({"*": LinkSpec(latency_ms=10),
+                         "peerB": LinkSpec(latency_ms=99)})
+    assert shaper.spec_for("peerB").latency_ms == 99
+    assert shaper.spec_for("anyone-else").latency_ms == 10
+
+
+# --- delivery queue ----------------------------------------------------------
+
+
+def test_unshaped_link_is_passthrough():
+    _, conn, sc = _wrapped()
+    assert sc.write(b"hello") == 5
+    assert conn.chunks == [b"hello"]
+    assert sc._drain_thread is None  # no thread for no-op links
+
+
+def test_latency_defers_but_delivers_in_order():
+    _, conn, sc = _wrapped({"*": LinkSpec(latency_ms=80)})
+    t0 = time.monotonic()
+    for i in range(5):
+        assert sc.write(b"m%d" % i) == 2
+    sent_in = time.monotonic() - t0
+    # write() must NOT sleep the sender: packets ride the pipe in
+    # flight (5 x 80ms serialized would be 400ms+)
+    assert sent_in < 0.25, f"writes blocked {sent_in:.3f}s"
+    deadline = time.monotonic() + 5
+    while len(conn.chunks) < 5 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert conn.chunks == [b"m0", b"m1", b"m2", b"m3", b"m4"]
+    # and the FIRST delivery waited out the latency
+    assert conn.stamps[0] - t0 >= 0.07
+
+
+def test_drop_is_a_retransmit_penalty_not_data_loss():
+    _, conn, sc = _wrapped({"*": LinkSpec(drop=0.999)})
+    t0 = time.monotonic()
+    sc.write(b"precious")
+    deadline = time.monotonic() + 5
+    while not conn.chunks and time.monotonic() < deadline:
+        time.sleep(0.01)
+    # the write was "dropped" yet the bytes still arrive — loss on a
+    # reliable stream is a delay spike (RTO floor 200ms), not vanishing
+    assert conn.chunks == [b"precious"]
+    assert conn.stamps[0] - t0 >= 0.15
+
+
+# --- partition semantics -----------------------------------------------------
+
+
+def test_partitioned_write_stalls_then_delivers_on_heal():
+    shaper, conn, sc = _wrapped(partition=("peerA",))
+    done = threading.Event()
+
+    def _send():
+        sc.write(b"queued-through-the-split")
+        done.set()
+
+    t = threading.Thread(target=_send, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    assert not done.is_set(), "write returned during the partition"
+    assert conn.chunks == [], "bytes leaked through the partition"
+    shaper.set_partition(())  # heal
+    assert done.wait(5), "write never unblocked after heal"
+    deadline = time.monotonic() + 5
+    while not conn.chunks and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert conn.chunks == [b"queued-through-the-split"]
+
+
+def test_close_unblocks_a_partitioned_write():
+    _, _conn, sc = _wrapped(partition=("peerA",))
+    errs = []
+
+    def _send():
+        try:
+            sc.write(b"doomed")
+        except OSError as e:
+            errs.append(e)
+
+    t = threading.Thread(target=_send, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    sc.close()
+    t.join(5)
+    assert not t.is_alive(), "write still stalled after close"
+    assert errs, "closed-during-partition write must raise, not succeed"
+
+
+def test_partition_stall_deadline_raises(monkeypatch):
+    monkeypatch.setattr(shaping, "PARTITION_STALL_MAX_S", 0.2)
+    _, _conn, sc = _wrapped(partition=("peerA",))
+    with pytest.raises(OSError):
+        sc.write(b"never")
+
+
+def test_runtime_repartition_reaches_existing_conns():
+    shaper, conn, sc = _wrapped()
+    sc.write(b"before")
+    shaper.set_partition(("peerA",))
+    t = threading.Thread(target=lambda: sc.write(b"during"), daemon=True)
+    t.start()
+    time.sleep(0.2)
+    assert conn.chunks == [b"before"]
+    shaper.set_partition(())
+    t.join(5)
+    deadline = time.monotonic() + 5
+    while len(conn.chunks) < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert conn.chunks == [b"before", b"during"]
+
+
+# --- backpressure ------------------------------------------------------------
+
+
+def test_full_queue_backpressures_writes():
+    _, conn, sc = _wrapped({"*": LinkSpec(latency_ms=300)})
+    sc.QUEUE_MAX_BYTES = 64
+    payload = b"y" * 64
+    t0 = time.monotonic()
+    sc.write(payload)         # fills the queue
+    sc.write(payload)         # must wait for the drain
+    waited = time.monotonic() - t0
+    assert waited >= 0.2, f"second write should have blocked ({waited:.3f}s)"
+    deadline = time.monotonic() + 5
+    while len(conn.chunks) < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(conn.chunks) == 2

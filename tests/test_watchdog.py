@@ -71,7 +71,7 @@ def test_consensus_progress_check_detects_stall():
         time.sleep(0.12)
         ok, reason, details = check()
         assert not ok
-        assert "no height/round progress" in reason and "7/0/Prevote" in reason
+        assert "no height progress" in reason and "7/0/Prevote" in reason
         assert details["step"] == "Prevote"
         # the verdict names the last timeline event = the stalled step
         assert details["last_timeline_event"]["event"] \
@@ -85,7 +85,10 @@ def test_consensus_progress_resets_on_advance():
     check = wdg.consensus_progress_check(cs, stall_timeout_s=0.1)
     check()
     time.sleep(0.12)
-    cs.rs.round += 1  # a round bump IS progress
+    cs.rs.round += 1  # round churn without commits is NOT progress:
+    ok, reason, _ = check()  # that's how a quorum-less minority looks
+    assert not ok and "no height progress" in reason
+    cs.rs.height += 1  # a commit IS progress
     ok, _, details = check()
     assert ok and details["stalled_for_s"] < 0.1
 
@@ -268,14 +271,14 @@ def test_silent_peers_stall_flips_healthz_and_names_step():
         assert not ok, "watchdog never flagged the stall"
         assert elapsed < 10 * deadline_s, \
             f"detected only after {elapsed:.2f}s (deadline {deadline_s}s)"
-        assert "no height/round progress" in reasons[0]
+        assert "no height progress" in reasons[0]
         assert "7/0/Prevote" in reasons[0]
 
         # /healthz flips to 503 and carries the reason
         status, body = _get(f"{base}/healthz")
         assert status == 503
         assert body["healthy"] is False
-        assert any("no height/round progress" in r
+        assert any("no height progress" in r
                    for r in body["reasons"])
 
         # the timeline RPC names the stalled step
@@ -372,7 +375,7 @@ def test_real_consensus_stall_detected():
             time.sleep(0.05)
         ok, reasons = wd.healthy()
         assert not ok, "real stall never detected"
-        assert "no height/round progress" in reasons[0]
+        assert "no height progress" in reasons[0]
         last = timeline.last_event()
         assert last is not None and last["height"] == 1
         assert last["event"] in timeline.CONSENSUS_STEP_EVENTS

@@ -262,12 +262,22 @@ def cmd_sidecar(args) -> int:
               f"(backend={server.backend_name()})")
     print(f"Sidecar listening on {server.addr} "
           f"backend={server.backend_name()} id={server.server_id}")
-    stop = []
+    # SIGINT stops immediately (operator ^C); SIGTERM drains first —
+    # stop accepting, answer OVERLOADED (clients fall back in-process
+    # penalty-free), finish in-flight joint dispatches, exit 0
+    stop, term = [], []
     signal.signal(signal.SIGINT, lambda *a: stop.append(1))
-    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: term.append(1))
     try:
-        while not stop:
+        while not stop and not term:
             time.sleep(0.2)
+        if term and not stop:
+            print("SIGTERM: draining sidecar "
+                  "(new requests get OVERLOADED)...", flush=True)
+            clean = server.drain(
+                timeout=cfg.sidecar.request_deadline_ns / 1e9 + 5.0)
+            print("Drain complete" if clean
+                  else "Drain timed out; stopping anyway")
     finally:
         print("Stopping sidecar...")
         server.stop()

@@ -90,6 +90,21 @@ class P2PConfig:
     allow_duplicate_ip: bool = False
     handshake_timeout_ns: int = 20_000 * MS
     dial_timeout_ns: int = 3000 * MS
+    # --- connection fuzzing (p2p/fuzz.py; config.go FuzzConnConfig) ---
+    # Test/scenario-only: wraps every peer connection in FuzzedConnection.
+    test_fuzz: bool = False
+    test_fuzz_mode: str = "drop"  # drop | delay | partition
+    test_fuzz_max_delay_ms: int = 3000
+    test_fuzz_prob_drop_rw: float = 0.2
+    test_fuzz_prob_drop_conn: float = 0.0
+    test_fuzz_prob_sleep: float = 0.0
+    test_fuzz_seed: int = 0
+    # comma-separated peer ids hard-dropped by MODE_PARTITION
+    test_fuzz_partition_ids: str = ""
+    # --- WAN link shaping (p2p/shaping.py) ---
+    # "peer_or_*:latency_ms=200,jitter_ms=20,bw_kbps=1024,drop=0.05;..."
+    shape_links: str = ""
+    shape_seed: int = 0
 
 
 @dataclass
@@ -287,6 +302,10 @@ class BaseConfig:
     # maverick-style byzantine schedule "name@height,..." (test nets only;
     # tmtpu/consensus/misbehavior.py)
     misbehaviors: str = ""
+    # built-in kvstore app: take a statesync snapshot every N heights
+    # (0 = never). Scenario nets use this so a joiner has a snapshot to
+    # restore; the reference's e2e app has the same knob.
+    app_snapshot_interval: int = 0
 
 
 @dataclass

@@ -139,6 +139,26 @@ def validate(cfg: Config) -> None:
     if cfg.p2p.max_num_inbound_peers < 0 or \
             cfg.p2p.max_num_outbound_peers < 0:
         raise ValueError("p2p peer limits cannot be negative")
+    if cfg.p2p.test_fuzz_mode not in ("drop", "delay", "partition"):
+        raise ValueError(
+            f"p2p.test_fuzz_mode must be drop/delay/partition, got "
+            f"{cfg.p2p.test_fuzz_mode!r}")
+    for name, p in (("test_fuzz_prob_drop_rw",
+                     cfg.p2p.test_fuzz_prob_drop_rw),
+                    ("test_fuzz_prob_drop_conn",
+                     cfg.p2p.test_fuzz_prob_drop_conn),
+                    ("test_fuzz_prob_sleep", cfg.p2p.test_fuzz_prob_sleep)):
+        if not 0.0 <= p <= 1.0:
+            raise ValueError(f"p2p.{name} must be in [0, 1]")
+    if cfg.p2p.test_fuzz_max_delay_ms < 0:
+        raise ValueError("p2p.test_fuzz_max_delay_ms cannot be negative")
+    if cfg.p2p.shape_links:
+        from tmtpu.p2p.shaping import parse_links
+
+        try:
+            parse_links(cfg.p2p.shape_links)
+        except ValueError as exc:
+            raise ValueError(f"p2p.shape_links: {exc}") from exc
     if cfg.state_sync.enable:
         if not cfg.state_sync.rpc_servers:
             raise ValueError("state_sync requires rpc_servers")

@@ -14,6 +14,14 @@ A misbehavior schedule is ``{height: name}``; supported names:
     The node stays silent in prevote at the scheduled height (liveness
     fault: forces the round to time out and move on).
 
+``garbage-sig``
+    Alongside its honest prevote the node gossips a burst of votes
+    carrying random 64-byte signatures — spam aimed straight at the
+    batch-verify admission path (sigcache, sidecar, TPU dispatch).
+    Honest nodes must reject every one without the block rate
+    collapsing; no evidence results (an invalid signature proves
+    nothing about who sent it).
+
 The conflicting signature is produced by signing with the raw key,
 bypassing the privval double-sign protection — exactly the maverick
 setup: the *protection* is the honest node's; a byzantine node by
@@ -24,7 +32,11 @@ Schedule syntax (CLI ``--misbehaviors``): ``name@height[,name@height...]``
 
 from __future__ import annotations
 
-SUPPORTED = ("double-prevote", "absent-prevote")
+SUPPORTED = ("double-prevote", "absent-prevote", "garbage-sig")
+
+# votes gossiped per garbage-sig burst — enough to exercise batch
+# admission every round of the height without drowning a localnet
+GARBAGE_SIG_BURST = 16
 
 
 def parse_schedule(spec: str) -> dict[int, str]:

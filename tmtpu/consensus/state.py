@@ -15,6 +15,7 @@ backend) instead of per-vote serial verifies (:1947 tryAddVote).
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
@@ -1061,6 +1062,24 @@ class ConsensusState(BaseService):
                                 self.state.chain_id, evil)
             if self.on_own_vote is not None:
                 self.on_own_vote(evil)
+        if (misbehavior == "garbage-sig" and vote_type == PREVOTE
+                and self.on_own_vote is not None):
+            # invalid-signature spam: a burst of otherwise-plausible
+            # votes whose 64-byte signatures are random noise, aimed at
+            # honest nodes' batch-verify admission (sigcache/sidecar).
+            # Distinct timestamps keep the lanes distinct through dedup.
+            # No evidence can come of these — rejection is the test.
+            from tmtpu.consensus.misbehavior import GARBAGE_SIG_BURST
+
+            for i in range(GARBAGE_SIG_BURST):
+                junk = Vote(
+                    type=vote_type, height=rs.height, round=rs.round,
+                    block_id=block_id, timestamp=vote.timestamp + 1 + i,
+                    validator_address=vote.validator_address,
+                    validator_index=idx,
+                    signature=os.urandom(64),
+                )
+                self.on_own_vote(junk)
 
     def _vote_time(self) -> int:
         """state.go voteTime: monotonic over last block time."""

@@ -42,6 +42,8 @@ class NodeSpec:
     # extra "section.key" -> value config overrides for this node
     config: dict = field(default_factory=dict)
     misbehaviors: dict = field(default_factory=dict)  # height -> name
+    # extra environment for the node subprocess (e.g. TMTPU_SIDECAR_ADDR)
+    env: dict = field(default_factory=dict)
 
 
 @dataclass

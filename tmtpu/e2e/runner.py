@@ -71,12 +71,16 @@ class _Node:
         self._release_ports()
         env = dict(os.environ)
         env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
-        # nodes run CPU crypto: no jax import in-subprocess, keeps spawn fast
-        env.setdefault("TMTPU_CRYPTO_BACKEND", "cpu")
+        # default: nodes run CPU crypto — no jax import in-subprocess,
+        # keeps spawn fast. A spec may override (sidecar scenarios point
+        # nodes at a shared verification daemon).
+        backend = str(self.spec.config.get("base.crypto_backend", "cpu"))
+        env.setdefault("TMTPU_CRYPTO_BACKEND", backend)
+        env.update({k: str(v) for k, v in self.spec.env.items()})
         log = open(os.path.join(self.home, "node.log"), "ab")
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "tmtpu.cmd", "start",
-             "--home", self.home, "--crypto-backend", "cpu"],
+             "--home", self.home, "--crypto-backend", backend],
             stdout=log, stderr=subprocess.STDOUT, env=env,
             start_new_session=True,
         )
@@ -169,6 +173,10 @@ class Runner:
         # e2e profile: fast rounds so tests finish in seconds
         test = Config.test_config()
         cfg.consensus = test.consensus
+        if node.spec.misbehaviors:
+            cfg.base.misbehaviors = ",".join(
+                f"{name}@{h}" for h, name in
+                sorted(node.spec.misbehaviors.items()))
         for key, value in node.spec.config.items():
             section, _, name = key.partition(".")
             setattr(getattr(cfg, section), name, value)

@@ -234,6 +234,10 @@ consensus_total_txs = DEFAULT.counter("consensus", "total_txs",
                                       "Total txs committed")
 consensus_block_size = DEFAULT.gauge("consensus", "block_size_bytes",
                                      "Size of the latest block")
+consensus_invalid_votes = DEFAULT.counter(
+    "consensus", "invalid_votes_total",
+    "Gossiped votes rejected at signature verification — the admission "
+    "filter doing its job under byzantine garbage-signature spam")
 # Per-step latency breakdown (consensus/metrics.go StepDurationSeconds
 # in later reference releases: ONE histogram with a step label): time
 # spent in each round step, observed on every step transition by
@@ -277,6 +281,22 @@ def observe_step_duration(step: int, seconds: float) -> None:
 
 
 p2p_peers = DEFAULT.gauge("p2p", "peers", "Number of connected peers")
+
+# p2p/shaping.py + p2p/fuzz.py link emulation: writes perturbed by the
+# shaper — kind=loss counts writes swallowed by sampled WAN loss,
+# kind=partition counts writes stalled by a partition (TCP-backpressure
+# emulation; the write blocks, it is never silently dropped). Plus the
+# artificial latency injected per shaped write. A production scrape
+# showing nonzero values means someone left [p2p] shaping on a real node.
+p2p_shape_drops = DEFAULT.counter(
+    "p2p", "shape_drops_total",
+    "Peer-connection writes dropped (loss) or stalled (partition) by "
+    "link shaping",
+    labels=("kind",))
+p2p_shape_delay = DEFAULT.histogram(
+    "p2p", "shape_delay_seconds",
+    "Artificial latency injected per shaped peer-connection write",
+    buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.2, 0.5, 1, 2))
 mempool_size = DEFAULT.gauge("mempool", "size",
                              "Number of uncommitted txs")
 

@@ -161,20 +161,23 @@ class Watchdog:
 def consensus_progress_check(cs, stall_timeout_s: float,
                              is_syncing: Optional[Callable[[], bool]] = None
                              ) -> CheckFn:
-    """Unhealthy when height/round has not advanced for
-    ``stall_timeout_s`` (and the node is not block/state syncing). The
-    verdict names the stuck height/round/step and the timeline's last
-    recorded event — the step that stalled."""
+    """Unhealthy when HEIGHT has not advanced for ``stall_timeout_s``
+    (and the node is not block/state syncing). Round/step churn does
+    not reset the timer: a validator cut off from quorum keeps timing
+    out into ever-higher rounds forever, and rounds without commits are
+    the signature of a stalled consensus, not progress (a partitioned
+    minority would otherwise report healthy indefinitely). The verdict
+    names the stuck height/round/step and the timeline's last recorded
+    event — the step that stalled."""
     from tmtpu.libs import timeline
 
-    last = {"hrs": None, "t": time.monotonic()}
+    last = {"height": None, "t": time.monotonic()}
 
     def check() -> Tuple[bool, str, Dict]:
         rs = cs.round_state_nolock()
-        hrs = (rs.height, rs.round, rs.step)
         now = time.monotonic()
-        if hrs != last["hrs"]:
-            last["hrs"], last["t"] = hrs, now
+        if rs.height != last["height"]:
+            last["height"], last["t"] = rs.height, now
         if is_syncing is not None and is_syncing():
             last["t"] = now  # progress is the syncer's job right now
             return True, "", {"syncing": True}
@@ -184,7 +187,7 @@ def consensus_progress_check(cs, stall_timeout_s: float,
                    "last_timeline_event": timeline.last_event()}
         if age > stall_timeout_s:
             return (False,
-                    f"no height/round progress for {age:.1f}s at "
+                    f"no height progress for {age:.1f}s at "
                     f"{rs.height_round_step()}", details)
         return True, "", details
 

@@ -79,7 +79,9 @@ class Node(BaseService):
         # --- proxy app (node.go createAndStartProxyAppConns) ---
         if app is None:
             if config.base.proxy_app == "kvstore":
-                app = KVStoreApplication(_make_db(config, "app"))
+                app = KVStoreApplication(
+                    _make_db(config, "app"),
+                    snapshot_interval=config.base.app_snapshot_interval)
             elif config.base.proxy_app == "noop":
                 from tmtpu.abci.types import Application
 
@@ -204,8 +206,11 @@ class Node(BaseService):
         self.node_key = None
         self.switch = None
         self.node_id = ""
+        self.consensus_reactor = None
         self.fast_sync = False
         self.state_sync = False
+        self.link_shaper = None
+        self.fuzz_config = None
         if config.p2p.laddr:
             from tmtpu.consensus.reactor import ConsensusReactor
             from tmtpu.mempool.reactor import MempoolReactor
@@ -233,6 +238,7 @@ class Node(BaseService):
                 dial_timeout=config.p2p.dial_timeout_ns / 1e9,
                 handshake_timeout=config.p2p.handshake_timeout_ns / 1e9,
             )
+            transport.conn_wrapper = self._build_conn_wrapper(config)
             transport.listen(config.p2p.laddr)
             self.transport = transport
             # advertise the RESOLVED port (ephemeral ":0" binds would
@@ -386,7 +392,7 @@ class Node(BaseService):
             slow_span_threshold_s=hc.slow_span_threshold_ns / 1e9)
         wd.register("consensus", wdg.consensus_progress_check(
             self.consensus, hc.consensus_stall_timeout_ns / 1e9,
-            is_syncing=lambda: self.fast_sync or self.state_sync))
+            is_syncing=self._is_syncing))
         if self.switch is not None and hc.min_peers > 0:
             wd.register("p2p", wdg.peer_count_check(
                 self.switch.num_peers, hc.min_peers))
@@ -394,7 +400,8 @@ class Node(BaseService):
             wd.register("mempool", wdg.mempool_drain_check(
                 self.mempool, hc.mempool_stall_timeout_ns / 1e9))
         wd.register("sync", wdg.sync_status_check(
-            lambda: self.fast_sync, lambda: self.state_sync))
+            lambda: self._is_syncing() and not self.state_sync,
+            lambda: self.state_sync))
         if self.config.base.crypto_backend != "cpu":
             wd.register("crypto", wdg.tpu_backend_check(
                 hc.fallback_storm_window_ns / 1e9,
@@ -407,12 +414,26 @@ class Node(BaseService):
                 hc.fallback_storm_threshold))
         return wd
 
+    def _is_syncing(self) -> bool:
+        """Live sync verdict. ``self.fast_sync``/``self.state_sync``
+        record the LAUNCH decision and ``fast_sync`` is never cleared;
+        the consensus reactor's ``wait_sync`` is the flag the handover
+        actually flips (blocksync/statesync -> consensus, mirroring
+        node.go's ConsensusReactor.WaitSync()). Reading the stale launch
+        flag kept every multi-validator node "syncing" for its whole
+        life, which permanently disarmed the consensus stall watchdog
+        and /readyz."""
+        if self.consensus_reactor is not None:
+            return bool(self.state_sync
+                        or self.consensus_reactor.wait_sync)
+        return self.fast_sync or self.state_sync
+
     def _readiness(self):
         """/readyz verdict: live AND caught up. A syncing node is
         healthy (the watchdog gives sync a pass) but must not take
         traffic yet."""
         ok, reasons = self.watchdog.healthy()
-        syncing = self.fast_sync or self.state_sync
+        syncing = self._is_syncing()
         ready = ok and not syncing
         return ready, {"ready": ready, "syncing": syncing,
                        "reasons": reasons}
@@ -477,6 +498,50 @@ class Node(BaseService):
         # blocksync fetches the tail and hands consensus the final state
         # via ConsensusReactor.switch_to_consensus
         self.blocksync_reactor.switch_to_fast_sync(state)
+
+    def _build_conn_wrapper(self, config):
+        """Compose the transport's conn_wrapper from [p2p] fuzz/shaping
+        config. The LinkShaper is ALWAYS built when rpc.unsafe is on —
+        even with an empty link table — so ``unsafe_net_shape`` can
+        shape/partition a running node whose config started clean."""
+        from tmtpu.p2p.shaping import LinkShaper, parse_links
+
+        shaper = None
+        if config.p2p.shape_links or config.rpc.unsafe:
+            shaper = LinkShaper(parse_links(config.p2p.shape_links),
+                                seed=config.p2p.shape_seed)
+        self.link_shaper = shaper
+        fuzz_cfg = None
+        if config.p2p.test_fuzz:
+            from tmtpu.p2p.fuzz import FuzzConnConfig
+
+            fuzz_cfg = FuzzConnConfig(
+                mode=config.p2p.test_fuzz_mode,
+                max_delay_s=config.p2p.test_fuzz_max_delay_ms / 1000.0,
+                prob_drop_rw=config.p2p.test_fuzz_prob_drop_rw,
+                prob_drop_conn=config.p2p.test_fuzz_prob_drop_conn,
+                prob_sleep=config.p2p.test_fuzz_prob_sleep,
+                seed=config.p2p.test_fuzz_seed or None,
+                partition_ids=[
+                    p.strip() for p in
+                    config.p2p.test_fuzz_partition_ids.split(",")
+                    if p.strip()])
+        self.fuzz_config = fuzz_cfg
+        if shaper is None and fuzz_cfg is None:
+            return None
+
+        def wrap(conn, peer_id):
+            # fuzz innermost so shaping (partition/latency) applies to
+            # the stream the fuzzer lets through
+            if fuzz_cfg is not None:
+                from tmtpu.p2p.fuzz import FuzzedConnection
+
+                conn = FuzzedConnection(conn, fuzz_cfg, peer_id=peer_id)
+            if shaper is not None:
+                conn = shaper.wrap(conn, peer_id)
+            return conn
+
+        return wrap
 
     def _only_validator_is_us(self) -> bool:
         """node.go onlyValidatorIsUs — a single-validator chain where we ARE

@@ -1,49 +1,94 @@
 """FuzzedConnection (reference: p2p/fuzz.go) — wraps a connection-like
 object and probabilistically delays or drops reads/writes, driven by
 FuzzConnConfig (config/config.go:663). Used by network fault-injection
-tests to shake out ordering and partial-delivery assumptions."""
+tests — and, via the ``[p2p] fuzz_*`` config section + the transport's
+``conn_wrapper`` hook, by scenario localnets — to shake out ordering
+and partial-delivery assumptions."""
 
 from __future__ import annotations
 
 import random
 import time
-from typing import Optional
+from typing import Iterable, Optional
 
 
 class FuzzConnConfig:
-    """config/config.go FuzzConnConfig defaults."""
+    """config/config.go FuzzConnConfig defaults, plus MODE_PARTITION:
+    a stall-by-peer-id-set mode for scripted network splits. The
+    ``partition_ids`` set is read live on every operation, so mutating
+    it (scenario engine over ``unsafe_net_shape``) re-partitions every
+    existing connection without reconnects."""
 
     MODE_DROP = "drop"
     MODE_DELAY = "delay"
+    MODE_PARTITION = "partition"
 
     def __init__(self, mode: str = MODE_DROP,
                  max_delay_s: float = 3.0,
                  prob_drop_rw: float = 0.2,
                  prob_drop_conn: float = 0.0,
                  prob_sleep: float = 0.0,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None,
+                 partition_ids: Optional[Iterable[str]] = None):
         self.mode = mode
         self.max_delay_s = max_delay_s
         self.prob_drop_rw = prob_drop_rw
         self.prob_drop_conn = prob_drop_conn
         self.prob_sleep = prob_sleep
         self.rng = random.Random(seed)
+        self.partition_ids = set(partition_ids or ())
+
+    def set_partition(self, ids: Iterable[str]) -> None:
+        """Replace the partitioned peer set (empty iterable = heal)."""
+        self.partition_ids = set(ids)
 
 
 class FuzzedConnection:
     """Duck-types the SecretConnection surface (write / read_exact /
-    close) the MConnection drives."""
+    close) the MConnection drives. ``peer_id`` identifies the remote for
+    MODE_PARTITION; connections wrapped without one never partition."""
 
-    def __init__(self, conn, config: Optional[FuzzConnConfig] = None):
+    def __init__(self, conn, config: Optional[FuzzConnConfig] = None,
+                 peer_id: str = ""):
         self.conn = conn
         self.config = config or FuzzConnConfig()
+        self.peer_id = peer_id
         self._dead = False
+        self._closed = False
+
+    def _partitioned(self) -> bool:
+        cfg = self.config
+        return (cfg.mode == FuzzConnConfig.MODE_PARTITION
+                and bool(self.peer_id)
+                and self.peer_id in cfg.partition_ids)
 
     def _fuzz(self) -> bool:
         """Returns True if the operation should be swallowed."""
         cfg = self.config
         if self._dead:
             raise ConnectionError("fuzz: connection dropped")
+        if cfg.mode == FuzzConnConfig.MODE_PARTITION:
+            # stall, never swallow: returning success for a write the
+            # peer will never see marks gossip as delivered in PeerState
+            # and wedges catch-up after the heal (see p2p/shaping.py) —
+            # real TCP backpressures, so the write blocks until heal,
+            # close, or the stall deadline kills the conn
+            if self._partitioned():
+                from tmtpu.p2p import shaping as _shaping
+                from tmtpu.libs import metrics as _m
+
+                _m.p2p_shape_drops.inc(kind="partition")
+                deadline = (time.monotonic()
+                            + _shaping.PARTITION_STALL_MAX_S)
+                while self._partitioned():
+                    if self._closed or self._dead:
+                        raise ConnectionError(
+                            "fuzz: closed during partition")
+                    if time.monotonic() > deadline:
+                        raise ConnectionError(
+                            "fuzz: partitioned write stalled out")
+                    time.sleep(0.05)
+            return False
         if cfg.mode == FuzzConnConfig.MODE_DELAY:
             if cfg.rng.random() < cfg.prob_sleep:
                 time.sleep(cfg.rng.random() * cfg.max_delay_s)
@@ -73,6 +118,7 @@ class FuzzedConnection:
         return self.conn.read_exact(n)
 
     def close(self) -> None:
+        self._closed = True  # unblocks a write stalled in a partition
         try:
             self.conn.close()
         except OSError:

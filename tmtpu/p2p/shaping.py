@@ -1,0 +1,379 @@
+"""Per-link WAN emulation for localnet peer connections.
+
+Real deployments put validators behind oceans: 100-300 ms of latency,
+jitter, constrained bandwidth, and a few percent loss. The localnet
+benches so far ran on loopback, which hides every timeout/gossip
+interaction the consensus timeouts exist for. This module shapes each
+DIRECTED peer link at the transport layer — the shim wraps the
+SecretConnection right after the handshake identifies the peer, so
+everything above (MConnection, reactors) is untouched.
+
+Three pieces:
+
+- :class:`LinkSpec` — the per-link shape: latency_ms, jitter_ms,
+  bw_kbps, drop probability. Parsed from the ``[p2p] shape_links``
+  string (``"<peer_id_or_*>:latency_ms=200,jitter_ms=20,bw_kbps=1024,
+  drop=0.05;..."``).
+- :class:`LinkShaper` — per-node policy table (peer id -> LinkSpec,
+  ``*`` default) plus a mutable partition set. ``wrap(conn, peer_id)``
+  is installed as the transport's ``conn_wrapper``. Policies and the
+  partition set are read live by every wrapped connection, so the
+  scenario engine re-shapes a running node over ``unsafe_net_shape``
+  without reconnects.
+- :class:`ShapedConnection` — the conn wrapper. Egress-side only: each
+  node shapes what IT sends, so a directed link A->B is configured on A.
+  Partition = stalled writes (TCP-backpressure emulation, see below);
+  drop = seeded per-write retransmission penalty; latency+jitter =
+  deferred delivery through a per-connection drain thread (packets stay
+  pipelined in flight, as on a real WAN — sleeping in the sender thread
+  would cap the link at one packet per RTT, which is a satellite modem,
+  not a WAN); bandwidth = token bucket feeding the same queue.
+
+Partition semantics matter: a real network split does NOT silently eat
+bytes on a live TCP stream — the kernel retransmits, the sender's
+window fills, writes BLOCK, and everything queued delivers after the
+heal (or the connection dies trying). Swallowing writes while
+returning success is a behavior no real network exhibits, and it
+poisons gossip: reactors mark messages as delivered in PeerState and
+never resend, so a short partition leaves a peer wedged forever
+(observed: a validator split at height 1 never caught up — the
+majority believed it already had block 1's parts). So partitioned
+writes STALL until heal, close, or ``PARTITION_STALL_MAX_S`` — the
+MConnection send queues back up, try_send starts failing honestly,
+and the catch-up state stays truthful.
+
+The same reasoning shapes ``drop``: the emulation rides a reliable
+localhost TCP stream, where a lost segment surfaces to the application
+as a retransmission delay spike, never as missing bytes. So a sampled
+"drop" charges an RTO-style penalty (~3x the one-way latency, floored
+at 200 ms) and then delivers the write anyway.
+
+Shaping is deterministic per (seed, peer_id): every link derives its
+RNG from the node seed and the peer id, so two runs with the same seed
+drop the same writes.
+"""
+
+from __future__ import annotations
+
+import collections
+import random
+import threading
+import time
+import zlib
+from typing import Dict, Iterable, Optional
+
+from tmtpu.libs import metrics as _m
+
+# How long a partitioned write stalls before the connection is declared
+# dead (OSError). Mirrors real TCP: retransmission backoff holds a
+# one-sided conversation alive for a while, then the connection drops
+# and the switch's redial loop takes over. Kept just above the
+# MConnection PONG_TIMEOUT so ping liveness usually kills the conn
+# first, the way it would on hardware.
+PARTITION_STALL_MAX_S = 60.0
+_PARTITION_POLL_S = 0.05
+
+
+class LinkSpec:
+    """Shape of one directed link. All fields optional; zero = off."""
+
+    __slots__ = ("latency_ms", "jitter_ms", "bw_kbps", "drop")
+
+    def __init__(self, latency_ms: float = 0.0, jitter_ms: float = 0.0,
+                 bw_kbps: float = 0.0, drop: float = 0.0):
+        self.latency_ms = float(latency_ms)
+        self.jitter_ms = float(jitter_ms)
+        self.bw_kbps = float(bw_kbps)
+        self.drop = float(drop)
+
+    def validate(self) -> None:
+        if self.latency_ms < 0 or self.jitter_ms < 0 or self.bw_kbps < 0:
+            raise ValueError("link shape values must be >= 0")
+        if not 0.0 <= self.drop < 1.0:
+            raise ValueError(f"drop must be in [0, 1), got {self.drop}")
+
+    def is_noop(self) -> bool:
+        return (self.latency_ms == 0 and self.jitter_ms == 0
+                and self.bw_kbps == 0 and self.drop == 0)
+
+    def to_dict(self) -> Dict[str, float]:
+        return {"latency_ms": self.latency_ms, "jitter_ms": self.jitter_ms,
+                "bw_kbps": self.bw_kbps, "drop": self.drop}
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "LinkSpec":
+        unknown = set(d) - {"latency_ms", "jitter_ms", "bw_kbps", "drop"}
+        if unknown:
+            raise ValueError(f"unknown link shape keys: {sorted(unknown)}")
+        spec = cls(**{k: float(v) for k, v in d.items()})
+        spec.validate()
+        return spec
+
+    def __repr__(self) -> str:
+        return (f"LinkSpec(latency_ms={self.latency_ms}, "
+                f"jitter_ms={self.jitter_ms}, bw_kbps={self.bw_kbps}, "
+                f"drop={self.drop})")
+
+
+def parse_links(spec: str) -> Dict[str, LinkSpec]:
+    """``"peer_or_*:k=v,k=v;peer2:k=v"`` -> {peer_id: LinkSpec}.
+
+    The empty string parses to an empty table. Raises ValueError on any
+    malformed entry — config validation fails loudly, never silently
+    un-shapes a link."""
+    table: Dict[str, LinkSpec] = {}
+    for entry in filter(None, (e.strip() for e in spec.split(";"))):
+        if ":" not in entry:
+            raise ValueError(
+                f"link shape entry {entry!r}: expected 'peer:k=v,...'")
+        peer, _, kvs = entry.partition(":")
+        peer = peer.strip()
+        if not peer:
+            raise ValueError(f"link shape entry {entry!r}: empty peer id")
+        params: Dict[str, float] = {}
+        for kv in filter(None, (p.strip() for p in kvs.split(","))):
+            if "=" not in kv:
+                raise ValueError(
+                    f"link shape entry {entry!r}: bad param {kv!r}")
+            k, _, v = kv.partition("=")
+            try:
+                params[k.strip()] = float(v)
+            except ValueError:
+                raise ValueError(
+                    f"link shape entry {entry!r}: non-numeric {kv!r}"
+                ) from None
+        table[peer] = LinkSpec.from_dict(params)
+    return table
+
+
+def render_links(table: Dict[str, LinkSpec]) -> str:
+    """Inverse of :func:`parse_links` (config round-trip, RPC echo)."""
+    parts = []
+    for peer in sorted(table):
+        s = table[peer]
+        kvs = ",".join(f"{k}={v:g}" for k, v in s.to_dict().items() if v)
+        parts.append(f"{peer}:{kvs}" if kvs else f"{peer}:drop=0")
+    return ";".join(parts)
+
+
+class LinkShaper:
+    """Per-node shaping policy: link table + partition set, applied to
+    every peer connection via the transport ``conn_wrapper`` hook."""
+
+    def __init__(self, links: Optional[Dict[str, LinkSpec]] = None,
+                 seed: int = 0):
+        self._lock = threading.Lock()
+        self._links: Dict[str, LinkSpec] = dict(links or {})
+        self._partition: set = set()
+        self._seed = int(seed)
+
+    # --- policy reads (called per write from ShapedConnection) ---
+
+    def spec_for(self, peer_id: str) -> Optional[LinkSpec]:
+        with self._lock:
+            return self._links.get(peer_id) or self._links.get("*")
+
+    def is_partitioned(self, peer_id: str) -> bool:
+        with self._lock:
+            return peer_id in self._partition
+
+    # --- runtime mutation (scenario engine over unsafe_net_shape) ---
+
+    def set_links(self, links: Dict[str, LinkSpec]) -> None:
+        with self._lock:
+            self._links = dict(links)
+
+    def update_links(self, links: Dict[str, LinkSpec]) -> None:
+        with self._lock:
+            self._links.update(links)
+
+    def set_partition(self, ids: Iterable[str]) -> None:
+        """Replace the partitioned peer set (empty iterable = heal)."""
+        with self._lock:
+            self._partition = set(ids)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._links.clear()
+            self._partition.clear()
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {"links": {p: s.to_dict()
+                              for p, s in sorted(self._links.items())},
+                    "partition": sorted(self._partition),
+                    "seed": self._seed}
+
+    # --- the transport hook ---
+
+    def wrap(self, conn, peer_id: str):
+        """``Transport.conn_wrapper`` signature. Always wraps (even with
+        an empty table) so runtime re-shaping reaches connections that
+        were established before the first ``unsafe_net_shape`` call."""
+        return ShapedConnection(conn, self, peer_id)
+
+
+class ShapedConnection:
+    """Egress-shaping conn wrapper duck-typing the SecretConnection
+    surface (write / read_exact / close) the MConnection drives.
+
+    Delayed writes go through a per-connection FIFO drain thread:
+    ``write`` computes the packet's delivery time, enqueues, and
+    returns immediately, so many packets ride the emulated pipe
+    concurrently (real latency is propagation delay, not a throughput
+    cap). The drain thread delivers strictly in order — a reliable
+    stream never reorders — and a bounded queue gives the sender
+    honest backpressure when the pipe backs up."""
+
+    # bounded in-flight buffer: kernel socket buffer + pipe BDP stand-in
+    QUEUE_MAX_BYTES = 256 * 1024
+
+    def __init__(self, conn, shaper: LinkShaper, peer_id: str):
+        self.conn = conn
+        self.shaper = shaper
+        self.peer_id = peer_id
+        # deterministic per (node seed, peer id): reruns with the same
+        # seed drop the same writes on the same links
+        self._rng = random.Random(
+            shaper._seed ^ zlib.crc32(peer_id.encode()))
+        # token bucket for bandwidth; lazily (re)filled against the
+        # live bw_kbps so runtime re-shaping takes effect mid-stream
+        self._bucket_bytes = 0.0
+        self._bucket_at = time.monotonic()
+        self._closed = False
+        # delayed-delivery queue; the drain thread starts on the first
+        # shaped write so unshaped links never pay for a thread
+        self._q: collections.deque = collections.deque()
+        self._q_cv = threading.Condition()
+        self._q_bytes = 0
+        self._drain_err: Optional[Exception] = None
+        self._drain_thread: Optional[threading.Thread] = None
+
+    def _throttle(self, spec: LinkSpec, n: int) -> float:
+        """Seconds until n bytes fit the bw_kbps token bucket."""
+        rate = spec.bw_kbps * 1024.0  # bytes/s
+        now = time.monotonic()
+        self._bucket_bytes = min(
+            rate * 0.25,  # burst: at most 250ms of pipe
+            self._bucket_bytes + (now - self._bucket_at) * rate)
+        self._bucket_at = now
+        self._bucket_bytes -= n
+        if self._bucket_bytes >= 0:
+            return 0.0
+        return -self._bucket_bytes / rate
+
+    def _stall_while_partitioned(self) -> None:
+        if not self.shaper.is_partitioned(self.peer_id):
+            return
+        _m.p2p_shape_drops.inc(kind="partition")
+        deadline = time.monotonic() + PARTITION_STALL_MAX_S
+        while self.shaper.is_partitioned(self.peer_id):
+            if self._closed:
+                raise OSError("connection closed during partition")
+            if time.monotonic() > deadline:
+                raise OSError("link partitioned: write stalled out")
+            time.sleep(_PARTITION_POLL_S)
+
+    # -- the drain thread ----------------------------------------------------
+
+    def _ensure_drain(self) -> None:
+        if self._drain_thread is None:
+            t = threading.Thread(target=self._drain, daemon=True,
+                                 name=f"link-drain-{self.peer_id[:8]}")
+            self._drain_thread = t
+            t.start()
+
+    def _drain(self) -> None:
+        while True:
+            with self._q_cv:
+                while not self._q and not self._closed:
+                    self._q_cv.wait(0.5)
+                if self._closed:
+                    return
+                deliver_at, data = self._q[0]
+            wait = deliver_at - time.monotonic()
+            if wait > 0:
+                time.sleep(wait)
+            try:
+                # a partition that lands while packets are in flight
+                # holds them too (they were not yet on the wire); the
+                # pong timeout or close() ends a too-long stall
+                while self.shaper.is_partitioned(self.peer_id):
+                    if self._closed:
+                        return
+                    time.sleep(_PARTITION_POLL_S)
+                self.conn.write(data)
+            except Exception as e:  # noqa: BLE001 — surface via write()
+                with self._q_cv:
+                    self._drain_err = e
+                    self._q.clear()
+                    self._q_bytes = 0
+                    self._q_cv.notify_all()
+                return
+            with self._q_cv:
+                self._q.popleft()
+                self._q_bytes -= len(data)
+                self._q_cv.notify_all()
+
+    def _enqueue(self, data: bytes, delay: float) -> int:
+        self._ensure_drain()
+        deadline = time.monotonic() + PARTITION_STALL_MAX_S
+        with self._q_cv:
+            while self._q_bytes >= self.QUEUE_MAX_BYTES:
+                if self._closed:
+                    raise OSError("connection closed")
+                if self._drain_err is not None:
+                    raise OSError(f"shaped link died: {self._drain_err}")
+                if time.monotonic() > deadline:
+                    raise OSError("shaped link backed up: send stalled")
+                self._q_cv.wait(0.5)
+            if self._drain_err is not None:
+                raise OSError(f"shaped link died: {self._drain_err}")
+            self._q.append((time.monotonic() + delay, data))
+            self._q_bytes += len(data)
+            self._q_cv.notify_all()
+        return len(data)
+
+    # -- the conn surface ----------------------------------------------------
+
+    def write(self, data: bytes) -> int:
+        self._stall_while_partitioned()
+        if self._drain_err is not None:
+            raise OSError(f"shaped link died: {self._drain_err}")
+        spec = self.shaper.spec_for(self.peer_id)
+        delay = 0.0
+        if spec is not None and not spec.is_noop():
+            if spec.drop and self._rng.random() < spec.drop:
+                # loss on a reliable stream = retransmission, not
+                # vanished bytes (see module docstring)
+                _m.p2p_shape_drops.inc(kind="loss")
+                delay += max(0.2, 3.0 * spec.latency_ms / 1000.0)
+            if spec.latency_ms or spec.jitter_ms:
+                delay += (spec.latency_ms
+                          + self._rng.random() * spec.jitter_ms) / 1000.0
+            if spec.bw_kbps:
+                delay += self._throttle(spec, len(data))
+        if delay <= 0 and self._drain_thread is None:
+            return self.conn.write(data)  # unshaped fast path
+        if delay > 0:
+            _m.p2p_shape_delay.observe(delay)
+        # once the drain thread owns the socket, EVERY write must queue
+        # behind it (two writers would interleave frames mid-packet)
+        return self._enqueue(data, delay)
+
+    def read_exact(self, n: int) -> bytes:
+        # ingress is shaped by the SENDER's egress policy; reading
+        # through untouched keeps the stream framing intact
+        return self.conn.read_exact(n)
+
+    def close(self) -> None:
+        with self._q_cv:
+            self._closed = True  # unblocks stalled writes + the drain
+            self._q_cv.notify_all()
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+
+    def __getattr__(self, name):
+        return getattr(self.conn, name)

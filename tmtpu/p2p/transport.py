@@ -113,11 +113,18 @@ class Transport:
     """p2p/transport.go MultiplexTransport."""
 
     def __init__(self, node_key: NodeKey, node_info: NodeInfo,
-                 dial_timeout: float = 3.0, handshake_timeout: float = 20.0):
+                 dial_timeout: float = 3.0, handshake_timeout: float = 20.0,
+                 conn_wrapper=None):
         self.node_key = node_key
         self.node_info = node_info
         self.dial_timeout = dial_timeout
         self.handshake_timeout = handshake_timeout
+        # conn_wrapper(secret_conn, peer_id) -> conn-like — the link
+        # shaping / fuzzing shim (p2p/shaping.py, p2p/fuzz.py). Applied
+        # after the handshake, once the peer's wire identity is known,
+        # so both inbound and outbound connections are covered and the
+        # handshake itself is never shaped.
+        self.conn_wrapper = conn_wrapper
         self._listener: Optional[socket.socket] = None
         self._closed = threading.Event()
 
@@ -190,6 +197,8 @@ class Transport:
         if reason is not None:
             raise TransportError(f"incompatible peer: {reason}")
         conn.settimeout(None)
+        if self.conn_wrapper is not None:
+            sc = self.conn_wrapper(sc, peer_info.node_id)
         return sc, peer_info
 
     def close(self) -> None:

@@ -147,6 +147,41 @@ class HTTPClient:
     def consensus_state(self):
         return self.call("consensus_state")
 
+    def health_detail(self):
+        return self.call("health_detail")
+
+    def timeline(self, height: Optional[int] = None, last: int = 20):
+        p = {"last": str(last)}
+        if height is not None:
+            p["height"] = str(height)
+        return self.call("timeline", **p)
+
+    def metrics(self):
+        return self.call("metrics")
+
+    # -- unsafe scenario control (requires [rpc] unsafe on the node) --------
+
+    def unsafe_net_shape(self, links: Optional[str] = None,
+                         partition: Optional[list] = None,
+                         clear: bool = False):
+        p = {}
+        if links is not None:
+            p["links"] = links
+        if partition is not None:
+            p["partition"] = partition
+        if clear:
+            p["clear"] = True
+        return self.call("unsafe_net_shape", **p)
+
+    def unsafe_inject_fault(self, site: Optional[str] = None,
+                            mode: Optional[str] = None, **kw):
+        p = {k: v for k, v in kw.items() if v is not None}
+        if site is not None:
+            p["site"] = site
+        if mode is not None:
+            p["mode"] = mode
+        return self.call("unsafe_inject_fault", **p)
+
     # -- chain data ---------------------------------------------------------
 
     def block(self, height: Optional[int] = None):

@@ -97,11 +97,48 @@ def _commit_json(c) -> dict:
     }
 
 
+def _vote_json(v) -> dict:
+    if v is None:
+        return None
+    return {
+        "type": v.type, "height": str(v.height), "round": v.round,
+        "block_id": _block_id_json(v.block_id),
+        "timestamp": _ns_to_rfc3339(v.timestamp),
+        "validator_address": _hex(v.validator_address),
+        "validator_index": v.validator_index,
+        "signature": _b64(v.signature) if v.signature else None,
+    }
+
+
+def _evidence_json(ev) -> dict:
+    """tmjson-style tagged evidence (types/evidence.go MarshalJSON). The
+    scenario engine's evidence_committed oracle reads this off /block —
+    an empty list here must mean the BLOCK carries none, not that the
+    serializer dropped it."""
+    if getattr(ev, "TYPE", "") == "duplicate/vote":
+        return {"type": "tendermint/DuplicateVoteEvidence", "value": {
+            "vote_a": _vote_json(ev.vote_a),
+            "vote_b": _vote_json(ev.vote_b),
+            "TotalVotingPower": str(ev.total_voting_power),
+            "ValidatorPower": str(ev.validator_power),
+            "Timestamp": _ns_to_rfc3339(ev.timestamp),
+        }}
+    if getattr(ev, "TYPE", "") == "light_client_attack":
+        return {"type": "tendermint/LightClientAttackEvidence", "value": {
+            "CommonHeight": str(ev.common_height),
+            "TotalVotingPower": str(ev.total_voting_power),
+            "Timestamp": _ns_to_rfc3339(ev.timestamp),
+            "ByzantineValidators": [
+                _hex(v.address) for v in ev.byzantine_validators],
+        }}
+    return {"type": f"tendermint/{type(ev).__name__}", "value": {}}
+
+
 def _block_json(b) -> dict:
     return {
         "header": _header_json(b.header),
         "data": {"txs": [_b64(t) for t in b.txs]},
-        "evidence": {"evidence": []},
+        "evidence": {"evidence": [_evidence_json(e) for e in b.evidence]},
         "last_commit": _commit_json(b.last_commit),
     }
 
@@ -679,7 +716,72 @@ def build_routes(env: Environment) -> dict:
         return {"healthy": ok, "reasons": reasons,
                 "checks": wd.verdicts()}
 
+    # --- unsafe scenario-control routes ------------------------------------
+    #
+    # The scenario engine's runtime levers: re-shape/partition the p2p
+    # links and script faultinject sites on a RUNNING node. Gated on
+    # [rpc] unsafe (the reference's unsafe-route convention) inside the
+    # handler, so a production node answers method-not-allowed instead
+    # of silently exposing a network-partition button.
+
+    def _require_unsafe():
+        if not node.config.rpc.unsafe:
+            raise RPCError(-32601,
+                           "unsafe RPC routes disabled ([rpc] unsafe)")
+
+    def unsafe_net_shape(links=None, partition=None, clear=None):
+        """Mutate the node's LinkShaper: ``links`` uses the [p2p]
+        shape_links string grammar (merged into the live table),
+        ``partition`` replaces the blackholed peer-id set (empty list =
+        heal), ``clear`` drops all shaping. Returns the post-mutation
+        snapshot."""
+        _require_unsafe()
+        shaper = getattr(node, "link_shaper", None)
+        if shaper is None:
+            raise RPCError(-32603, "node has no link shaper (p2p off?)")
+        from tmtpu.p2p.shaping import parse_links
+
+        if clear:
+            shaper.clear()
+        if links is not None:
+            try:
+                shaper.update_links(parse_links(str(links)))
+            except ValueError as exc:
+                raise RPCError(-32602, f"bad links spec: {exc}") from exc
+        if partition is not None:
+            if isinstance(partition, str):
+                partition = [p.strip() for p in partition.split(",")
+                             if p.strip()]
+            shaper.set_partition(partition)
+        return shaper.snapshot()
+
+    def unsafe_inject_fault(site=None, mode=None, count=None, after=None,
+                            ms=None, p=None, seed=None, clear=None):
+        """Script a libs/faultinject plan on a running node (same knobs
+        as the TMTPU_FAULTS env grammar). ``clear`` with no site drops
+        every active plan. Returns registered sites + active plans."""
+        _require_unsafe()
+        from tmtpu.libs import faultinject as fi
+
+        if clear:
+            fi.clear(str(site) if site else None)
+        elif site is not None:
+            if not mode:
+                raise RPCError(-32602, "mode required to script a fault")
+            if site not in fi.sites():
+                raise RPCError(-32602, f"unknown fault site {site!r}; "
+                                       f"registered: {fi.sites()}")
+            fi.script(str(site), str(mode),
+                      count=int(count) if count is not None else None,
+                      after=int(after) if after is not None else 0,
+                      ms=float(ms) if ms is not None else 0.0,
+                      p=float(p) if p is not None else 1.0,
+                      seed=int(seed) if seed is not None else 0)
+        return {"sites": fi.sites(), "active": fi.active()}
+
     return {
+        "unsafe_net_shape": unsafe_net_shape,
+        "unsafe_inject_fault": unsafe_inject_fault,
         "health": health, "status": status, "genesis": genesis,
         "metrics": metrics, "timeline": timeline,
         "health_detail": health_detail,

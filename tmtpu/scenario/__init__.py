@@ -1,0 +1,27 @@
+"""Declarative adversarial-localnet scenarios.
+
+A scenario is data: a topology (validators + full nodes, optional shared
+verification sidecar), a per-link WAN shape, a seeded fault timeline
+(process kills, partitions, sidecar crash storms, faultinject scripts),
+a byzantine roster (misbehavior schedules per node), and a list of
+oracles that judge PASS/FAIL from the evidence the net emitted —
+heights, watchdog verdicts, timeline journals, metrics, committed
+evidence. The engine never inspects node internals: everything it knows
+arrives over public RPC, exactly like an operator debugging a real net.
+
+    from tmtpu.scenario import library, ScenarioEngine
+    spec = library.get("split_brain")
+    verdict = ScenarioEngine(spec, outdir="/tmp/sb").run()
+    assert verdict["pass"], verdict
+
+Modules: ``spec`` (the declarative dataclasses), ``net`` (the e2e-runner
+subclass that owns processes and the shaping/partition fan-out),
+``oracles`` (the named pass/fail predicates over gathered evidence),
+``engine`` (timeline execution + evidence gathering + judging) and
+``library`` (the named starter scenarios).
+"""
+
+from tmtpu.scenario.spec import (FaultAction, OracleSpec,  # noqa: F401
+                                 ScenarioSpec)
+from tmtpu.scenario.engine import ScenarioEngine  # noqa: F401
+from tmtpu.scenario import library  # noqa: F401

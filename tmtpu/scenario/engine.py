@@ -1,0 +1,334 @@
+"""Scenario engine: execute a spec's fault timeline, gather evidence,
+judge PASS/FAIL.
+
+One run is four phases on a single clock (t=0 at net start):
+
+1. **Run** — nodes come up (plus the sidecar daemon when the spec wants
+   one), tx load starts, and a sampler thread polls every node's height
+   and watchdog verdict (the health time-series that stall/convergence
+   oracles read).
+2. **Perturb** — fault actions execute at their ``at_s`` offsets:
+   signals, partitions (unsafe_net_shape fan-out), faultinject scripts,
+   sidecar kill/restart storms, validator-set txs, statesync joins.
+3. **Settle** — load stops and the net quiesces for ``settle_s`` so
+   convergence is judged on steady state, not on an in-flight burst.
+4. **Judge** — a final RPC sweep per node (status, health_detail,
+   metrics, timeline, block bodies) becomes the ``Evidence`` bundle;
+   each oracle in the spec renders a verdict over it. PASS = every
+   oracle passed. The engine never inspects process internals — a
+   scenario that cannot be judged from public RPC evidence fails.
+
+The verdict (and the evidence the judgment used, minus block bodies)
+is persisted under the run's outdir for post-mortems.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+
+from tmtpu.scenario import oracles as oracle_mod
+from tmtpu.scenario.net import ScenarioNet
+from tmtpu.scenario.oracles import Evidence
+from tmtpu.scenario.spec import ScenarioSpec
+
+_SAMPLE_INTERVAL_S = 0.7
+_BLOCK_FETCH_CAP = 200          # per node; scenarios run far shorter
+
+
+class ScenarioEngine:
+    def __init__(self, spec: ScenarioSpec, outdir: str, log=None):
+        self.spec = spec
+        self.outdir = outdir
+        self._log = log or (lambda msg: None)
+        self.net = ScenarioNet(spec, outdir)
+        self.samples: list = []
+        self.events: list = []
+        self._t0 = 0.0
+        self._sampling = threading.Event()
+        self._timers: list = []
+
+    # -- clock ---------------------------------------------------------------
+
+    def _now(self) -> float:
+        return time.monotonic() - self._t0
+
+    # -- sampling ------------------------------------------------------------
+
+    def _sample_once(self) -> None:
+        t = self._now()
+        for node in self.net.nodes:
+            if node.proc is None:        # never started (manual joiner)
+                continue
+            entry = {"t": round(t, 3), "node": node.spec.name,
+                     "height": -1, "healthy": False, "reasons": []}
+            try:
+                st = node.client.status()
+                entry["height"] = int(
+                    st["sync_info"]["latest_block_height"])
+                hd = node.client.health_detail()
+                entry["healthy"] = bool(hd.get("healthy"))
+                entry["reasons"] = list(hd.get("reasons", []))
+            except Exception as e:
+                entry["reasons"] = [f"rpc: {e}"]
+            self.samples.append(entry)
+
+    def _sampler(self) -> None:
+        while self._sampling.is_set():
+            self._sample_once()
+            time.sleep(_SAMPLE_INTERVAL_S)
+
+    # -- fault execution -----------------------------------------------------
+
+    def _execute(self, action) -> str:
+        net, p = self.net, action.params
+        op = action.op
+        if op == "kill":
+            node = net.node(action.node)
+            node.signal(signal.SIGKILL)
+            if node.proc is not None:
+                node.proc.wait(10)
+            return "killed"
+        if op == "start":
+            net.node(action.node).start()
+            return "started"
+        if op == "restart":
+            node = net.node(action.node)
+            node.stop()
+            down = float(p.get("down_s", 0.5))
+            if down:
+                time.sleep(down)
+            node.start()
+            return f"restarted after {down}s"
+        if op == "sigterm":
+            node = net.node(action.node)
+            node.signal(signal.SIGTERM)
+            if node.proc is not None:
+                node.proc.wait(15)
+            return "terminated"
+        if op == "pause":
+            node = net.node(action.node)
+            node.signal(signal.SIGSTOP)
+            for_s = float(p.get("for_s", 3.0))
+            timer = threading.Timer(
+                for_s, lambda: node.signal(signal.SIGCONT))
+            timer.daemon = True
+            timer.start()
+            self._timers.append(timer)
+            return f"paused for {for_s}s"
+        if op == "amnesia":
+            net.amnesia(action.node)
+            return "privval state wiped, restarted"
+        if op == "partition":
+            res = net.partition(p["groups"])
+            return f"partitioned {p['groups']}: " + self._fanout_digest(res)
+        if op == "heal":
+            return "healed: " + self._fanout_digest(net.heal())
+        if op == "shape":
+            res = net.shape(p["links"], p.get("nodes"))
+            return f"shaped {p['links']!r}: " + self._fanout_digest(res)
+        if op == "clear_shape":
+            return "cleared: " + self._fanout_digest(
+                net.clear_shape(p.get("nodes")))
+        if op == "inject":
+            kw = {k: v for k, v in p.items()
+                  if k in ("count", "after", "ms", "p", "seed")}
+            net.node(action.node).client.unsafe_inject_fault(
+                site=p["site"], mode=p["mode"], **kw)
+            return f"scripted {p['site']}={p['mode']}"
+        if op == "clear_faults":
+            targets = [net.node(action.node)] if action.node else \
+                [n for n in net.nodes if n.running]
+            for node in targets:
+                node.client.unsafe_inject_fault(clear=True)
+            return f"cleared faults on {len(targets)} nodes"
+        if op == "sidecar_kill":
+            net.kill_sidecar()
+            return f"sidecar SIGKILL #{net.sidecar_kills}"
+        if op == "sidecar_term":
+            net.term_sidecar()
+            return "sidecar SIGTERM (drained)"
+        if op == "sidecar_restart":
+            net.start_sidecar()
+            return "sidecar restarted"
+        if op == "tx":
+            tx = p["tx"].encode() if isinstance(p["tx"], str) else p["tx"]
+            self._any_live_client().broadcast_tx_sync(tx)
+            return f"broadcast {len(tx)}B tx"
+        if op == "add_validator":
+            from tmtpu.abci.example.kvstore import make_validator_tx
+            from tmtpu.crypto.ed25519 import gen_priv_key_from_secret
+            power = int(p.get("power", 10))
+            # deterministic key: same seed -> same validator set history
+            secret = f"scenario:{self.spec.name}:{self.spec.seed}:" \
+                     f"{action.at_s}".encode()
+            pub = gen_priv_key_from_secret(secret).pub_key().bytes()
+            self._any_live_client().broadcast_tx_sync(
+                make_validator_tx(pub, power))
+            return f"validator-update tx power={power}"
+        if op == "join_statesync":
+            res = net.join_statesync(
+                action.node, trust_height=int(p.get("trust_height", 1)))
+            return f"statesync join: {res}"
+        raise ValueError(f"unknown fault op {op!r}")
+
+    @staticmethod
+    def _fanout_digest(res: dict) -> str:
+        bad = {n: r["error"] for n, r in res.items() if not r["ok"]}
+        return f"{len(res) - len(bad)}/{len(res)} ok" + \
+            (f", errors {bad}" if bad else "")
+
+    def _any_live_client(self):
+        for node in self.net.nodes:
+            if node.running:
+                return node.client
+        raise RuntimeError("no live node")
+
+    def _run_timeline(self) -> None:
+        for action in sorted(self.spec.faults, key=lambda a: a.at_s):
+            delay = action.at_s - self._now()
+            if delay > 0:
+                time.sleep(delay)
+            t = round(self._now(), 3)
+            try:
+                detail = self._execute(action)
+                ok = True
+            except Exception as e:
+                detail, ok = f"{type(e).__name__}: {e}", False
+            self._log(f"[{t:7.2f}s] {action.op} {action.node or '*'}: "
+                      f"{detail}")
+            self.events.append({"t": t, "op": action.op,
+                                "node": action.node, "ok": ok,
+                                "detail": detail})
+        tail = self.spec.duration_s - self._now()
+        if tail > 0:
+            time.sleep(tail)
+
+    # -- evidence ------------------------------------------------------------
+
+    def _gather(self) -> Evidence:
+        nodes = {}
+        for node in self.net.nodes:
+            snap = {"final_height": -1, "running": node.running,
+                    "health": None, "metrics": None, "timeline": None,
+                    "blocks": {}}
+            if node.proc is not None:
+                try:
+                    st = node.client.status()
+                    snap["final_height"] = int(
+                        st["sync_info"]["latest_block_height"])
+                    snap["health"] = node.client.health_detail()
+                    snap["metrics"] = node.client.metrics()
+                    snap["timeline"] = node.client.timeline(last=100)
+                    snap["blocks"] = self._fetch_blocks(
+                        node, snap["final_height"])
+                except Exception as e:
+                    snap["error"] = str(e)
+            nodes[node.spec.name] = snap
+        return Evidence(self.spec, self.events, self.samples, nodes,
+                        sidecar_kills=self.net.sidecar_kills)
+
+    @staticmethod
+    def _fetch_blocks(node, top: int) -> dict:
+        if top < 2:
+            return {}
+        lo = max(2, top - _BLOCK_FETCH_CAP + 1)
+        heights = list(range(lo, top + 1))
+        blocks = {}
+        for i in range(0, len(heights), 25):
+            chunk = heights[i:i + 25]
+            results = node.client.call_batch(
+                [("block", {"height": str(h)}) for h in chunk])
+            for h, res in zip(chunk, results):
+                if not isinstance(res, Exception):
+                    blocks[h] = res["block"]
+        return blocks
+
+    # -- the run -------------------------------------------------------------
+
+    def run(self) -> dict:
+        spec = self.spec
+        problems = spec.validate()
+        if problems:
+            raise ValueError(f"invalid scenario: {problems}")
+        started_unix = time.time()
+        self._log(f"scenario {spec.name!r}: {spec.validators} validators"
+                  + (f" + {spec.full_nodes} full nodes"
+                     if spec.full_nodes else "")
+                  + (" + sidecar" if spec.sidecar else "")
+                  + f", seed {spec.seed}")
+        try:
+            self.net.setup()
+            if spec.sidecar:
+                self.net.start_sidecar()
+            self.net.start()
+            self._t0 = time.monotonic()
+            self._sampling.set()
+            sampler = threading.Thread(target=self._sampler, daemon=True)
+            sampler.start()
+            if spec.load_rate > 0:
+                self.net.start_load()
+            self._run_timeline()
+            self.net.stop_load()
+            if spec.settle_s > 0:
+                self._log(f"[{self._now():7.2f}s] settling "
+                          f"{spec.settle_s}s before judging")
+                time.sleep(spec.settle_s)
+            self._sampling.clear()
+            sampler.join(3)
+            self._sample_once()        # one last row at judge time
+            evidence = self._gather()
+        finally:
+            self._sampling.clear()
+            for timer in self._timers:
+                timer.cancel()
+            self.net.stop()
+
+        verdicts = []
+        for ospec in spec.oracles:
+            fn = oracle_mod.get(ospec.name)
+            try:
+                ok, detail = fn(evidence, **ospec.params)
+            except Exception as e:
+                ok, detail = False, f"oracle crashed: " \
+                    f"{type(e).__name__}: {e}"
+            verdicts.append({"name": ospec.name,
+                             "params": dict(ospec.params),
+                             "pass": bool(ok), "detail": detail})
+            self._log(f"  {'PASS' if ok else 'FAIL'} {ospec.name}: "
+                      f"{detail}")
+        verdict = {
+            "scenario": spec.name,
+            "seed": spec.seed,
+            "pass": all(v["pass"] for v in verdicts),
+            "oracles": verdicts,
+            "final_heights": evidence.final_heights(),
+            "events": self.events,
+            "sidecar_kills": self.net.sidecar_kills,
+            "started_unix": round(started_unix, 3),
+            "wall_s": round(time.time() - started_unix, 3),
+            "outdir": self.outdir,
+        }
+        self._persist(verdict)
+        self._log(f"verdict: {'PASS' if verdict['pass'] else 'FAIL'} "
+                  f"({verdict['wall_s']}s)")
+        return verdict
+
+    def _persist(self, verdict: dict) -> None:
+        try:
+            os.makedirs(self.outdir, exist_ok=True)
+            with open(os.path.join(self.outdir, "verdict.json"),
+                      "w") as f:
+                json.dump(verdict, f, indent=2, sort_keys=True)
+            with open(os.path.join(self.outdir, "samples.json"),
+                      "w") as f:
+                json.dump(self.samples, f)
+        except OSError:
+            pass  # judging succeeded; persistence is best-effort
+
+
+def run_scenario(spec: ScenarioSpec, outdir: str, log=None) -> dict:
+    return ScenarioEngine(spec, outdir, log=log).run()

@@ -1,0 +1,252 @@
+"""The named starter scenarios.
+
+Each builder returns a fresh ``ScenarioSpec`` (callers may mutate their
+copy — shrink durations for CI, crank load for soak runs). ``FAST``
+lists the pair cheap enough to ride tier-1 under the ``scenarios``
+pytest marker; the rest run on demand via tools/scenario_run.py.
+
+Timing notes: scenario nets run the e2e fast consensus profile
+(~0.4 s propose timeout), so an unperturbed 4-validator localnet
+commits a block roughly every 0.3–1 s. Stall watchdogs run on a 5 s
+leash (net.py), which sets the floor on how short a detectable
+partition can be.
+"""
+
+from __future__ import annotations
+
+from tmtpu.scenario.spec import FaultAction, OracleSpec, ScenarioSpec
+
+SECOND_NS = 10**9
+
+
+def split_brain() -> ScenarioSpec:
+    """Partition a 4-validator net 3|1 for 10 s, then heal. The majority
+    keeps committing (3/4 power > 2/3); the minority must NOTICE it is
+    stalled (watchdog verdict — the detection half of the exercise) and
+    then catch back up to within 2 heights of the leader inside 30 s of
+    the heal."""
+    return ScenarioSpec(
+        name="split_brain",
+        description="3|1 partition + heal: minority stalls, detects it, "
+                    "rejoins",
+        validators=4, load_rate=10.0, duration_s=29.0, settle_s=5.0,
+        faults=[
+            # the split waits until the net is demonstrably committing:
+            # a node partitioned during startup blocksync gets a syncing
+            # pass from the watchdog and the stall oracle has nothing
+            # to observe
+            FaultAction(8.0, "partition", params={
+                "groups": [["v00", "v01", "v02"], ["v03"]]}),
+            FaultAction(18.0, "heal"),
+        ],
+        oracles=[
+            OracleSpec("stall_detected", {"node": "v03",
+                                          "check": "consensus",
+                                          "after_op": "partition",
+                                          "before_op": "heal"}),
+            OracleSpec("rejoin", {"op": "heal", "within_s": 30.0,
+                                  "spread": 2}),
+            OracleSpec("chain_agreement"),
+            OracleSpec("height_min", {"min": 5}),
+            OracleSpec("all_healthy"),
+        ])
+
+
+def sidecar_crash_storm() -> ScenarioSpec:
+    """SIGKILL the shared verification daemon five times under tx flood,
+    restarting it 2 s later each time. Nodes must absorb every outage on
+    the penalty-free in-process path (fallback lanes >= kills), keep
+    perfect agreement (a single wrong verify result would fork state),
+    and end healthy with the daemon path back in use."""
+    kills = [5.0, 10.0, 15.0, 20.0, 25.0]
+    faults = []
+    for t in kills:
+        faults.append(FaultAction(t, "sidecar_kill", node="sidecar"))
+        faults.append(FaultAction(t + 2.0, "sidecar_restart",
+                                  node="sidecar"))
+    return ScenarioSpec(
+        name="sidecar_crash_storm",
+        description="5x sidecar SIGKILL under load: fallback covers "
+                    "every outage, zero divergence",
+        validators=3, sidecar=True, load_rate=30.0,
+        duration_s=30.0, settle_s=6.0,
+        faults=faults,
+        oracles=[
+            OracleSpec("sidecar_fallbacks_cover_kills",
+                       {"min_per_kill": 1}),
+            OracleSpec("chain_agreement"),
+            OracleSpec("height_min", {"min": 6}),
+            OracleSpec("all_healthy"),
+            OracleSpec("timeline_saw", {"event": "crypto.sidecar"}),
+        ])
+
+
+def equivocation() -> ScenarioSpec:
+    """One validator double-prevotes at height 3. Honest nodes must turn
+    the conflict into DuplicateVoteEvidence and COMMIT it — every honest
+    node's chain carries the proof, not just a mempool."""
+    return ScenarioSpec(
+        name="equivocation",
+        description="double-prevote byzantine validator: duplicate-vote "
+                    "evidence lands on every honest chain",
+        validators=4, load_rate=5.0, duration_s=14.0, settle_s=4.0,
+        misbehaviors={"v03": {3: "double-prevote"}},
+        oracles=[
+            OracleSpec("evidence_committed",
+                       {"type": "tendermint/DuplicateVoteEvidence"}),
+            OracleSpec("chain_agreement"),
+            OracleSpec("height_min", {"min": 6}),
+        ])
+
+
+def garbage_sig_flood() -> ScenarioSpec:
+    """A byzantine validator sprays bursts of random-signature votes at
+    three heights. The batch-verify admission filter must reject every
+    lane (invalid-vote counter ticks, no evidence manufactured) without
+    the block rate collapsing more than 20%."""
+    return ScenarioSpec(
+        name="garbage_sig_flood",
+        description="garbage-signature vote spam: rejected at admission, "
+                    "block rate holds",
+        validators=4, load_rate=10.0, duration_s=24.0, settle_s=5.0,
+        misbehaviors={"v03": {6: "garbage-sig", 9: "garbage-sig",
+                              12: "garbage-sig"}},
+        oracles=[
+            OracleSpec("metric_min",
+                       {"name": "tendermint_consensus_invalid_votes_total",
+                        "min": 1, "nodes": "sum"}),
+            OracleSpec("no_evidence"),
+            OracleSpec("chain_agreement"),
+            OracleSpec("height_min", {"min": 10}),
+            OracleSpec("block_rate_stable", {"split_s": 8.0,
+                                             "max_drop": 0.2}),
+        ])
+
+
+def wan_200ms() -> ScenarioSpec:
+    """Every link shaped to 200 ms +-40 ms with 5% loss — a
+    cross-continent WAN on localhost. Consensus timeouts widen to
+    production scale; the net must still commit and stay healthy, and
+    the shape metrics must prove the WAN was actually in the path."""
+    return ScenarioSpec(
+        name="wan_200ms",
+        description="200ms/5%-loss WAN shaping: liveness holds at "
+                    "production timeouts",
+        validators=4, load_rate=5.0, duration_s=30.0, settle_s=8.0,
+        links="*:latency_ms=200,jitter_ms=40,drop=0.05",
+        config={
+            "consensus.timeout_propose_ns": 2 * SECOND_NS,
+            "consensus.timeout_prevote_ns": SECOND_NS,
+            "consensus.timeout_precommit_ns": SECOND_NS,
+            "consensus.timeout_commit_ns": SECOND_NS // 2,
+            "health.consensus_stall_timeout_ns": 20 * SECOND_NS,
+        },
+        oracles=[
+            OracleSpec("height_min", {"min": 3}),
+            OracleSpec("chain_agreement"),
+            OracleSpec("all_healthy"),
+            OracleSpec("metric_min",
+                       {"name": "tendermint_p2p_shape_delay_seconds",
+                        "min": 100, "nodes": "sum"}),
+        ])
+
+
+def churn_rotation() -> ScenarioSpec:
+    """Rolling validator restarts while a validator-set update tx adds a
+    fifth key mid-run: membership churn on top of process churn. The
+    set change must reach every node (validators gauge hits 5) with no
+    divergence."""
+    return ScenarioSpec(
+        name="churn_rotation",
+        description="rolling restarts + validator-set rotation tx",
+        validators=4, load_rate=10.0, duration_s=26.0, settle_s=6.0,
+        faults=[
+            FaultAction(5.0, "restart", node="v01",
+                        params={"down_s": 1.0}),
+            FaultAction(10.0, "add_validator", params={"power": 10}),
+            FaultAction(16.0, "restart", node="v02",
+                        params={"down_s": 1.0}),
+        ],
+        oracles=[
+            OracleSpec("metric_min",
+                       {"name": "tendermint_consensus_validators",
+                        "min": 5, "nodes": "any"}),
+            OracleSpec("chain_agreement"),
+            OracleSpec("height_min", {"min": 8}),
+            OracleSpec("all_healthy"),
+        ])
+
+
+def statesync_join() -> ScenarioSpec:
+    """A fresh full node statesyncs into a net that is mid-flood:
+    snapshot restore + light-client verification + blocksync tail, all
+    while the validators keep committing at load. The joiner must land
+    within 3 heights of the leader by judge time."""
+    return ScenarioSpec(
+        name="statesync_join",
+        description="statesync join under tx flood: snapshot restore "
+                    "catches the live chain",
+        validators=3, full_nodes=1, full_node_start="manual",
+        load_rate=20.0, duration_s=34.0, settle_s=8.0,
+        config={"base.app_snapshot_interval": 4},
+        faults=[
+            FaultAction(14.0, "join_statesync", node="f00",
+                        params={"trust_height": 1}),
+        ],
+        oracles=[
+            OracleSpec("height_min", {"min": 10,
+                                      "nodes": ["v00", "v01", "v02"]}),
+            OracleSpec("height_spread", {"max": 3}),
+            OracleSpec("chain_agreement"),
+        ])
+
+
+def crash_restart_wal() -> ScenarioSpec:
+    """SIGKILL a validator twice under load. Each restart replays the
+    WAL with a cold signature cache and must rejoin without ever
+    double-signing (zero evidence on any chain) while the net keeps
+    committing."""
+    return ScenarioSpec(
+        name="crash_restart_wal",
+        description="kill -9 a validator twice under load: WAL replay "
+                    "rejoins, zero double-signs",
+        validators=3, load_rate=10.0, duration_s=16.0, settle_s=5.0,
+        faults=[
+            FaultAction(5.0, "kill", node="v01"),
+            FaultAction(7.0, "start", node="v01"),
+            FaultAction(11.0, "kill", node="v01"),
+            FaultAction(12.5, "start", node="v01"),
+        ],
+        oracles=[
+            OracleSpec("no_evidence"),
+            OracleSpec("chain_agreement"),
+            OracleSpec("height_min", {"min": 6}),
+            OracleSpec("height_spread", {"max": 2}),
+            OracleSpec("all_healthy"),
+        ])
+
+
+SCENARIOS = {
+    "split_brain": split_brain,
+    "sidecar_crash_storm": sidecar_crash_storm,
+    "equivocation": equivocation,
+    "garbage_sig_flood": garbage_sig_flood,
+    "wan_200ms": wan_200ms,
+    "churn_rotation": churn_rotation,
+    "statesync_join": statesync_join,
+    "crash_restart_wal": crash_restart_wal,
+}
+
+# cheap enough for tier-1 (the ``scenarios`` pytest marker)
+FAST = ("equivocation", "crash_restart_wal")
+
+
+def names() -> list:
+    return sorted(SCENARIOS)
+
+
+def get(name: str) -> ScenarioSpec:
+    try:
+        return SCENARIOS[name]()
+    except KeyError:
+        raise KeyError(f"unknown scenario {name!r}; known: {names()}")

@@ -1,0 +1,263 @@
+"""ScenarioNet: the process-owning half of the scenario engine.
+
+Subclasses the e2e Runner (tmtpu/e2e/runner.py) — same home-dir layout,
+genesis, subprocess nodes and tx load — and adds what adversarial
+scenarios need on top:
+
+- every node runs with ``[rpc] unsafe`` on, so the engine can re-shape
+  links, blackhole peers and script faultinject sites over RPC while
+  the net runs;
+- an optional shared verification sidecar daemon (``crypto.backend =
+  sidecar`` on every node) that the fault timeline can kill, drain and
+  restart — the crash-storm surface;
+- partition/heal/shape fan-out helpers that translate group-level
+  intent ("split {v00,v01,v02} from {v03}") into per-node
+  ``unsafe_net_shape`` calls (each node blackholes its own egress, so
+  applying the rule on every member severs both directions);
+- a statesync join helper that derives the light-client trust anchor
+  from a live node's ``commit`` RPC and rewrites the joiner's config
+  before starting it.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+from tmtpu.config import toml as cfg_toml
+from tmtpu.e2e.manifest import LoadSpec, Manifest, NodeSpec
+from tmtpu.e2e.runner import Runner, _hold_port, _REPO_ROOT
+from tmtpu.scenario.spec import ScenarioSpec
+
+# scenario nets watch for stalls on a tight leash: the default watchdog
+# deadline (30 s) is longer than most whole scenarios, so a partitioned
+# minority would never report unhealthy before the heal
+_STALL_TIMEOUT_NS = 5 * 10**9
+
+
+def build_manifest(spec: ScenarioSpec, sidecar_addr: str = "") -> Manifest:
+    """Translate a ScenarioSpec into the e2e Manifest the Runner
+    understands. Perturbations stay empty — the engine drives its own
+    wall-clock fault timeline instead of the Runner's height-triggered
+    one."""
+    nodes = []
+    for name in spec.node_names():
+        validator = name.startswith("v")
+        cfg = {
+            "rpc.unsafe": True,
+            "health.consensus_stall_timeout_ns": _STALL_TIMEOUT_NS,
+        }
+        if spec.links:
+            cfg["p2p.shape_links"] = spec.links
+            cfg["p2p.shape_seed"] = spec.seed
+        if spec.sidecar:
+            cfg["base.crypto_backend"] = "sidecar"
+            cfg["sidecar.addr"] = sidecar_addr
+        cfg.update(spec.config)
+        cfg.update(spec.node_config.get(name, {}))
+        start_at = 0
+        if not validator and spec.full_node_start == "manual":
+            start_at = -1  # provisioned, never auto-started
+        nodes.append(NodeSpec(
+            name=name, validator=validator, start_at=start_at,
+            key_type=spec.key_type, config=cfg,
+            misbehaviors=dict(spec.misbehaviors.get(name, {}))))
+    return Manifest(
+        chain_id=f"scenario-{spec.name}", nodes=nodes,
+        load=LoadSpec(rate=spec.load_rate, size=spec.load_size),
+        timeout_s=spec.timeout_s)
+
+
+class ScenarioNet(Runner):
+    def __init__(self, spec: ScenarioSpec, outdir: str):
+        self.spec = spec
+        self.sidecar_proc = None
+        self.sidecar_kills = 0
+        self.sidecar_home = os.path.join(outdir, "_sidecar")
+        if spec.sidecar:
+            port, self._sidecar_hold = _hold_port()
+            self.sidecar_addr = f"tcp://127.0.0.1:{port}"
+        else:
+            self.sidecar_addr = ""
+            self._sidecar_hold = None
+        super().__init__(build_manifest(spec, self.sidecar_addr), outdir)
+
+    def node(self, name: str):
+        for n in self.nodes:
+            if n.spec.name == name:
+                return n
+        raise KeyError(f"no node named {name!r}")
+
+    # -- sidecar daemon ------------------------------------------------------
+
+    def start_sidecar(self, timeout: float = 20.0) -> None:
+        """Launch (or relaunch) the shared verification daemon and block
+        until its listener accepts — nodes started before this point
+        would burn breaker budget on connection refusals."""
+        if self.sidecar_proc is not None and \
+                self.sidecar_proc.poll() is None:
+            return
+        if self._sidecar_hold is not None:
+            try:
+                self._sidecar_hold.close()
+            except OSError:
+                pass
+            self._sidecar_hold = None
+        os.makedirs(self.sidecar_home, exist_ok=True)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + \
+            env.get("PYTHONPATH", "")
+        env["TMTPU_CRYPTO_BACKEND"] = "cpu"
+        log = open(os.path.join(self.sidecar_home, "sidecar.log"), "ab")
+        self.sidecar_proc = subprocess.Popen(
+            [sys.executable, "-m", "tmtpu.cmd", "sidecar",
+             "--home", self.sidecar_home, "--addr", self.sidecar_addr,
+             "--backend", "cpu", "--no-warm"],
+            stdout=log, stderr=subprocess.STDOUT, env=env,
+            start_new_session=True)
+        host, port = self.sidecar_addr.split("://", 1)[1].rsplit(":", 1)
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                socket.create_connection((host, int(port)),
+                                         timeout=1.0).close()
+                return
+            except OSError:
+                if self.sidecar_proc.poll() is not None:
+                    raise RuntimeError(
+                        f"sidecar exited rc={self.sidecar_proc.returncode} "
+                        f"(see {self.sidecar_home}/sidecar.log)")
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"sidecar not accepting on {self.sidecar_addr}")
+                time.sleep(0.1)
+
+    def kill_sidecar(self) -> None:
+        if self.sidecar_proc is None or self.sidecar_proc.poll() is not None:
+            return
+        os.killpg(self.sidecar_proc.pid, signal.SIGKILL)
+        self.sidecar_proc.wait(10)
+        self.sidecar_kills += 1
+
+    def term_sidecar(self, timeout: float = 30.0) -> None:
+        if self.sidecar_proc is None or self.sidecar_proc.poll() is not None:
+            return
+        os.killpg(self.sidecar_proc.pid, signal.SIGTERM)
+        try:
+            self.sidecar_proc.wait(timeout)
+        except subprocess.TimeoutExpired:
+            os.killpg(self.sidecar_proc.pid, signal.SIGKILL)
+            self.sidecar_proc.wait(10)
+
+    # -- runtime shaping fan-out ---------------------------------------------
+
+    def _fanout(self, nodes, fn) -> dict:
+        """Apply ``fn(node)`` to each running target; collect per-node
+        outcomes instead of dying on the first RPC error (a node the
+        timeline just killed is an expected miss, not a run failure)."""
+        out = {}
+        for node in nodes:
+            try:
+                out[node.spec.name] = {"ok": True, "result": fn(node)}
+            except Exception as e:
+                out[node.spec.name] = {"ok": False, "error": str(e)}
+        return out
+
+    def partition(self, groups) -> dict:
+        """Sever traffic BETWEEN groups: each member stalls its egress
+        to every node outside its own group (TCP-backpressure emulation,
+        see p2p/shaping.py). Nodes in no group keep full connectivity
+        (scenarios that want a clean split list everyone)."""
+        by_name = {n.spec.name: n for n in self.nodes}
+        results = {}
+        for group in groups:
+            inside = set(group)
+            outside_ids = [by_name[n].node_id for n in by_name
+                           if n not in inside]
+            members = [by_name[n] for n in group]
+            results.update(self._fanout(
+                members,
+                lambda nd, ids=outside_ids:
+                    nd.client.unsafe_net_shape(partition=ids)))
+        return results
+
+    def heal(self) -> dict:
+        return self._fanout(
+            [n for n in self.nodes if n.running],
+            lambda nd: nd.client.unsafe_net_shape(partition=[]))
+
+    def shape(self, links: str, names=None) -> dict:
+        targets = [self.node(n) for n in names] if names else \
+            [n for n in self.nodes if n.running]
+        return self._fanout(
+            targets, lambda nd: nd.client.unsafe_net_shape(links=links))
+
+    def clear_shape(self, names=None) -> dict:
+        targets = [self.node(n) for n in names] if names else \
+            [n for n in self.nodes if n.running]
+        return self._fanout(
+            targets, lambda nd: nd.client.unsafe_net_shape(clear=True))
+
+    # -- late joins ----------------------------------------------------------
+
+    def _rewrite_config(self, node, mutate) -> None:
+        """Regenerate a down node's config.toml through the same path
+        setup() used, apply ``mutate(cfg)``, and persist."""
+        cfg = self._node_config(node)
+        peers = {n.spec.name: f"{n.node_id}@127.0.0.1:{n.p2p_port}"
+                 for n in self.nodes}
+        cfg.p2p.persistent_peers = ",".join(
+            p for name, p in peers.items() if name != node.spec.name)
+        mutate(cfg)
+        cfg_toml.write_config(
+            cfg, os.path.join(node.home, "config", "config.toml"))
+
+    def join_statesync(self, name: str, trust_height: int = 1) -> dict:
+        """Start ``name`` as a statesync joiner: trust anchor = the
+        block-id hash served by a live node's ``commit`` RPC at
+        ``trust_height``, snapshot/light-block sources = every running
+        validator's RPC."""
+        joiner = self.node(name)
+        live = [n for n in self.nodes
+                if n.running and n.spec.name != name]
+        if not live:
+            raise RuntimeError("no live node to anchor statesync trust")
+        commit = live[0].client.commit(height=trust_height)
+        trust_hash = commit["signed_header"]["commit"]["block_id"]["hash"]
+        rpc_servers = [f"http://127.0.0.1:{n.rpc_port}" for n in live[:2]]
+
+        def mutate(cfg):
+            cfg.state_sync.enable = True
+            cfg.state_sync.rpc_servers = rpc_servers
+            cfg.state_sync.trust_height = trust_height
+            cfg.state_sync.trust_hash = trust_hash
+            cfg.state_sync.discovery_time_ns = 10**9
+
+        self._rewrite_config(joiner, mutate)
+        joiner.start()
+        return {"trust_height": trust_height, "trust_hash": trust_hash,
+                "rpc_servers": rpc_servers}
+
+    def amnesia(self, name: str) -> None:
+        """Crash ``name`` and wipe its double-sign protection (the
+        privval last-signed state) before restarting — the amnesiac
+        validator from the fork-accountability literature."""
+        node = self.node(name)
+        node.signal(signal.SIGKILL)
+        if node.proc is not None:
+            node.proc.wait(10)
+        cfg = self._node_config(node)
+        state = cfg.rooted(cfg.base.priv_validator_state_file)
+        if os.path.exists(state):
+            os.unlink(state)
+        node.start()
+
+    def stop(self):
+        super().stop()
+        if self.sidecar_proc is not None and \
+                self.sidecar_proc.poll() is None:
+            self.term_sidecar(timeout=5.0)

@@ -1,0 +1,390 @@
+"""Scenario oracles: named pass/fail predicates over run evidence.
+
+An oracle never talks to the net — it reads the ``Evidence`` bundle the
+engine gathered (health samples, final RPC snapshots, block bodies,
+metrics, timeline journals, the executed fault timeline) and returns
+``(ok, detail)``. Keeping oracles pure makes verdicts reproducible from
+the persisted evidence file and lets tools/check_scenarios.py lint
+specs against this registry offline.
+
+Registry contract: specs reference oracles by function name; params in
+``OracleSpec.params`` are passed as keyword arguments.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+_builtin_min, _builtin_max = min, max
+
+
+class Evidence:
+    """Everything a judged run left behind. ``nodes`` maps node name to
+    the final RPC snapshot::
+
+        {"final_height": int, "running": bool,
+         "health": health_detail result | None,
+         "metrics": metrics result | None,
+         "timeline": timeline result | None,
+         "blocks": {height: block json}}
+
+    ``samples`` is the health time-series ({"t", "node", "height",
+    "healthy", "reasons"}, t = seconds since net start) and ``events``
+    the executed fault timeline ({"t", "op", "node", "ok", "detail"}).
+    """
+
+    def __init__(self, spec, events: List[dict], samples: List[dict],
+                 nodes: Dict[str, dict], sidecar_kills: int = 0):
+        self.spec = spec
+        self.events = events
+        self.samples = samples
+        self.nodes = nodes
+        self.sidecar_kills = sidecar_kills
+
+    # -- accessors -----------------------------------------------------------
+
+    def node_names(self) -> List[str]:
+        return sorted(self.nodes)
+
+    def honest(self) -> List[str]:
+        byz = set(self.spec.misbehaviors) if self.spec else set()
+        return [n for n in self.node_names() if n not in byz]
+
+    def final_heights(self, names: Optional[Iterable[str]] = None) \
+            -> Dict[str, int]:
+        names = list(names) if names else self.node_names()
+        return {n: self.nodes[n].get("final_height", -1) for n in names}
+
+    def event_times(self, op: str) -> List[float]:
+        return [e["t"] for e in self.events if e["op"] == op]
+
+    def heights_at(self, t: float) -> Dict[str, int]:
+        """Last sampled height per node at or before ``t``."""
+        out: Dict[str, int] = {}
+        for s in self.samples:
+            if s["t"] <= t and s["height"] >= 0:
+                out[s["node"]] = s["height"]
+        return out
+
+    def metric(self, node: str, name: str, series: str = "") -> float:
+        """Sum of a metric's series values on one node; ``series``
+        substring-filters the series keys (label renderings like
+        ``reason=overloaded``). Histograms contribute their count."""
+        snap = (self.nodes.get(node, {}).get("metrics") or {})
+        m = (snap.get("metrics") or {}).get(name)
+        if not m:
+            return 0.0
+        total = 0.0
+        for key, val in (m.get("series") or {}).items():
+            if series and series not in key:
+                continue
+            total += val["count"] if isinstance(val, dict) else float(val)
+        return total
+
+    def metric_total(self, name: str, series: str = "") -> float:
+        return sum(self.metric(n, name, series) for n in self.nodes)
+
+    def committed_evidence(self, node: str) -> List[dict]:
+        out = []
+        for h in sorted(self.nodes.get(node, {}).get("blocks", {})):
+            blk = self.nodes[node]["blocks"][h]
+            for ev in (blk.get("evidence", {}) or {}).get("evidence", []):
+                out.append({"height": h, **ev})
+        return out
+
+    def timeline_event_names(self, node: str) -> List[str]:
+        tl = self.nodes.get(node, {}).get("timeline") or {}
+        names = []
+        for rec in tl.get("heights", []):
+            for ev in rec.get("events", []):
+                names.append(ev.get("event", ""))
+        return names
+
+
+# -- registry -----------------------------------------------------------------
+
+ORACLES: Dict[str, callable] = {}
+
+
+def oracle(fn):
+    ORACLES[fn.__name__] = fn
+    return fn
+
+
+def names() -> List[str]:
+    return sorted(ORACLES)
+
+
+def get(name: str):
+    try:
+        return ORACLES[name]
+    except KeyError:
+        raise KeyError(f"unknown oracle {name!r}; known: {names()}")
+
+
+# -- progress / agreement -----------------------------------------------------
+
+@oracle
+def height_min(ev: Evidence, min: int = 3, nodes=None) -> Tuple[bool, str]:
+    """Every (selected) node's final height reached ``min``."""
+    hs = ev.final_heights(nodes)
+    low = {n: h for n, h in hs.items() if h < min}
+    return (not low,
+            f"final heights {hs}" + (f"; below {min}: {low}" if low else ""))
+
+
+@oracle
+def height_spread(ev: Evidence, max: int = 2, nodes=None) \
+        -> Tuple[bool, str]:
+    """No straggler: heights within ``max`` of the leader at the final
+    sampler sweep. Samples are used instead of the judge-time RPC
+    snapshots because the gather pass polls nodes seconds apart while an
+    idle net keeps committing empty blocks — sequential-poll skew would
+    masquerade as a straggler."""
+    hs = ev.heights_at(float("inf"))
+    if nodes:
+        hs = {n: h for n, h in hs.items() if n in set(nodes)}
+    if not hs:
+        hs = {n: h for n, h in ev.final_heights(nodes).items() if h >= 0}
+    if not hs:
+        return False, "no node reported a height"
+    spread = _builtin_max(hs.values()) - _builtin_min(hs.values())
+    return spread <= max, f"spread {spread} over {hs} (limit {max})"
+
+
+@oracle
+def chain_agreement(ev: Evidence) -> Tuple[bool, str]:
+    """App hash + header linkage agree at every height two nodes both
+    serve. A single wrong verify accepted anywhere shows up here as a
+    state divergence."""
+    names_ = ev.node_names()
+    if len(names_) < 2:
+        return True, "single node"
+    ref = _builtin_max(names_,
+                       key=lambda n: len(ev.nodes[n].get("blocks", {})))
+    ref_blocks = ev.nodes[ref].get("blocks", {})
+    compared = 0
+    for other in names_:
+        if other == ref:
+            continue
+        for h, blk in ev.nodes[other].get("blocks", {}).items():
+            rblk = ref_blocks.get(h)
+            if rblk is None:
+                continue
+            compared += 1
+            a, b = rblk["header"], blk["header"]
+            if a["app_hash"] != b["app_hash"]:
+                return False, f"app hash divergence {ref}/{other} at {h}"
+            if a["last_block_id"] != b["last_block_id"]:
+                return False, f"chain divergence {ref}/{other} at {h}"
+    return compared > 0, f"{compared} cross-node height comparisons agree" \
+        if compared else "no common heights to compare"
+
+
+@oracle
+def progress_after(ev: Evidence, op: str, min_blocks: int = 1) \
+        -> Tuple[bool, str]:
+    """The net kept committing after the LAST ``op`` event."""
+    times = ev.event_times(op)
+    if not times:
+        return False, f"no {op!r} event executed"
+    at = ev.heights_at(times[-1])
+    before = _builtin_max(at.values(), default=-1)
+    after = _builtin_max(ev.final_heights().values(), default=-1)
+    return (after - before >= min_blocks,
+            f"height {before} at last {op!r} -> {after} final "
+            f"(need +{min_blocks})")
+
+
+# -- health -------------------------------------------------------------------
+
+@oracle
+def all_healthy(ev: Evidence, nodes=None) -> Tuple[bool, str]:
+    """Every (selected) node's final watchdog verdict is healthy."""
+    names_ = list(nodes) if nodes else ev.node_names()
+    sick = {}
+    for n in names_:
+        h = ev.nodes.get(n, {}).get("health")
+        if not h or not h.get("healthy"):
+            sick[n] = (h or {}).get("reasons", ["no health snapshot"])
+    return not sick, f"unhealthy: {sick}" if sick else \
+        f"all {len(names_)} nodes healthy"
+
+
+@oracle
+def stall_detected(ev: Evidence, node: str, check: str = "consensus",
+                   after_op: Optional[str] = None,
+                   before_op: Optional[str] = None) -> Tuple[bool, str]:
+    """The watchdog on ``node`` reported a ``check`` stall inside the
+    [after_op, before_op] event window — the detection half of a
+    partition scenario (the minority MUST notice it is stalled)."""
+    t_lo = ev.event_times(after_op)[-1] if after_op and \
+        ev.event_times(after_op) else 0.0
+    ts_hi = ev.event_times(before_op) if before_op else []
+    t_hi = ts_hi[-1] if ts_hi else float("inf")
+    seen = []
+    for s in ev.samples:
+        if s["node"] != node or not (t_lo <= s["t"] <= t_hi + 2.0):
+            continue
+        if not s["healthy"] and any(check in r for r in s["reasons"]):
+            seen.append(round(s["t"], 1))
+    return (bool(seen),
+            f"{node} {check}-stall verdicts at t={seen[:5]}" if seen else
+            f"{node} never reported a {check} stall in "
+            f"[{t_lo:.1f}, {t_hi if t_hi != float('inf') else 'end'}]")
+
+
+@oracle
+def rejoin(ev: Evidence, op: str = "heal", within_s: float = 30.0,
+           spread: int = 2) -> Tuple[bool, str]:
+    """After the ``op`` event, every node converges to within ``spread``
+    of the leader — with fresh progress — inside ``within_s``."""
+    times = ev.event_times(op)
+    if not times:
+        return False, f"no {op!r} event executed"
+    t_heal = times[-1]
+    base = _builtin_max(ev.heights_at(t_heal).values(), default=-1)
+    # walk the sample timeline: earliest instant where all nodes are
+    # within `spread` of the then-leader AND the leader has moved on
+    last_by_node: Dict[str, int] = {}
+    for s in sorted(ev.samples, key=lambda s: s["t"]):
+        if s["t"] <= t_heal or s["height"] < 0:
+            continue
+        last_by_node[s["node"]] = s["height"]
+        if len(last_by_node) < len(ev.nodes):
+            continue
+        top = _builtin_max(last_by_node.values())
+        if top > base and top - _builtin_min(last_by_node.values()) \
+                <= spread:
+            dt = s["t"] - t_heal
+            return (dt <= within_s,
+                    f"converged {dt:.1f}s after {op!r} "
+                    f"(limit {within_s}s) at heights {last_by_node}")
+    return False, (f"never converged within spread {spread} after "
+                   f"{op!r} at t={t_heal:.1f} (baseline height {base})")
+
+
+# -- byzantine accountability -------------------------------------------------
+
+@oracle
+def evidence_committed(ev: Evidence,
+                       type: str = "tendermint/DuplicateVoteEvidence",
+                       nodes: str = "honest") -> Tuple[bool, str]:
+    """Every honest node committed at least one evidence item of
+    ``type`` — accountability actually landed on the chain, not just in
+    a mempool."""
+    names_ = ev.honest() if nodes == "honest" else list(nodes)
+    missing, found = [], {}
+    for n in names_:
+        items = [e for e in ev.committed_evidence(n)
+                 if e.get("type") == type]
+        if items:
+            found[n] = [e["height"] for e in items]
+        else:
+            missing.append(n)
+    return (not missing,
+            f"committed on {found}" if not missing else
+            f"no {type} on {missing} (found: {found})")
+
+
+@oracle
+def no_evidence(ev: Evidence) -> Tuple[bool, str]:
+    """Zero committed evidence anywhere — crash/restart and spam
+    scenarios must not manufacture double-signs."""
+    hits = {n: ev.committed_evidence(n) for n in ev.node_names()}
+    hits = {n: [f"{e['type']}@{e['height']}" for e in v]
+            for n, v in hits.items() if v}
+    return not hits, f"unexpected evidence: {hits}" if hits else \
+        "no evidence committed"
+
+
+# -- metrics / timeline -------------------------------------------------------
+
+@oracle
+def metric_min(ev: Evidence, name: str, min: float = 1.0,
+               node: Optional[str] = None, series: str = "",
+               nodes: str = "any") -> Tuple[bool, str]:
+    """A metric crossed a floor: on one named node, summed over the net
+    (nodes="sum"), on every honest node (nodes="each_honest"), or on at
+    least one node (default)."""
+    if node:
+        v = ev.metric(node, name, series)
+        return v >= min, f"{name}[{series}] on {node} = {v} (floor {min})"
+    if nodes == "sum":
+        v = ev.metric_total(name, series)
+        return v >= min, f"{name}[{series}] net total = {v} (floor {min})"
+    per = {n: ev.metric(n, name, series)
+           for n in (ev.honest() if nodes == "each_honest"
+                     else ev.node_names())}
+    if nodes == "each_honest":
+        low = {n: v for n, v in per.items() if v < min}
+        return not low, f"{name}[{series}] per honest node {per}" + \
+            (f"; below {min}: {sorted(low)}" if low else "")
+    ok = any(v >= min for v in per.values())
+    return ok, f"{name}[{series}] per node {per} (floor {min} on any)"
+
+
+@oracle
+def metric_max(ev: Evidence, name: str, max: float = 0.0,
+               node: Optional[str] = None, series: str = "") \
+        -> Tuple[bool, str]:
+    """A metric stayed under a ceiling (summed net-wide unless ``node``
+    pins it)."""
+    v = ev.metric(node, name, series) if node else \
+        ev.metric_total(name, series)
+    where = node or "net total"
+    return v <= max, f"{name}[{series}] {where} = {v} (ceiling {max})"
+
+
+@oracle
+def sidecar_fallbacks_cover_kills(ev: Evidence, min_per_kill: float = 1.0) \
+        -> Tuple[bool, str]:
+    """Every daemon kill forced at least ``min_per_kill`` penalty-free
+    in-process fallback lanes somewhere on the net — proof the clients
+    actually absorbed each outage instead of wedging."""
+    if ev.sidecar_kills == 0:
+        return False, "no sidecar kills executed"
+    got = ev.metric_total("tendermint_sidecar_client_fallback_total")
+    need = ev.sidecar_kills * min_per_kill
+    return (got >= need,
+            f"{got} fallback lanes vs {ev.sidecar_kills} kills "
+            f"(need >= {need})")
+
+
+@oracle
+def block_rate_stable(ev: Evidence, split_s: float,
+                      max_drop: float = 0.2) -> Tuple[bool, str]:
+    """Commit rate after ``split_s`` (when the adversarial phase is on)
+    is within ``max_drop`` of the rate before it — spam absorbed, not
+    amplified."""
+    before = ev.heights_at(split_s)
+    final = ev.final_heights()
+    h_split = _builtin_max(before.values(), default=-1)
+    h_end = _builtin_max(final.values(), default=-1)
+    ts = [s["t"] for s in ev.samples]
+    if h_split < 0 or not ts:
+        return False, "no samples before the split point"
+    t_end = _builtin_max(ts)
+    first = _builtin_min(ts)
+    if t_end <= split_s or split_s <= first:
+        return False, f"split {split_s}s outside run [{first:.1f},{t_end:.1f}]"
+    rate_before = h_split / split_s
+    rate_after = (h_end - h_split) / (t_end - split_s)
+    if rate_before <= 0:
+        return False, f"no progress before t={split_s}s"
+    ratio = rate_after / rate_before
+    return (ratio >= 1.0 - max_drop,
+            f"rate {rate_before:.2f} -> {rate_after:.2f} blocks/s "
+            f"(x{ratio:.2f}, floor x{1.0 - max_drop:.2f})")
+
+
+@oracle
+def timeline_saw(ev: Evidence, event: str, node: Optional[str] = None) \
+        -> Tuple[bool, str]:
+    """Some node's per-height timeline journal recorded ``event`` (e.g.
+    ``crypto.sidecar`` proves verifies actually rode the daemon)."""
+    targets = [node] if node else ev.node_names()
+    hits = [n for n in targets if event in ev.timeline_event_names(n)]
+    return (bool(hits),
+            f"{event!r} on {hits}" if hits else
+            f"{event!r} absent from timeline journals of {targets}")

@@ -1,0 +1,184 @@
+"""Scenario specs: the declarative surface of the harness.
+
+A ``ScenarioSpec`` is pure data — no sockets, no subprocesses — so specs
+can be linted offline (tools/check_scenarios.py), serialized into
+verdict reports, and diffed in review. The engine (engine.py) is the
+only interpreter.
+
+Conventions:
+
+- Validators are named ``v00``, ``v01``, ...; full nodes ``f00``, ...
+- ``FaultAction.at_s`` is seconds after net start; the engine executes
+  actions in at_s order off one clock, so a scenario replays the same
+  sequence every run (jittered sub-second scheduling noise aside).
+- A full node with ``start="manual"`` is provisioned but not started;
+  a ``start`` or ``join_statesync`` action brings it up mid-run.
+- ``oracles`` name predicates registered in scenario/oracles.py; unknown
+  names fail validation, not the run.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+# Every fault op the engine knows how to execute. check_scenarios lints
+# specs against this list so a typo'd op fails in CI, not mid-run.
+FAULT_OPS = (
+    "kill",             # SIGKILL the node (no restart; pair with "start")
+    "start",            # start a provisioned-but-down node
+    "restart",          # graceful stop + start
+    "sigterm",          # SIGTERM only (graceful shutdown, stays down)
+    "pause",            # SIGSTOP for params["for_s"] then SIGCONT
+    "amnesia",          # stop, wipe privval last-sign state, start
+    "partition",        # params["groups"]: blackhole between groups
+    "heal",             # clear every node's partition set
+    "shape",            # params["links"]: merge link-shape grammar string
+    "clear_shape",      # drop all shaping on params["nodes"] or everyone
+    "inject",           # faultinject script via unsafe_inject_fault
+    "clear_faults",     # clear faultinject scripts
+    "sidecar_kill",     # SIGKILL the shared verification daemon
+    "sidecar_term",     # SIGTERM the daemon (graceful drain path)
+    "sidecar_restart",  # start the daemon again on the same address
+    "tx",               # broadcast params["tx"] (str) via a live node
+    "add_validator",    # kvstore val-update tx: fresh key, params["power"]
+    "join_statesync",   # configure state_sync from live RPC, then start
+)
+
+
+@dataclass
+class FaultAction:
+    at_s: float
+    op: str
+    node: str = ""                       # target node name ("" = net-wide)
+    params: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"at_s": self.at_s, "op": self.op, "node": self.node,
+                "params": dict(self.params)}
+
+
+@dataclass
+class OracleSpec:
+    name: str
+    params: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "params": dict(self.params)}
+
+
+@dataclass
+class ScenarioSpec:
+    name: str
+    description: str = ""
+    validators: int = 4
+    full_nodes: int = 0
+    sidecar: bool = False                # shared batch-verify daemon
+    load_rate: float = 10.0              # tx/s offered while running
+    load_size: int = 32
+    duration_s: float = 20.0             # fault-timeline window
+    settle_s: float = 8.0                # post-load quiesce before judging
+    seed: int = 1                        # drives shaping/fuzz determinism
+    # "section.key" -> value config overrides applied to every node
+    config: dict = field(default_factory=dict)
+    # node name -> {"section.key": value} overrides (applied after config)
+    node_config: dict = field(default_factory=dict)
+    # [p2p] shape_links grammar applied to every node at startup
+    links: str = ""
+    # byzantine roster: node name -> {height: misbehavior name}
+    misbehaviors: dict = field(default_factory=dict)
+    faults: list = field(default_factory=list)     # [FaultAction]
+    oracles: list = field(default_factory=list)    # [OracleSpec]
+    timeout_s: float = 180.0             # hard ceiling on the whole run
+    key_type: str = "ed25519"
+    # full nodes start with the net by default; "manual" waits for a
+    # start/join_statesync action
+    full_node_start: str = "auto"
+
+    # -- naming --------------------------------------------------------------
+
+    def validator_names(self) -> list:
+        return [f"v{i:02d}" for i in range(self.validators)]
+
+    def full_node_names(self) -> list:
+        return [f"f{i:02d}" for i in range(self.full_nodes)]
+
+    def node_names(self) -> list:
+        return self.validator_names() + self.full_node_names()
+
+    def byzantine_nodes(self) -> list:
+        return sorted(self.misbehaviors)
+
+    def honest_nodes(self) -> list:
+        byz = set(self.misbehaviors)
+        return [n for n in self.node_names() if n not in byz]
+
+    # -- validation ----------------------------------------------------------
+
+    def validate(self) -> list:
+        """Offline lint: returns human-readable problems (empty = clean).
+        Referenced fault sites and oracle names are checked by the
+        callers that can import those registries (tools/check_scenarios
+        adds the cross-registry checks)."""
+        problems = []
+        if self.validators < 1:
+            problems.append(f"{self.name}: needs at least one validator")
+        names = set(self.node_names())
+        for node in self.misbehaviors:
+            if node not in names:
+                problems.append(
+                    f"{self.name}: byzantine roster names unknown node "
+                    f"{node!r}")
+        for node in self.node_config:
+            if node not in names:
+                problems.append(
+                    f"{self.name}: node_config names unknown node {node!r}")
+        for fa in self.faults:
+            if fa.op not in FAULT_OPS:
+                problems.append(
+                    f"{self.name}: fault at t={fa.at_s} uses unknown op "
+                    f"{fa.op!r}")
+            if fa.node and fa.node != "sidecar" and fa.node not in names:
+                problems.append(
+                    f"{self.name}: fault {fa.op!r} targets unknown node "
+                    f"{fa.node!r}")
+            if fa.op == "partition":
+                groups = fa.params.get("groups") or []
+                flat = [n for g in groups for n in g]
+                if len(groups) < 2:
+                    problems.append(
+                        f"{self.name}: partition needs >= 2 groups")
+                for n in flat:
+                    if n not in names:
+                        problems.append(
+                            f"{self.name}: partition group names unknown "
+                            f"node {n!r}")
+            if fa.at_s > self.duration_s:
+                problems.append(
+                    f"{self.name}: fault {fa.op!r} at t={fa.at_s} is past "
+                    f"duration_s={self.duration_s}")
+        if self.links:
+            try:
+                from tmtpu.p2p.shaping import parse_links
+                parse_links(self.links)
+            except ValueError as e:
+                problems.append(f"{self.name}: bad links spec: {e}")
+        if not self.oracles:
+            problems.append(f"{self.name}: no oracles — nothing to judge")
+        if any(f.op.startswith("sidecar") for f in self.faults) \
+                and not self.sidecar:
+            problems.append(
+                f"{self.name}: sidecar fault ops but sidecar=False")
+        return problems
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name, "description": self.description,
+            "validators": self.validators, "full_nodes": self.full_nodes,
+            "sidecar": self.sidecar, "load_rate": self.load_rate,
+            "duration_s": self.duration_s, "settle_s": self.settle_s,
+            "seed": self.seed, "links": self.links,
+            "misbehaviors": {n: dict(m) for n, m in
+                             self.misbehaviors.items()},
+            "faults": [f.to_dict() for f in self.faults],
+            "oracles": [o.to_dict() for o in self.oracles],
+        }

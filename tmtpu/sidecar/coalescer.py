@@ -86,6 +86,7 @@ class Coalescer:
         self._cond = threading.Condition(self._lock)
         self._queues: Dict[str, List[PendingRequest]] = {}
         self._queued_lanes = 0
+        self._inflight = 0            # batches cut but not yet answered
         self._dispatch_seq = 0
         self._mesh_dispatches = 0
         self._running = False
@@ -152,12 +153,34 @@ class Coalescer:
         with self._lock:
             return self._queued_lanes
 
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Block until every queued request has dispatched AND every cut
+        batch has been answered, or the timeout passes (returns False).
+        The dispatcher keeps running — graceful shutdown calls drain()
+        first (with admission already closed upstream), then stop()."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._running and (self._queued_lanes > 0
+                                     or self._inflight > 0):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                # bounded wait: the dispatcher notifies on completion,
+                # but a wedged engine must not turn drain into a hang
+                self._cond.wait(timeout=min(remaining, 0.25))
+            return self._queued_lanes == 0 and self._inflight == 0
+
     def snapshot(self) -> Dict:
         with self._lock:
             per_curve = {c: sum(len(r.items) for r in q)
                          for c, q in self._queues.items() if q}
             return {"queued_lanes": self._queued_lanes,
                     "queued_by_curve": per_curve,
+                    "inflight_batches": self._inflight,
                     "dispatches": self._dispatch_seq,
                     "mesh_dispatches": self._mesh_dispatches,
                     "scheduler": self.scheduler.snapshot()}
@@ -207,6 +230,7 @@ class Coalescer:
                         batch.append(r)
                         taken_lanes += len(r.items)
                     self._queued_lanes -= taken_lanes
+                    self._inflight += 1
                     from tmtpu.libs import metrics as _m
 
                     _m.sidecar_server_queue_lanes.set(self._queued_lanes)
@@ -214,7 +238,12 @@ class Coalescer:
                 if not self._running:
                     return
             if batch:
-                self._dispatch(batch[0].curve, batch)
+                try:
+                    self._dispatch(batch[0].curve, batch)
+                finally:
+                    with self._cond:
+                        self._inflight -= 1
+                        self._cond.notify_all()
 
     def _dispatch(self, curve: str, batch: List[PendingRequest]) -> None:
         from tmtpu.libs import metrics as _m

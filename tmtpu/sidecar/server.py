@@ -88,6 +88,7 @@ class SidecarServer:
         self._conns: set = set()
         self._conns_lock = threading.Lock()
         self._running = False
+        self._draining = False
         self._started_at = 0.0
         self._warmed = False
 
@@ -176,6 +177,28 @@ class SidecarServer:
         if self._health_laddr:
             self._start_health_http()
 
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Graceful-shutdown phase one (the SIGTERM path): stop taking
+        new work, finish what's in flight. Closes the listener, answers
+        every subsequent VerifyRequest with STATUS_OVERLOADED (clients
+        treat ONLY overload as penalty-free fallback — a drain must not
+        cost every connected node a breaker-worth of errors), and blocks
+        until the coalescer has dispatched its queue and answered every
+        in-flight joint batch, or the timeout passes (returns False).
+        Ping/Stats keep working throughout. Call stop() afterwards."""
+        self._draining = True
+        listener, self._listener = self._listener, None
+        if listener is not None:
+            try:
+                listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                listener.close()
+            except OSError:
+                pass
+        return self.coalescer.drain(timeout)
+
     def stop(self) -> None:
         self._running = False
         if self._listener is not None:
@@ -227,6 +250,7 @@ class SidecarServer:
             "addr": self.addr,
             "backend": self.backend_name(),
             "warmed": self._warmed,
+            "draining": self._draining,
             "uptime_s": round(max(0.0, time.monotonic() -
                                   self._started_at), 3),
             "connections": n_conns,
@@ -358,6 +382,11 @@ class SidecarServer:
                 request_id=req.request_id, status=status,
                 lane_count=len(req.lanes), error=error))
 
+        if self._draining:
+            # OVERLOADED, not SHUTTING_DOWN: the client's overload path
+            # falls back in-process without charging its breaker
+            reject(proto.STATUS_OVERLOADED, "daemon draining for shutdown")
+            return
         if req.curve not in KEY_TYPES:
             reject(proto.STATUS_BAD_REQUEST,
                    f"unknown curve {req.curve!r}")

@@ -18,6 +18,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from tmtpu.crypto import batch as crypto_batch
+from tmtpu.libs import metrics as _metrics
 from tmtpu.libs import timeline, trace
 from tmtpu.libs.bits import BitArray
 from tmtpu.types.block import BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT, \
@@ -179,6 +180,7 @@ class VoteSet:
                 applied_power = 0
                 for (i, vote, val, existing), ok in zip(prepared, mask):
                     if not ok:
+                        _metrics.consensus_invalid_votes.inc()
                         err = VoteError(
                             f"invalid signature from {vote.validator_address.hex()}"
                         )

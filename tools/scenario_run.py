@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Run named adversarial scenarios and report PASS/FAIL verdicts.
+
+The runner half of tmtpu/scenario/: builds the spec from the library,
+executes the fault timeline against a real subprocess localnet, judges
+the oracles from public RPC evidence, and persists verdict.json +
+samples.json under the outdir for post-mortems.
+
+    python tools/scenario_run.py split_brain
+    python tools/scenario_run.py --list
+    python tools/scenario_run.py all --outdir /tmp/scn
+    python tools/scenario_run.py fast --seed 7 --json
+
+Exit 0 = every requested scenario passed, 1 = any verdict failed,
+2 = usage error. ``fast`` expands to the tier-1 pair, ``all`` to the
+whole library.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tmtpu.scenario import library  # noqa: E402
+from tmtpu.scenario.engine import run_scenario  # noqa: E402
+
+
+def _expand(names):
+    out = []
+    for name in names:
+        if name == "all":
+            out.extend(library.names())
+        elif name == "fast":
+            out.extend(library.FAST)
+        else:
+            out.append(name)
+    # de-dup, keep order
+    seen = set()
+    return [n for n in out if not (n in seen or seen.add(n))]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="run declarative adversarial scenarios")
+    ap.add_argument("scenarios", nargs="*",
+                    help="scenario names, or 'all' / 'fast'")
+    ap.add_argument("--list", action="store_true",
+                    help="list known scenarios and exit")
+    ap.add_argument("--outdir", default="",
+                    help="evidence root (default: a fresh tmp dir)")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="override the spec seed")
+    ap.add_argument("--json", action="store_true",
+                    help="print full verdicts as JSON")
+    args = ap.parse_args()
+
+    if args.list or not args.scenarios:
+        for name in library.names():
+            spec = library.get(name)
+            fast = " [fast]" if name in library.FAST else ""
+            print(f"{name:22s} {spec.description}{fast}")
+        return 0 if args.list else 2
+
+    names = _expand(args.scenarios)
+    unknown = [n for n in names if n not in library.SCENARIOS]
+    if unknown:
+        print(f"unknown scenario(s): {unknown}; known: {library.names()}",
+              file=sys.stderr)
+        return 2
+
+    outroot = args.outdir or tempfile.mkdtemp(prefix="tmtpu-scenario-")
+    verdicts = []
+    for name in names:
+        spec = library.get(name)
+        if args.seed is not None:
+            spec.seed = args.seed
+        outdir = os.path.join(outroot, name)
+        t0 = time.monotonic()
+        try:
+            v = run_scenario(spec, outdir, log=lambda m: print(f"  {m}"))
+        except Exception as e:  # noqa: BLE001 — report, keep going
+            v = {"scenario": name, "pass": False, "oracles": [],
+                 "error": f"{type(e).__name__}: {e}",
+                 "wall_s": round(time.monotonic() - t0, 3),
+                 "outdir": outdir}
+            print(f"  engine error: {v['error']}", file=sys.stderr)
+        verdicts.append(v)
+
+    if args.json:
+        print(json.dumps(verdicts, indent=2, sort_keys=True))
+    else:
+        print()
+        for v in verdicts:
+            mark = "PASS" if v["pass"] else "FAIL"
+            oracles = v.get("oracles", [])
+            bad = [o["name"] for o in oracles if not o["pass"]]
+            extra = f" (failed: {', '.join(bad)})" if bad else ""
+            extra += f" — {v['error']}" if v.get("error") else ""
+            print(f"{mark} {v['scenario']:22s} "
+                  f"{len(oracles) - len(bad)}/{len(oracles)} oracles, "
+                  f"{v.get('wall_s', '?')}s{extra}")
+        print(f"\nevidence under {outroot}")
+    return 0 if all(v["pass"] for v in verdicts) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
