@@ -1,0 +1,95 @@
+"""Tier-1 wiring for the recv-thread blocking lint
+(tools/check_recv_sync.py): the tree must stay clean — no ABCI ``*_sync``
+call reachable from any Reactor's ``receive()`` — and the lint itself
+must detect direct, transitive, and whitelisted variants."""
+
+import textwrap
+
+from tools import check_recv_sync
+
+
+def test_tree_is_clean():
+    """No reactor in tmtpu/ performs a synchronous ABCI round trip on a
+    p2p recv thread (beyond the reviewed statesync whitelist)."""
+    assert check_recv_sync.check() == []
+
+
+def _lint_scratch(tmp_path, monkeypatch, source):
+    scratch = tmp_path / "scratch"
+    scratch.mkdir()
+    (scratch / "offender.py").write_text(textwrap.dedent(source))
+    monkeypatch.setattr(check_recv_sync, "REPO", str(tmp_path))
+    monkeypatch.setattr(check_recv_sync, "_SCAN", ("scratch",))
+    return check_recv_sync.check()
+
+
+def test_detects_direct_sync_call(tmp_path, monkeypatch):
+    findings = _lint_scratch(tmp_path, monkeypatch, """
+        class BadReactor(Reactor):
+            def receive(self, channel_id, peer, msg_bytes):
+                self.proxy_app.check_tx_sync(msg_bytes)
+        """)
+    assert any("BadReactor.receive::check_tx_sync" in f
+               for f in findings), findings
+
+
+def test_detects_transitive_sync_call(tmp_path, monkeypatch):
+    """A sync call buried two same-class helpers deep is still reachable
+    from the recv thread and must be flagged."""
+    findings = _lint_scratch(tmp_path, monkeypatch, """
+        class SneakyReactor(Reactor):
+            def receive(self, channel_id, peer, msg_bytes):
+                self._handle(msg_bytes)
+
+            def _handle(self, msg_bytes):
+                self._admit(msg_bytes)
+
+            def _admit(self, tx):
+                return self.mempool.proxy_app.commit_sync()
+        """)
+    assert any("SneakyReactor._admit::commit_sync" in f
+               for f in findings), findings
+
+
+def test_ignores_worker_thread_sync_calls(tmp_path, monkeypatch):
+    """Sync ABCI calls on methods NOT reachable from receive() (e.g. a
+    dedicated admit worker) are the sanctioned pattern and stay clean."""
+    findings = _lint_scratch(tmp_path, monkeypatch, """
+        class GoodReactor(Reactor):
+            def receive(self, channel_id, peer, msg_bytes):
+                self._rx_q.put_nowait(msg_bytes)
+
+            def _admit_routine(self):
+                while True:
+                    tx = self._rx_q.get()
+                    self.proxy_app.check_tx_sync(tx)
+        """)
+    assert findings == []
+
+
+def test_whitelist_suppresses_reviewed_site(tmp_path, monkeypatch):
+    findings = _lint_scratch(tmp_path, monkeypatch, """
+        class AllowedReactor(Reactor):
+            def receive(self, channel_id, peer, msg_bytes):
+                self.proxy_app.query_sync(msg_bytes)
+        """)
+    assert len(findings) == 1
+    site = "scratch/offender.py::AllowedReactor.receive::query_sync"
+    monkeypatch.setattr(check_recv_sync, "WHITELIST",
+                        check_recv_sync.WHITELIST | {site})
+    assert check_recv_sync.check() == []
+
+
+def test_non_reactor_classes_are_ignored(tmp_path, monkeypatch):
+    findings = _lint_scratch(tmp_path, monkeypatch, """
+        class Harness:
+            def receive(self, channel_id, peer, msg_bytes):
+                self.proxy_app.deliver_tx_sync(msg_bytes)
+        """)
+    assert findings == []
+
+
+def test_main_exit_codes(capsys):
+    assert check_recv_sync.main() == 0
+    out = capsys.readouterr().out
+    assert "no ABCI sync calls" in out

@@ -1,0 +1,347 @@
+"""Batched CheckTx admission + dedup-aware gossip (throughput tier).
+
+Exactness contract: a gather window folding N concurrent check_tx calls
+into one signature flush + one pipelined ABCI burst must resolve to
+exactly the per-tx verdicts the serial path gives — same codes, same
+residents, same raised errors. The gossip contract: a tx is never echoed
+to the peer that sent it, and never re-sent to a peer after the
+broadcast cursor restarts from the mempool front.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tmtpu.abci import types as abci
+from tmtpu.abci.client import LocalClient
+from tmtpu.crypto.ed25519 import gen_priv_key
+from tmtpu.mempool import signed_tx
+from tmtpu.mempool.clist_mempool import (
+    CListMempool, MempoolFullError, TxInMempoolError,
+)
+from tmtpu.mempool.priority_mempool import PriorityMempool
+from tmtpu.mempool.reactor import MempoolReactor, TxsPB
+
+
+class JudgeApp(abci.Application):
+    """CheckTx verdict encoded in the tx: ``rej:`` fails with code 7,
+    ``ok:pN:`` passes with priority N, anything else passes at priority
+    0. Records every tx the app actually saw, and can be armed to fail
+    specific txs on RECHECK only."""
+
+    def __init__(self):
+        self.seen = []
+        self.reject_on_recheck = set()
+        self.recheck_priority = {}
+        self.check_delay_s = 0.0
+
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        tx = bytes(req.tx)
+        self.seen.append(tx)
+        if self.check_delay_s:
+            time.sleep(self.check_delay_s)
+        if req.type == abci.CHECK_TX_TYPE_RECHECK:
+            if tx in self.reject_on_recheck:
+                return abci.ResponseCheckTx(code=9, log="recheck reject")
+            if tx in self.recheck_priority:
+                return abci.ResponseCheckTx(
+                    code=0, priority=self.recheck_priority[tx])
+        if tx.startswith(b"rej:"):
+            return abci.ResponseCheckTx(code=7, log="judged invalid")
+        pri = 0
+        if tx.startswith(b"ok:p"):
+            pri = int(tx.split(b":")[1][1:])
+        return abci.ResponseCheckTx(code=0, priority=pri)
+
+
+def _mk(mempool_cls, app=None, **kw):
+    app = app or JudgeApp()
+    kw.setdefault("batch_gather_wait_s", 0.01)
+    return mempool_cls(LocalClient(app), **kw), app
+
+
+def _submit_concurrent(mp, txs):
+    """Submit txs from concurrent threads (one gather window), returning
+    {tx: code or exception-name}."""
+    verdicts = {}
+    lock = threading.Lock()
+
+    def one(tx):
+        try:
+            mp.check_tx(tx, cb=lambda r, t=tx: verdicts.setdefault(t, r.code))
+        except Exception as e:
+            with lock:
+                verdicts[tx] = type(e).__name__
+
+    ts = [threading.Thread(target=one, args=(tx,)) for tx in txs]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return verdicts
+
+
+@pytest.mark.parametrize("mempool_cls", [CListMempool, PriorityMempool])
+def test_batch_matches_serial_verdicts(mempool_cls):
+    """Mixed valid/invalid txs through one gather == serial verdicts."""
+    priv = gen_priv_key()
+    txs = [b"ok:a", b"rej:b", b"ok:c", b"rej:d", b"ok:e",
+           signed_tx.encode(b"ok:signed", priv)]
+    bad_sig = bytearray(signed_tx.encode(b"ok:tamper", priv))
+    bad_sig[-1] ^= 0xFF
+    txs.append(bytes(bad_sig))
+
+    serial_mp, _ = _mk(mempool_cls, batch_check=False,
+                       verify_signatures=False)
+    serial = {}
+    for tx in txs:
+        if signed_tx.is_signed(tx):
+            # serial reference for envelopes: verify one-by-one
+            p = signed_tx.parse(tx)
+            if p is None or not p[0].verify_signature(
+                    signed_tx.sign_bytes(p[2]), p[1]):
+                serial[tx] = 1
+                continue
+        serial_mp.check_tx(tx, cb=lambda r, t=tx: serial.setdefault(t, r.code))
+
+    batched_mp, _ = _mk(mempool_cls)
+    batched = _submit_concurrent(batched_mp, txs)
+
+    assert batched == serial
+    assert batched_mp.size() == serial_mp.size() == 4  # a, c, e, signed
+
+
+@pytest.mark.parametrize("mempool_cls", [CListMempool, PriorityMempool])
+def test_sig_rejects_never_reach_the_app(mempool_cls):
+    priv = gen_priv_key()
+    bad = bytearray(signed_tx.encode(b"ok:x", priv))
+    bad[40] ^= 0x01  # corrupt the pubkey region
+    malformed = signed_tx.MAGIC + b"\x01tiny"
+    mp, app = _mk(mempool_cls)
+    verdicts = _submit_concurrent(mp, [bytes(bad), malformed, b"ok:fine"])
+    assert verdicts[bytes(bad)] == 1
+    assert verdicts[malformed] == 1
+    assert verdicts[b"ok:fine"] == 0
+    assert app.seen == [b"ok:fine"]  # rejected envelopes skipped ABCI
+
+
+@pytest.mark.parametrize("mempool_cls", [CListMempool, PriorityMempool])
+def test_sig_screen_holds_with_batching_disabled(mempool_cls):
+    """batch_check=False must not silently drop the envelope contract:
+    the legacy sync path screens each signature individually."""
+    priv = gen_priv_key()
+    bad = bytearray(signed_tx.encode(b"ok:x", priv))
+    bad[-1] ^= 0xFF
+    mp, app = _mk(mempool_cls, batch_check=False)
+    codes = {}
+    mp.check_tx(bytes(bad), cb=lambda r: codes.setdefault("bad", r.code))
+    mp.check_tx(signed_tx.encode(b"ok:good", priv),
+                cb=lambda r: codes.setdefault("good", r.code))
+    assert codes == {"bad": 1, "good": 0}
+    assert mp.size() == 1
+    assert app.seen == [signed_tx.encode(b"ok:good", priv)]
+
+
+@pytest.mark.parametrize("mempool_cls", [CListMempool, PriorityMempool])
+def test_duplicate_still_raises_synchronously(mempool_cls):
+    mp, _ = _mk(mempool_cls)
+    mp.check_tx(b"ok:dup")
+    with pytest.raises(TxInMempoolError):
+        mp.check_tx(b"ok:dup")
+    with pytest.raises(TxInMempoolError):
+        mp.check_tx_nowait(b"ok:dup")
+
+
+def test_check_tx_nowait_does_not_block_on_gather_or_app():
+    """The reactor's admission surface: enqueue-and-return even when the
+    app is slow and the gather window is long."""
+    app = JudgeApp()
+    app.check_delay_s = 0.2
+    mp, _ = _mk(CListMempool, app=app, batch_gather_wait_s=0.1)
+    t0 = time.monotonic()
+    mp.check_tx_nowait(b"ok:slow")
+    took = time.monotonic() - t0
+    assert took < 0.05, f"check_tx_nowait blocked {took:.3f}s"
+    deadline = time.monotonic() + 5
+    while mp.size() == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert mp.size() == 1
+
+
+@pytest.mark.parametrize("mempool_cls", [CListMempool, PriorityMempool])
+def test_committed_while_in_flight_is_not_resurrected(mempool_cls):
+    """A tx that commits while its admission is still in the gather/ABCI
+    pipeline must not reappear in the mempool afterwards — resurrection
+    gets it proposed (and applied) a second time."""
+    app = JudgeApp()
+    app.check_delay_s = 0.2
+    mp, _ = _mk(mempool_cls, app=app, batch_gather_wait_s=0.01)
+    mp.check_tx_nowait(b"ok:race")
+    time.sleep(0.05)  # admission is now inside the slow CheckTx call
+    mp.lock()
+    try:
+        mp.update(1, [b"ok:race"], [abci.ResponseDeliverTx(code=0)])
+    finally:
+        mp.unlock()
+    time.sleep(0.4)  # let the in-flight admission finish applying
+    assert mp.size() == 0
+    assert mp.reap_max_txs(-1) == []
+
+
+def test_full_mempool_raises_synchronously_v0():
+    mp, _ = _mk(CListMempool, max_txs=2)
+    mp.check_tx(b"ok:1")
+    mp.check_tx(b"ok:2")
+    with pytest.raises(MempoolFullError):
+        mp.check_tx(b"ok:3")
+
+
+def test_priority_eviction_error_through_batch_path():
+    """v1 fullness resolves inside the gather worker (_add eviction);
+    the sync caller still sees MempoolFullError."""
+    mp, _ = _mk(PriorityMempool, max_txs=2)
+    mp.check_tx(b"ok:p5:a")
+    mp.check_tx(b"ok:p5:b")
+    with pytest.raises(MempoolFullError):
+        mp.check_tx(b"ok:p1:c")  # lower priority: no victim
+    mp.check_tx(b"ok:p9:d")  # higher priority: evicts
+    assert mp.size() == 2
+
+
+@pytest.mark.parametrize("mempool_cls", [CListMempool, PriorityMempool])
+def test_recheck_batch_removes_invalid(mempool_cls):
+    """update() recheck runs as one pipelined batch and must drop
+    exactly the txs the app now rejects."""
+    mp, app = _mk(mempool_cls)
+    for tx in (b"ok:keep1", b"ok:drop", b"ok:keep2", b"ok:committed"):
+        mp.check_tx(tx)
+    assert mp.size() == 4
+    app.reject_on_recheck.add(b"ok:drop")
+    mp.lock()
+    try:
+        mp.update(1, [b"ok:committed"], [abci.ResponseDeliverTx(code=0)])
+    finally:
+        mp.unlock()
+    deadline = time.monotonic() + 5
+    while mp.size() != 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert sorted(mp.reap_max_txs(-1)) == [b"ok:keep1", b"ok:keep2"]
+
+
+def test_recheck_batch_updates_priority_v1():
+    mp, app = _mk(PriorityMempool)
+    mp.check_tx(b"ok:p1:low")
+    mp.check_tx(b"ok:p5:high")
+    mp.check_tx(b"ok:gone")
+    app.recheck_priority[b"ok:p1:low"] = 50  # promoted on recheck
+    mp.lock()
+    try:
+        mp.update(1, [b"ok:gone"], [abci.ResponseDeliverTx(code=0)])
+    finally:
+        mp.unlock()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if mp.reap_max_txs(-1) == [b"ok:p1:low", b"ok:p5:high"]:
+            break
+        time.sleep(0.01)
+    assert mp.reap_max_txs(-1) == [b"ok:p1:low", b"ok:p5:high"]
+
+
+# --------------------------------------------------------------- gossip
+
+
+class FakePeer:
+    def __init__(self, node_id: str):
+        self.node_id = node_id
+        self.sent = []  # flattened txs handed to our send queue
+        self._running = True
+
+    def is_running(self) -> bool:
+        return self._running
+
+    def has_channel(self, channel_id: int) -> bool:
+        return True
+
+    def send(self, channel_id: int, data: bytes) -> bool:
+        self.sent.extend(bytes(t) for t in TxsPB.decode(data).txs)
+        return True
+
+
+def _mk_reactor():
+    mp, app = _mk(CListMempool, batch_gather_wait_s=0.002)
+    reactor = MempoolReactor(mp, broadcast=True, seen_cache=128)
+    reactor.on_start()
+    return reactor, mp, app
+
+
+def _wait(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while not cond() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert cond()
+
+
+def test_gossip_no_echo_to_sender():
+    reactor, mp, _ = _mk_reactor()
+    sender, other = FakePeer("peer-sender"), FakePeer("peer-other")
+    reactor.add_peer(sender)
+    reactor.add_peer(other)
+    try:
+        reactor.receive(0x30, sender, TxsPB(txs=[b"ok:echo"]).encode())
+        _wait(lambda: mp.size() == 1)
+        _wait(lambda: b"ok:echo" in other.sent)
+        time.sleep(0.3)  # several cursor cycles
+        assert b"ok:echo" not in sender.sent
+    finally:
+        reactor.on_stop()
+
+
+def test_gossip_no_resend_after_cursor_restart():
+    """Committing the tail tx resets the broadcast cursor to the mempool
+    front; the per-peer seen-cache must keep already-delivered txs from
+    going out again."""
+    reactor, mp, _ = _mk_reactor()
+    peer = FakePeer("peer-x")
+    reactor.add_peer(peer)
+    try:
+        for tx in (b"ok:t1", b"ok:t2", b"ok:t3"):
+            mp.check_tx(tx)
+        _wait(lambda: len(peer.sent) >= 3)
+        mp.lock()
+        try:
+            # removing the tail makes the cursor restart from the front
+            mp.update(1, [b"ok:t3"], [abci.ResponseDeliverTx(code=0)])
+        finally:
+            mp.unlock()
+        time.sleep(0.5)  # plenty of restart cycles
+        for tx in (b"ok:t1", b"ok:t2", b"ok:t3"):
+            assert peer.sent.count(tx) == 1, peer.sent
+    finally:
+        reactor.on_stop()
+
+
+def test_gossip_seen_cache_cleared_on_remove_peer():
+    reactor, mp, _ = _mk_reactor()
+    peer = FakePeer("peer-y")
+    try:
+        reactor.receive(0x30, peer, TxsPB(txs=[b"ok:z"]).encode())
+        assert peer.node_id in reactor._seen
+        reactor.remove_peer(peer, "bye")
+        assert peer.node_id not in reactor._seen
+    finally:
+        reactor.on_stop()
+
+
+def test_gossip_rx_dup_marks_sender():
+    """A tx received again from a second peer marks that peer as a
+    sender (so broadcast skips it) instead of re-admitting."""
+    reactor, mp, _ = _mk_reactor()
+    a, b = FakePeer("peer-a"), FakePeer("peer-b")
+    try:
+        reactor.receive(0x30, a, TxsPB(txs=[b"ok:w"]).encode())
+        _wait(lambda: mp.size() == 1)
+        reactor.receive(0x30, b, TxsPB(txs=[b"ok:w"]).encode())
+        _wait(lambda: {"peer-a", "peer-b"} <= mp.senders(b"ok:w"))
+    finally:
+        reactor.on_stop()

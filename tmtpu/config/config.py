@@ -31,6 +31,15 @@ class ConsensusConfig:
     create_empty_blocks_interval_ns: int = 0
     double_sign_check_height: int = 0
     wal_file: str = "data/cs.wal/wal"
+    # async ApplyBlock overlap: run the block's ABCI execution (DeliverTx
+    # round trips + app Commit) on a dedicated executor thread so the
+    # consensus receive loop keeps draining next-height proposal/vote
+    # gossip instead of stalling for the whole block. The WAL ENDHEIGHT
+    # record is written BEFORE the handoff, so a crash mid-apply replays
+    # through the standard handshake path (identical to the serial
+    # executor's post_endheight crash window). Off by default; the
+    # throughput tier (tools/localnet_load_ab.py) turns it on.
+    async_exec: bool = False
 
     def propose_timeout(self, round: int) -> int:
         return self.timeout_propose_ns + self.timeout_propose_delta_ns * round
@@ -69,6 +78,23 @@ class MempoolConfig:
     # than EITHER axis is purged on update; 0 disables
     ttl_num_blocks: int = 0
     ttl_duration_ns: int = 0
+    # batched CheckTx: concurrent check_tx calls gather for up to
+    # batch_gather_wait before resolving as ONE pass — signed-tx
+    # envelopes verify through a single crypto/batch.py flush
+    # (sigcache-fronted, breaker-protected) and the surviving ABCI
+    # CheckTx round trips are pipelined instead of serialized. Off =
+    # the legacy one-sync-round-trip-per-tx path.
+    batch_check: bool = True
+    batch_gather_wait_ns: int = 2 * MS
+    batch_max_txs: int = 256
+    # verify mempool/signed_tx.py envelopes at admission (rejects bad
+    # signatures before they cost an ABCI round trip); plain txs are
+    # unaffected either way
+    verify_signatures: bool = True
+    # per-peer seen-tx LRU for gossip dedup: a tx is never echoed to a
+    # peer that sent it OR already received it from us (entries per
+    # peer; 0 disables the LRU and falls back to senders-only dedup)
+    gossip_seen_cache: int = 4096
 
 
 @dataclass

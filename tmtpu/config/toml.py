@@ -136,6 +136,12 @@ def validate(cfg: Config) -> None:
         raise ValueError("mempool.size must be positive")
     if cfg.mempool.version not in ("v0", "v1"):
         raise ValueError(f"unknown mempool.version {cfg.mempool.version!r}")
+    if cfg.mempool.batch_gather_wait_ns < 0:
+        raise ValueError("mempool.batch_gather_wait_ns cannot be negative")
+    if cfg.mempool.batch_max_txs < 1:
+        raise ValueError("mempool.batch_max_txs must be >= 1")
+    if cfg.mempool.gossip_seen_cache < 0:
+        raise ValueError("mempool.gossip_seen_cache cannot be negative")
     if cfg.p2p.max_num_inbound_peers < 0 or \
             cfg.p2p.max_num_outbound_peers < 0:
         raise ValueError("p2p peer limits cannot be negative")

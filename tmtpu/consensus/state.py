@@ -90,6 +90,22 @@ class RetrySignMessage:
         self.parts = parts
 
 
+class ApplyBlockDoneMessage:
+    """Internal: the async ApplyBlock worker finished height ``height``
+    (consensus.async_exec). Carries the executor's (new_state,
+    retain_height) result or the error that halted it. Never hits the
+    WAL or the wire — on crash-recovery the WAL's ENDHEIGHT barrier plus
+    handshake replay reconstruct the apply instead."""
+
+    __slots__ = ("height", "block", "result", "error")
+
+    def __init__(self, height: int, block, result, error):
+        self.height = height
+        self.block = block
+        self.result = result
+        self.error = error
+
+
 class ConsensusState(BaseService):
     def __init__(self, config: ConsensusConfig, state, block_exec,
                  block_store, mempool=None, evidence_pool=None,
@@ -138,6 +154,11 @@ class ConsensusState(BaseService):
         self.on_own_proposal = None  # callable(Proposal, PartSet)
         # new-height listeners (e.g. tests waiting for commits)
         self._height_cv = threading.Condition(self._mtx)
+        # async ApplyBlock overlap (config.async_exec): True between the
+        # handoff to the executor thread and the done-message draining
+        # back through the receive loop; finalize paths no-op while set
+        self._apply_inflight = False
+        self._apply_started_s = 0.0
 
         self.update_to_state(state)
         self._sync_timeout_commit = True
@@ -499,6 +520,8 @@ class ConsensusState(BaseService):
                     self._set_proposal_safe(mi.msg.proposal)
                 elif isinstance(mi.msg, BlockPartMessage):
                     self._add_proposal_block_part(mi.msg, mi.peer_id)
+                elif isinstance(mi.msg, ApplyBlockDoneMessage):
+                    self._finalize_commit_resume(mi.msg)
                 elif isinstance(mi.msg, RetrySignMessage):
                     m = mi.msg
                     # only while the round that wanted the vote is current
@@ -813,7 +836,7 @@ class ConsensusState(BaseService):
 
     def _try_finalize_commit(self, height: int) -> None:
         rs = self.rs
-        if rs.height != height:
+        if rs.height != height or self._apply_inflight:
             return
         precommits = rs.votes.precommits(rs.commit_round)
         if precommits is None:
@@ -833,7 +856,8 @@ class ConsensusState(BaseService):
         from tmtpu.libs import fail
 
         rs = self.rs
-        if rs.height != height or rs.step != STEP_COMMIT:
+        if rs.height != height or rs.step != STEP_COMMIT or \
+                self._apply_inflight:
             return
         precommits = rs.votes.precommits(rs.commit_round)
         block_id, _ = precommits.two_thirds_majority()
@@ -849,8 +873,55 @@ class ConsensusState(BaseService):
             self.wal.write_end_height(height)
         # 2: ENDHEIGHT written, app not yet committed
         fail.fail_point("cs.finalize.post_endheight")
+        if self.config.async_exec and not self.replay_mode and \
+                self.wal is not None:
+            # async ApplyBlock overlap: the WAL's ENDHEIGHT is the commit
+            # barrier (a crash anywhere past it replays block H through
+            # the handshake, identical to a serial post_endheight crash),
+            # so the ABCI execution can run on the executor thread while
+            # THIS loop keeps draining next-height proposal/vote gossip.
+            # rs stays parked at STEP_COMMIT for height H until the
+            # done-message arrives — nothing signs, so nothing can
+            # double-sign; finalize re-entry is fenced by _apply_inflight
+            self._apply_inflight = True
+            self._apply_started_s = time.monotonic()
+            fail.fail_point("cs.finalize.async_handoff")
+
+            def _done(result, error, _h=height, _blk=block):
+                self.internal_msg_queue.put(MsgInfo(
+                    ApplyBlockDoneMessage(_h, _blk, result, error), ""))
+
+            self.block_exec.apply_block_async(self.state, block_id, block,
+                                              _done)
+            return
         new_state, retain_height = self.block_exec.apply_block(
             self.state, block_id, block)
+        self._finalize_commit_tail(height, block, new_state, retain_height)
+
+    def _finalize_commit_resume(self, m: ApplyBlockDoneMessage) -> None:
+        """Second half of an async _finalize_commit, dispatched from the
+        receive loop when the executor's done-message drains."""
+        from tmtpu.libs import fail, metrics as _m
+
+        if not self._apply_inflight or self.rs.height != m.height:
+            return  # stale (e.g. duplicate after a test reset)
+        self._apply_inflight = False
+        fail.fail_point("cs.finalize.pre_resume")
+        if m.error is not None:
+            # same contract as a serial apply_block raise: consensus halts
+            # (receive loop catches, syncs the WAL, exits)
+            raise m.error
+        _m.consensus_async_apply_overlap.observe(
+            time.monotonic() - self._apply_started_s)
+        new_state, retain_height = m.result
+        self._finalize_commit_tail(m.height, m.block, new_state,
+                                   retain_height)
+
+    def _finalize_commit_tail(self, height: int, block, new_state,
+                              retain_height: int) -> None:
+        from tmtpu.libs import fail
+
+        rs = self.rs
         fail.fail_point("cs.finalize.post_apply")  # 3: app committed
         if retain_height > 0:
             try:

@@ -299,6 +299,33 @@ p2p_shape_delay = DEFAULT.histogram(
     buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.2, 0.5, 1, 2))
 mempool_size = DEFAULT.gauge("mempool", "size",
                              "Number of uncommitted txs")
+# throughput tier: batched admission + dedup-aware gossip
+mempool_batch_flushes = DEFAULT.counter(
+    "mempool", "batch_flushes_total",
+    "CheckTx gather windows flushed (one pipelined ABCI burst each)")
+mempool_batch_txs = DEFAULT.counter(
+    "mempool", "batch_txs_total",
+    "Txs admitted through batched CheckTx gather windows")
+mempool_sig_rejects = DEFAULT.counter(
+    "mempool", "sig_rejects_total",
+    "Signed-tx envelopes rejected at admission (malformed or bad "
+    "signature) before any ABCI round trip")
+mempool_gossip_dedup_skips = DEFAULT.counter(
+    "mempool", "gossip_dedup_skips_total",
+    "Txs NOT echoed to a peer because its seen-cache (or the sender "
+    "set) already covers them")
+mempool_gossip_rx_dups = DEFAULT.counter(
+    "mempool", "gossip_rx_dups_total",
+    "Received gossip txs already resident in the mempool cache "
+    "(wasted bandwidth a peer's dedup should have prevented)")
+# async ApplyBlock overlap: how much execution time ran concurrently
+# with next-height gossip intake instead of blocking the state machine
+consensus_async_apply_overlap = DEFAULT.histogram(
+    "consensus", "async_apply_overlap_seconds",
+    "Wall time ApplyBlock spent on the async executor while the "
+    "consensus receive loop kept draining gossip",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             0.5, 1.0, 2.5))
 
 
 # --- the node health engine metric set (libs/watchdog.py) -------------------

@@ -7,11 +7,22 @@ reference's core structure: broadcast routines hold a CElement cursor and
 block on ``next_wait`` — no rescans, no mempool-lock contention with
 CheckTx/reap on the hot path. A hash→element map provides O(1) dedup and
 removal.
+
+Throughput tier: admission is BATCHED. Concurrent ``check_tx`` calls
+gather for a bounded window on a dedicated worker, signed-tx envelopes
+(``mempool/signed_tx.py``) verify as ONE ``crypto/batch.py`` flush
+(sigcache-fronted, breaker-protected, sidecar/mesh-capable), and the
+surviving ABCI CheckTx round trips are pipelined through
+``check_tx_batch_async`` + one flush instead of one synchronous round
+trip per tx. ``check_tx_nowait`` is the enqueue-and-return surface the
+p2p reactor uses so recv-side admission never blocks on the window.
 """
 
 from __future__ import annotations
 
+import queue
 import threading
+import time
 from collections import OrderedDict
 from typing import Callable, List, Optional
 
@@ -50,6 +61,29 @@ class TxCache:
     def remove(self, tx: bytes) -> None:
         with self._lock:
             self._map.pop(tmhash.sum(tx), None)
+
+
+def pipelined_check_tx(proxy_app, reqs: List[abci.RequestCheckTx]
+                       ) -> List[abci.ResponseCheckTx]:
+    """N CheckTx round trips as one pipelined burst: enqueue every
+    request, flush once, wait. Clients without the batch surface (e.g.
+    gRPC) fall back to serial sync calls."""
+    if not reqs:
+        return []
+    batch = getattr(proxy_app, "check_tx_batch_async", None)
+    if batch is None:
+        return [proxy_app.check_tx_sync(r) for r in reqs]
+    reqres = batch(reqs)
+    proxy_app.flush_sync()
+    out = []
+    for rr in reqres:
+        res = rr.wait(timeout=60.0).check_tx
+        if res is None:
+            from tmtpu.abci.client import ClientError
+
+            raise ClientError("CheckTx response missing (app conn failed)")
+        out.append(res)
+    return out
 
 
 class AsyncRecheckMixin:
@@ -95,11 +129,263 @@ class AsyncRecheckMixin:
         raise NotImplementedError
 
 
-class CListMempool(AsyncRecheckMixin):
+class _AdmitEntry:
+    __slots__ = ("tx", "tx_info", "cb", "done", "result", "error",
+                 "sig_failed")
+
+    def __init__(self, tx: bytes, tx_info: dict, cb: Optional[Callable]):
+        self.tx = tx
+        self.tx_info = tx_info
+        self.cb = cb
+        self.done = threading.Event()
+        self.result: Optional[abci.ResponseCheckTx] = None
+        self.error: Optional[BaseException] = None
+        self.sig_failed = False
+
+
+class BatchCheckMixin:
+    """Gather-window batched admission shared by both mempool versions.
+
+    Subclasses provide ``_precheck_admit(tx)`` (synchronous full/dup/
+    pre_check screens — these raise on the caller's thread, exactly the
+    legacy contract) and ``_apply_check_tx_result(tx, res, tx_info)``
+    (mempool bookkeeping for one resolved CheckTx). The worker is lazy:
+    no thread exists until the first batched check_tx, and it retires
+    after ~30s idle so short-lived test mempools don't leak pollers."""
+
+    def _init_batch_check(self, batch_check: bool, gather_wait_s: float,
+                          max_batch: int, verify_signatures: bool) -> None:
+        self.batch_check = bool(batch_check)
+        self.verify_signatures = bool(verify_signatures)
+        self._gather_wait_s = max(0.0, float(gather_wait_s))
+        self._batch_max_txs = max(1, int(max_batch))
+        self._admit_q: "queue.Queue[_AdmitEntry]" = queue.Queue()
+        self._admit_running = False
+        self._admit_mtx = threading.Lock()
+        # keys of recently committed txs: an admission that was in flight
+        # (gather window, ABCI queue) when its tx committed must NOT be
+        # inserted afterwards — the tx is in a block, and resurrecting it
+        # gets it proposed (and applied) a second time. The tx cache alone
+        # can't tell "seen because admission started" from "seen because
+        # committed", so update() records commits here and the insert
+        # paths drop late arrivals. Bounded LRU, caller holds self._lock.
+        self._committed_keys: "OrderedDict[bytes, None]" = OrderedDict()
+        self._committed_cap = 16384
+
+    # -- public admission surface -------------------------------------------
+
+    def check_tx(self, tx: bytes, cb: Optional[Callable] = None,
+                 tx_info: Optional[dict] = None) -> None:
+        """Admit one tx, blocking until its CheckTx verdict is applied
+        (the RPC/broadcast surface). Raises dup/full/pre-check errors
+        synchronously, like the reference."""
+        tx = bytes(tx)
+        self._precheck_admit(tx)
+        if not self.batch_check:
+            if self.verify_signatures and not self._verify_tx_signature(tx):
+                from tmtpu.libs import metrics as _m
+
+                _m.mempool_sig_rejects.inc()
+                res = abci.ResponseCheckTx(code=1, log="invalid signature")
+                self._apply_check_tx_result(tx, res, tx_info or {})
+                if cb is not None:
+                    cb(res)
+                return
+            res = self.proxy_app.check_tx_sync(abci.RequestCheckTx(
+                tx=tx, type=abci.CHECK_TX_TYPE_NEW))
+            self._apply_check_tx_result(tx, res, tx_info or {})
+            if cb is not None:
+                cb(res)
+            return
+        entry = _AdmitEntry(tx, tx_info or {}, cb)
+        self._enqueue_admit(entry)
+        if not entry.done.wait(timeout=60.0):
+            from tmtpu.abci.client import ClientError
+
+            raise ClientError("batched CheckTx timed out")
+        if entry.error is not None:
+            raise entry.error
+
+    def check_tx_nowait(self, tx: bytes, cb: Optional[Callable] = None,
+                        tx_info: Optional[dict] = None) -> None:
+        """Enqueue-and-return admission for recv threads: the cheap
+        synchronous screens (dup/full/pre-check) still raise here, but
+        the ABCI round trip and any signature verification happen on the
+        gather worker — the caller NEVER blocks on the gather window or
+        the app conn."""
+        tx = bytes(tx)
+        self._precheck_admit(tx)
+        self._enqueue_admit(_AdmitEntry(tx, tx_info or {}, cb))
+
+    def _note_committed(self, key: bytes) -> None:
+        self._committed_keys[key] = None
+        self._committed_keys.move_to_end(key)
+        while len(self._committed_keys) > self._committed_cap:
+            self._committed_keys.popitem(last=False)
+
+    def _already_committed(self, key: bytes) -> bool:
+        return key in self._committed_keys
+
+    def _verify_tx_signature(self, tx: bytes) -> bool:
+        """Per-tx (unbatched) envelope screen for the legacy sync path —
+        the signature contract must hold whether or not batching is on;
+        only the cost profile may differ (one lane per tx here vs one
+        flush per gather on the worker)."""
+        from tmtpu.crypto import batch as _crypto_batch
+        from tmtpu.mempool import signed_tx as _stx
+
+        if not _stx.is_signed(tx):
+            return True
+        parsed = _stx.parse(tx)
+        if parsed is None:
+            return False
+        pub, sig, payload = parsed
+        return _crypto_batch.verify_one(pub, _stx.sign_bytes(payload), sig)
+
+    # -- gather worker -------------------------------------------------------
+
+    def _enqueue_admit(self, entry: _AdmitEntry) -> None:
+        self._admit_q.put(entry)
+        with self._admit_mtx:
+            if not self._admit_running:
+                self._admit_running = True
+                threading.Thread(target=self._admit_worker, daemon=True,
+                                 name="mempool-batch-check").start()
+
+    def _admit_worker(self) -> None:
+        idle_deadline = time.monotonic() + 30.0
+        while True:
+            try:
+                first = self._admit_q.get(timeout=0.5)
+            except queue.Empty:
+                if time.monotonic() >= idle_deadline:
+                    with self._admit_mtx:
+                        if self._admit_q.empty():
+                            self._admit_running = False
+                            return
+                continue
+            idle_deadline = time.monotonic() + 30.0
+            batch = [first]
+            if self.batch_check:
+                self._gather(batch)
+            try:
+                self._process_admit_batch(batch)
+            except Exception as e:  # app conn gone / client error
+                for en in batch:
+                    if not en.done.is_set():
+                        if en.error is None and en.result is None:
+                            en.error = e
+                        en.done.set()
+
+    def _gather(self, batch: List[_AdmitEntry]) -> None:
+        """Linger a bounded few ms so concurrent submitters share one
+        signature flush and one pipelined ABCI burst. The adaptive
+        crypto scheduler can extend the configured floor when device
+        rate×RTT data says fuller flushes amortize better (it reports
+        0.0 on CPU-only nodes, keeping the config window exact)."""
+        from tmtpu.crypto import batch as _crypto_batch
+
+        wait = max(self._gather_wait_s,
+                   _crypto_batch.SCHEDULER.gather_wait_s(len(batch)))
+        deadline = time.monotonic() + wait
+        while len(batch) < self._batch_max_txs:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                try:
+                    batch.append(self._admit_q.get_nowait())
+                except queue.Empty:
+                    break
+                continue
+            try:
+                batch.append(self._admit_q.get(timeout=left))
+            except queue.Empty:
+                break
+
+    def _process_admit_batch(self, batch: List[_AdmitEntry]) -> None:
+        from tmtpu.libs import metrics as _m
+
+        # 1) signature screen: every signed-tx envelope in the gather
+        #    resolves through ONE batch-verifier flush — sigcache hits
+        #    cost no lane, duplicates collapse, breakers guard the
+        #    device path — and failures never reach the app at all
+        if self.verify_signatures:
+            from tmtpu.mempool import signed_tx as _stx
+
+            lanes: List[_AdmitEntry] = []
+            verifier = None
+            for en in batch:
+                if not _stx.is_signed(en.tx):
+                    continue
+                parsed = _stx.parse(en.tx)
+                if parsed is None:
+                    en.sig_failed = True
+                    continue
+                pub, sig, payload = parsed
+                if verifier is None:
+                    from tmtpu.crypto import batch as _crypto_batch
+
+                    verifier = _crypto_batch.new_batch_verifier()
+                verifier.add(pub, _stx.sign_bytes(payload), sig)
+                lanes.append(en)
+            if lanes:
+                _ok, mask = verifier.verify()
+                for en, ok in zip(lanes, mask):
+                    if not ok:
+                        en.sig_failed = True
+        survivors: List[_AdmitEntry] = []
+        for en in batch:
+            if en.sig_failed:
+                _m.mempool_sig_rejects.inc()
+                self._finish_admit(en, abci.ResponseCheckTx(
+                    code=1, log="invalid signature"))
+            else:
+                survivors.append(en)
+        if not survivors:
+            return
+        # 2) pipelined ABCI: enqueue all CheckTx requests, one flush
+        _m.mempool_batch_flushes.inc()
+        _m.mempool_batch_txs.inc(len(survivors))
+        responses = pipelined_check_tx(self.proxy_app, [
+            abci.RequestCheckTx(tx=en.tx, type=abci.CHECK_TX_TYPE_NEW)
+            for en in survivors])
+        for en, res in zip(survivors, responses):
+            self._finish_admit(en, res)
+
+    def _finish_admit(self, en: _AdmitEntry,
+                      res: abci.ResponseCheckTx) -> None:
+        try:
+            self._apply_check_tx_result(en.tx, res, en.tx_info)
+        except Exception as e:  # e.g. v1 eviction failure
+            en.error = e
+            en.done.set()
+            return
+        en.result = res
+        if en.cb is not None:
+            try:
+                en.cb(res)
+            except Exception:
+                pass  # a callback error must not poison the batch
+        en.done.set()
+
+    # -- subclass hooks ------------------------------------------------------
+
+    def _precheck_admit(self, tx: bytes) -> None:
+        raise NotImplementedError
+
+    def _apply_check_tx_result(self, tx: bytes, res: abci.ResponseCheckTx,
+                               tx_info: dict) -> None:
+        raise NotImplementedError
+
+
+class CListMempool(BatchCheckMixin, AsyncRecheckMixin):
     def __init__(self, proxy_app, max_txs: int = 5000,
                  max_txs_bytes: int = 1 << 30, cache_size: int = 10000,
                  keep_invalid_txs_in_cache: bool = False,
-                 pre_check: Optional[Callable] = None):
+                 pre_check: Optional[Callable] = None,
+                 batch_check: bool = True,
+                 batch_gather_wait_s: float = 0.002,
+                 batch_max_txs: int = 256,
+                 verify_signatures: bool = True):
         self.proxy_app = proxy_app
         self.max_txs = max_txs
         self.max_txs_bytes = max_txs_bytes
@@ -110,16 +396,17 @@ class CListMempool(AsyncRecheckMixin):
         self._txs: "OrderedDict[bytes, CElement]" = OrderedDict()
         self._txs_bytes = 0
         self._init_recheck()
+        self._init_batch_check(batch_check, batch_gather_wait_s,
+                               batch_max_txs, verify_signatures)
         self._height = 0
         self._lock = threading.RLock()
         self._update_lock = threading.RLock()  # Lock()/Unlock() surface
         self._notify: List[Callable] = []
 
     # -- Mempool interface (mempool/mempool.go:30) --------------------------
+    # check_tx / check_tx_nowait provided by BatchCheckMixin.
 
-    def check_tx(self, tx: bytes, cb: Optional[Callable] = None,
-                 tx_info: Optional[dict] = None) -> None:
-        tx = bytes(tx)
+    def _precheck_admit(self, tx: bytes) -> None:
         with self._lock:
             if len(self._txs) >= self.max_txs or \
                     self._txs_bytes + len(tx) > self.max_txs_bytes:
@@ -132,20 +419,15 @@ class CListMempool(AsyncRecheckMixin):
             if err is not None:
                 self.cache.remove(tx)
                 raise ValueError(f"pre-check failed: {err}")
-        res = self.proxy_app.check_tx_sync(abci.RequestCheckTx(
-            tx=tx, type=abci.CHECK_TX_TYPE_NEW))
-        self._resolve_check_tx(tx, res, tx_info or {})
-        if cb is not None:
-            cb(res)
 
-    def _resolve_check_tx(self, tx: bytes, res: abci.ResponseCheckTx,
-                          tx_info: dict) -> None:
+    def _apply_check_tx_result(self, tx: bytes, res: abci.ResponseCheckTx,
+                               tx_info: dict) -> None:
         key = tmhash.sum(tx)
         with self._lock:
             if res.is_ok():
-                if key not in self._txs:
+                if key not in self._txs and not self._already_committed(key):
                     info = {
-                        "tx": tx, "gas_wanted": res.gas_wanted,
+                        "tx": tx, "hash": key, "gas_wanted": res.gas_wanted,
                         "height": self._height,
                         "senders": set(filter(None, [tx_info.get("sender")])),
                     }
@@ -202,11 +484,12 @@ class CListMempool(AsyncRecheckMixin):
         with self._lock:
             self._height = height
             for tx, res in zip(txs, deliver_tx_responses):
+                key = tmhash.sum(tx)
                 if res.is_ok():
                     self.cache.push(tx)  # committed: keep in cache forever-ish
+                    self._note_committed(key)
                 elif not self.keep_invalid_txs_in_cache:
                     self.cache.remove(tx)
-                key = tmhash.sum(tx)
                 el = self._txs.pop(key, None)
                 if el is not None:
                     self._list.remove(el)
@@ -222,11 +505,18 @@ class CListMempool(AsyncRecheckMixin):
         _m.mempool_size.set(self.size())
 
     def _recheck_pass(self) -> None:
+        """Re-validate survivors as ONE pipelined async batch (N queued
+        requests + one flush) instead of N serial sync round trips — at
+        5k txs the serial loop held the shared app mutex for the whole
+        sweep and starved CheckTx admission."""
         with self._lock:
             remaining = [i["tx"] for i in self._list]
-        for tx in remaining:
-            res = self.proxy_app.check_tx_sync(abci.RequestCheckTx(
-                tx=tx, type=abci.CHECK_TX_TYPE_RECHECK))
+        if not remaining:
+            return
+        responses = pipelined_check_tx(self.proxy_app, [
+            abci.RequestCheckTx(tx=tx, type=abci.CHECK_TX_TYPE_RECHECK)
+            for tx in remaining])
+        for tx, res in zip(remaining, responses):
             if not res.is_ok():
                 with self._lock:
                     el = self._txs.pop(tmhash.sum(tx), None)

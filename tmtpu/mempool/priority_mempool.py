@@ -21,16 +21,21 @@ from tmtpu.abci import types as abci
 from tmtpu.crypto import tmhash
 from tmtpu.libs.clist import CElement, CList
 from tmtpu.mempool.clist_mempool import (
-    AsyncRecheckMixin, MempoolFullError, TxCache, TxInMempoolError,
+    AsyncRecheckMixin, BatchCheckMixin, MempoolFullError, TxCache,
+    TxInMempoolError, pipelined_check_tx,
 )
 
 
-class PriorityMempool(AsyncRecheckMixin):
+class PriorityMempool(BatchCheckMixin, AsyncRecheckMixin):
     def __init__(self, proxy_app, max_txs: int = 5000,
                  max_txs_bytes: int = 1 << 30, cache_size: int = 10000,
                  keep_invalid_txs_in_cache: bool = False,
                  pre_check: Optional[Callable] = None,
-                 ttl_num_blocks: int = 0, ttl_duration_ns: int = 0):
+                 ttl_num_blocks: int = 0, ttl_duration_ns: int = 0,
+                 batch_check: bool = True,
+                 batch_gather_wait_s: float = 0.002,
+                 batch_max_txs: int = 256,
+                 verify_signatures: bool = True):
         self.proxy_app = proxy_app
         self.max_txs = max_txs
         self.max_txs_bytes = max_txs_bytes
@@ -46,15 +51,17 @@ class PriorityMempool(AsyncRecheckMixin):
         self._height = 0
         self._seq = itertools.count()  # FIFO tiebreak within a priority
         self._init_recheck()
+        self._init_batch_check(batch_check, batch_gather_wait_s,
+                               batch_max_txs, verify_signatures)
         self._lock = threading.RLock()
         self._update_lock = threading.RLock()
         self._notify: List[Callable] = []
 
     # -- Mempool interface ---------------------------------------------------
+    # check_tx / check_tx_nowait provided by BatchCheckMixin. v1 has no
+    # up-front full check: fullness resolves in _add via eviction.
 
-    def check_tx(self, tx: bytes, cb: Optional[Callable] = None,
-                 tx_info: Optional[dict] = None) -> None:
-        tx = bytes(tx)
+    def _precheck_admit(self, tx: bytes) -> None:
         if not self.cache.push(tx):
             raise TxInMempoolError("tx already exists in cache")
         if self.pre_check is not None:
@@ -62,20 +69,21 @@ class PriorityMempool(AsyncRecheckMixin):
             if err is not None:
                 self.cache.remove(tx)
                 raise ValueError(f"pre-check failed: {err}")
-        res = self.proxy_app.check_tx_sync(abci.RequestCheckTx(
-            tx=tx, type=abci.CHECK_TX_TYPE_NEW))
+
+    def _apply_check_tx_result(self, tx: bytes, res: abci.ResponseCheckTx,
+                               tx_info: dict) -> None:
         if res.is_ok():
-            self._add(tx, res, tx_info or {})
+            self._add(tx, res, tx_info)  # may raise MempoolFullError
         elif not self.keep_invalid_txs_in_cache:
             self.cache.remove(tx)
-        if cb is not None:
-            cb(res)
 
     def _add(self, tx: bytes, res: abci.ResponseCheckTx,
              tx_info: dict) -> None:
         key = tmhash.sum(tx)
         with self._lock:
-            if key in self._txs:
+            if key in self._txs or self._already_committed(key):
+                # committed while this admission was in flight: inserting
+                # now would get the tx proposed (and applied) twice
                 return
             # eviction (v1): make room by dropping strictly-lower-priority
             # residents; refuse if the newcomer can't fit even then
@@ -97,7 +105,7 @@ class PriorityMempool(AsyncRecheckMixin):
                 # evicted txs must be re-submittable (they're in no block)
                 self._remove_tx(victim_key, drop_cache=True)
             info = {
-                "tx": tx, "priority": res.priority,
+                "tx": tx, "hash": key, "priority": res.priority,
                 "gas_wanted": res.gas_wanted, "seq": next(self._seq),
                 "height": self._height,
                 "time_ns": time.time_ns(),  # for ttl_duration (tx.go:16)
@@ -159,11 +167,13 @@ class PriorityMempool(AsyncRecheckMixin):
         with self._lock:
             self._height = height
             for tx, res in zip(txs, deliver_tx_responses):
+                key = tmhash.sum(tx)
                 if res.is_ok():
                     self.cache.push(tx)
+                    self._note_committed(key)
                 elif not self.keep_invalid_txs_in_cache:
                     self.cache.remove(tx)
-                self._remove_tx(tmhash.sum(tx), drop_cache=False)
+                self._remove_tx(key, drop_cache=False)
             self._purge_expired(height)
         # async recheck, same rationale as CListMempool._schedule_recheck
         self._schedule_recheck()
@@ -187,11 +197,17 @@ class PriorityMempool(AsyncRecheckMixin):
                 self._remove_tx(key, drop_cache=True)
 
     def _recheck_pass(self) -> None:
+        # one pipelined async batch (N queued requests + a single flush)
+        # instead of N serial sync round trips — same rationale as
+        # CListMempool._recheck_pass
         with self._lock:
             remaining = [i["tx"] for i in self._txs.values()]
-        for tx in remaining:
-            res = self.proxy_app.check_tx_sync(abci.RequestCheckTx(
-                tx=tx, type=abci.CHECK_TX_TYPE_RECHECK))
+        if not remaining:
+            return
+        responses = pipelined_check_tx(self.proxy_app, [
+            abci.RequestCheckTx(tx=tx, type=abci.CHECK_TX_TYPE_RECHECK)
+            for tx in remaining])
+        for tx, res in zip(remaining, responses):
             with self._lock:
                 info = self._txs.get(tmhash.sum(tx))
                 if info is None:

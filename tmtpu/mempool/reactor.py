@@ -1,14 +1,26 @@
 """Mempool reactor (reference: mempool/v0/reactor.go) — gossips txs on
 channel 0x30 via per-peer broadcast threads; received txs go through
-CheckTx with the sender recorded so they aren't echoed back."""
+CheckTx with the sender recorded so they aren't echoed back.
+
+Dedup-aware gossip: each peer carries a seen-tx LRU covering both
+directions — txs the peer SENT us and txs we already sent IT. The
+cursor-based broadcast consults it before echoing, which (a) never
+returns a tx to its sender even after the tx leaves the mempool (the
+``senders`` set dies with the mempool entry), and (b) fixes the
+tail-removal restart: when the cursor resets to the mempool front, the
+LRU prevents re-sending everything the peer already has.
+"""
 
 from __future__ import annotations
 
 import queue
 import threading
 import time
+from collections import OrderedDict
 from typing import Dict
 
+from tmtpu.crypto import tmhash
+from tmtpu.libs import metrics as _m
 from tmtpu.libs.protoio import ProtoMessage
 from tmtpu.mempool.clist_mempool import CListMempool, MempoolFullError, \
     TxInMempoolError
@@ -24,12 +36,44 @@ class TxsPB(ProtoMessage):
     FIELDS = [(1, "txs", ("rep", "bytes"))]
 
 
+class PeerSeenCache:
+    """Bounded LRU of tx hashes one peer is known to have (either
+    direction). Thread-safe: the p2p recv thread and the peer's
+    broadcast thread both touch it."""
+
+    def __init__(self, size: int):
+        self.size = int(size)
+        self._map: "OrderedDict[bytes, None]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def add(self, key: bytes) -> None:
+        if self.size <= 0:
+            return
+        with self._lock:
+            if key in self._map:
+                self._map.move_to_end(key)
+                return
+            self._map[key] = None
+            if len(self._map) > self.size:
+                self._map.popitem(last=False)
+
+    def __contains__(self, key: bytes) -> bool:
+        if self.size <= 0:
+            return False
+        with self._lock:
+            return key in self._map
+
+
 class MempoolReactor(Reactor):
-    def __init__(self, mempool: CListMempool, broadcast: bool = True):
+    def __init__(self, mempool: CListMempool, broadcast: bool = True,
+                 seen_cache: int = 4096):
         super().__init__("MEMPOOL")
         self.mempool = mempool
         self.broadcast = broadcast
+        self.seen_cache = int(seen_cache)
         self._stopped = threading.Event()
+        self._seen: Dict[str, PeerSeenCache] = {}
+        self._seen_mtx = threading.Lock()
         # received txs are admitted on a dedicated worker, NOT the p2p recv
         # thread (the reference uses CheckTxAsync for the same reason): a
         # CheckTx ABCI round-trip per tx on the recv thread makes every
@@ -50,6 +94,13 @@ class MempoolReactor(Reactor):
     def on_stop(self) -> None:
         self._stopped.set()
 
+    def _peer_seen(self, node_id: str) -> PeerSeenCache:
+        with self._seen_mtx:
+            cache = self._seen.get(node_id)
+            if cache is None:
+                cache = self._seen[node_id] = PeerSeenCache(self.seen_cache)
+            return cache
+
     def add_peer(self, peer: Peer) -> None:
         if not self.broadcast or not peer.has_channel(MEMPOOL_CHANNEL):
             return
@@ -58,11 +109,20 @@ class MempoolReactor(Reactor):
                              name=f"mempool-bcast-{peer.node_id[:8]}")
         t.start()
 
+    def remove_peer(self, peer: Peer, reason: str) -> None:
+        with self._seen_mtx:
+            self._seen.pop(peer.node_id, None)
+
     def receive(self, channel_id: int, peer: Peer, msg_bytes: bytes) -> None:
         m = TxsPB.decode(msg_bytes)
+        seen = self._peer_seen(peer.node_id)
         for tx in m.txs:
+            tx = bytes(tx)
+            # the sender obviously has this tx: record it so the
+            # broadcast cursor never echoes it back
+            seen.add(tmhash.sum(tx))
             try:
-                self._rx_q.put_nowait((bytes(tx), peer.node_id))
+                self._rx_q.put_nowait((tx, peer.node_id))
             except queue.Full:
                 # backpressure: drop — the peer's broadcast routine will
                 # offer it again via another peer or a later batch
@@ -75,8 +135,14 @@ class MempoolReactor(Reactor):
             except queue.Empty:
                 continue
             try:
-                self.mempool.check_tx(tx, tx_info={"sender": sender})
-            except (TxInMempoolError, MempoolFullError):
+                # enqueue-and-return: the mempool's gather worker does
+                # the signature flush + pipelined ABCI round trip, so a
+                # tx flood never parks this thread on the gather window
+                self.mempool.check_tx_nowait(tx, tx_info={"sender": sender})
+            except TxInMempoolError:
+                _m.mempool_gossip_rx_dups.inc()
+                self.mempool.mark_sender(tx, sender)
+            except MempoolFullError:
                 self.mempool.mark_sender(tx, sender)
             except Exception:
                 pass
@@ -88,23 +154,33 @@ class MempoolReactor(Reactor):
         old full-reap-per-iteration loop went quadratic under load and
         starved CheckTx/reap of the mempool lock)."""
         el = None
+        seen = self._peer_seen(peer.node_id)
         while peer.is_running() and not self._stopped.is_set():
             if el is None:
                 el = self.mempool.wait_front(timeout=0.2)
                 if el is None:
                     continue
             # collect a batch from the cursor forward, without waiting
-            batch, cur, last = [], el, el
+            batch, keys, cur, last = [], [], el, el
             while cur is not None and len(batch) < 100:
                 v = cur.value
-                if not cur.removed and peer.node_id not in v["senders"]:
-                    batch.append(v["tx"])
+                if not cur.removed:
+                    key = v.get("hash") or tmhash.sum(v["tx"])
+                    if key in seen or peer.node_id in v["senders"]:
+                        _m.mempool_gossip_dedup_skips.inc()
+                    else:
+                        batch.append(v["tx"])
+                        keys.append(key)
                 last = cur
                 cur = cur.next
             if batch and not peer.send(MEMPOOL_CHANNEL,
                                        TxsPB(txs=batch).encode()):
                 time.sleep(0.05)  # send queue full: retry same position
                 continue
+            # only a handed-off batch counts as delivered to the peer's
+            # send queue — a failed send must stay eligible for retry
+            for key in keys:
+                seen.add(key)
             # advance: block until `last` gains a successor or is removed
             nxt = last.next_wait(timeout=0.2)
             if nxt is not None:

@@ -1,0 +1,82 @@
+"""Signed-tx envelope for batched CheckTx verification.
+
+The apps in this tree (kvstore/counter) do no signature checks, so
+CheckTx signature cost historically didn't exist — and neither did the
+throughput win of batching it. This envelope gives load generators and
+signature-carrying workloads a standard wrapper the mempool verifies
+BEFORE the ABCI round trip, through the same ``crypto/batch.py`` →
+sidecar → mesh stack consensus votes use (sigcache-fronted,
+breaker-protected, one flush per gather window instead of one
+``verify_signature`` per tx on the admission path).
+
+Wire layout (ed25519 only for now; the multi-curve registry can extend
+the curve byte later)::
+
+    MAGIC(4) | curve(1)=0x01 | pubkey(32) | sig(64) | payload
+
+The signature covers ``sign_bytes(payload)`` — domain-separated so an
+envelope signature can never be replayed as a vote/proposal signature.
+Txs that don't start with MAGIC are plain txs and bypass verification
+entirely; txs that start with MAGIC but don't parse are rejected at
+admission (a malformed envelope is an attack surface, not a payload).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from tmtpu.crypto.ed25519 import (
+    PUB_KEY_SIZE, SIGNATURE_SIZE, PrivKeyEd25519, PubKeyEd25519,
+)
+from tmtpu.crypto.keys import PubKey
+
+MAGIC = b"\xd4TX1"
+CURVE_ED25519 = 0x01
+_HEADER = len(MAGIC) + 1 + PUB_KEY_SIZE + SIGNATURE_SIZE
+_DOMAIN = b"tmtpu/signed-tx/v1\x00"
+
+
+def sign_bytes(payload: bytes) -> bytes:
+    """The message the envelope signature covers."""
+    return _DOMAIN + payload
+
+
+def is_signed(tx: bytes) -> bool:
+    """True when the tx claims to be an envelope (starts with MAGIC) —
+    it may still fail to parse, which is a rejection, not a plain tx."""
+    return tx[:len(MAGIC)] == MAGIC
+
+
+def encode(payload: bytes, priv: PrivKeyEd25519) -> bytes:
+    pk = priv.pub_key().bytes()
+    sig = priv.sign(sign_bytes(payload))
+    return MAGIC + bytes([CURVE_ED25519]) + pk + sig + bytes(payload)
+
+
+def parse(tx: bytes) -> Optional[Tuple[PubKey, bytes, bytes]]:
+    """(pubkey, sig, payload) for a well-formed envelope, None for a
+    malformed one. Callers gate on ``is_signed`` first; plain txs never
+    reach here."""
+    if len(tx) < _HEADER or tx[:len(MAGIC)] != MAGIC:
+        return None
+    if tx[len(MAGIC)] != CURVE_ED25519:
+        return None
+    off = len(MAGIC) + 1
+    pk_bytes = tx[off:off + PUB_KEY_SIZE]
+    sig = tx[off + PUB_KEY_SIZE:off + PUB_KEY_SIZE + SIGNATURE_SIZE]
+    payload = tx[_HEADER:]
+    try:
+        pub = PubKeyEd25519(pk_bytes)
+    except ValueError:
+        return None
+    return pub, bytes(sig), bytes(payload)
+
+
+def payload(tx: bytes) -> bytes:
+    """The app-visible payload: the envelope body for signed txs, the tx
+    itself otherwise. (The ABCI app still receives the FULL tx bytes —
+    block inclusion and tx hashes cover the envelope — this helper is
+    for harnesses that want to reason about the inner payload.)"""
+    if is_signed(tx) and len(tx) >= _HEADER:
+        return tx[_HEADER:]
+    return tx

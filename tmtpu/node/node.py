@@ -165,6 +165,10 @@ class Node(BaseService):
             max_txs_bytes=config.mempool.max_txs_bytes,
             cache_size=config.mempool.cache_size,
             keep_invalid_txs_in_cache=config.mempool.keep_invalid_txs_in_cache,
+            batch_check=config.mempool.batch_check,
+            batch_gather_wait_s=config.mempool.batch_gather_wait_ns / 1e9,
+            batch_max_txs=config.mempool.batch_max_txs,
+            verify_signatures=config.mempool.verify_signatures,
         )
         if config.mempool.version == "v1":
             from tmtpu.mempool.priority_mempool import PriorityMempool
@@ -267,7 +271,8 @@ class Node(BaseService):
                 wait_sync=self.fast_sync or self.state_sync)
             self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
             self.switch.add_reactor("MEMPOOL", MempoolReactor(
-                self.mempool, broadcast=config.mempool.broadcast))
+                self.mempool, broadcast=config.mempool.broadcast,
+                seen_cache=config.mempool.gossip_seen_cache))
             # blocksync reactor version per config (node.go:450 picks the
             # blockchain reactor by config.FastSync.Version the same way)
             if config.block_sync.version == "v2":

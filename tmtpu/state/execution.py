@@ -8,6 +8,7 @@ updateState (:403, valset + params changes) → app Commit under mempool lock
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import List, Optional, Tuple
 
@@ -31,6 +32,12 @@ class BlockExecutionError(Exception):
 # yet durable) — the handshake/replay path must reconverge
 _FAULT_ABCI_COMMIT = faultinject.register("abci.commit")
 
+# chaos hook at the top of the async ApplyBlock worker: a crash here dies
+# AFTER the WAL ENDHEIGHT barrier but BEFORE any app/state mutation — the
+# widest window the overlap opens — and recovery must replay the block via
+# handshake exactly like the serial executor's post_endheight crash
+_FAULT_ASYNC_APPLY = faultinject.register("exec.async_apply")
+
 
 class BlockExecutor:
     def __init__(self, state_store: StateStore, proxy_app, mempool=None,
@@ -41,6 +48,8 @@ class BlockExecutor:
         self.evidence_pool = evidence_pool
         self.event_bus = event_bus
         self.verify_backend = verify_backend
+        self._exec_pool = None  # lazy single-worker pool for async apply
+        self._exec_pool_mtx = threading.Lock()
 
     # -- proposal -----------------------------------------------------------
 
@@ -135,6 +144,36 @@ class BlockExecutor:
                         seconds=round(_time.perf_counter() - t0, 6))
         return new_state, retain_height
 
+    def apply_block_async(self, state: State, block_id: BlockID,
+                          block: Block, done) -> None:
+        """Run apply_block on a dedicated single-worker executor and call
+        ``done(result, error)`` when it finishes (exactly one is None).
+
+        The single worker preserves apply ordering by construction;
+        consensus additionally guarantees one apply in flight (it holds
+        the committed block at STEP_COMMIT until the done-message drains
+        through its receive loop). The caller owns the WAL barrier: this
+        must only be invoked after ENDHEIGHT(H) is durable, so a crash
+        anywhere in here recovers through the handshake replay path the
+        serial executor already exercises."""
+        def _run():
+            try:
+                faultinject.fire(_FAULT_ASYNC_APPLY)
+                result = self.apply_block(state, block_id, block)
+            except BaseException as e:
+                done(None, e)
+            else:
+                done(result, None)
+
+        with self._exec_pool_mtx:
+            if self._exec_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._exec_pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="apply-block")
+            pool = self._exec_pool
+        pool.submit(_run)
+
     def _exec_block_on_proxy_app(self, state: State, block: Block
                                  ) -> ABCIResponses:
         """execution.go:259 — BeginBlock, pipelined DeliverTxs, EndBlock."""
@@ -146,10 +185,15 @@ class BlockExecutor:
             last_commit_info=commit_info,
             byzantine_validators=byz_vals,
         ))
-        reqres = [
-            self.proxy_app.deliver_tx_async(abci.RequestDeliverTx(tx=tx))
-            for tx in block.txs
-        ]
+        # one batched enqueue + one flush for the whole block: amortizes
+        # the per-frame mutex/socket round trip (clients without the
+        # batch surface keep the per-tx async enqueue)
+        batch = getattr(self.proxy_app, "deliver_tx_batch_async", None)
+        reqs = [abci.RequestDeliverTx(tx=tx) for tx in block.txs]
+        if batch is not None:
+            reqres = batch(reqs)
+        else:
+            reqres = [self.proxy_app.deliver_tx_async(r) for r in reqs]
         self.proxy_app.flush_sync()
         deliver_txs = [rr.wait(timeout=60.0).deliver_tx for rr in reqres]
         if any(dt is None for dt in deliver_txs):

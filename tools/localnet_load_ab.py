@@ -1,0 +1,194 @@
+"""Throughput-tier A/B on the 4-node localnet (ISSUE 10 acceptance): the
+same real-TCP kvstore network as tools/localnet_ab.py, run twice over an
+identical signed-tx workload —
+
+  serial arm    pre-PR tx path: per-tx CheckTx round trips with a
+                one-lane signature verify each (batch_check off), no
+                gossip dedup (seen cache 0), serial ApplyBlock;
+  pipelined arm this PR's path: gather-window batched CheckTx (one
+                native signature flush + one pipelined ABCI burst per
+                gather), per-peer dedup gossip, async ApplyBlock overlap.
+
+Both arms run closed-loop at a fixed offered load: N pre-signed txs are
+offered round-robin to every node's ``check_tx_nowait`` surface, and the
+arm is timed until the kvstore has applied all N — so committed tx/s is
+measured at a 100% commit rate by construction, and any arm that cannot
+reach 100% fails loudly instead of flattering itself. Double-sign safety
+rides along: every committed block on every node is scanned for
+evidence, which must stay empty.
+
+Prints one JSON line per arm plus a combined summary:
+
+    {"metric": "localnet_load_ab", "serial": {...}, "pipelined": {...},
+     "speedup": ..., "txs": N}
+
+Run: python tools/localnet_load_ab.py [num_txs]
+"""
+
+import json
+import pathlib
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+import tests.conftest  # noqa: F401  (forces jax onto CPU devices)
+
+from tmtpu.config.config import Config  # noqa: E402
+from tmtpu.crypto import sigcache  # noqa: E402
+from tmtpu.crypto.ed25519 import gen_priv_key  # noqa: E402
+from tmtpu.libs import metrics as _m  # noqa: E402
+from tmtpu.mempool import signed_tx  # noqa: E402
+from tmtpu.node.node import Node  # noqa: E402
+from tmtpu.privval.file_pv import FilePV  # noqa: E402
+from tmtpu.types.genesis import GenesisDoc, GenesisValidator  # noqa: E402
+from tools import measure_lock  # noqa: E402
+
+
+def _mk_net_nodes(n, tmp, pipelined: bool, power=10):
+    """4-node full-mesh TCP net (tools/localnet_ab.py lineage), with the
+    throughput-tier knobs set per arm through the production config —
+    never by monkeypatching the mempool after the fact."""
+    pvs = []
+    for i in range(n):
+        home = tmp / f"node{i}"
+        (home / "config").mkdir(parents=True)
+        (home / "data").mkdir(parents=True)
+        cfg = Config.test_config()
+        cfg.base.home = str(home)
+        cfg.base.crypto_backend = "cpu"
+        cfg.rpc.laddr = ""
+        cfg.mempool.batch_check = pipelined
+        cfg.mempool.gossip_seen_cache = 4096 if pipelined else 0
+        cfg.consensus.async_exec = pipelined
+        pv = FilePV.load_or_generate(
+            cfg.rooted(cfg.base.priv_validator_key_file),
+            cfg.rooted(cfg.base.priv_validator_state_file))
+        pvs.append((cfg, pv))
+    gen = GenesisDoc(
+        chain_id="load-ab-chain", genesis_time=time.time_ns(),
+        validators=[GenesisValidator(pv.get_pub_key(), power)
+                    for _, pv in pvs],
+    )
+    nodes = []
+    for cfg, pv in pvs:
+        gen.save_as(cfg.genesis_path)
+        nodes.append(Node(cfg))
+    addrs = [f"{nd.node_id}@127.0.0.1:{nd.p2p_port}" for nd in nodes]
+    for i, nd in enumerate(nodes):
+        nd.switch.set_persistent_peers([a for j, a in enumerate(addrs)
+                                        if j != i])
+    return nodes
+
+
+def _cval(counter) -> float:
+    return sum(counter.summary_series().values())
+
+
+def _app_size(node) -> int:
+    from tmtpu.abci import types as abci
+
+    res = node.proxy_app.query.info_sync(abci.RequestInfo(version=""))
+    return int(json.loads(res.data)["size"])
+
+
+def _evidence_count(node) -> int:
+    total = 0
+    for h in range(1, node.block_store.height() + 1):
+        blk = node.block_store.load_block(h)
+        if blk is not None:
+            total += len(blk.evidence)
+    return total
+
+
+def _run_arm(pipelined: bool, txs: list, drain_timeout_s: float) -> dict:
+    arm = "pipelined" if pipelined else "serial"
+    sigcache.DEFAULT.invalidate_all()
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix=f"load-ab-{arm}-"))
+    nodes = _mk_net_nodes(4, tmp, pipelined=pipelined)
+    n_txs = len(txs)
+    try:
+        for nd in nodes:
+            nd.start()
+        while any(nd.switch.num_peers() < 3 for nd in nodes):
+            time.sleep(0.1)
+        for nd in nodes:
+            assert nd.consensus.wait_for_height(2, timeout=60)
+
+        flushes0 = _cval(_m.mempool_batch_flushes)
+        dedup0 = _cval(_m.mempool_gossip_dedup_skips)
+        t0 = time.monotonic()
+
+        def offer(shard_txs, node):
+            # fixed offered load: every tx in the shard is offered once;
+            # nowait = the RPC/recv-thread admission surface
+            for tx in shard_txs:
+                while True:
+                    try:
+                        node.mempool.check_tx_nowait(tx)
+                        break
+                    except Exception:
+                        time.sleep(0.01)  # mempool full: back off, re-offer
+
+        threads = [threading.Thread(target=offer, args=(txs[i::4], nd),
+                                    daemon=True)
+                   for i, nd in enumerate(nodes)]
+        for t in threads:
+            t.start()
+
+        deadline = time.monotonic() + drain_timeout_s
+        committed = 0
+        while committed < n_txs and time.monotonic() < deadline:
+            committed = _app_size(nodes[0])
+            time.sleep(0.05)
+        elapsed = time.monotonic() - t0
+        committed = _app_size(nodes[0])
+        for t in threads:
+            t.join(timeout=10)
+
+        evidence = sum(_evidence_count(nd) for nd in nodes)
+        heights = [nd.block_store.height() for nd in nodes]
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+    out = {
+        "arm": arm,
+        "offered_txs": n_txs,
+        "committed_txs": committed,
+        "commit_rate": round(committed / n_txs, 4),
+        "window_s": round(elapsed, 2),
+        "committed_tx_per_s": round(committed / elapsed, 1),
+        "blocks": max(heights),
+        "batch_flushes": int(_cval(_m.mempool_batch_flushes) - flushes0),
+        "gossip_dedup_skips": int(_cval(_m.mempool_gossip_dedup_skips)
+                                  - dedup0),
+        "double_sign_evidence": evidence,
+    }
+    print(json.dumps(out), file=sys.stderr)
+    return out
+
+
+def main(n_txs: int = 2000):
+    priv = gen_priv_key()
+    print(f"pre-signing {n_txs} txs...", file=sys.stderr)
+    txs = [signed_tx.encode(b"ld-%d=%d" % (i, i), priv)
+           for i in range(n_txs)]
+    with measure_lock.hold("localnet_load_ab"):
+        serial = _run_arm(False, txs, drain_timeout_s=600.0)
+        pipelined = _run_arm(True, txs, drain_timeout_s=600.0)
+    result = {
+        "metric": "localnet_load_ab",
+        "txs": n_txs,
+        "serial": serial,
+        "pipelined": pipelined,
+        "speedup": round(pipelined["committed_tx_per_s"] /
+                         max(1e-9, serial["committed_tx_per_s"]), 2),
+    }
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 2000)
