@@ -163,10 +163,9 @@ def test_commit_window_sites_are_the_real_ones():
     """The names above must match the literals compiled into
     consensus/state.py and state/execution.py — a rename there without
     updating the chaos tests would silently stop injecting."""
-    import tools.check_failpoints as cf
+    from tmtpu.analysis.index import default_index
 
-    registered, ensured = cf.collect_sites()
-    known = set(registered) | set(ensured)
+    known = default_index().fault_site_names()
     for name in COMMIT_WINDOW_SITES:
         assert name in known, name
 

@@ -1,0 +1,43 @@
+"""Unified static-analysis engine.
+
+One repo indexer (``index.py``: parsed ASTs, class/method tables, call
+resolution, and the shared catalogs of fault sites / metrics / timeline
+events / config knobs), a rule registry (``registry.py``) of small
+plugins consuming that index and emitting structured ``Finding``s, and a
+checked-in baseline (``baseline.py``, ``tools/lint_baseline.json``)
+where every grandfathered violation lives with a written justification.
+
+Entry points:
+
+- ``python tools/lint.py`` — the CLI (``--json``, ``--rule``,
+  ``--baseline``, ``--changed``).
+- ``tests/test_lint.py`` — one indexed tier-1 pass over the full rule
+  set plus per-rule synthetic-tree detection fixtures.
+- The seven legacy ``tools/check_*.py`` CLIs are thin shims over their
+  ported rules.
+
+See docs/ANALYSIS.md for the rule catalog and how to write a rule.
+"""
+
+from __future__ import annotations
+
+from tmtpu.analysis.findings import Finding  # noqa: F401
+from tmtpu.analysis.index import RepoIndex, default_index  # noqa: F401
+from tmtpu.analysis import registry  # noqa: F401
+
+
+def run_rule(rule_id: str, index: "RepoIndex" = None,
+             apply_baseline: bool = True):
+    """Run one rule against the (default) repo index and return its NEW
+    findings — after baseline suppressions, matching what the CLI would
+    fail on. The legacy ``tools/check_*.py`` shims are this call."""
+    from tmtpu.analysis import baseline as baseline_mod
+
+    idx = index or default_index()
+    results = registry.run(idx, [rule_id])
+    findings = results.get(rule_id, [])
+    if not apply_baseline:
+        return findings
+    base = baseline_mod.load(baseline_mod.default_path(idx.root))
+    new, _suppressed, _stale = baseline_mod.apply(base, {rule_id: findings})
+    return new.get(rule_id, [])

@@ -1,0 +1,106 @@
+"""Checked-in lint baseline: grandfathered findings with justifications.
+
+``tools/lint_baseline.json`` holds one entry per rule:
+
+    {"rules": {
+        "<rule id>": {"status": "clean"},
+        "<rule id>": {"status": "suppressions", "suppressions": [
+            {"key": "<finding key>", "reason": "<why this is OK>"}]}}}
+
+Semantics:
+
+- A finding whose ``key`` appears in its rule's suppressions is
+  *grandfathered*: tracked, reported under ``--json``, but not a
+  failure. Every suppression carries a written reason — that IS the
+  whitelist-with-justification workflow.
+- A finding with no suppression is NEW and fails the lint.
+- A suppression whose key no longer matches any finding is STALE and
+  reported as a warning so dead entries get pruned.
+- ``status: clean`` records the reviewed expectation that the rule has
+  zero findings (the meta-rule requires every rule to carry either
+  status).
+
+``tools/lint.py --update-baseline`` rewrites the file from the current
+tree, preserving reasons for keys that persist and stamping
+``TODO: justify or fix`` on new ones — those must be edited into real
+justifications (or fixed) before review.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Tuple
+
+from tmtpu.analysis.findings import Finding
+
+TODO_REASON = "TODO: justify or fix"
+
+
+def default_path(root: str) -> str:
+    return os.path.join(root, "tools", "lint_baseline.json")
+
+
+def load(path: str) -> dict:
+    """Load a baseline file; a missing file is an empty baseline."""
+    if not os.path.isfile(path):
+        return {"rules": {}}
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or not isinstance(
+            data.get("rules", None), dict):
+        raise ValueError(f"malformed baseline {path}: expected "
+                         f'{{"rules": {{...}}}}')
+    return data
+
+
+def suppression_map(baseline: dict, rule_id: str) -> Dict[str, str]:
+    entry = baseline.get("rules", {}).get(rule_id, {})
+    return {s["key"]: s.get("reason", "") for s in
+            entry.get("suppressions", []) if "key" in s}
+
+
+def apply(baseline: dict, results: Dict[str, List[Finding]]
+          ) -> Tuple[Dict[str, List[Finding]], Dict[str, List[Finding]],
+                     Dict[str, List[str]]]:
+    """Split raw rule results into (new, suppressed, stale-suppression
+    keys) per rule."""
+    new: Dict[str, List[Finding]] = {}
+    suppressed: Dict[str, List[Finding]] = {}
+    stale: Dict[str, List[str]] = {}
+    for rid, findings in results.items():
+        sup = suppression_map(baseline, rid)
+        seen_keys = set()
+        for f in findings:
+            seen_keys.add(f.key)
+            (suppressed if f.key in sup else new).setdefault(
+                rid, []).append(f)
+        missing = [k for k in sup if k not in seen_keys]
+        if missing:
+            stale[rid] = missing
+    return new, suppressed, stale
+
+
+def update(baseline: dict, results: Dict[str, List[Finding]]) -> dict:
+    """Fold the current results into a fresh baseline: every rule that
+    ran gets an entry; existing reasons survive for keys still found;
+    new keys get the TODO reason; vanished keys are dropped."""
+    out = {"rules": dict(baseline.get("rules", {}))}
+    for rid, findings in results.items():
+        old = suppression_map(baseline, rid)
+        if not findings:
+            out["rules"][rid] = {"status": "clean"}
+            continue
+        sups = []
+        for f in sorted(findings, key=lambda f: f.key):
+            sups.append({"key": f.key,
+                         "reason": old.get(f.key, TODO_REASON)})
+        out["rules"][rid] = {"status": "suppressions",
+                             "suppressions": sups}
+    return out
+
+
+def save(baseline: dict, path: str) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(baseline, fh, indent=2, sort_keys=True)
+        fh.write("\n")

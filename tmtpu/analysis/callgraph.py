@@ -1,0 +1,386 @@
+"""Interprocedural held-lock/call-graph engine for the deep analyzers.
+
+The three deep rules (lock-order, blocking-under-lock,
+replay-determinism) all need the same expensive facts:
+
+- which ``self.attr`` (and module-level) names are locks, what kind
+  (plain vs reentrant), and which Conditions alias which locks;
+- what a method *transitively* does — locks acquired, interesting call
+  sites hit — across same-class helpers, inherited mixin methods, typed
+  attribute calls (``self.attr = ClassName(...)``), and name-unique
+  method resolution when the receiver's type is unknown;
+- which locks are held at each of those points.
+
+``Analyzer`` computes memoized per-method event summaries over the
+shared ``RepoIndex``. Events are (kind, label, held-locks, file, line,
+call-chain) tuples; rules plug in a ``marker_fn`` that labels the AST
+nodes they care about (ABCI sync calls, wall-clock reads, set
+iteration, ...) and consume the transitive event stream.
+
+Resolution is deliberately conservative-but-useful:
+
+- ``self.m()`` resolves through the context class and its bases (so
+  mixin methods analyze under the class that actually runs them);
+- ``self.attr.m()`` resolves through ``attr``'s constructor type when
+  ``__init__`` assigned a known class, else falls back to name lookup;
+- any other ``x.m()`` / bare ``f()`` resolves only when at most
+  ``max_candidates`` classes/functions define that name — common names
+  (``get``, ``update``, ...) are skipped rather than guessed.
+
+Cycles return empty summaries (no fixpoint needed for flagging) and
+``max_depth`` bounds the chain. Findings therefore UNDER-approximate:
+absence of a finding is not proof, but every finding has a concrete
+witness chain.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Callable, Dict, FrozenSet, List, Optional, Tuple
+
+from tmtpu.analysis.index import ClassInfo, RepoIndex
+
+# constructors that produce locks: threading primitives and the
+# libs/sync factories (Mutex -> Lock, RMutex -> RLock)
+PLAIN_LOCK_CTORS = {"Lock", "Mutex", "Semaphore", "BoundedSemaphore"}
+REENTRANT_LOCK_CTORS = {"RLock", "RMutex"}
+CONDITION_CTORS = {"Condition"}
+
+
+@dataclass(frozen=True)
+class Event:
+    kind: str                    # "acquire" | "marker"
+    label: str                   # lock id, or marker_fn's label
+    held: FrozenSet[str]         # lock ids held at this point
+    rel: str
+    line: int
+    chain: Tuple[str, ...]       # call chain, outermost first
+
+    def via(self) -> str:
+        return " -> ".join(self.chain)
+
+
+class Analyzer:
+    def __init__(self, index: RepoIndex, prefixes: Tuple[str, ...] = ("tmtpu",),
+                 marker_fn: Optional[Callable[[ast.AST], Optional[str]]] = None,
+                 max_candidates: int = 3, max_depth: int = 10):
+        self.index = index
+        self.prefixes = prefixes
+        self.marker_fn = marker_fn or (lambda node: None)
+        self.max_candidates = max_candidates
+        self.max_depth = max_depth
+        self._classes = index.classes(*prefixes)
+        self._functions_by_name = self._build_function_table()
+        self._methods_by_name: Dict[str, List[ClassInfo]] = {}
+        for cls in self._classes:
+            for m in cls.methods:
+                self._methods_by_name.setdefault(m, []).append(cls)
+        self._lock_tables: Dict[int, Tuple[dict, dict]] = {}
+        self._module_locks = self._build_module_locks()
+        self._method_table: Dict[int, Dict[str, Tuple[ClassInfo,
+                                                      ast.FunctionDef]]] = {}
+        self._events_memo: Dict[Tuple[int, str], List[Event]] = {}
+        self._in_progress: set = set()
+
+    # ----------------------------------------------------------- tables
+
+    def _build_function_table(self):
+        out: Dict[str, List[Tuple[str, ast.FunctionDef]]] = {}
+        for fi in self.index.files(*self.prefixes):
+            if fi.tree is None:
+                continue
+            for node in fi.tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out.setdefault(node.name, []).append((fi.rel, node))
+        return out
+
+    @staticmethod
+    def _ctor_name(call: ast.Call) -> str:
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            return fn.id
+        if isinstance(fn, ast.Attribute):
+            return fn.attr
+        return ""
+
+    def _build_module_locks(self) -> Dict[Tuple[str, str], str]:
+        """{(rel, name): kind} for module-level ``NAME = Lock()``."""
+        out = {}
+        for fi in self.index.files(*self.prefixes):
+            if fi.tree is None:
+                continue
+            for node in fi.tree.body:
+                if not (isinstance(node, ast.Assign) and
+                        isinstance(node.value, ast.Call)):
+                    continue
+                ctor = self._ctor_name(node.value)
+                kind = ("plain" if ctor in PLAIN_LOCK_CTORS else
+                        "reentrant" if ctor in REENTRANT_LOCK_CTORS else
+                        None)
+                if kind is None:
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out[(fi.rel, tgt.id)] = kind
+        return out
+
+    def lock_table(self, cls: ClassInfo) -> Tuple[Dict[str, str],
+                                                  Dict[str, str]]:
+        """(locks, aliases) for a context class: ``locks`` maps lock
+        attr -> kind ("plain"/"reentrant"/"condition"); ``aliases`` maps
+        Condition attrs wrapping another lock attr to that attr. Base
+        classes' assignments are folded in (mixin locks analyze under
+        the running class)."""
+        key = id(cls)
+        if key in self._lock_tables:
+            return self._lock_tables[key]
+        locks: Dict[str, str] = {}
+        aliases: Dict[str, str] = {}
+        for owner in self._mro(cls):
+            for fn in owner.methods.values():
+                for node in ast.walk(fn):
+                    if not (isinstance(node, ast.Assign) and
+                            isinstance(node.value, ast.Call)):
+                        continue
+                    ctor = self._ctor_name(node.value)
+                    attrs = [t.attr for t in node.targets
+                             if isinstance(t, ast.Attribute) and
+                             isinstance(t.value, ast.Name) and
+                             t.value.id == "self"]
+                    if not attrs:
+                        continue
+                    if ctor in PLAIN_LOCK_CTORS:
+                        for a in attrs:
+                            locks.setdefault(a, "plain")
+                    elif ctor in REENTRANT_LOCK_CTORS:
+                        for a in attrs:
+                            locks.setdefault(a, "reentrant")
+                    elif ctor in CONDITION_CTORS:
+                        wrapped = None
+                        if node.value.args:
+                            arg = node.value.args[0]
+                            if isinstance(arg, ast.Attribute) and \
+                                    isinstance(arg.value, ast.Name) and \
+                                    arg.value.id == "self":
+                                wrapped = arg.attr
+                        for a in attrs:
+                            if wrapped:
+                                aliases.setdefault(a, wrapped)
+                            else:
+                                locks.setdefault(a, "condition")
+        self._lock_tables[key] = (locks, aliases)
+        return locks, aliases
+
+    def _mro(self, cls: ClassInfo) -> List[ClassInfo]:
+        """Approximate MRO by simple base names, cycle-safe."""
+        out, seen, frontier = [], set(), [cls]
+        while frontier:
+            c = frontier.pop(0)
+            if c.name in seen:
+                continue
+            seen.add(c.name)
+            out.append(c)
+            for base in c.base_names:
+                frontier.extend(self._classes_named(base))
+        return out
+
+    def _classes_named(self, name: str) -> List[ClassInfo]:
+        return [c for c in self._classes if c.name == name]
+
+    def methods_of(self, cls: ClassInfo
+                   ) -> Dict[str, Tuple[ClassInfo, ast.FunctionDef]]:
+        """Own + inherited methods by name; own definitions win."""
+        key = id(cls)
+        if key not in self._method_table:
+            table: Dict[str, Tuple[ClassInfo, ast.FunctionDef]] = {}
+            for owner in self._mro(cls):
+                for name, fn in owner.methods.items():
+                    table.setdefault(name, (owner, fn))
+            self._method_table[key] = table
+        return self._method_table[key]
+
+    def lock_id(self, cls: ClassInfo, attr: str) -> str:
+        return f"{cls.name}.{attr}"
+
+    # -------------------------------------------------------- resolution
+
+    def resolve_lock(self, cls: ClassInfo, rel: str, expr: ast.AST
+                     ) -> Optional[str]:
+        """Lock id a ``with``-context expression acquires, if known."""
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            locks, aliases = self.lock_table(cls)
+            attr = aliases.get(expr.attr, expr.attr)
+            if attr in locks:
+                return self.lock_id(cls, attr)
+        elif isinstance(expr, ast.Name):
+            if (rel, expr.id) in self._module_locks:
+                return f"{rel}::{expr.id}"
+        return None
+
+    def lock_kind(self, cls: ClassInfo, lock_id: str) -> Optional[str]:
+        if "::" in lock_id:
+            rel, name = lock_id.split("::", 1)
+            return self._module_locks.get((rel, name))
+        cname, _, attr = lock_id.partition(".")
+        if cname == cls.name:
+            return self.lock_table(cls)[0].get(attr)
+        for c in self._classes_named(cname):
+            kind = self.lock_table(c)[0].get(attr)
+            if kind:
+                return kind
+        return None
+
+    def resolve_call(self, cls: Optional[ClassInfo], call: ast.Call
+                     ) -> List[Tuple[Optional[ClassInfo], ast.FunctionDef,
+                                     str]]:
+        """Callee frames for one call node: [(context class or None,
+        fn node, rel)]. Empty when unknown/too ambiguous."""
+        fn = call.func
+        # self.m(...)
+        if isinstance(fn, ast.Attribute) and \
+                isinstance(fn.value, ast.Name) and fn.value.id == "self" \
+                and cls is not None:
+            target = self.methods_of(cls).get(fn.attr)
+            if target is not None:
+                owner, node = target
+                return [(cls, node, owner.rel)]  # keep calling context
+            return []
+        # self.attr.m(...) with a constructor-typed attr
+        if isinstance(fn, ast.Attribute) and \
+                isinstance(fn.value, ast.Attribute) and \
+                isinstance(fn.value.value, ast.Name) and \
+                fn.value.value.id == "self" and cls is not None:
+            ctor = cls.attr_ctors.get(fn.value.attr)
+            if ctor:
+                for c in self._classes_named(ctor):
+                    target = self.methods_of(c).get(fn.attr)
+                    if target is not None:
+                        owner, node = target
+                        return [(c, node, owner.rel)]
+        # any other x.m(...): name-unique method resolution
+        if isinstance(fn, ast.Attribute):
+            cands = self._methods_by_name.get(fn.attr, [])
+            if 1 <= len(cands) <= self.max_candidates:
+                return [(c, c.methods[fn.attr], c.rel) for c in cands]
+            return []
+        # bare f(...): module-level functions, name-unique
+        if isinstance(fn, ast.Name):
+            cands = self._functions_by_name.get(fn.id, [])
+            if 1 <= len(cands) <= self.max_candidates:
+                return [(None, node, rel) for rel, node in cands]
+        return []
+
+    # ------------------------------------------------------------ events
+
+    def events(self, cls: Optional[ClassInfo], method: str = "",
+               fn: Optional[ast.FunctionDef] = None,
+               rel: str = "") -> List[Event]:
+        """Transitive event summary for a method (by name, resolved in
+        ``cls``'s context) or a loose function node. Held sets and
+        chains in the result are relative to this frame's entry."""
+        if fn is None:
+            assert cls is not None
+            target = self.methods_of(cls).get(method)
+            if target is None:
+                return []
+            owner, fn = target
+            rel = owner.rel
+        memo_key = (id(cls) if cls is not None else 0, fn.name, id(fn))
+        if memo_key in self._events_memo:
+            return self._events_memo[memo_key]
+        if memo_key in self._in_progress or \
+                len(self._in_progress) >= self.max_depth * 16:
+            return []  # cycle / runaway: stop summarizing this path
+        self._in_progress.add(memo_key)
+        try:
+            events = self._walk(cls, fn, rel)
+        finally:
+            self._in_progress.discard(memo_key)
+        self._events_memo[memo_key] = events
+        return events
+
+    def _walk(self, cls: Optional[ClassInfo], fn: ast.FunctionDef,
+              rel: str) -> List[Event]:
+        frame = f"{cls.name}.{fn.name}" if cls is not None else fn.name
+        out: List[Event] = []
+        seen: set = set()
+
+        def emit(kind, label, held, e_rel, line, chain):
+            ev = Event(kind, label, frozenset(held), e_rel, line,
+                       (frame,) + chain)
+            dkey = (ev.kind, ev.label, ev.held, ev.rel, ev.line)
+            if dkey not in seen:
+                seen.add(dkey)
+                out.append(ev)
+
+        def handle_call(node: ast.Call, held: Tuple[str, ...]):
+            label = self.marker_fn(node)
+            if label is not None:
+                emit("marker", label, held, rel, node.lineno, ())
+                return
+            # .acquire() on a known lock: record the edge (unscoped —
+            # the held set is not extended past this statement)
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "acquire" and \
+                    cls is not None:
+                lid = self.resolve_lock(cls, rel, f.value)
+                if lid is not None:
+                    emit("acquire", lid, held, rel, node.lineno, ())
+                    return
+            for sub_cls, sub_fn, sub_rel in self.resolve_call(cls, node):
+                if len(self._in_progress) >= self.max_depth:
+                    continue
+                for ev in self.events(sub_cls, fn=sub_fn, rel=sub_rel):
+                    emit(ev.kind, ev.label, set(held) | set(ev.held),
+                         ev.rel, ev.line, ev.chain)
+
+        def visit(node: ast.AST, held: Tuple[str, ...]):
+            if isinstance(node, ast.With):
+                acquired = []
+                for item in node.items:
+                    lid = self.resolve_lock(cls, rel, item.context_expr) \
+                        if cls is not None else None
+                    if lid is None and isinstance(item.context_expr,
+                                                  ast.Name):
+                        lid = self.resolve_lock(cls or _NO_CLS, rel,
+                                                item.context_expr)
+                    if lid is not None:
+                        emit("acquire", lid, held, rel, node.lineno, ())
+                        acquired.append(lid)
+                    else:
+                        visit(item.context_expr, held)
+                inner = held + tuple(acquired)
+                for stmt in node.body:
+                    visit(stmt, inner)
+                return
+            if isinstance(node, ast.Call):
+                handle_call(node, held)
+                for child in ast.iter_child_nodes(node):
+                    visit(child, held)
+                return
+            if isinstance(node, (ast.For, ast.comprehension)):
+                label = self.marker_fn(node)
+                if label is not None:
+                    emit("marker", label, held, rel,
+                         getattr(node, "lineno",
+                                 getattr(node.iter, "lineno", 0)), ())
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                return  # nested defs run later, on unknown threads
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in fn.body:
+            visit(stmt, ())
+        return out
+
+
+class _NoClass:
+    """Sentinel context for module-level lock resolution."""
+    name = ""
+    attr_ctors: dict = {}
+
+
+_NO_CLS = None  # module-lock resolution handles Name exprs without a class

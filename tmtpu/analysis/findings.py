@@ -1,0 +1,44 @@
+"""Structured lint findings.
+
+A ``Finding`` is one rule violation: rule id, file:line, human message,
+severity, and a *stable key* — the identity the baseline matches on.
+Keys deliberately omit line numbers (code above a violation moving it
+down must not invalidate its suppression); rules build them from the
+structural facts of the violation (qualnames, lock pairs, call names).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+SEVERITIES = ("error", "warn")
+
+
+@dataclass
+class Finding:
+    rule: str
+    file: str          # repo-relative, "/"-separated
+    message: str
+    line: int = 0
+    severity: str = "error"
+    key: str = ""      # stable identity for baseline matching
+
+    def __post_init__(self):
+        if not self.key:
+            self.key = f"{self.rule}::{self.file}::{self.message}"
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"bad severity {self.severity!r}")
+
+    def location(self) -> str:
+        return f"{self.file}:{self.line}" if self.line else self.file
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule, "file": self.file, "line": self.line,
+            "message": self.message, "severity": self.severity,
+            "key": self.key,
+        }
+
+    def __str__(self) -> str:
+        return f"{self.location()}: [{self.rule}] {self.message}"

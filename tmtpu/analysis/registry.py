@@ -1,0 +1,105 @@
+"""Rule registry: each lint rule is a small plugin over the shared index.
+
+A rule is a function ``fn(index: RepoIndex) -> list[Finding]`` registered
+with the ``@rule(...)`` decorator. Registration declares:
+
+- ``rule_id`` — stable id (baseline entries and ``--rule`` use it);
+- ``doc`` — one-line description (the catalog in docs/ANALYSIS.md and
+  ``tools/lint.py --list`` render it);
+- ``triggers`` — path prefixes whose changes make the rule worth
+  re-running (``tools/lint.py --changed`` intersects these with the
+  ``git merge-base`` diff); ``("",)`` means "any change";
+- ``requires_import`` — True for rules that import runtime registries
+  (scenario library, sidecar protocol) and therefore only run against
+  the real repo, never a synthetic fixture tree.
+
+``run()`` executes rules against one index in-process — no per-rule
+re-walk, no subprocess spawns.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from tmtpu.analysis.findings import Finding
+from tmtpu.analysis.index import RepoIndex
+
+
+@dataclass
+class Rule:
+    rule_id: str
+    fn: Callable[[RepoIndex], List[Finding]]
+    doc: str
+    triggers: Tuple[str, ...] = ("",)
+    requires_import: bool = False
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def rule(rule_id: str, *, doc: str, triggers: Sequence[str] = ("",),
+         requires_import: bool = False):
+    """Register a rule plugin. Rules live in tmtpu/analysis/rules/."""
+    def deco(fn):
+        if rule_id in RULES:
+            raise ValueError(f"duplicate rule id {rule_id!r}")
+        RULES[rule_id] = Rule(rule_id, fn, doc, tuple(triggers),
+                              requires_import)
+        return fn
+    return deco
+
+
+def load_rules() -> Dict[str, Rule]:
+    """Import the rules package (idempotent) and return the registry."""
+    from tmtpu.analysis import rules  # noqa: F401  (imports register)
+
+    return RULES
+
+
+def all_rule_ids() -> List[str]:
+    return sorted(load_rules())
+
+
+def run(index: RepoIndex, rule_ids: Optional[Sequence[str]] = None
+        ) -> Dict[str, List[Finding]]:
+    """Run the requested rules (default: all) against one shared index.
+    Returns {rule_id: [findings]} with an entry for every rule that ran
+    (empty list = clean). Rules needing runtime imports are skipped
+    silently on non-repo indexes (synthetic fixture trees)."""
+    rules = load_rules()
+    ids = list(rule_ids) if rule_ids is not None else sorted(rules)
+    unknown = [i for i in ids if i not in rules]
+    if unknown:
+        raise KeyError(
+            f"unknown rule(s) {unknown}; known: {sorted(rules)}")
+    out: Dict[str, List[Finding]] = {}
+    for rid in ids:
+        r = rules[rid]
+        if r.requires_import and not index.importable:
+            continue
+        findings = list(r.fn(index))
+        for f in findings:
+            if f.rule != rid:
+                raise ValueError(
+                    f"rule {rid!r} emitted a finding tagged {f.rule!r}")
+        out[rid] = findings
+    return out
+
+
+def affected_rules(changed_files: Sequence[str]) -> List[str]:
+    """Rule ids whose trigger prefixes intersect the changed file set —
+    the ``--changed`` pre-commit fast path."""
+    rules = load_rules()
+    changed = [c.replace("\\", "/") for c in changed_files]
+    out = []
+    for rid, r in sorted(rules.items()):
+        for trig in r.triggers:
+            if trig == "":
+                out.append(rid)
+                break
+            if any(c == trig or c.startswith(trig.rstrip("/") + "/")
+                   for c in changed):
+                out.append(rid)
+                break
+    return out

@@ -1,0 +1,147 @@
+"""blocking-lock rule: no blocking operation under a hot lock or on a
+recv thread.
+
+The consensus receive loop holds ``ConsensusState._mtx`` for a whole
+message batch and every gossip/query thread contends on it; the mempool
+locks gate CheckTx admission. A blocking call inside those regions (or
+on a peer connection's recv thread) stalls the pipeline for its full
+duration. This rule walks the interprocedural held-lock engine over
+every tmtpu/ method and flags *markers* — operations known to block —
+reachable while a hot lock is held, plus any marker reachable from a
+Reactor's ``receive()`` regardless of locks.
+
+Markers: ABCI ``*_sync`` round trips, ``time.sleep``, file I/O
+(``open``/``fsync``/``write_sync``/``flush*``), socket traffic,
+subprocess spawns, and crypto dispatch (``new_batch_verifier`` — every
+construction site in this tree is immediately followed by
+``.verify()``, a TPU/sidecar dispatch — and ``verify_one``).
+
+Deliberate blocking (the WAL-before-process fsync, serial-mode
+ApplyBlock, the in-window vote-batch dispatch) is suppressed in
+tools/lint_baseline.json with its justification; anything new fails.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import List, Optional
+
+from tmtpu.analysis.callgraph import Analyzer, Event
+from tmtpu.analysis.findings import Finding
+from tmtpu.analysis.index import RepoIndex
+from tmtpu.analysis.registry import rule
+from tmtpu.analysis.rules.recv_sync import ABCI_SYNC_METHODS, _is_reactor
+
+# (class glob, lock attr) pairs naming the hot locks: the consensus
+# state mutex and the mempool admission/update locks
+HOT_LOCK_PATTERNS = (
+    ("*State", "_mtx"),
+    ("*Mempool*", "_lock"),
+    ("*Mempool*", "_update_lock"),
+)
+
+_IO_ATTRS = {"fsync", "write_sync", "flush_sync", "flush_and_sync"}
+_SOCKET_ATTRS = {"sendall", "recv", "connect", "accept",
+                 "create_connection"}
+_SUBPROCESS_ATTRS = {"run", "Popen", "check_output", "check_call",
+                     "call"}
+_DISPATCH_NAMES = {"new_batch_verifier", "verify_one"}
+
+
+def _recv_name(expr: ast.AST) -> str:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return ""
+
+
+def blocking_marker(node: ast.AST) -> Optional[str]:
+    """Label blocking operations; None for everything else."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        if fn.id == "open":
+            return "file-io:open"
+        if fn.id in _DISPATCH_NAMES:
+            return f"dispatch:{fn.id}"
+        return None
+    if not isinstance(fn, ast.Attribute):
+        return None
+    recv = _recv_name(fn.value)
+    if fn.attr in ABCI_SYNC_METHODS:
+        return f"abci-sync:{fn.attr}"
+    if fn.attr == "sleep" and recv == "time":
+        return "sleep:time.sleep"
+    if fn.attr in _IO_ATTRS:
+        return f"file-io:{fn.attr}"
+    if fn.attr in _DISPATCH_NAMES:
+        return f"dispatch:{fn.attr}"
+    if fn.attr in _SUBPROCESS_ATTRS and recv == "subprocess":
+        return f"subprocess:{fn.attr}"
+    if fn.attr in _SOCKET_ATTRS and (
+            recv == "socket" or "sock" in recv.lower() or
+            recv.lower().endswith("conn")):
+        return f"socket:{fn.attr}"
+    return None
+
+
+def _hot_locks(held) -> List[str]:
+    out = []
+    for lock in held:
+        if "::" in lock:
+            continue  # module-level locks are never the hot set
+        cls_name, _, attr = lock.partition(".")
+        for cpat, lattr in HOT_LOCK_PATTERNS:
+            if attr == lattr and fnmatch.fnmatch(cls_name, cpat):
+                out.append(lock)
+                break
+    return out
+
+
+@rule("blocking-lock",
+      doc="no sleep/IO/ABCI round trip/crypto dispatch reachable while "
+          "holding a hot lock or on a reactor recv thread",
+      triggers=("tmtpu",))
+def check(index: RepoIndex) -> List[Finding]:
+    az = Analyzer(index, marker_fn=blocking_marker)
+    findings = []
+    seen = set()
+
+    def add(ev: Event, context: str, key: str):
+        if key in seen:
+            return
+        seen.add(key)
+        findings.append(Finding(
+            "blocking-lock", ev.rel,
+            f"blocking op {ev.label} at {ev.rel}:{ev.line} is reachable "
+            f"{context} (via {ev.via()}) — move it outside the critical "
+            f"section / hand it to a worker, or suppress with a "
+            f"justification",
+            line=ev.line, key=key))
+
+    for cls in az._classes:
+        for name in az.methods_of(cls):
+            for ev in az.events(cls, name):
+                if ev.kind != "marker":
+                    continue
+                for lock in _hot_locks(ev.held):
+                    # key on the innermost frame so one marker reached
+                    # from many entry points is one finding
+                    add(ev, f"while holding {lock}",
+                        f"blocking-lock::{lock}::{ev.label}"
+                        f"::{ev.rel}::{ev.chain[-1]}")
+
+    for cls in az._classes:
+        if not _is_reactor(cls) or "receive" not in cls.methods:
+            continue
+        for ev in az.events(cls, "receive"):
+            if ev.kind != "marker":
+                continue
+            add(ev, f"on {cls.name}'s recv thread",
+                f"blocking-lock::recv::{cls.name}::{ev.label}"
+                f"::{ev.rel}::{ev.chain[-1]}")
+
+    return sorted(findings, key=lambda f: f.key)

@@ -1,0 +1,109 @@
+"""determinism rule: replayed consensus paths must be deterministic.
+
+WAL replay re-drives ``_replay_msg_info`` / ``_handle_msgs`` /
+``_handle_timeout`` and block re-application re-runs
+``BlockExecutor.apply_block``; any wall-clock read, unseeded randomness,
+or iteration over an unordered set on those paths can make the replayed
+node diverge from its pre-crash self (different vote timestamp,
+different proposal, different app hash). This rule computes the
+call-graph closure from those seed methods and flags:
+
+- wall clock: ``time.time`` / ``time.time_ns`` / ``datetime.now`` /
+  ``datetime.utcnow`` (``time.monotonic`` / ``perf_counter`` are
+  observability-only and deliberately exempt);
+- randomness: module-level ``random.*``, ``os.urandom``, ``uuid.uuid4``
+  (a seeded ``Random`` instance is fine — only the shared module RNG
+  and OS entropy are flagged);
+- unordered iteration: ``for x in {...}`` / ``for x in set(...)`` and
+  their comprehension forms (dict/list preserve order; sets don't).
+
+The protocol-timestamp sites (vote/proposal times, timeout scheduling)
+are real wall-clock reads that are SAFE because the message is WAL'd
+before processing and replay reads the recorded value — each is
+suppressed in tools/lint_baseline.json with that justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from tmtpu.analysis.callgraph import Analyzer, Event
+from tmtpu.analysis.findings import Finding
+from tmtpu.analysis.index import RepoIndex
+from tmtpu.analysis.registry import rule
+
+# (class name, method) seeds: the consensus message/timeout handlers
+# (everything the WAL replays) and the block application path
+SEEDS = (
+    ("ConsensusState", "_handle_msgs"),
+    ("ConsensusState", "_handle_timeout"),
+    ("ConsensusState", "_replay_msg_info"),
+    ("BlockExecutor", "apply_block"),
+)
+
+_WALLCLOCK = {"time", "time_ns"}
+_DATETIME = {"now", "utcnow", "today"}
+_RANDOM_FNS = {"random", "randint", "choice", "choices", "shuffle",
+               "uniform", "randrange", "getrandbits", "sample",
+               "randbytes"}
+
+
+def _is_set_expr(expr: ast.AST) -> bool:
+    return isinstance(expr, ast.Set) or (
+        isinstance(expr, ast.Call) and
+        isinstance(expr.func, ast.Name) and expr.func.id == "set")
+
+
+def determinism_marker(node: ast.AST) -> Optional[str]:
+    """Label nondeterminism hazards; None for everything else."""
+    if isinstance(node, (ast.For, ast.comprehension)):
+        return "set-iter" if _is_set_expr(node.iter) else None
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    if not isinstance(fn, ast.Attribute):
+        return None
+    recv = fn.value.id if isinstance(fn.value, ast.Name) else ""
+    if recv == "time" and fn.attr in _WALLCLOCK:
+        return f"wallclock:time.{fn.attr}"
+    if recv == "datetime" and fn.attr in _DATETIME:
+        return f"wallclock:datetime.{fn.attr}"
+    if recv == "random" and fn.attr in _RANDOM_FNS:
+        return f"random:random.{fn.attr}"
+    if recv == "os" and fn.attr == "urandom":
+        return "random:os.urandom"
+    if recv == "uuid" and fn.attr in ("uuid1", "uuid4"):
+        return f"random:uuid.{fn.attr}"
+    return None
+
+
+@rule("determinism",
+      doc="no wall clock, unseeded randomness, or set-order iteration "
+          "reachable from the WAL-replayed consensus handlers or "
+          "apply_block",
+      triggers=("tmtpu",))
+def check(index: RepoIndex) -> List[Finding]:
+    az = Analyzer(index, marker_fn=determinism_marker)
+    findings = []
+    seen = set()
+    for cls_name, method in SEEDS:
+        for cls in index.classes_by_name.get(cls_name, []):
+            for ev in az.events(cls, method):
+                if ev.kind != "marker":
+                    continue
+                key = (f"determinism::{ev.label}::{ev.rel}"
+                       f"::{ev.chain[-1]}")
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(Finding(
+                    "determinism", ev.rel,
+                    f"nondeterminism on a replayed path: {ev.label} at "
+                    f"{ev.rel}:{ev.line} is reachable from "
+                    f"{cls_name}.{method} (via {ev.via()}) — a "
+                    f"replaying node can diverge from its pre-crash "
+                    f"self; derive the value from WAL'd state or "
+                    f"suppress with a justification",
+                    line=ev.line, key=key))
+    return sorted(findings, key=lambda f: f.key)

@@ -1,0 +1,61 @@
+"""failpoints rule: the chaos surface must stay testable and unambiguous.
+
+Port of tools/check_failpoints.py onto the shared index:
+
+1. No duplicate ``faultinject.register`` names (injection by name must
+   be unambiguous), and no name used both by ``register()`` and the
+   idempotent ``ensure``/``fail_point`` forms.
+2. Every fault site appears in at least one test — a fail point nobody
+   injects in CI is untested recovery code wearing a tested name.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from tmtpu.analysis.findings import Finding
+from tmtpu.analysis.index import RepoIndex
+from tmtpu.analysis.registry import rule
+
+
+def _split(site: str):
+    rel, _, line = site.rpartition(":")
+    return rel, int(line) if line.isdigit() else 0
+
+
+@rule("failpoints",
+      doc="fault-injection sites are unique by name and each is "
+          "exercised by at least one test",
+      triggers=("tmtpu", "tests"))
+def check(index: RepoIndex) -> List[Finding]:
+    registered, ensured = index.fault_sites()
+    findings = []
+    for name, sites in sorted(registered.items()):
+        rel, line = _split(sites[0])
+        if len(sites) > 1:
+            findings.append(Finding(
+                "failpoints", rel,
+                f"duplicate fault site {name!r}: registered at "
+                f"{', '.join(sites)} — injection by name is ambiguous",
+                line=line, key=f"failpoints::dup::{name}"))
+        if name in ensured:
+            findings.append(Finding(
+                "failpoints", rel,
+                f"duplicate fault site {name!r}: register() at "
+                f"{sites[0]} also used as a fail_point/ensure name at "
+                f"{ensured[name][0]}",
+                line=line, key=f"failpoints::mixed::{name}"))
+    all_sites = {**{n: s[0] for n, s in ensured.items()},
+                 **{n: s[0] for n, s in registered.items()}}
+    corpus = index.test_corpus()
+    for name, where in sorted(all_sites.items()):
+        if name not in corpus:
+            rel, line = _split(where)
+            findings.append(Finding(
+                "failpoints", rel,
+                f"untested fault site {name!r} ({where}): no test "
+                f"mentions it — inject it at least once (script()/"
+                f"TMTPU_FAULTS) so the recovery path it guards runs "
+                f"in CI",
+                line=line, key=f"failpoints::untested::{name}"))
+    return findings

@@ -1,0 +1,78 @@
+"""lock-order rule: no lock pair acquired in both orders, no plain-lock
+self-nesting.
+
+Walks every method of every tmtpu/ class through the interprocedural
+held-lock engine (callgraph.Analyzer) and collects acquisition edges
+``held -> acquired``. Two findings:
+
+1. **Order inversion**: locks A and B where some path acquires B while
+   holding A and another acquires A while holding B — the classic
+   two-thread deadlock. Condition(lock) aliasing is resolved first so
+   ``with self._height_cv`` counts as its wrapped mutex.
+2. **Self-deadlock**: a non-reentrant lock (threading.Lock / sync.Mutex)
+   acquired while already held on the same path — guaranteed hang, no
+   second thread needed. RLocks are exempt by construction.
+
+Both witnesses (call chain + file:line) ride along in the message so a
+finding is checkable without re-running the analysis.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from tmtpu.analysis.callgraph import Analyzer, Event
+from tmtpu.analysis.findings import Finding
+from tmtpu.analysis.index import RepoIndex
+from tmtpu.analysis.registry import rule
+
+
+def _witness(ev: Event) -> str:
+    return f"{ev.rel}:{ev.line} via {ev.via()}"
+
+
+@rule("lock-order",
+      doc="no lock pair is acquired in both orders across the call "
+          "graph, and no non-reentrant lock nests under itself",
+      triggers=("tmtpu",))
+def check(index: RepoIndex) -> List[Finding]:
+    az = Analyzer(index)
+    # (held, acquired) -> first witness event + its context class
+    edges: Dict[Tuple[str, str], Tuple[Event, object]] = {}
+    self_nests: Dict[str, Tuple[Event, object]] = {}
+
+    for cls in az._classes:
+        for name in az.methods_of(cls):
+            for ev in az.events(cls, name):
+                if ev.kind != "acquire":
+                    continue
+                for held in ev.held:
+                    if held == ev.label:
+                        self_nests.setdefault(ev.label, (ev, cls))
+                    else:
+                        edges.setdefault((held, ev.label), (ev, cls))
+
+    findings = []
+    for (a, b) in sorted(edges):
+        if a < b and (b, a) in edges:
+            ev_ab, _ = edges[(a, b)]
+            ev_ba, _ = edges[(b, a)]
+            findings.append(Finding(
+                "lock-order", ev_ab.rel,
+                f"lock order inversion between {a} and {b}: "
+                f"{a} -> {b} at {_witness(ev_ab)}; "
+                f"{b} -> {a} at {_witness(ev_ba)} — two threads taking "
+                f"these paths concurrently deadlock",
+                line=ev_ab.line,
+                key=f"lock-order::cycle::{a}<->{b}"))
+    for lock, (ev, cls) in sorted(self_nests.items()):
+        if az.lock_kind(cls, lock) != "plain":
+            continue  # RLock/RMutex re-entry is fine
+        findings.append(Finding(
+            "lock-order", ev.rel,
+            f"self-deadlock: non-reentrant lock {lock} is acquired at "
+            f"{_witness(ev)} while already held on the same path — "
+            f"this hangs without any second thread",
+            line=ev.line,
+            key=f"lock-order::self::{lock}"))
+    return findings

@@ -1,0 +1,86 @@
+"""meta rule: every rule is documented, tested, and baselined.
+
+The framework's own hygiene: a rule that exists in the registry but has
+no entry in docs/ANALYSIS.md is undiscoverable; one never mentioned in
+tests/test_lint.py has no proof it detects its failure mode; one absent
+from tools/lint_baseline.json has no reviewed expectation (clean vs
+suppressed). Baseline entries for rule ids that no longer exist are
+dead weight and flagged too.
+
+Runs against the real repo only (``requires_import``): synthetic
+fixture trees legitimately lack docs/tests/baseline.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+from tmtpu.analysis import baseline as baseline_mod
+from tmtpu.analysis.findings import Finding
+from tmtpu.analysis.index import RepoIndex
+from tmtpu.analysis.registry import all_rule_ids, rule
+
+DOC_PATH = "docs/ANALYSIS.md"
+TEST_PATH = "tests/test_lint.py"
+
+
+@rule("meta",
+      doc="every registered rule has a docs/ANALYSIS.md entry, a "
+          "tests/test_lint.py mention, and a baseline status; no "
+          "baseline entry names an unknown rule",
+      triggers=("tmtpu/analysis", "docs", "tools", "tests"),
+      requires_import=True)
+def check(index: RepoIndex) -> List[Finding]:
+    ids = all_rule_ids()
+    findings = []
+
+    doc_file = os.path.join(index.root, DOC_PATH)
+    doc_src = ""
+    if os.path.isfile(doc_file):
+        with open(doc_file, encoding="utf-8") as fh:
+            doc_src = fh.read()
+    else:
+        findings.append(Finding(
+            "meta", DOC_PATH,
+            f"{DOC_PATH} is missing — the rule catalog has no home",
+            key="meta::no-doc"))
+
+    test_fi = index.get(TEST_PATH)
+    test_src = test_fi.source if test_fi is not None else ""
+    if test_fi is None:
+        findings.append(Finding(
+            "meta", TEST_PATH,
+            f"{TEST_PATH} is missing — no rule has detection proof",
+            key="meta::no-test"))
+
+    bl = baseline_mod.load(baseline_mod.default_path(index.root))
+    bl_rules = bl.get("rules", {})
+
+    for rid in ids:
+        if doc_src and f"`{rid}`" not in doc_src:
+            findings.append(Finding(
+                "meta", DOC_PATH,
+                f"rule {rid!r} has no entry in {DOC_PATH} — document "
+                f"what it checks and why",
+                key=f"meta::doc::{rid}"))
+        if test_src and rid not in test_src:
+            findings.append(Finding(
+                "meta", TEST_PATH,
+                f"rule {rid!r} is never mentioned in {TEST_PATH} — add "
+                f"a fixture proving it detects its failure mode (or at "
+                f"least that it runs clean on the real tree)",
+                key=f"meta::test::{rid}"))
+        if rid not in bl_rules:
+            findings.append(Finding(
+                "meta", "tools/lint_baseline.json",
+                f"rule {rid!r} has no baseline entry — run tools/"
+                f"lint.py --update-baseline and review its status",
+                key=f"meta::baseline::{rid}"))
+    for rid in sorted(set(bl_rules) - set(ids)):
+        findings.append(Finding(
+            "meta", "tools/lint_baseline.json",
+            f"baseline names unknown rule {rid!r} — the rule was "
+            f"removed or renamed; prune the entry",
+            key=f"meta::unknown-baseline::{rid}"))
+    return findings

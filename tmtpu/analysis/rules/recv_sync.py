@@ -1,0 +1,93 @@
+"""recv-sync rule: no ABCI ``*_sync`` call reachable from Reactor.receive.
+
+Port of tools/check_recv_sync.py. ``receive()`` runs on the peer
+connection's recv thread — a synchronous ABCI round trip there queues
+every subsequent message from that peer (consensus votes included)
+behind the app. The rule walks each Reactor subclass's ``receive`` and
+every same-class helper it transitively calls, and flags ABCI sync call
+sites.
+
+The old module's hardcoded WHITELIST (the two statesync snapshot-serving
+sites) now lives in tools/lint_baseline.json as suppressions — same
+keys, same reviewed reasons, one mechanism for every rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tmtpu.analysis.findings import Finding
+from tmtpu.analysis.index import ClassInfo, RepoIndex
+from tmtpu.analysis.registry import rule
+
+# the ABCI client's synchronous surface (abci/client.py Client) — these
+# block for the app's response
+ABCI_SYNC_METHODS = {
+    "echo_sync", "info_sync", "init_chain_sync", "query_sync",
+    "begin_block_sync", "check_tx_sync", "deliver_tx_sync",
+    "end_block_sync", "commit_sync", "flush_sync", "list_snapshots_sync",
+    "offer_snapshot_sync", "load_snapshot_chunk_sync",
+    "apply_snapshot_chunk_sync",
+}
+
+
+def _is_reactor(cls: ClassInfo) -> bool:
+    return any(b == "Reactor" or b.endswith("Reactor")
+               for b in cls.base_names)
+
+
+def _self_calls(fn: ast.FunctionDef) -> set:
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == "self":
+            out.add(node.func.attr)
+    return out
+
+
+def _sync_sites(fn: ast.FunctionDef) -> list:
+    return [(n.func.attr, n.lineno) for n in ast.walk(fn)
+            if isinstance(n, ast.Call) and
+            isinstance(n.func, ast.Attribute) and
+            n.func.attr in ABCI_SYNC_METHODS]
+
+
+@rule("recv-sync",
+      doc="no synchronous ABCI round trip reachable from a Reactor's "
+          "receive() (the peer recv thread must enqueue and return)",
+      triggers=("tmtpu",))
+def check(index: RepoIndex) -> List[Finding]:
+    findings = []
+    for fi in index.files("tmtpu"):
+        if fi.parse_error is not None:
+            findings.append(Finding(
+                "recv-sync", fi.rel,
+                f"syntax error: {fi.parse_error}",
+                key=f"recv-sync::syntax::{fi.rel}"))
+    for cls in index.classes("tmtpu"):
+        if not _is_reactor(cls) or "receive" not in cls.methods:
+            continue
+        seen, frontier = {"receive"}, ["receive"]
+        while frontier:
+            name = frontier.pop()
+            fn = cls.methods.get(name)
+            if fn is None:
+                continue  # inherited / dynamic — the blocking-lock
+                # rule's interprocedural walk covers those paths
+            for attr, lineno in _sync_sites(fn):
+                site = f"{cls.rel}::{cls.name}.{name}::{attr}"
+                findings.append(Finding(
+                    "recv-sync", cls.rel,
+                    f"recv-thread sync ABCI call: {site} is reachable "
+                    f"from {cls.name}.receive() — enqueue to a worker "
+                    f"(e.g. mempool check_tx_nowait) or suppress in the "
+                    f"baseline with a reviewed reason",
+                    line=lineno, key=site))
+            for callee in _self_calls(fn):
+                if callee not in seen:
+                    seen.add(callee)
+                    frontier.append(callee)
+    return sorted(findings, key=lambda f: f.key)

@@ -1,0 +1,97 @@
+"""scenarios rule: every scenario spec must be runnable and judgeable.
+
+Port of tools/check_scenarios.py. A scenario naming an unregistered
+fault site, a nonexistent oracle, or a metric the node never emits fails
+at RUN time — twenty seconds into a subprocess localnet, or silently (a
+misspelled metric reads 0.0 and "passes" a floor of 0). This rule
+front-loads those contract checks.
+
+The fault-site / metric / timeline-event catalogs now come from the
+shared index (the same ones failpoints/metrics/timeline consume), so an
+engine-side rename is caught by one source of truth. The rule itself
+imports the scenario library + oracle registry, hence
+``requires_import`` — it runs against the real repo only.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import List
+
+from tmtpu.analysis.findings import Finding
+from tmtpu.analysis.index import RepoIndex
+from tmtpu.analysis.registry import rule
+
+# oracle param keys whose value is a metric name / timeline event name
+METRIC_PARAM_ORACLES = {"metric_min", "metric_max"}
+TIMELINE_PARAM_ORACLES = {"timeline_saw"}
+
+_LIB = "tmtpu/scenario/library.py"
+
+
+@rule("scenarios",
+      doc="scenario specs validate; fault sites, oracles, oracle "
+          "params, metric and timeline names all resolve",
+      triggers=("tmtpu",), requires_import=True)
+def check(index: RepoIndex) -> List[Finding]:
+    from tmtpu.scenario import library
+    from tmtpu.scenario import oracles as oracle_mod
+
+    findings = []
+
+    def add(message, key):
+        findings.append(Finding("scenarios", _LIB, message, key=key))
+
+    sites = index.fault_site_names()
+    metrics = index.metric_names()
+    events = index.timeline_events()
+
+    for fast in library.FAST:
+        if fast not in library.SCENARIOS:
+            add(f"FAST names unknown scenario {fast!r} — the tier-1 "
+                f"marker would collect nothing",
+                f"scenarios::fast::{fast}")
+
+    for name in library.names():
+        spec = library.get(name)
+        where = f"scenario {name!r}"
+        for problem in spec.validate():
+            add(f"{where}: {problem}",
+                f"scenarios::validate::{name}::{problem}")
+        for action in spec.faults:
+            if action.op == "inject":
+                site = action.params.get("site", "")
+                if site not in sites:
+                    add(f"{where}: inject at t={action.at_s} targets "
+                        f"unregistered fault site {site!r} — known: "
+                        f"{sorted(sites)}",
+                        f"scenarios::inject::{name}::{site}")
+        for ospec in spec.oracles:
+            try:
+                fn = oracle_mod.get(ospec.name)
+            except KeyError:
+                add(f"{where}: unknown oracle {ospec.name!r} — known: "
+                    f"{oracle_mod.names()}",
+                    f"scenarios::oracle::{name}::{ospec.name}")
+                continue
+            try:
+                inspect.signature(fn).bind(None, **ospec.params)
+            except TypeError as e:
+                add(f"{where}: oracle {ospec.name!r} params "
+                    f"{sorted(ospec.params)} do not bind: {e}",
+                    f"scenarios::params::{name}::{ospec.name}")
+            if ospec.name in METRIC_PARAM_ORACLES:
+                metric = ospec.params.get("name", "")
+                if metric not in metrics:
+                    add(f"{where}: oracle {ospec.name!r} reads metric "
+                        f"{metric!r} which libs/metrics.py never "
+                        f"defines — the oracle would judge 0.0 forever",
+                        f"scenarios::metric::{name}::{metric}")
+            if ospec.name in TIMELINE_PARAM_ORACLES:
+                event = ospec.params.get("event", "")
+                if event not in events:
+                    add(f"{where}: oracle {ospec.name!r} waits for "
+                        f"timeline event {event!r} which no code path "
+                        f"records — known: {sorted(events)}",
+                        f"scenarios::event::{name}::{event}")
+    return findings

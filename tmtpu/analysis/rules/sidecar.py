@@ -1,0 +1,127 @@
+"""sidecar rule: the wire protocol and its telemetry stay fully covered.
+
+Port of tools/check_sidecar.py:
+
+1. Every class in ``protocol.MESSAGE_TYPES`` has a round-trip sample in
+   tests/test_sidecar_protocol.py's SAMPLES dict (and no stale samples).
+2. Every ``sidecar_*`` metric carries the ``tendermint_sidecar_`` prefix
+   and renders through the DEFAULT registry.
+3. Every sidecar metric has a write site somewhere in the tree, and
+   every sidecar write names a registered metric.
+
+Imports the protocol module and metrics registry (render check needs the
+real renderer), hence ``requires_import``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from tmtpu.analysis.findings import Finding
+from tmtpu.analysis.index import METRIC_WRITE_RE, RepoIndex
+from tmtpu.analysis.registry import rule
+
+PROTOCOL_TEST = "tests/test_sidecar_protocol.py"
+_PROTO_MOD = "tmtpu/sidecar/protocol.py"
+_METRICS_MOD = "tmtpu/libs/metrics.py"
+
+_SAMPLE_RE = re.compile(r"proto\.([A-Za-z_][A-Za-z0-9_]*)\s*:")
+_SIDECAR_WRITE = re.compile(
+    r"\b(?:metrics\.|_m\.)?(sidecar_[a-z0-9_]*)" + METRIC_WRITE_RE)
+
+
+def _protocol_findings(index: RepoIndex) -> List[Finding]:
+    from tmtpu.sidecar import protocol as proto
+
+    fi = index.get(PROTOCOL_TEST)
+    if fi is None:
+        return [Finding("sidecar", PROTOCOL_TEST,
+                        f"missing protocol test file: {PROTOCOL_TEST}",
+                        key="sidecar::no-test-file")]
+    findings = []
+    if "SAMPLES" not in fi.source:
+        return [Finding("sidecar", PROTOCOL_TEST,
+                        f"{PROTOCOL_TEST} has no SAMPLES dict — the "
+                        f"round-trip coverage this rule asserts is gone",
+                        key="sidecar::no-samples")]
+    if "def test_frame_round_trip" not in fi.source:
+        findings.append(Finding(
+            "sidecar", PROTOCOL_TEST,
+            f"{PROTOCOL_TEST} lost test_frame_round_trip — samples "
+            f"exist but nothing round-trips them",
+            key="sidecar::no-round-trip-test"))
+    sampled = set(_SAMPLE_RE.findall(fi.source))
+    registered = {cls.__name__ for cls in proto.MESSAGE_TYPES.values()}
+    for name in sorted(registered - sampled):
+        findings.append(Finding(
+            "sidecar", _PROTO_MOD,
+            f"untested wire message: protocol.{name} is registered in "
+            f"MESSAGE_TYPES but has no encode/decode round-trip sample "
+            f"in {PROTOCOL_TEST}",
+            key=f"sidecar::unsampled::{name}"))
+    for name in sorted(sampled - registered):
+        findings.append(Finding(
+            "sidecar", PROTOCOL_TEST,
+            f"stale sample: {PROTOCOL_TEST} samples proto.{name}, "
+            f"which is not in MESSAGE_TYPES",
+            key=f"sidecar::stale-sample::{name}"))
+    return findings
+
+
+def _metric_findings(index: RepoIndex) -> List[Finding]:
+    from tmtpu.libs import metrics
+
+    sidecar_attrs = {
+        attr: obj for attr, obj in vars(metrics).items()
+        if isinstance(obj, metrics._Metric) and
+        attr.startswith("sidecar_")}
+    if not sidecar_attrs:
+        return [Finding(
+            "sidecar", _METRICS_MOD,
+            "no sidecar_* metrics found in tmtpu/libs/metrics.py — the "
+            "sidecar metric set was removed or renamed",
+            key="sidecar::no-metrics")]
+    findings = []
+    rendered = metrics.render_prometheus()
+    for attr, obj in sorted(sidecar_attrs.items()):
+        if not obj.name.startswith("tendermint_sidecar_"):
+            findings.append(Finding(
+                "sidecar", _METRICS_MOD,
+                f"misfiled metric: {attr} renders as {obj.name!r}, "
+                f"outside the tendermint_sidecar_ subsystem",
+                key=f"sidecar::misfiled::{attr}"))
+        if f"# TYPE {obj.name} " not in rendered:
+            findings.append(Finding(
+                "sidecar", _METRICS_MOD,
+                f"unrendered metric: {attr} ({obj.name}) does not "
+                f"appear in render_prometheus() — it bypassed the "
+                f"DEFAULT registry and neither the daemon /metrics nor "
+                f"the node exposition will serve it",
+                key=f"sidecar::unrendered::{attr}"))
+    written = set()
+    for fi in index.files():
+        written.update(_SIDECAR_WRITE.findall(fi.source))
+    for attr in sorted(set(sidecar_attrs) - written):
+        findings.append(Finding(
+            "sidecar", _METRICS_MOD,
+            f"dead metric: {attr} ({sidecar_attrs[attr].name}) is "
+            f"registered but never written anywhere in the tree",
+            key=f"sidecar::dead::{attr}"))
+    for name in sorted(written - set(sidecar_attrs)):
+        findings.append(Finding(
+            "sidecar", _METRICS_MOD,
+            f"unknown metric: sidecar metric {name} is written "
+            f"somewhere in the tree but not registered in "
+            f"tmtpu/libs/metrics.py",
+            key=f"sidecar::unknown::{name}"))
+    return findings
+
+
+@rule("sidecar",
+      doc="every sidecar wire message round-trips in a test; every "
+          "sidecar metric is prefixed, rendered, and written",
+      triggers=("tmtpu/sidecar", "tmtpu/libs", "tests"),
+      requires_import=True)
+def check(index: RepoIndex) -> List[Finding]:
+    return _protocol_findings(index) + _metric_findings(index)

@@ -1,0 +1,109 @@
+"""sigcache rule: every hot-path signature check rides the batch layer.
+
+Port of tools/check_sigcache.py:
+
+1. No direct ``.verify_signature(`` call outside the oracle/fallback
+   layer — a raw call bypasses the verified-signature cache AND the
+   batch/dedup layer. Allowed: the crypto key implementations, the TPU/
+   native oracle code, and the per-connection cold paths.
+2. Every ``verify_commit*`` function in types/commit_verify.py
+   constructs its lanes through the batch layer; the declared entry
+   points must all exist (else this rule's coverage map is stale).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tmtpu.analysis.findings import Finding
+from tmtpu.analysis.index import RepoIndex
+from tmtpu.analysis.registry import rule
+
+# the oracle/fallback layer: the ONLY tmtpu/ files allowed to call
+# .verify_signature( directly (prefixes end with "/", exact paths don't)
+SERIAL_ALLOWED = (
+    "tmtpu/crypto/",    # key impls + batch fallback
+    "tmtpu/tpu/",       # device kernels vs oracle
+    "tmtpu/native/",    # host-prep oracle notes
+    # cold paths: one verify per connection / per harness run, no batch
+    # to amortize against and nothing a cache would ever hit twice
+    "tmtpu/p2p/conn/secret_connection.py",
+    "tmtpu/p2p/conn/plain_connection.py",
+    "tmtpu/privval/harness.py",
+)
+
+# commit verification entry points that must batch (rule 2)
+COMMIT_FNS = ("verify_commit", "verify_commit_light",
+              "verify_commit_light_trusting", "verify_commits_light_batch")
+COMMIT_IMPL = "tmtpu/types/commit_verify.py"
+
+
+@rule("sigcache",
+      doc="no serial .verify_signature() outside the oracle layer; "
+          "every verify_commit* goes through the batch verifier",
+      triggers=("tmtpu",))
+def check(index: RepoIndex) -> List[Finding]:
+    findings = []
+    for fi in index.files("tmtpu"):
+        if fi.rel.startswith(SERIAL_ALLOWED) or fi.rel in SERIAL_ALLOWED:
+            continue
+        if ".verify_signature" not in fi.source:
+            continue
+        if fi.tree is None:
+            findings.append(Finding(
+                "sigcache", fi.rel,
+                f"syntax error parsing {fi.rel}: {fi.parse_error}",
+                key=f"sigcache::syntax::{fi.rel}"))
+            continue
+        for node in ast.walk(fi.tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "verify_signature":
+                findings.append(Finding(
+                    "sigcache", fi.rel,
+                    f"serial verify in hot path: {fi.rel}:{node.lineno} "
+                    f"calls .verify_signature() directly — route it "
+                    f"through crypto/batch.py (new_batch_verifier / "
+                    f"verify_one) so the verified-signature cache and "
+                    f"batch dedup apply",
+                    line=node.lineno,
+                    key=f"sigcache::serial::{fi.rel}"))
+
+    impl = index.get(COMMIT_IMPL)
+    if impl is None or impl.tree is None:
+        findings.append(Finding(
+            "sigcache", COMMIT_IMPL,
+            f"{COMMIT_IMPL} missing or unparseable — commit "
+            f"verification moved without updating this rule",
+            key="sigcache::no-commit-impl"))
+        return findings
+    all_names = {n.name for n in ast.walk(impl.tree)
+                 if isinstance(n, ast.FunctionDef)}
+    for node in ast.walk(impl.tree):
+        if not (isinstance(node, ast.FunctionDef) and
+                node.name.startswith("verify_commit")):
+            continue
+        body_src = ast.dump(node)
+        helper_calls = [c.func.id for c in ast.walk(node)
+                        if isinstance(c, ast.Call) and
+                        isinstance(c.func, ast.Name)]
+        if "new_batch_verifier" not in body_src and \
+                "BatchVerifier" not in body_src and \
+                not any(n.startswith("_verify") for n in helper_calls):
+            findings.append(Finding(
+                "sigcache", COMMIT_IMPL,
+                f"unbatched commit verify: {COMMIT_IMPL} {node.name}() "
+                f"never constructs a BatchVerifier — commit lanes "
+                f"would bypass the cache-aware batch path",
+                line=node.lineno,
+                key=f"sigcache::unbatched::{node.name}"))
+    for fn in COMMIT_FNS:
+        if fn not in all_names:
+            findings.append(Finding(
+                "sigcache", COMMIT_IMPL,
+                f"missing commit verify entry point: {fn} not found in "
+                f"{COMMIT_IMPL} — the rule's coverage map is stale; "
+                f"update COMMIT_FNS",
+                key=f"sigcache::missing::{fn}"))
+    return sorted(findings, key=lambda f: (f.file, f.line, f.key))

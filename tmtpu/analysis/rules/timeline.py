@@ -1,0 +1,53 @@
+"""timeline rule: timeline step names and trace span names stay aligned.
+
+Port of tools/check_timeline.py, made fully static: the declared
+``CONSENSUS_STEP_EVENTS`` tuple is parsed out of libs/timeline.py by the
+index instead of imported. The journal (per-height ordering) and the
+span ring (durations) are two views of the same step; they only
+correlate if the names are byte-identical.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from tmtpu.analysis.findings import Finding
+from tmtpu.analysis.index import RepoIndex
+from tmtpu.analysis.registry import rule
+
+_TIMELINE_MOD = "tmtpu/libs/timeline.py"
+
+
+@rule("timeline",
+      doc="every consensus step event recorded into the timeline has a "
+          "byte-identical trace span name, and vice versa",
+      triggers=("tmtpu",))
+def check(index: RepoIndex) -> List[Finding]:
+    span_names = index.span_names()
+    recorded = index.timeline_record_sites()
+    step_events = index.consensus_step_events()
+
+    findings = []
+    for ev in step_events:
+        if ev not in span_names:
+            findings.append(Finding(
+                "timeline", _TIMELINE_MOD,
+                f"timeline step {ev!r} (timeline.CONSENSUS_STEP_EVENTS)"
+                f" has no matching trace span name under tmtpu/",
+                key=f"timeline::step-span::{ev}"))
+    for ev, rel in sorted(recorded.items()):
+        if not ev.startswith("consensus."):
+            continue  # only step events must mirror span names
+        if ev not in span_names:
+            findings.append(Finding(
+                "timeline", rel,
+                f"timeline records consensus step {ev!r} in {rel} but "
+                f"no trace.traced/trace.span literal uses that name",
+                key=f"timeline::recorded-span::{ev}"))
+        if ev not in step_events:
+            findings.append(Finding(
+                "timeline", rel,
+                f"timeline records consensus step {ev!r} in {rel} but "
+                f"it is missing from timeline.CONSENSUS_STEP_EVENTS",
+                key=f"timeline::undeclared::{ev}"))
+    return findings

@@ -423,6 +423,7 @@ class CListMempool(BatchCheckMixin, AsyncRecheckMixin):
     def _apply_check_tx_result(self, tx: bytes, res: abci.ResponseCheckTx,
                                tx_info: dict) -> None:
         key = tmhash.sum(tx)
+        added = False
         with self._lock:
             if res.is_ok():
                 if key not in self._txs and not self._already_committed(key):
@@ -433,11 +434,16 @@ class CListMempool(BatchCheckMixin, AsyncRecheckMixin):
                     }
                     self._txs[key] = self._list.push_back(info)
                     self._txs_bytes += len(tx)
-                    for fn in self._notify:
-                        fn()
+                    added = True
             else:
                 if not self.keep_invalid_txs_in_cache:
                     self.cache.remove(tx)
+        if added:
+            # callbacks run OUTSIDE self._lock: a txs-available listener
+            # that re-enters the mempool (or grabs its own lock) must not
+            # nest under the admission lock
+            for fn in self._notify:
+                fn()
         from tmtpu.libs import metrics as _m
 
         _m.mempool_size.set(self.size())

@@ -114,8 +114,11 @@ class PriorityMempool(BatchCheckMixin, AsyncRecheckMixin):
             info["_el"] = self._list.push_back(info)
             self._txs[key] = info
             self._txs_bytes += len(tx)
-            for fn in self._notify:
-                fn()
+        # callbacks run OUTSIDE self._lock: a txs-available listener that
+        # re-enters the mempool (or grabs its own lock) must not nest
+        # under the admission lock
+        for fn in self._notify:
+            fn()
         from tmtpu.libs import metrics as _m
 
         _m.mempool_size.set(self.size())

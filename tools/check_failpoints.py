@@ -1,106 +1,31 @@
 #!/usr/bin/env python
-"""Fault-site lint: the chaos surface must stay testable and unambiguous.
+"""Thin shim over the unified lint engine (tmtpu/analysis).
 
-Two invariants over the libs/faultinject site catalog (every
-``faultinject.register("...")`` and named ``fail.fail_point("...")``
-call in tmtpu/):
-
-1. **No duplicate names.** ``TMTPU_FAULTS="site=crash"`` targets a site
-   by name; two call sites sharing a name make an injection ambiguous
-   (``faultinject.register`` enforces this at runtime — but only on the
-   import paths a given process actually executes; this catches clashes
-   across modules that are never co-imported).
-
-2. **Every site is exercised by at least one test.** A fail point
-   nobody injects in CI is untested recovery code wearing a tested
-   name — the site literal must appear somewhere under tests/ (direct
-   ``script()``/``fire()`` use or a TMTPU_FAULTS env string).
-
-``faultinject.ensure(name)`` is exempt from the duplicate check (it is
-the idempotent variant fail_point uses on every call), but its names
-still count toward — and are held to — the coverage rule.
-
-Run directly (``python tools/check_failpoints.py``) or through the
-tier-1 suite (tests/test_check_failpoints.py). Exit 0 = clean,
-1 = findings.
+These checks now live in tmtpu/analysis/rules/failpoints.py as the
+``failpoints`` rule, running off the shared repo index with the other
+rules; suppressions (with reviewed justifications) live in
+tools/lint_baseline.json. This CLI is kept so the old entry point
+(``python tools/check_failpoints.py``) keeps working — prefer
+``python tools/lint.py --rule failpoints`` (one index, every rule).
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
-from collections import defaultdict
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
-# unique-name registrations (duplicates are findings)
-_REGISTER_RE = re.compile(r"faultinject\.register\(\s*[\"']([^\"']+)[\"']")
-# idempotent names: repeated occurrences fine, coverage still required
-_ENSURE_RE = re.compile(
-    r"(?:faultinject\.ensure|fail\.fail_point|(?<![.\w])fail_point)"
-    r"\(\s*[\"']([^\"']+)[\"']")
-
-
-def _py_files(*roots):
-    for entry in roots:
-        path = os.path.join(REPO, entry)
-        if os.path.isfile(path):
-            yield path
-            continue
-        for root, _dirs, files in os.walk(path):
-            for f in files:
-                if f.endswith(".py"):
-                    yield os.path.join(root, f)
-
-
-def collect_sites():
-    """{name: [file:line, ...]} for registered sites, plus the set of
-    ensure/fail_point names (idempotent registrations)."""
-    registered = defaultdict(list)
-    ensured = defaultdict(list)
-    for path in _py_files("tmtpu"):
-        rel = os.path.relpath(path, REPO)
-        with open(path, encoding="utf-8") as fh:
-            src = fh.read()
-        for m in _REGISTER_RE.finditer(src):
-            line = src.count("\n", 0, m.start()) + 1
-            registered[m.group(1)].append(f"{rel}:{line}")
-        for m in _ENSURE_RE.finditer(src):
-            line = src.count("\n", 0, m.start()) + 1
-            ensured[m.group(1)].append(f"{rel}:{line}")
-    return registered, ensured
-
-
-def _test_corpus() -> str:
-    return "\n".join(
-        open(p, encoding="utf-8").read() for p in _py_files("tests"))
+RULE = "failpoints"
 
 
 def check() -> list:
-    """Returns a list of human-readable findings (empty = clean)."""
-    registered, ensured = collect_sites()
-    findings = []
-    for name, sites in sorted(registered.items()):
-        if len(sites) > 1:
-            findings.append(
-                f"duplicate fault site {name!r}: registered at "
-                f"{', '.join(sites)} — injection by name is ambiguous")
-        if name in ensured:
-            findings.append(
-                f"duplicate fault site {name!r}: register() at "
-                f"{sites[0]} also used as a fail_point/ensure name at "
-                f"{ensured[name][0]}")
-    all_sites = {**{n: s[0] for n, s in ensured.items()},
-                 **{n: s[0] for n, s in registered.items()}}
-    corpus = _test_corpus()
-    for name, where in sorted(all_sites.items()):
-        if name not in corpus:
-            findings.append(
-                f"untested fault site {name!r} ({where}): no test "
-                f"mentions it — inject it at least once (script()/"
-                f"TMTPU_FAULTS) so the recovery path it guards runs in CI")
-    return findings
+    """Human-readable NEW findings (baseline-suppressed excluded)."""
+    from tmtpu.analysis import run_rule
+
+    return [str(f) for f in run_rule(RULE)]
 
 
 def main() -> int:
@@ -110,12 +35,9 @@ def main() -> int:
     if findings:
         print(f"{len(findings)} fault-site finding(s)", file=sys.stderr)
         return 1
-    registered, ensured = collect_sites()
-    n = len(set(registered) | set(ensured))
-    print(f"check_failpoints: {n} fault sites, all unique and tested")
+    print(f"check_failpoints: clean (rule {RULE!r} via tools/lint.py)")
     return 0
 
 
 if __name__ == "__main__":
-    sys.path.insert(0, REPO)
     sys.exit(main())

@@ -1,124 +1,31 @@
 #!/usr/bin/env python
-"""Dead-metric lint: every metric registered in tmtpu/libs/metrics.py
-must have at least one write site (``.inc(`` / ``.set(`` / ``.add(`` /
-``.observe(``) somewhere in the tree (tmtpu/, tools/, tests/, bench.py),
-and every write site must name a metric that actually exists.
+"""Thin shim over the unified lint engine (tmtpu/analysis).
 
-A registered-but-never-written metric renders as a permanent zero on
-/metrics — it looks monitored while measuring nothing, which is worse
-than absent. A write to a metric attribute that was renamed away raises
-AttributeError only on the (possibly rare) code path that hits it; this
-lint catches both statically.
-
-It also fails on metrics registered but never rendered: a Counter /
-Gauge / Histogram constructed directly (outside the DEFAULT registry's
-factory methods) accepts writes forever but never appears in
-``render_prometheus()`` — from a scraper's point of view it does not
-exist. Every tendermint metric must go through
-``DEFAULT.counter/gauge/histogram`` so /metrics serves it.
-
-Run directly (``python tools/check_metrics.py``) or through the tier-1
-suite (tests/test_check_metrics.py). Exit 0 = clean, 1 = findings.
+These checks now live in tmtpu/analysis/rules/metrics.py as the
+``metrics`` rule, running off the shared repo index with the other
+rules; suppressions (with reviewed justifications) live in
+tools/lint_baseline.json. This CLI is kept so the old entry point
+(``python tools/check_metrics.py``) keeps working — prefer
+``python tools/lint.py --rule metrics`` (one index, every rule).
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
-# module-level helpers in metrics.py count as write sites for the metrics
-# they wrap (callers go through the helper, not the metric attribute)
-_WRITE_RE = r"\.(?:inc|set|add|observe)\("
-
-# directories scanned for write sites
-_SCAN = ("tmtpu", "tools", "tests", "bench.py")
-
-
-def _metric_attrs():
-    """{attr_name: metric_object} for every registered metric bound to a
-    module-level name in tmtpu.libs.metrics."""
-    from tmtpu.libs import metrics
-
-    out = {}
-    for attr, obj in vars(metrics).items():
-        if isinstance(obj, metrics._Metric) and not attr.startswith("_"):
-            out[attr] = obj
-    return out
-
-
-def _iter_source_files():
-    for entry in _SCAN:
-        path = os.path.join(REPO, entry)
-        if os.path.isfile(path):
-            yield path
-            continue
-        for root, _dirs, files in os.walk(path):
-            for f in files:
-                if f.endswith(".py"):
-                    yield os.path.join(root, f)
-
-
-# metric objects must come from the registry factories (lowercase
-# .counter/.gauge/.histogram); a direct class construction outside
-# libs/metrics.py itself (and tests, which build throwaway registries)
-# is never rendered on /metrics
-_DIRECT_CTOR = re.compile(
-    r"\b(?:metrics\.)?(Counter|Gauge|Histogram)\(\s*[\"']")
-
-_CTOR_EXEMPT = (os.path.join("tmtpu", "libs", "metrics.py"), "tests")
-
-
-def _unrendered_constructions():
-    """(file, class) pairs for metric objects built outside the DEFAULT
-    registry — registered in the author's head, never rendered."""
-    out = []
-    for path in _iter_source_files():
-        rel = os.path.relpath(path, REPO)
-        if rel.startswith(_CTOR_EXEMPT[1] + os.sep) or \
-                rel == _CTOR_EXEMPT[0]:
-            continue
-        with open(path, encoding="utf-8") as fh:
-            src = fh.read()
-        for m in _DIRECT_CTOR.finditer(src):
-            out.append((rel, m.group(1)))
-    return out
+RULE = "metrics"
 
 
 def check() -> list:
-    """Returns a list of human-readable findings (empty = clean)."""
-    attrs = _metric_attrs()
-    written = set()
-    referenced = {}  # attr-like name -> first file it was written in
-    pat = re.compile(
-        r"\b(?:metrics\.|_m\.)?([a-z][a-z0-9_]*)" + _WRITE_RE)
-    for path in _iter_source_files():
-        with open(path, encoding="utf-8") as fh:
-            src = fh.read()
-        for m in pat.finditer(src):
-            name = m.group(1)
-            if name in attrs:
-                written.add(name)
-            elif name.startswith(("consensus_", "p2p_", "mempool_",
-                                  "crypto_")):
-                referenced.setdefault(name, os.path.relpath(path, REPO))
-    findings = []
-    for attr in sorted(set(attrs) - written):
-        findings.append(
-            f"dead metric: {attr} ({attrs[attr].name}) is registered in "
-            f"tmtpu/libs/metrics.py but never written anywhere")
-    for name, path in sorted(referenced.items()):
-        findings.append(
-            f"unknown metric: {name} is written in {path} but not "
-            f"registered in tmtpu/libs/metrics.py")
-    for rel, cls in sorted(_unrendered_constructions()):
-        findings.append(
-            f"unrendered metric: {rel} constructs a {cls} directly — it "
-            f"bypasses the DEFAULT registry and never appears on "
-            f"/metrics; use DEFAULT.{cls.lower()}(...)")
-    return findings
+    """Human-readable NEW findings (baseline-suppressed excluded)."""
+    from tmtpu.analysis import run_rule
+
+    return [str(f) for f in run_rule(RULE)]
 
 
 def main() -> int:
@@ -128,10 +35,9 @@ def main() -> int:
     if findings:
         print(f"{len(findings)} metric finding(s)", file=sys.stderr)
         return 1
-    print(f"check_metrics: {len(_metric_attrs())} metrics, all written")
+    print(f"check_metrics: clean (rule {RULE!r} via tools/lint.py)")
     return 0
 
 
 if __name__ == "__main__":
-    sys.path.insert(0, REPO)
     sys.exit(main())

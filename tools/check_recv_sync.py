@@ -1,137 +1,31 @@
 #!/usr/bin/env python
-"""Recv-thread blocking lint: no ABCI ``*_sync`` call may be reachable
-from a Reactor's ``receive()`` method.
+"""Thin shim over the unified lint engine (tmtpu/analysis).
 
-``receive()`` runs on the peer connection's recv thread — a synchronous
-ABCI round trip there queues every subsequent message from that peer
-(consensus votes and proposals included) behind the app. Under tx load
-this is exactly the failure the mempool reactor's admit worker exists to
-prevent: the recv thread must enqueue and return. This lint walks each
-Reactor subclass's ``receive`` and every same-class helper it
-(transitively) calls, and flags any ABCI sync call site it can reach.
-
-Whitelist: sites that are intentionally synchronous because the message
-is rare and the app call is cheap/read-only (statesync snapshot serving
-happens a handful of times per node lifetime, not per tx).
-
-Run directly (``python tools/check_recv_sync.py``) or through the tier-1
-suite (tests/test_check_recv_sync.py). Exit 0 = clean, 1 = findings.
+These checks now live in tmtpu/analysis/rules/recv_sync.py as the
+``recv-sync`` rule, running off the shared repo index with the other
+rules; suppressions (with reviewed justifications) live in
+tools/lint_baseline.json. This CLI is kept so the old entry point
+(``python tools/check_recv_sync.py``) keeps working — prefer
+``python tools/lint.py --rule recv-sync`` (one index, every rule).
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
-# directories scanned for Reactor subclasses
-_SCAN = ("tmtpu",)
-
-# the ABCI client's synchronous surface (abci/client.py Client) — these
-# block for the app's response
-ABCI_SYNC_METHODS = {
-    "echo_sync", "info_sync", "init_chain_sync", "query_sync",
-    "begin_block_sync", "check_tx_sync", "deliver_tx_sync",
-    "end_block_sync", "commit_sync", "flush_sync", "list_snapshots_sync",
-    "offer_snapshot_sync", "load_snapshot_chunk_sync",
-    "apply_snapshot_chunk_sync",
-}
-
-# "<relpath>::<Class>.<method>::<sync-call>" sites allowed to stay
-# synchronous, with the reason reviewed here:
-WHITELIST = {
-    # snapshot serving answers a chunk_request with a read-only app call;
-    # statesync traffic is a handful of messages per node lifetime, never
-    # interleaved with consensus-critical gossip on the same connection
-    "tmtpu/statesync/reactor.py::StatesyncReactor.receive"
-    "::load_snapshot_chunk_sync",
-    "tmtpu/statesync/reactor.py::StatesyncReactor._recent_snapshots"
-    "::list_snapshots_sync",
-}
-
-
-def _iter_source_files():
-    for entry in _SCAN:
-        path = os.path.join(REPO, entry)
-        for root, _dirs, files in os.walk(path):
-            for f in files:
-                if f.endswith(".py"):
-                    yield os.path.join(root, f)
-
-
-def _is_reactor_class(node: ast.ClassDef) -> bool:
-    for base in node.bases:
-        name = base.id if isinstance(base, ast.Name) else (
-            base.attr if isinstance(base, ast.Attribute) else "")
-        if name == "Reactor" or name.endswith("Reactor"):
-            return True
-    return False
-
-
-def _self_calls(fn: ast.FunctionDef) -> set:
-    """Names of self.<method>() calls inside fn."""
-    out = set()
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Call) and \
-                isinstance(node.func, ast.Attribute) and \
-                isinstance(node.func.value, ast.Name) and \
-                node.func.value.id == "self":
-            out.add(node.func.attr)
-    return out
-
-
-def _sync_sites(fn: ast.FunctionDef) -> list:
-    """(attr, lineno) for every ABCI sync call inside fn."""
-    out = []
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Call) and \
-                isinstance(node.func, ast.Attribute) and \
-                node.func.attr in ABCI_SYNC_METHODS:
-            out.append((node.func.attr, node.lineno))
-    return out
+RULE = "recv-sync"
 
 
 def check() -> list:
-    """Returns a list of human-readable findings (empty = clean)."""
-    findings = []
-    for path in _iter_source_files():
-        rel = os.path.relpath(path, REPO).replace(os.sep, "/")
-        with open(path, encoding="utf-8") as fh:
-            try:
-                tree = ast.parse(fh.read())
-            except SyntaxError as e:
-                findings.append(f"syntax error: {rel}: {e}")
-                continue
-        for cls in [n for n in tree.body if isinstance(n, ast.ClassDef)
-                    and _is_reactor_class(n)]:
-            methods = {n.name: n for n in cls.body
-                       if isinstance(n, (ast.FunctionDef,
-                                         ast.AsyncFunctionDef))}
-            if "receive" not in methods:
-                continue
-            # BFS over same-class helpers reachable from receive()
-            seen, frontier = {"receive"}, ["receive"]
-            while frontier:
-                name = frontier.pop()
-                fn = methods.get(name)
-                if fn is None:
-                    continue  # inherited / dynamic — out of scope
-                for attr, lineno in _sync_sites(fn):
-                    site = f"{rel}::{cls.name}.{name}::{attr}"
-                    if site not in WHITELIST:
-                        findings.append(
-                            f"recv-thread sync ABCI call: {site} "
-                            f"(line {lineno}) is reachable from "
-                            f"{cls.name}.receive() — enqueue to a worker "
-                            f"(e.g. mempool check_tx_nowait) or whitelist "
-                            f"with a reviewed reason")
-                for callee in _self_calls(fn):
-                    if callee not in seen:
-                        seen.add(callee)
-                        frontier.append(callee)
-    return sorted(findings)
+    """Human-readable NEW findings (baseline-suppressed excluded)."""
+    from tmtpu.analysis import run_rule
+
+    return [str(f) for f in run_rule(RULE)]
 
 
 def main() -> int:
@@ -141,10 +35,9 @@ def main() -> int:
     if findings:
         print(f"{len(findings)} recv-sync finding(s)", file=sys.stderr)
         return 1
-    print("check_recv_sync: no ABCI sync calls on reactor recv paths")
+    print(f"check_recv_sync: clean (rule {RULE!r} via tools/lint.py)")
     return 0
 
 
 if __name__ == "__main__":
-    sys.path.insert(0, REPO)
     sys.exit(main())

@@ -1,153 +1,31 @@
 #!/usr/bin/env python
-"""Scenario-library lint: every spec must be runnable and judgeable.
+"""Thin shim over the unified lint engine (tmtpu/analysis).
 
-A scenario that names a fault site nobody registered, an oracle that
-does not exist, or a metric the node never emits fails at RUN time —
-twenty seconds into a subprocess localnet, or worse, silently (an
-oracle probing a misspelled metric reads 0.0 and "passes" a floor of
-0). This lint front-loads those contract checks to import time:
-
-1. Every library spec passes ``ScenarioSpec.validate()`` (ops, node
-   names, partition groups, timeline bounds).
-2. Every ``inject`` action names a faultinject site actually registered
-   in tmtpu/ (same catalog check_failpoints.py enforces).
-3. Every oracle name resolves in the oracle registry, and its params
-   bind to the oracle's signature (a typo'd kwarg would crash the
-   oracle at judge time and fail the run with a TypeError, not a
-   verdict).
-4. Metric names referenced by metric oracles exist in the
-   libs/metrics.py catalog (``tendermint_<subsystem>_<name>``).
-5. Timeline event names referenced by ``timeline_saw`` are events some
-   code path actually records.
-6. The FAST tier-1 pair names real scenarios.
-
-Run directly (``python tools/check_scenarios.py``) or through the
-tier-1 suite (tests/test_check_scenarios.py). Exit 0 = clean,
-1 = findings.
+These checks now live in tmtpu/analysis/rules/scenarios.py as the
+``scenarios`` rule, running off the shared repo index with the other
+rules; suppressions (with reviewed justifications) live in
+tools/lint_baseline.json. This CLI is kept so the old entry point
+(``python tools/check_scenarios.py``) keeps working — prefer
+``python tools/lint.py --rule scenarios`` (one index, every rule).
 """
 
 from __future__ import annotations
 
-import inspect
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
-_REGISTER_RE = re.compile(
-    r"(?:faultinject\.register|faultinject\.ensure|fail\.fail_point"
-    r"|(?<![.\w])fail_point)\(\s*[\"']([^\"']+)[\"']")
-_METRIC_RE = re.compile(
-    r"DEFAULT\.(?:counter|gauge|histogram)\(\s*[\"'](\w+)[\"'],"
-    r"\s*[\"'](\w+)[\"']", re.S)
-_TIMELINE_CONST_RE = re.compile(r"EVENT_\w+\s*=\s*[\"']([\w.]+)[\"']")
-_TIMELINE_RECORD_RE = re.compile(
-    r"record\(\s*[^,()]+,\s*[\"']([\w.]+)[\"']", re.S)
-
-# oracle param keys whose value is a metric name / timeline event name
-_METRIC_PARAM_ORACLES = {"metric_min", "metric_max"}
-_TIMELINE_PARAM_ORACLES = {"timeline_saw"}
-
-
-def _py_files(*roots):
-    for entry in roots:
-        path = os.path.join(REPO, entry)
-        if os.path.isfile(path):
-            yield path
-            continue
-        for root, _dirs, files in os.walk(path):
-            for f in files:
-                if f.endswith(".py"):
-                    yield os.path.join(root, f)
-
-
-def registered_fault_sites() -> set:
-    sites = set()
-    for path in _py_files("tmtpu"):
-        with open(path, encoding="utf-8") as fh:
-            sites.update(_REGISTER_RE.findall(fh.read()))
-    return sites
-
-
-def known_metrics() -> set:
-    src = open(os.path.join(REPO, "tmtpu", "libs", "metrics.py"),
-               encoding="utf-8").read()
-    return {f"tendermint_{sub}_{name}"
-            for sub, name in _METRIC_RE.findall(src)}
-
-
-def known_timeline_events() -> set:
-    events = set()
-    for path in _py_files("tmtpu"):
-        src = open(path, encoding="utf-8").read()
-        if path.endswith(os.path.join("libs", "timeline.py")):
-            events.update(_TIMELINE_CONST_RE.findall(src))
-        if "timeline" in src:
-            events.update(e for e in _TIMELINE_RECORD_RE.findall(src)
-                          if "." in e)
-    return events
+RULE = "scenarios"
 
 
 def check() -> list:
-    """Returns a list of human-readable findings (empty = clean)."""
-    from tmtpu.scenario import library
-    from tmtpu.scenario import oracles as oracle_mod
+    """Human-readable NEW findings (baseline-suppressed excluded)."""
+    from tmtpu.analysis import run_rule
 
-    findings = []
-    sites = registered_fault_sites()
-    metrics = known_metrics()
-    events = known_timeline_events()
-
-    for fast in library.FAST:
-        if fast not in library.SCENARIOS:
-            findings.append(
-                f"FAST names unknown scenario {fast!r} — the tier-1 "
-                f"marker would collect nothing")
-
-    for name in library.names():
-        spec = library.get(name)
-        where = f"scenario {name!r}"
-        for problem in spec.validate():
-            findings.append(f"{where}: {problem}")
-        for action in spec.faults:
-            if action.op == "inject":
-                site = action.params.get("site", "")
-                if site not in sites:
-                    findings.append(
-                        f"{where}: inject at t={action.at_s} targets "
-                        f"unregistered fault site {site!r} — known: "
-                        f"{sorted(sites)}")
-        for ospec in spec.oracles:
-            try:
-                fn = oracle_mod.get(ospec.name)
-            except KeyError:
-                findings.append(
-                    f"{where}: unknown oracle {ospec.name!r} — known: "
-                    f"{oracle_mod.names()}")
-                continue
-            try:
-                inspect.signature(fn).bind(None, **ospec.params)
-            except TypeError as e:
-                findings.append(
-                    f"{where}: oracle {ospec.name!r} params "
-                    f"{sorted(ospec.params)} do not bind: {e}")
-            if ospec.name in _METRIC_PARAM_ORACLES:
-                metric = ospec.params.get("name", "")
-                if metric not in metrics:
-                    findings.append(
-                        f"{where}: oracle {ospec.name!r} reads metric "
-                        f"{metric!r} which libs/metrics.py never "
-                        f"defines — the oracle would judge 0.0 forever")
-            if ospec.name in _TIMELINE_PARAM_ORACLES:
-                event = ospec.params.get("event", "")
-                if event not in events:
-                    findings.append(
-                        f"{where}: oracle {ospec.name!r} waits for "
-                        f"timeline event {event!r} which no code path "
-                        f"records — known: {sorted(events)}")
-    return findings
+    return [str(f) for f in run_rule(RULE)]
 
 
 def main() -> int:
@@ -157,10 +35,7 @@ def main() -> int:
     if findings:
         print(f"{len(findings)} scenario finding(s)", file=sys.stderr)
         return 1
-    from tmtpu.scenario import library
-    n_oracles = sum(len(library.get(n).oracles) for n in library.names())
-    print(f"check_scenarios: {len(library.names())} scenarios, "
-          f"{n_oracles} oracle bindings, all resolvable")
+    print(f"check_scenarios: clean (rule {RULE!r} via tools/lint.py)")
     return 0
 
 

@@ -1,160 +1,43 @@
 #!/usr/bin/env python
-"""Sidecar protocol/metric lint: the wire protocol and its telemetry
-must stay fully covered as they grow.
+"""Thin shim over the unified lint engine (tmtpu/analysis).
 
-Three rules:
-
-1. **Every wire message round-trips in a test.** Each class registered
-   in ``tmtpu/sidecar/protocol.py``'s ``MESSAGE_TYPES`` must appear as a
-   key in the ``SAMPLES`` dict of tests/test_sidecar_protocol.py — the
-   dict that drives the parametrized encode/decode round-trip test. A
-   new message type without a sample ships untested framing; a type
-   removed from the protocol but still sampled is a stale test.
-
-2. **Every sidecar metric is rendered.** Each module-level ``sidecar_*``
-   attribute in tmtpu/libs/metrics.py must come from the DEFAULT
-   registry (so ``render_prometheus()`` serves it — both the daemon's
-   ``/metrics`` and the node's exposition) and must carry the
-   ``tendermint_sidecar_`` prefix.
-
-3. **Every sidecar metric has a write site** (``.inc(`` / ``.set(`` /
-   ``.add(`` / ``.observe(``) somewhere in tmtpu/, tools/, tests/, or
-   bench.py — a registered-but-never-written metric renders as a
-   permanent zero that looks monitored while measuring nothing
-   (tools/check_metrics.py enforces the same tree-wide; this lint keeps
-   the failure local when only the sidecar set regresses).
-
-Run directly (``python tools/check_sidecar.py``) or through the tier-1
-suite (tests/test_check_sidecar.py). Exit 0 = clean, 1 = findings.
+These checks now live in tmtpu/analysis/rules/sidecar.py as the
+``sidecar`` rule, running off the shared repo index with the other
+rules; suppressions (with reviewed justifications) live in
+tools/lint_baseline.json. This CLI is kept so the old entry point
+(``python tools/check_sidecar.py``) keeps working — prefer
+``python tools/lint.py --rule sidecar`` (one index, every rule).
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
-PROTOCOL_TEST = os.path.join("tests", "test_sidecar_protocol.py")
-
-_WRITE_RE = r"\.(?:inc|set|add|observe)\("
-_SCAN = ("tmtpu", "tools", "tests", "bench.py")
-
-
-def _iter_source_files():
-    for entry in _SCAN:
-        path = os.path.join(REPO, entry)
-        if os.path.isfile(path):
-            yield path
-            continue
-        for root, _dirs, files in os.walk(path):
-            for f in files:
-                if f.endswith(".py"):
-                    yield os.path.join(root, f)
-
-
-def _protocol_findings() -> list:
-    from tmtpu.sidecar import protocol as proto
-
-    findings = []
-    test_path = os.path.join(REPO, PROTOCOL_TEST)
-    if not os.path.isfile(test_path):
-        return [f"missing protocol test file: {PROTOCOL_TEST}"]
-    with open(test_path, encoding="utf-8") as fh:
-        src = fh.read()
-
-    # the SAMPLES dict feeds the parametrized round-trip test; both must
-    # exist for rule 1 to mean anything
-    if "SAMPLES" not in src:
-        findings.append(
-            f"{PROTOCOL_TEST} has no SAMPLES dict — the round-trip "
-            f"coverage this lint asserts is gone")
-        return findings
-    if "def test_frame_round_trip" not in src:
-        findings.append(
-            f"{PROTOCOL_TEST} lost test_frame_round_trip — samples "
-            f"exist but nothing round-trips them")
-
-    sampled = set(re.findall(r"proto\.([A-Za-z_][A-Za-z0-9_]*)\s*:", src))
-    registered = {cls.__name__ for cls in proto.MESSAGE_TYPES.values()}
-    for name in sorted(registered - sampled):
-        findings.append(
-            f"untested wire message: protocol.{name} is registered in "
-            f"MESSAGE_TYPES but has no encode/decode round-trip sample "
-            f"in {PROTOCOL_TEST}")
-    for name in sorted(sampled - registered):
-        findings.append(
-            f"stale sample: {PROTOCOL_TEST} samples proto.{name}, which "
-            f"is not in MESSAGE_TYPES")
-    return findings
-
-
-def _metric_findings() -> list:
-    from tmtpu.libs import metrics
-
-    findings = []
-    sidecar_attrs = {
-        attr: obj for attr, obj in vars(metrics).items()
-        if isinstance(obj, metrics._Metric) and attr.startswith("sidecar_")
-    }
-    if not sidecar_attrs:
-        return ["no sidecar_* metrics found in tmtpu/libs/metrics.py — "
-                "the sidecar metric set was removed or renamed"]
-
-    rendered = metrics.render_prometheus()
-    for attr, obj in sorted(sidecar_attrs.items()):
-        if not obj.name.startswith("tendermint_sidecar_"):
-            findings.append(
-                f"misfiled metric: {attr} renders as {obj.name!r}, "
-                f"outside the tendermint_sidecar_ subsystem")
-        if f"# TYPE {obj.name} " not in rendered:
-            findings.append(
-                f"unrendered metric: {attr} ({obj.name}) does not appear "
-                f"in render_prometheus() — it bypassed the DEFAULT "
-                f"registry and neither the daemon /metrics nor the node "
-                f"exposition will serve it")
-
-    written = set()
-    pat = re.compile(r"\b(?:metrics\.|_m\.)?(sidecar_[a-z0-9_]*)"
-                     + _WRITE_RE)
-    for path in _iter_source_files():
-        with open(path, encoding="utf-8") as fh:
-            src = fh.read()
-        for m in pat.finditer(src):
-            written.add(m.group(1))
-    for attr in sorted(set(sidecar_attrs) - written):
-        findings.append(
-            f"dead metric: {attr} ({sidecar_attrs[attr].name}) is "
-            f"registered but never written anywhere in "
-            f"{'/'.join(_SCAN)}")
-    for name in sorted(written - set(sidecar_attrs)):
-        findings.append(
-            f"unknown metric: sidecar metric {name} is written "
-            f"somewhere in the tree but not registered in "
-            f"tmtpu/libs/metrics.py")
-    return findings
+RULE = "sidecar"
 
 
 def check() -> list:
-    """Returns a list of human-readable findings (empty = clean)."""
-    return _protocol_findings() + _metric_findings()
+    """Human-readable NEW findings (baseline-suppressed excluded)."""
+    from tmtpu.analysis import run_rule
+
+    return [str(f) for f in run_rule(RULE)]
 
 
 def main() -> int:
     findings = check()
+    for f in findings:
+        print(f)
     if findings:
-        for f in findings:
-            print(f"check_sidecar: {f}")
+        print(f"{len(findings)} sidecar finding(s)", file=sys.stderr)
         return 1
-    from tmtpu.sidecar import protocol as proto
-
-    print(f"check_sidecar: clean — {len(proto.MESSAGE_TYPES)} wire "
-          f"messages round-trip-tested, every sidecar metric rendered "
-          f"and written")
+    print(f"check_sidecar: clean (rule {RULE!r} via tools/lint.py)")
     return 0
 
 
 if __name__ == "__main__":
-    sys.path.insert(0, REPO)
     sys.exit(main())

@@ -1,144 +1,31 @@
 #!/usr/bin/env python
-"""Verify-once lint: every signature check in a hot path must ride the
-cache-aware batch layer (crypto/batch.py), never a bare serial
-``pub_key.verify_signature(...)``.
+"""Thin shim over the unified lint engine (tmtpu/analysis).
 
-Two rules, both static:
-
-1. **No direct serial verifies in hot paths.** A raw
-   ``.verify_signature(`` call site bypasses the process-wide
-   verified-signature cache AND the batch/dedup layer — the exact
-   redundant-lane problem ISSUE 4 removed. Only the oracle/fallback
-   layer may call it: the crypto key implementations themselves, the
-   batch verifier's serial fallback, ``verify_one`` (the cache-aware
-   serial wrapper), the TPU oracle tests, and the two cold paths that
-   verify once per connection/run (p2p handshake, privval harness).
-
-2. **Every ``verify_commit*`` implementation batches.** The functions in
-   types/commit_verify.py must construct their lanes through
-   ``new_batch_verifier`` (whose base class does the cache lookup,
-   in-batch dedup, and insert-on-success) — a rewrite that quietly
-   loops ``verify_signature`` per lane would pass rule 1 for its
-   CALLERS while reintroducing serial verification underneath them.
-
-Run directly (``python tools/check_sigcache.py``) or through the tier-1
-suite (tests/test_check_sigcache.py). Exit 0 = clean, 1 = findings.
+These checks now live in tmtpu/analysis/rules/sigcache.py as the
+``sigcache`` rule, running off the shared repo index with the other
+rules; suppressions (with reviewed justifications) live in
+tools/lint_baseline.json. This CLI is kept so the old entry point
+(``python tools/check_sigcache.py``) keeps working — prefer
+``python tools/lint.py --rule sigcache`` (one index, every rule).
 """
 
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
-# the oracle/fallback layer: the ONLY tmtpu/ files allowed to call
-# .verify_signature( directly
-_SERIAL_ALLOWED = (
-    os.path.join("tmtpu", "crypto") + os.sep,   # key impls + batch fallback
-    os.path.join("tmtpu", "tpu") + os.sep,      # device kernels vs oracle
-    os.path.join("tmtpu", "native") + os.sep,   # host-prep oracle notes
-    # cold paths: one verify per connection / per harness run, no batch
-    # to amortize against and nothing a cache would ever hit twice
-    os.path.join("tmtpu", "p2p", "conn", "secret_connection.py"),
-    os.path.join("tmtpu", "p2p", "conn", "plain_connection.py"),
-    os.path.join("tmtpu", "privval", "harness.py"),
-)
-
-_SERIAL_CALL = re.compile(r"\.verify_signature\(")
-
-# commit verification entry points that must batch (rule 2)
-_COMMIT_FNS = ("verify_commit", "verify_commit_light",
-               "verify_commit_light_trusting", "verify_commits_light_batch")
-_COMMIT_IMPL = os.path.join("tmtpu", "types", "commit_verify.py")
-
-
-def _iter_hot_files():
-    root = os.path.join(REPO, "tmtpu")
-    for dirpath, _dirs, files in os.walk(root):
-        for f in files:
-            if f.endswith(".py"):
-                yield os.path.join(dirpath, f)
-
-
-def _serial_call_sites():
-    """(relpath, lineno) for every direct .verify_signature( call in a
-    hot-path module (comments and docstrings ignored via ast)."""
-    out = []
-    for path in _iter_hot_files():
-        rel = os.path.relpath(path, REPO)
-        if rel.startswith(_SERIAL_ALLOWED) or rel in _SERIAL_ALLOWED:
-            continue
-        with open(path, encoding="utf-8") as fh:
-            src = fh.read()
-        if ".verify_signature" not in src:
-            continue
-        try:
-            tree = ast.parse(src)
-        except SyntaxError:
-            out.append((rel, 0))
-            continue
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Call) and \
-                    isinstance(node.func, ast.Attribute) and \
-                    node.func.attr == "verify_signature":
-                out.append((rel, node.lineno))
-    return out
-
-
-def _unbatched_commit_fns():
-    """verify_commit* functions in types/commit_verify.py whose body
-    never touches the batch layer."""
-    path = os.path.join(REPO, _COMMIT_IMPL)
-    with open(path, encoding="utf-8") as fh:
-        tree = ast.parse(fh.read())
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.FunctionDef):
-            continue
-        if not node.name.startswith("verify_commit"):
-            continue
-        body_src = ast.dump(node)
-        if "new_batch_verifier" not in body_src and \
-                "BatchVerifier" not in body_src and \
-                not any(n.startswith("_verify") for n in
-                        [c.func.id for c in ast.walk(node)
-                         if isinstance(c, ast.Call) and
-                         isinstance(c.func, ast.Name)]):
-            out.append(node.name)
-    return out
+RULE = "sigcache"
 
 
 def check() -> list:
-    findings = []
-    for rel, lineno in sorted(_serial_call_sites()):
-        findings.append(
-            f"serial verify in hot path: {rel}:{lineno} calls "
-            f".verify_signature() directly — route it through "
-            f"crypto/batch.py (new_batch_verifier / verify_one) so the "
-            f"verified-signature cache and batch dedup apply")
-    for name in sorted(_unbatched_commit_fns()):
-        findings.append(
-            f"unbatched commit verify: types/commit_verify.py {name}() "
-            f"never constructs a BatchVerifier — commit lanes would "
-            f"bypass the cache-aware batch path")
-    missing = [fn for fn in _COMMIT_FNS if fn not in _all_commit_names()]
-    for fn in missing:
-        findings.append(
-            f"missing commit verify entry point: {fn} not found in "
-            f"types/commit_verify.py — the lint's coverage map is stale; "
-            f"update _COMMIT_FNS")
-    return findings
+    """Human-readable NEW findings (baseline-suppressed excluded)."""
+    from tmtpu.analysis import run_rule
 
-
-def _all_commit_names():
-    path = os.path.join(REPO, _COMMIT_IMPL)
-    with open(path, encoding="utf-8") as fh:
-        tree = ast.parse(fh.read())
-    return {n.name for n in ast.walk(tree)
-            if isinstance(n, ast.FunctionDef)}
+    return [str(f) for f in run_rule(RULE)]
 
 
 def main() -> int:
@@ -148,12 +35,9 @@ def main() -> int:
     if findings:
         print(f"{len(findings)} sigcache finding(s)", file=sys.stderr)
         return 1
-    n = len(list(_iter_hot_files()))
-    print(f"check_sigcache: {n} hot-path files scanned, all commit "
-          f"verifies batched, no stray serial verifies")
+    print(f"check_sigcache: clean (rule {RULE!r} via tools/lint.py)")
     return 0
 
 
 if __name__ == "__main__":
-    sys.path.insert(0, REPO)
     sys.exit(main())

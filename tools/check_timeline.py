@@ -1,78 +1,31 @@
 #!/usr/bin/env python
-"""Timeline/trace naming lint: every consensus step name recorded into
-the per-height timeline (libs/timeline) must have a matching trace span
-name (a ``trace.traced("...")`` / ``trace.span("...")`` literal)
-somewhere under tmtpu/.
+"""Thin shim over the unified lint engine (tmtpu/analysis).
 
-The timeline journal and the span ring are two views of the same step
-(the journal keeps the per-height ordering, the ring keeps the
-durations); they only correlate if the names are byte-identical. A step
-event renamed on one side silently breaks the "which step stalled"
-diagnosis, so this lint checks both the declared
-``timeline.CONSENSUS_STEP_EVENTS`` tuple and every ``consensus.*``
-event literal at a record() call site against the set of span-name
-literals.
-
-Run directly (``python tools/check_timeline.py``) or through the tier-1
-suite (tests/test_check_timeline.py). Exit 0 = clean, 1 = findings.
+These checks now live in tmtpu/analysis/rules/timeline.py as the
+``timeline`` rule, running off the shared repo index with the other
+rules; suppressions (with reviewed justifications) live in
+tools/lint_baseline.json. This CLI is kept so the old entry point
+(``python tools/check_timeline.py``) keeps working — prefer
+``python tools/lint.py --rule timeline`` (one index, every rule).
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
-# span-name literals: @trace.traced("x") decorators and trace.span("x")
-# context managers
-_SPAN_RE = re.compile(
-    r"""\btrace\.(?:traced|span)\(\s*["']([a-z0-9_.]+)["']""")
-
-# timeline record sites with a literal event name (second positional arg)
-_RECORD_RE = re.compile(
-    r"""\b(?:timeline|_tl)\.record\(\s*[^,]+,\s*["']([a-z0-9_.]+)["']""")
-
-
-def _py_files(root: str):
-    for dirpath, _dirs, files in os.walk(os.path.join(REPO, root)):
-        for f in files:
-            if f.endswith(".py"):
-                yield os.path.join(dirpath, f)
+RULE = "timeline"
 
 
 def check() -> list:
-    """Returns a list of human-readable findings (empty = clean)."""
-    from tmtpu.libs import timeline
+    """Human-readable NEW findings (baseline-suppressed excluded)."""
+    from tmtpu.analysis import run_rule
 
-    span_names = set()
-    recorded = {}  # event name -> first file recorded in
-    for path in _py_files("tmtpu"):
-        with open(path, encoding="utf-8") as fh:
-            src = fh.read()
-        span_names.update(_SPAN_RE.findall(src))
-        for ev in _RECORD_RE.findall(src):
-            recorded.setdefault(ev, os.path.relpath(path, REPO))
-
-    findings = []
-    for ev in timeline.CONSENSUS_STEP_EVENTS:
-        if ev not in span_names:
-            findings.append(
-                f"timeline step {ev!r} (timeline.CONSENSUS_STEP_EVENTS) "
-                f"has no matching trace span name under tmtpu/")
-    for ev, path in sorted(recorded.items()):
-        if not ev.startswith("consensus."):
-            continue  # only step events must mirror span names
-        if ev not in span_names:
-            findings.append(
-                f"timeline records consensus step {ev!r} in {path} but no "
-                f"trace.traced/trace.span literal uses that name")
-        if ev not in timeline.CONSENSUS_STEP_EVENTS:
-            findings.append(
-                f"timeline records consensus step {ev!r} in {path} but it "
-                f"is missing from timeline.CONSENSUS_STEP_EVENTS")
-    return findings
+    return [str(f) for f in run_rule(RULE)]
 
 
 def main() -> int:
@@ -82,13 +35,9 @@ def main() -> int:
     if findings:
         print(f"{len(findings)} timeline finding(s)", file=sys.stderr)
         return 1
-    from tmtpu.libs import timeline
-
-    print(f"check_timeline: {len(timeline.CONSENSUS_STEP_EVENTS)} "
-          f"consensus step events, all span-matched")
+    print(f"check_timeline: clean (rule {RULE!r} via tools/lint.py)")
     return 0
 
 
 if __name__ == "__main__":
-    sys.path.insert(0, REPO)
     sys.exit(main())

@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""Unified lint CLI: every rule, one shared index, one process.
+
+    python tools/lint.py                  # all rules vs the baseline
+    python tools/lint.py --rule lock-order --rule determinism
+    python tools/lint.py --json           # machine-readable report
+    python tools/lint.py --changed        # pre-commit: only rules whose
+                                          # triggers intersect the diff
+                                          # vs `git merge-base HEAD main`
+    python tools/lint.py --changed origin/main
+    python tools/lint.py --update-baseline  # refresh tools/lint_baseline.json
+    python tools/lint.py --list           # rule catalog
+
+Exit codes: 0 = clean (baseline-suppressed findings allowed),
+1 = new findings, 2 = usage/runtime error.
+
+Suppressed findings stay visible under --json (``suppressed`` section);
+stale suppressions (keys matching nothing) print as warnings so dead
+baseline entries get pruned. See docs/ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tmtpu.analysis import baseline as baseline_mod  # noqa: E402
+from tmtpu.analysis import registry  # noqa: E402
+from tmtpu.analysis.index import RepoIndex, default_index  # noqa: E402
+
+
+def _changed_files(base: str) -> list:
+    """Repo-relative paths changed vs the merge base (+ uncommitted)."""
+    def git(*args):
+        out = subprocess.run(
+            ["git", "-C", REPO] + list(args),
+            capture_output=True, text=True, check=True)
+        return out.stdout.strip()
+
+    merge_base = git("merge-base", "HEAD", base)
+    lines = git("diff", "--name-only", merge_base).splitlines()
+    lines += git("diff", "--name-only", "--cached").splitlines()
+    lines += git("ls-files", "--others",
+                 "--exclude-standard").splitlines()
+    return sorted({ln for ln in lines if ln})
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="lint.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--rule", action="append", metavar="ID",
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit a JSON report instead of text")
+    ap.add_argument("--baseline", metavar="PATH",
+                    help="baseline file (default tools/lint_baseline.json)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from the current tree "
+                         "(new findings get a TODO reason)")
+    ap.add_argument("--changed", nargs="?", const="main", metavar="BASE",
+                    help="run only rules whose triggers intersect the "
+                         "diff vs `git merge-base HEAD BASE` "
+                         "(default BASE: main)")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered rules and exit")
+    ap.add_argument("--root", default=None,
+                    help="index a different tree (fixture testing)")
+    args = ap.parse_args(argv)
+
+    rules = registry.load_rules()
+    if args.list:
+        for rid in sorted(rules):
+            r = rules[rid]
+            extra = " [import]" if r.requires_import else ""
+            print(f"{rid:<14} {r.doc}{extra}")
+        return 0
+
+    rule_ids = args.rule
+    if rule_ids:
+        unknown = [r for r in rule_ids if r not in rules]
+        if unknown:
+            print(f"lint: unknown rule(s) {unknown}; "
+                  f"known: {sorted(rules)}", file=sys.stderr)
+            return 2
+    if args.changed is not None:
+        try:
+            changed = _changed_files(args.changed)
+        except subprocess.CalledProcessError as e:
+            print(f"lint: git diff vs {args.changed!r} failed: "
+                  f"{e.stderr or e}", file=sys.stderr)
+            return 2
+        affected = registry.affected_rules(changed)
+        rule_ids = [r for r in (rule_ids or sorted(rules))
+                    if r in affected]
+        if not rule_ids:
+            print("lint: no rules triggered by the change set")
+            return 0
+
+    index = RepoIndex(args.root) if args.root else default_index()
+    try:
+        results = registry.run(index, rule_ids)
+    except KeyError as e:
+        print(f"lint: {e}", file=sys.stderr)
+        return 2
+
+    bl_path = args.baseline or baseline_mod.default_path(index.root)
+    try:
+        bl = baseline_mod.load(bl_path)
+    except (ValueError, json.JSONDecodeError) as e:
+        print(f"lint: {e}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        updated = baseline_mod.update(bl, results)
+        baseline_mod.save(updated, bl_path)
+        n_sup = sum(len(e.get("suppressions", []))
+                    for e in updated["rules"].values())
+        todo = sum(1 for e in updated["rules"].values()
+                   for s in e.get("suppressions", [])
+                   if s["reason"] == baseline_mod.TODO_REASON)
+        print(f"lint: baseline written to {bl_path} "
+              f"({n_sup} suppressions, {todo} needing justification)")
+        return 0 if todo == 0 else 1
+
+    new, suppressed, stale = baseline_mod.apply(bl, results)
+
+    if args.json:
+        report = {
+            "rules_run": sorted(results),
+            "new": {r: [f.to_dict() for f in fs]
+                    for r, fs in sorted(new.items())},
+            "suppressed": {r: [f.to_dict() for f in fs]
+                           for r, fs in sorted(suppressed.items())},
+            "stale_suppressions": stale,
+        }
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        for rid in sorted(new):
+            for f in new[rid]:
+                print(f)
+        for rid, keys in sorted(stale.items()):
+            for k in keys:
+                print(f"lint: warning: stale suppression in {rid}: "
+                      f"{k!r} matches no finding — prune it",
+                      file=sys.stderr)
+        n_new = sum(len(v) for v in new.values())
+        n_sup = sum(len(v) for v in suppressed.values())
+        if n_new:
+            print(f"lint: {n_new} new finding(s) across "
+                  f"{len(new)} rule(s) ({n_sup} suppressed by baseline)",
+                  file=sys.stderr)
+        else:
+            print(f"lint: clean — {len(results)} rule(s), "
+                  f"{n_sup} baseline-suppressed finding(s)")
+    return 1 if any(new.values()) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

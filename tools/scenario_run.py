@@ -59,6 +59,10 @@ def main() -> int:
                     help="override the spec seed")
     ap.add_argument("--json", action="store_true",
                     help="print full verdicts as JSON")
+    ap.add_argument("--no-validate", action="store_true",
+                    help="skip the pre-run contract check (scenarios "
+                         "lint rule: inject sites / oracles / metric "
+                         "and timeline names must resolve)")
     args = ap.parse_args()
 
     if args.list or not args.scenarios:
@@ -74,6 +78,22 @@ def main() -> int:
         print(f"unknown scenario(s): {unknown}; known: {library.names()}",
               file=sys.stderr)
         return 2
+
+    if not args.no_validate:
+        # same contract check the lint enforces (tmtpu/analysis rules
+        # "scenarios", resolved against the shared index catalogs) —
+        # fail here in milliseconds instead of twenty seconds into a
+        # subprocess localnet
+        from tmtpu.analysis import run_rule
+
+        problems = run_rule("scenarios")
+        if problems:
+            for p in problems:
+                print(f"scenario_run: {p}", file=sys.stderr)
+            print(f"scenario_run: {len(problems)} library contract "
+                  f"problem(s); fix them or rerun with --no-validate",
+                  file=sys.stderr)
+            return 2
 
     outroot = args.outdir or tempfile.mkdtemp(prefix="tmtpu-scenario-")
     verdicts = []
