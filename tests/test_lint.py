@@ -10,8 +10,9 @@ attention on the three deep analyzers: lock-order, blocking-lock,
 determinism.
 
 Rule ids covered here (the meta rule asserts this list stays complete):
-blocking-lock, determinism, failpoints, lock-order, meta, metrics,
-recv-sync, scenarios, sidecar, sigcache, timeline.
+blocking-lock, determinism, exception-safety, failpoints, jax-hygiene,
+lock-order, meta, metrics, recv-sync, scenarios, sidecar, sigcache,
+timeline, wire-taint.
 """
 
 from __future__ import annotations
@@ -25,9 +26,9 @@ from tmtpu.analysis import registry
 from tmtpu.analysis.index import RepoIndex, default_index
 
 ALL_RULES = [
-    "blocking-lock", "determinism", "failpoints", "lock-order", "meta",
-    "metrics", "recv-sync", "scenarios", "sidecar", "sigcache",
-    "timeline",
+    "blocking-lock", "determinism", "exception-safety", "failpoints",
+    "jax-hygiene", "lock-order", "meta", "metrics", "recv-sync",
+    "scenarios", "sidecar", "sigcache", "timeline", "wire-taint",
 ]
 
 
@@ -454,3 +455,311 @@ def test_baseline_apply_and_update_semantics(tmp_path):
 
     updated = baseline_mod.update(bl, {"r": []})
     assert updated["rules"]["r"] == {"status": "clean"}
+
+
+# -------------------------------------------------------------- wire-taint
+
+
+def test_wire_taint_follows_queue_handoff(tmp_path):
+    """receive() enqueues raw wire bytes; a state-thread handler drains
+    the queue and tallies them with no verification in between — the
+    channel fixpoint must carry the taint across the thread handoff."""
+    idx = _tree(tmp_path, {"tmtpu/consensus/r.py": """
+class VoteReactor(Reactor):
+    def __init__(self):
+        self._q = queue.Queue()
+        self.votes = VoteSet()
+
+    def receive(self, chid, peer, msg_bytes):
+        self._q.put(msg_bytes)
+
+    def _handle(self):
+        msg = self._q.get()
+        self.votes.add_verified_vote(msg)
+"""})
+    keys = _keys(_run(idx, "wire-taint"))
+    assert any("tally" in k and "wire" in k for k in keys), keys
+
+
+def test_wire_taint_sanitizer_launders_the_frame(tmp_path):
+    """The same flow with a verify_one() gate between the drain and the
+    sink is the sanctioned shape — no finding."""
+    idx = _tree(tmp_path, {"tmtpu/consensus/r.py": """
+class VoteReactor(Reactor):
+    def __init__(self):
+        self._q = queue.Queue()
+        self.votes = VoteSet()
+
+    def receive(self, chid, peer, msg_bytes):
+        self._q.put(msg_bytes)
+
+    def _handle(self):
+        msg = self._q.get()
+        if not verify_one(msg.pk, msg.data, msg.sig):
+            return
+        self.votes.add_verified_vote(msg)
+"""})
+    assert _run(idx, "wire-taint") == []
+
+
+def test_wire_taint_direct_sink_and_rpc_params(tmp_path):
+    idx = _tree(tmp_path, {
+        "tmtpu/consensus/w.py": """
+class WalReactor(Reactor):
+    def receive(self, chid, peer, msg_bytes):
+        self.wal.write(msg_bytes)
+""",
+        "tmtpu/rpc/core.py": """
+def build_routes(env):
+    def broadcast_tx_sync(tx):
+        env.signer.sign_vote(tx)
+    return {"broadcast_tx_sync": broadcast_tx_sync}
+""",
+    })
+    keys = _keys(_run(idx, "wire-taint"))
+    assert any("wal-write" in k for k in keys), keys
+    assert any("privval-sign" in k and "rpc" in k for k in keys), keys
+
+
+# -------------------------------------------------------- exception-safety
+
+
+def test_exception_safety_lock_across_raise(tmp_path):
+    idx = _tree(tmp_path, {"tmtpu/consensus/l.py": """
+class S:
+    def bad(self):
+        self._mtx.acquire()
+        self.apply(self.block)
+        self._mtx.release()
+
+    def good(self):
+        with self._mtx:
+            self.apply(self.block)
+            raise ValueError("scoped release is exception-safe")
+
+    def also_good(self):
+        self._mtx.acquire()
+        try:
+            self.apply(self.block)
+        finally:
+            self._mtx.release()
+"""})
+    keys = _keys(_run(idx, "exception-safety"))
+    assert "exception-safety::lock-across-raise::tmtpu/consensus/l.py" \
+           "::S.bad::self._mtx" in keys
+    assert not any("good" in k for k in keys), keys
+
+
+def test_exception_safety_unjoined_thread(tmp_path):
+    idx = _tree(tmp_path, {"tmtpu/p2p/t.py": """
+import threading
+
+class Leaky:
+    def start(self):
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def stop(self):
+        self._stopped.set()
+
+class Clean:
+    def start(self):
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def stop(self):
+        self._stopped.set()
+        t = self._t
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)
+"""})
+    keys = _keys(_run(idx, "exception-safety"))
+    assert "exception-safety::unjoined-thread::tmtpu/p2p/t.py" \
+           "::Leaky._t" in keys
+    assert not any("Clean" in k for k in keys), keys
+
+
+def test_exception_safety_unclosed_resource_and_with_alias(tmp_path):
+    idx = _tree(tmp_path, {"tmtpu/state/f.py": """
+def leak(path):
+    f = open(path, "rb")
+    data = f.read(4)
+    return data
+
+def closed_by_with_alias(path):
+    f = open(path, "rb")
+    with f:
+        return f.read()
+"""})
+    keys = _keys(_run(idx, "exception-safety"))
+    assert "exception-safety::unclosed-resource::tmtpu/state/f.py" \
+           "::leak::f" in keys
+    assert not any("closed_by_with_alias" in k for k in keys), keys
+
+
+def test_exception_safety_breaker_leak_and_delegated_failure(tmp_path):
+    idx = _tree(tmp_path, {"tmtpu/tpu/b.py": """
+def leaky(pbr, dev):
+    if pbr.allow():
+        out = run_kernel(dev)
+        pbr.record_success()
+    return out
+
+def delegated(pbr, dev):
+    if pbr.allow():
+        try:
+            out = run_kernel(dev)
+            pbr.record_success()
+        except Exception as e:
+            note_pallas_failure(pbr, e)
+            out = run_fallback(dev)
+    return out
+"""})
+    keys = _keys(_run(idx, "exception-safety"))
+    assert "exception-safety::breaker-leak::tmtpu/tpu/b.py::leaky" in keys
+    assert not any("delegated" in k for k in keys), keys
+
+
+# ------------------------------------------------------------- jax-hygiene
+
+
+def test_jax_hygiene_host_sync_on_hot_flush_path(tmp_path):
+    """A .item() readback reached through a helper from _verify_pending
+    is a per-flush device stall; the same marker on a cold path (outside
+    the dispatch tier) is exempt."""
+    idx = _tree(tmp_path, {
+        "tmtpu/crypto/batch.py": """
+class BatchVerifier:
+    def _verify_pending(self):
+        mask = self._flush()
+        return self._count(mask)
+
+    def _count(self, mask):
+        return mask.sum().item()
+""",
+        "tmtpu/consensus/cold.py": """
+def config_height(arr):
+    return arr[0].item()
+""",
+    })
+    keys = _keys(_run(idx, "jax-hygiene"))
+    assert any("host-sync:item" in k and "crypto/batch.py" in k
+               for k in keys), keys
+    assert not any("cold" in k for k in keys), keys
+
+
+def test_jax_hygiene_bucket_bypass_and_quantized_dispatch(tmp_path):
+    idx = _tree(tmp_path, {"tmtpu/tpu/k.py": """
+import jax
+
+@jax.jit
+def _verify_jit(dev):
+    return dev
+
+def raw_dispatch(dev):
+    return _verify_jit(dev)
+
+def bucketed_dispatch(dev, n):
+    padded = _pad_to_bucket(n)
+    return _verify_jit(pad_packed(dev, padded))
+"""})
+    keys = _keys(_run(idx, "jax-hygiene"))
+    assert "jax-hygiene::bucket-bypass::tmtpu/tpu/k.py::raw_dispatch" \
+           "::_verify_jit" in keys
+    assert not any("bucketed_dispatch" in k for k in keys), keys
+
+
+def test_jax_hygiene_unguarded_dispatch_vs_breaker(tmp_path):
+    """batch_verify* outside tmtpu/tpu/ needs breaker discipline; the
+    sync point behind a breaker fallback (pbr.allow() in frame) is the
+    sanctioned shape and stays clean."""
+    idx = _tree(tmp_path, {"tmtpu/consensus/v.py": """
+def naked(pks, msgs, sigs):
+    return batch_verify(pks, msgs, sigs)
+
+def guarded(pks, msgs, sigs, pbr):
+    if not pbr.allow():
+        return [one_verify(p, m, s) for p, m, s in zip(pks, msgs, sigs)]
+    return batch_verify(pks, msgs, sigs)
+"""})
+    keys = _keys(_run(idx, "jax-hygiene"))
+    assert "jax-hygiene::unguarded-dispatch::tmtpu/consensus/v.py" \
+           "::naked::batch_verify" in keys
+    assert not any("::guarded::" in k for k in keys), keys
+
+
+# ------------------------------------------------------------ result cache
+
+
+def test_result_cache_roundtrip_and_invalidation(tmp_path):
+    from tmtpu.analysis.cache import ResultCache
+
+    files = {"tmtpu/consensus/l.py": """
+class S:
+    def bad(self):
+        self._mtx.acquire()
+        self.apply(self.block)
+        self._mtx.release()
+"""}
+    idx = _tree(tmp_path, files)
+    cache = ResultCache(str(tmp_path))
+    stats: dict = {}
+    r1 = registry.run(idx, ["exception-safety"], cache=cache, stats=stats)
+    assert stats["exception-safety"]["cached"] is False
+    cache.save()
+
+    # warm: same tree, fresh cache object -> served from disk
+    cache2 = ResultCache(str(tmp_path))
+    stats2: dict = {}
+    r2 = registry.run(idx, ["exception-safety"], cache=cache2,
+                      stats=stats2)
+    assert stats2["exception-safety"]["cached"] is True
+    assert _keys(r2["exception-safety"]) == _keys(r1["exception-safety"])
+
+    # an edit (content + size change) invalidates
+    (tmp_path / "tmtpu/consensus/l.py").write_text("x = 1\n")
+    idx3 = RepoIndex(str(tmp_path))
+    cache3 = ResultCache(str(tmp_path))
+    stats3: dict = {}
+    r3 = registry.run(idx3, ["exception-safety"], cache=cache3,
+                      stats=stats3)
+    assert stats3["exception-safety"]["cached"] is False
+    assert r3["exception-safety"] == []
+
+
+def test_cli_sarif_output(capsys):
+    from tools import lint
+
+    assert lint.main(["--format", "sarif", "--rule", "blocking-lock",
+                      "--no-cache"]) == 0
+    sarif = json.loads(capsys.readouterr().out)
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    assert run["tool"]["driver"]["name"] == "tmtpu-lint"
+    assert run["tool"]["driver"]["rules"][0]["id"] == "blocking-lock"
+    # the baselined findings surface as suppressed results, not failures
+    assert all("suppressions" in r for r in run["results"])
+    assert all(r["partialFingerprints"]["lintKey"] for r in run["results"])
+
+
+def test_cli_update_baseline_prunes_and_writes_meta(tmp_path, capsys,
+                                                   monkeypatch):
+    from tools import lint
+
+    meta_path = tmp_path / "lint_meta.json"
+    monkeypatch.setattr(lint, "META_PATH", str(meta_path))
+    bl_path = tmp_path / "baseline.json"
+    bl_path.write_text(json.dumps({"rules": {"timeline": {
+        "status": "suppressions", "suppressions": [
+            {"key": "timeline::gone::xyz", "reason": "stale entry"}]}}}))
+    assert lint.main(["--rule", "timeline", "--no-cache",
+                      "--baseline", str(bl_path),
+                      "--update-baseline"]) == 0
+    out = capsys.readouterr().out
+    assert "pruned stale suppression [timeline] 'timeline::gone::xyz'" \
+           in out
+    assert json.loads(bl_path.read_text())["rules"]["timeline"] == \
+           {"status": "clean"}
+    meta = json.loads(meta_path.read_text())
+    assert meta["rules"]["timeline"]["findings"] == 0
+    assert meta["rules"]["timeline"]["seconds"] >= 0

@@ -1,0 +1,83 @@
+"""Shutdown paths join their worker threads (exception-safety fixes).
+
+The `exception-safety` lint's unjoined-thread check found a dozen
+stop()/on_stop() paths that set a flag and returned while the worker
+thread still ran, racing teardown (a test tearing down a node could see
+the old worker touch a closed socket or a reopened WAL). The fixes
+join with a bounded timeout, guarded against self-join when stop() is
+invoked from the worker's own callback. These are the runtime proofs
+for the representative fixes; the lint fixture in tests/test_lint.py
+covers the pattern structurally for the rest.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from tmtpu.consensus.ticker import TimeoutTicker
+from tmtpu.state.txindex import IndexerService
+from tmtpu.types.event_bus import EventBus
+
+
+def test_ticker_stop_joins_worker():
+    ticker = TimeoutTicker(lambda ti: None)
+    ticker.start()
+    assert ticker._thread.is_alive()
+    ticker.stop()
+    assert not ticker._thread.is_alive()
+
+
+def test_ticker_stop_from_timeout_callback_does_not_self_join():
+    """stop() fired from the on_timeout callback runs ON the ticker
+    thread — the join must skip itself instead of deadlocking."""
+    from tmtpu.consensus.ticker import TimeoutInfo
+
+    ticker = None
+    fired = threading.Event()
+
+    def on_timeout(ti):
+        ticker.stop()          # would deadlock without the guard
+        fired.set()
+
+    ticker = TimeoutTicker(on_timeout)
+    ticker.start()
+    ticker.schedule_timeout(TimeoutInfo(duration_ns=1, height=1,
+                                        round=0, step=1))
+    assert fired.wait(timeout=5.0)
+    deadline = time.monotonic() + 5.0
+    while ticker._thread.is_alive():
+        assert time.monotonic() < deadline, "ticker thread never exited"
+        time.sleep(0.01)
+
+
+def test_indexer_service_stop_joins_worker():
+    class NullIndexer:
+        def index(self, tx_result):
+            pass
+
+    svc = IndexerService(NullIndexer(), EventBus())
+    svc.start()
+    assert svc._thread.is_alive()
+    svc.stop()
+    assert not svc._thread.is_alive()
+
+
+def test_socket_client_stop_before_start_is_safe():
+    """stop() before start(): the join path must tolerate threads that
+    were never created (they are None, not missing attributes)."""
+    from tmtpu.abci.client import SocketClient
+
+    SocketClient("tcp://127.0.0.1:1").stop()
+
+
+def test_blocksync_reactor_stop_joins_pool_routine():
+    from tmtpu.blocksync.reactor import BlocksyncReactor
+
+    r = BlocksyncReactor.__new__(BlocksyncReactor)
+    r._stopped = threading.Event()
+    r._thread = threading.Thread(
+        target=lambda: r._stopped.wait(10.0), daemon=True)
+    r._thread.start()
+    r.on_stop()
+    assert not r._thread.is_alive()

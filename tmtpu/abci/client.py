@@ -197,6 +197,8 @@ class SocketClient(Client):
         self._global_cb = None
         self._err: Optional[Exception] = None
         self._stopped = threading.Event()
+        self._send_t: Optional[threading.Thread] = None
+        self._recv_t: Optional[threading.Thread] = None
 
     def start(self) -> None:
         self._sock = _dial(self.addr)
@@ -214,6 +216,13 @@ class SocketClient(Client):
             except OSError:
                 pass
             self._sock.close()
+        cur = threading.current_thread()
+        st = self._send_t
+        if st is not None and st is not cur:
+            st.join(timeout=2.0)
+        rt = self._recv_t
+        if rt is not None and rt is not cur:
+            rt.join(timeout=2.0)
 
     def _send_loop(self) -> None:
         wfile = self._sock.makefile("wb")

@@ -117,6 +117,9 @@ class GRPCClient(Client):
                 self._sock.close()
             except OSError:
                 pass
+        t = self._worker
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)
 
     # -- calls --------------------------------------------------------------
 

@@ -1,0 +1,135 @@
+"""Incremental lint result cache under ``.lint_cache/``.
+
+Parsing the tree costs ~1s and the rule sweep ~3s; a pre-commit hook
+that pays that on every invocation gets disabled by its users. The fix
+is NOT caching ASTs (a pickled ``ast.Module`` forest loads *slower*
+than re-parsing the source) but caching **per-rule results** keyed by
+the per-file fingerprints of everything the rule can read:
+
+- each source file contributes a ``"mtime_ns:size"`` key, recorded
+  per (repo-relative) path;
+- a rule's file set = the indexed files under its trigger prefixes
+  (all files for catch-all triggers), plus the *infra set* — the
+  analysis framework itself (``tmtpu/analysis/``), the lint driver,
+  the baseline, and ``docs/ANALYSIS.md`` — so engine or baseline edits
+  invalidate everything, conservatively.
+
+A rule's cached findings are reused only when every file key in its
+recorded set matches the tree *exactly* (adds, deletes, and edits all
+miss). A warm ``--changed`` re-run — same tree, cache populated — does
+zero parsing and zero rule work.
+
+The cache is advisory: corrupt or version-skewed files are ignored and
+rewritten. It only ever engages for the real repo root (fixture trees
+under ``tmp_path`` churn too fast to be worth fingerprinting and must
+not write into the repo).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from tmtpu.analysis.findings import Finding
+from tmtpu.analysis.index import RepoIndex
+
+CACHE_DIRNAME = ".lint_cache"
+CACHE_BASENAME = "results.json"
+# bump when Finding serialization or fingerprint semantics change
+CACHE_VERSION = 1
+
+# files every rule implicitly depends on (prefixes and exact paths,
+# repo-relative): the framework, the driver, the baseline, the docs
+# the meta rule reads
+INFRA_PREFIXES = ("tmtpu/analysis/",)
+INFRA_FILES = ("docs/ANALYSIS.md", "tools/lint.py",
+               "tools/lint_baseline.json", "tests/test_lint.py")
+
+
+def _file_key(path: str) -> Optional[str]:
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return f"{st.st_mtime_ns}:{st.st_size}"
+
+
+class ResultCache:
+    """Load-once / save-once per-rule finding cache."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self.path = os.path.join(self.root, CACHE_DIRNAME, CACHE_BASENAME)
+        self._rules: Dict[str, dict] = {}
+        self._dirty = False
+        try:
+            with open(self.path, encoding="utf-8") as fh:
+                data = json.load(fh)
+            if data.get("version") == CACHE_VERSION and \
+                    isinstance(data.get("rules"), dict):
+                self._rules = data["rules"]
+        except (OSError, ValueError):
+            pass
+
+    # --------------------------------------------------------- fingerprint
+
+    def _rule_files(self, index: RepoIndex, triggers) -> List[str]:
+        rels = set()
+        if "" in triggers:
+            rels.update(fi.rel for fi in index.files())
+        else:
+            for trig in triggers:
+                rels.update(fi.rel for fi in index.files(trig))
+        for fi in index.files(*INFRA_PREFIXES):
+            rels.add(fi.rel)
+        rels.update(INFRA_FILES)
+        return sorted(rels)
+
+    def _current_keys(self, index: RepoIndex, triggers) -> Dict[str, str]:
+        out = {}
+        for rel in self._rule_files(index, triggers):
+            key = _file_key(os.path.join(self.root, rel))
+            if key is not None:
+                out[rel] = key
+        return out
+
+    # -------------------------------------------------------------- lookup
+
+    def lookup(self, rule_id: str, index: RepoIndex,
+               triggers) -> Optional[List[Finding]]:
+        """Cached findings for ``rule_id`` iff its file set is unchanged
+        (same paths, same mtime/size for each); None on any miss."""
+        entry = self._rules.get(rule_id)
+        if entry is None:
+            return None
+        if entry.get("files") != self._current_keys(index, triggers):
+            return None
+        try:
+            return [Finding(**f) for f in entry["findings"]]
+        except (TypeError, ValueError, KeyError):
+            return None
+
+    def store(self, rule_id: str, index: RepoIndex, triggers,
+              findings: List[Finding]) -> None:
+        self._rules[rule_id] = {
+            "files": self._current_keys(index, triggers),
+            "findings": [f.to_dict() for f in findings],
+        }
+        self._dirty = True
+
+    # --------------------------------------------------------------- save
+
+    def save(self) -> None:
+        if not self._dirty:
+            return
+        try:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            tmp = self.path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump({"version": CACHE_VERSION, "rules": self._rules},
+                          fh, sort_keys=True)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass                      # advisory: a read-only tree is fine
+        self._dirty = False

@@ -1,0 +1,525 @@
+"""Interprocedural forward-dataflow (taint) layer over the callgraph.
+
+The wire-taint rule needs a fact the lock engine cannot express: *which
+values* a method touches, not just *what it calls*. This module adds a
+taint engine on top of ``callgraph.Analyzer``'s resolution rules
+(``self.m()`` via MRO, ``self.attr.m()`` via constructor types,
+name-unique fallback — identical conservatism, identical witness
+chains):
+
+- **Summaries are label-polymorphic.** ``summarize(cls, fn, tainted)``
+  analyzes one method with each tainted parameter carrying *its own
+  name* as an abstract label. The memo key is (context class, function,
+  tainted-param set), so one summary serves every call site; callers
+  substitute the abstract labels with whatever concrete labels their
+  arguments carry. Pseudo-params of the form ``self.attr`` seed
+  attribute taint the same way (used for channel propagation).
+
+- **Gen:** assignments, tuple unpacking, ``for`` targets, ``with ... as``,
+  attribute/subscript/operator composition, and *unresolved* calls all
+  propagate taint from operands to results (a decode helper we cannot
+  resolve is assumed to return tainted bytes). Stores into ``self.attr``
+  (plain assignment or ``.put/.append/.add/...`` on a self attribute)
+  are recorded as **attr writes** so callers — and the channel fixpoint
+  — can see taint crossing an object boundary.
+
+- **Kill:** a call to a *sanitizer* (``validate_basic``, ``verify_one``,
+  the batch-verify family) launders the **whole frame** from that
+  statement on. Statically tracking the verified-mask indexing that
+  follows a batch verify is out of reach; the invariant this enforces is
+  the paper's actual one — *a verification call stands between the wire
+  and the sink on every path* — and statement order is exactly how the
+  code expresses it.
+
+- **Sinks** are classified by a caller-supplied ``sink_fn(call)``; a
+  sink call with tainted arguments (or a tainted receiver) emits a
+  ``TaintHit`` with a witness chain, outermost frame first, just like
+  the lock engine's events.
+
+- **Channels:** ``propagate(seeds)`` runs the entry summaries, then
+  iterates to a bounded fixpoint over a global channel map: any
+  ``(class, attr)`` that received tainted writes
+  (``self._queue.put(tainted_msg)``) makes every ``self.attr`` read in
+  that class's methods yield those labels on the next round, and every
+  method of a tainted class becomes an entry (thread loops are entered
+  by the runtime, not by calls we can see) — so taint follows the
+  reactor-thread → queue → state-thread handoff that every reactor in
+  this codebase uses, including through helpers that *return* the
+  drained messages. Labels only grow, so the fixpoint terminates.
+
+Like the lock engine, findings UNDER-approximate: sequential processing
+of branches means a sanitizer in an early branch launders later code,
+and unresolved *receivers* drop attribute taint. Every hit carries a
+hand-checkable witness chain.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import (Callable, Dict, FrozenSet, Iterable, List, Optional,
+                    Set, Tuple)
+
+from tmtpu.analysis.callgraph import Analyzer
+from tmtpu.analysis.index import ClassInfo, RepoIndex
+
+EMPTY: FrozenSet[str] = frozenset()
+
+# receiver methods that store their argument into the receiver's
+# collection: self.attr.put(x) taints (class, attr)
+_STORE_METHODS = {"put", "put_nowait", "append", "appendleft", "add",
+                  "extend", "push", "insert"}
+# receiver methods that read an element back out of a collection
+_LOAD_METHODS = {"get", "get_nowait", "pop", "popleft"}
+
+
+@dataclass(frozen=True)
+class TaintHit:
+    sink: str                    # sink_fn's label, e.g. "tally:add_verified_vote"
+    labels: FrozenSet[str]       # taint labels reaching the sink
+    rel: str
+    line: int
+    chain: Tuple[str, ...]       # call chain, outermost first
+
+    def via(self) -> str:
+        return " -> ".join(self.chain)
+
+
+@dataclass(frozen=True)
+class Summary:
+    """Label-polymorphic effect summary of one (class, fn, tainted) frame."""
+    hits: Tuple[TaintHit, ...]                       # sinks reached
+    ret: FrozenSet[str]                              # labels flowing to return
+    attr_writes: Tuple[Tuple[str, str, FrozenSet[str]], ...]
+    # (class_name, attr, labels) stored into self.attr somewhere below
+    sanitizes: bool = False
+    # frame (transitively) called a sanitizer: a call to this function
+    # counts as a verification gate in the caller too
+
+
+_EMPTY_SUMMARY = Summary((), EMPTY, ())
+
+
+class TaintAnalyzer:
+    """Forward taint propagation along the callgraph's resolution rules."""
+
+    def __init__(self, index: RepoIndex,
+                 sink_fn: Callable[[ast.Call], Optional[str]],
+                 sanitizers: Set[str],
+                 prefixes: Tuple[str, ...] = ("tmtpu",),
+                 max_depth: int = 10):
+        self.cg = Analyzer(index, prefixes=prefixes, max_depth=max_depth)
+        self.sink_fn = sink_fn
+        self.sanitizers = set(sanitizers)
+        self.max_depth = max_depth
+        # global channel taint: (class name, attr) -> concrete labels;
+        # consulted at every self.attr read, grown by propagate()
+        self.channels: Dict[Tuple[str, str], FrozenSet[str]] = {}
+        self._memo: Dict[Tuple[int, int, FrozenSet[str]], Summary] = {}
+        self._in_progress: set = set()
+
+    # --------------------------------------------------------- summaries
+
+    def summarize(self, cls: Optional[ClassInfo], fn: ast.FunctionDef,
+                  rel: str, tainted: FrozenSet[str]) -> Summary:
+        """Effect summary with each tainted param labeled by its own name
+        (names of the form ``self.attr`` seed attribute taint)."""
+        if not tainted:
+            tainted = EMPTY
+        key = (id(cls) if cls is not None else 0, id(fn), tainted)
+        if key in self._memo:
+            return self._memo[key]
+        if key in self._in_progress or \
+                len(self._in_progress) >= self.max_depth:
+            return _EMPTY_SUMMARY      # cycle / depth: stop, under-approximate
+        self._in_progress.add(key)
+        try:
+            summary = _FrameWalk(self, cls, fn, rel, tainted).run()
+        finally:
+            self._in_progress.discard(key)
+        self._memo[key] = summary
+        return summary
+
+    def entry_hits(self, cls: Optional[ClassInfo], fn: ast.FunctionDef,
+                   rel: str, seeds: Dict[str, str]
+                   ) -> Tuple[List[TaintHit],
+                              List[Tuple[str, str, FrozenSet[str]]]]:
+        """Analyze an entry point with concrete labels: ``seeds`` maps a
+        param name (or ``self.attr`` pseudo-param) to a concrete label.
+        Returns (hits, attr_writes) with abstract labels substituted."""
+        summary = self.summarize(cls, fn, rel, frozenset(seeds))
+        subst = {p: frozenset([lbl]) for p, lbl in seeds.items()}
+        hits = []
+        for h in summary.hits:
+            concrete = _substitute(h.labels, subst)
+            if concrete:
+                hits.append(TaintHit(h.sink, concrete, h.rel, h.line,
+                                     h.chain))
+        writes = []
+        for cname, attr, labels in summary.attr_writes:
+            concrete = _substitute(labels, subst)
+            if concrete:
+                writes.append((cname, attr, concrete))
+        return hits, writes
+
+    # ----------------------------------------------------- channel fixpoint
+
+    def propagate(self, seeds: Iterable[Tuple[Optional[ClassInfo],
+                                              ast.FunctionDef, str,
+                                              Dict[str, str]]],
+                  max_rounds: int = 4) -> List[TaintHit]:
+        """Run entry seeds to a bounded fixpoint over the channel map.
+
+        Each round analyzes the seeds plus every method of every class
+        with a tainted channel (a drained queue may surface anywhere in
+        the class — thread loops are entered by the runtime, not by
+        calls the callgraph can see). Tainted writes grow the channel
+        map; when it stops growing, the hit set is complete. Memoized
+        summaries are invalidated between rounds because channel reads
+        feed them."""
+        seeds = list(seeds)
+        hits: List[TaintHit] = []
+        hit_keys: set = set()
+        for _ in range(max_rounds):
+            writes: Dict[Tuple[str, str], Set[str]] = {}
+            entries = list(seeds)
+            entered = {(id(c) if c else 0, id(f)) for c, f, _, _ in seeds}
+            tainted_classes = {cname for (cname, _attr) in self.channels}
+            enter_classes: List[ClassInfo] = []
+            for cname in tainted_classes:
+                enter_classes.extend(self.cg._classes_named(cname))
+            # owners too: a class holding `self.pool = BlockPool(...)`
+            # drains the pool's channels from its own thread loop
+            for cls in self.cg._classes:
+                if set(cls.attr_ctors.values()) & tainted_classes:
+                    enter_classes.append(cls)
+            for cls in enter_classes:
+                for mname, (owner, fn) in self.cg.methods_of(cls).items():
+                    ekey = (id(cls), id(fn))
+                    if ekey in entered:
+                        continue
+                    entered.add(ekey)
+                    entries.append((cls, fn, owner.rel, {}))
+            for cls, fn, rel, labels in entries:
+                h, w = self.entry_hits(cls, fn, rel, labels)
+                for hit in h:
+                    k = (hit.sink, hit.labels, hit.rel, hit.chain)
+                    if k not in hit_keys:
+                        hit_keys.add(k)
+                        hits.append(hit)
+                for cname, attr, ls in w:
+                    writes.setdefault((cname, attr), set()).update(ls)
+            grown = False
+            for key, ls in writes.items():
+                have = self.channels.get(key, EMPTY)
+                if not set(ls) <= set(have):
+                    self.channels[key] = frozenset(have | ls)
+                    grown = True
+            if not grown:
+                break
+            self._memo.clear()   # summaries read the channel map
+        return hits
+
+
+def _substitute(labels: FrozenSet[str],
+                subst: Dict[str, FrozenSet[str]]) -> FrozenSet[str]:
+    """Map abstract (param-name) labels through ``subst``; concrete
+    labels (channel taint like ``wire``) pass through unchanged."""
+    out: Set[str] = set()
+    for lbl in labels:
+        out.update(subst.get(lbl, frozenset((lbl,))))
+    return frozenset(out)
+
+
+def _reads_self_attr(fn: ast.FunctionDef, attr: str) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and node.attr == attr and \
+                isinstance(node.value, ast.Name) and node.value.id == "self":
+            return True
+    return False
+
+
+class _FrameWalk:
+    """One summarization pass over a single function frame.
+
+    ``env`` maps local names and ``self.attr`` paths to abstract label
+    sets. Statements are processed in source order; branch bodies run
+    sequentially (see module docstring for the soundness trade)."""
+
+    def __init__(self, ta: TaintAnalyzer, cls: Optional[ClassInfo],
+                 fn: ast.FunctionDef, rel: str, tainted: FrozenSet[str]):
+        self.ta = ta
+        self.cls = cls
+        self.fn = fn
+        self.rel = rel
+        self.frame = f"{cls.name}.{fn.name}" if cls is not None else fn.name
+        self.env: Dict[str, FrozenSet[str]] = {p: frozenset([p])
+                                               for p in tainted}
+        # class names whose channel taint this frame's self.attr reads see
+        self.self_classes = tuple(c.name for c in ta.cg._mro(cls)) \
+            if cls is not None else ()
+        self.hits: List[TaintHit] = []
+        self.ret: Set[str] = set()
+        self.attr_writes: Dict[Tuple[str, str], Set[str]] = {}
+        self.sanitized = False
+
+    # ------------------------------------------------------------- run
+
+    def run(self) -> Summary:
+        for stmt in self.fn.body:
+            self._stmt(stmt)
+        writes = tuple((c, a, frozenset(ls))
+                       for (c, a), ls in sorted(self.attr_writes.items()))
+        return Summary(tuple(self.hits), frozenset(self.ret), writes,
+                       self.sanitized)
+
+    # ------------------------------------------------------ statements
+
+    def _stmt(self, node: ast.stmt) -> None:
+        if isinstance(node, ast.Assign):
+            labels = self._expr(node.value)
+            for tgt in node.targets:
+                self._bind(tgt, labels)
+        elif isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                self._bind(node.target, self._expr(node.value))
+        elif isinstance(node, ast.AugAssign):
+            labels = self._expr(node.value) | self._read_target(node.target)
+            self._bind(node.target, labels)
+        elif isinstance(node, ast.Return):
+            if node.value is not None:
+                self.ret.update(self._expr(node.value))
+        elif isinstance(node, ast.Expr):
+            self._expr(node.value)
+        elif isinstance(node, ast.For):
+            self._bind(node.target, self._expr(node.iter))
+            for s in node.body:
+                self._stmt(s)
+            for s in node.orelse:
+                self._stmt(s)
+        elif isinstance(node, ast.While):
+            self._expr(node.test)
+            for s in node.body:
+                self._stmt(s)
+            for s in node.orelse:
+                self._stmt(s)
+        elif isinstance(node, ast.If):
+            self._expr(node.test)
+            for s in node.body:
+                self._stmt(s)
+            for s in node.orelse:
+                self._stmt(s)
+        elif isinstance(node, ast.Try):
+            for s in node.body:
+                self._stmt(s)
+            for h in node.handlers:
+                for s in h.body:
+                    self._stmt(s)
+            for s in node.orelse:
+                self._stmt(s)
+            for s in node.finalbody:
+                self._stmt(s)
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                labels = self._expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars, labels)
+            for s in node.body:
+                self._stmt(s)
+        elif isinstance(node, (ast.Raise, ast.Assert)):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    self._expr(child)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def (callback/thread target): taint flows in via the
+            # closure — walk its body against a throwaway copy of the env
+            # so sinks inside are caught, but its local bindings stay local
+            saved = dict(self.env)
+            for s in node.body:
+                self._stmt(s)
+            self.env = saved
+        else:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    self._expr(child)
+                elif isinstance(child, ast.stmt):
+                    self._stmt(child)
+
+    def _bind(self, tgt: ast.expr, labels: FrozenSet[str]) -> None:
+        if isinstance(tgt, ast.Name):
+            if labels:
+                self.env[tgt.id] = labels
+            else:
+                self.env.pop(tgt.id, None)       # kill on clean reassignment
+        elif isinstance(tgt, ast.Attribute) and \
+                isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+            key = f"self.{tgt.attr}"
+            if labels:
+                self.env[key] = self.env.get(key, EMPTY) | labels
+                self._record_attr_write(tgt.attr, labels)
+            else:
+                self.env.pop(key, None)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._bind(elt, labels)          # coarse: every elt tainted
+        elif isinstance(tgt, ast.Subscript):
+            # x[k] = tainted: the container becomes tainted
+            self._bind(tgt.value, labels | self._read_target(tgt.value))
+        elif isinstance(tgt, ast.Starred):
+            self._bind(tgt.value, labels)
+
+    def _read_target(self, tgt: ast.expr) -> FrozenSet[str]:
+        if isinstance(tgt, ast.Name):
+            return self.env.get(tgt.id, EMPTY)
+        if isinstance(tgt, ast.Attribute) and \
+                isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+            return self.env.get(f"self.{tgt.attr}", EMPTY)
+        return EMPTY
+
+    def _record_attr_write(self, attr: str, labels: FrozenSet[str]) -> None:
+        cname = self.cls.name if self.cls is not None else self.rel
+        self.attr_writes.setdefault((cname, attr), set()).update(labels)
+
+    # ----------------------------------------------------- expressions
+
+    def _expr(self, node: ast.expr) -> FrozenSet[str]:
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id, EMPTY)
+        if isinstance(node, ast.Attribute):
+            if isinstance(node.value, ast.Name) and node.value.id == "self":
+                out = self.env.get(f"self.{node.attr}", EMPTY)
+                for cname in self.self_classes:
+                    out |= self.ta.channels.get((cname, node.attr), EMPTY)
+                return out
+            return self._expr(node.value)        # field of tainted is tainted
+        if isinstance(node, ast.Call):
+            return self._call(node)
+        if isinstance(node, (ast.Lambda,)):
+            saved = dict(self.env)
+            out = self._expr(node.body)
+            self.env = saved
+            return out
+        out: Set[str] = set()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                out.update(self._expr(child))
+        return frozenset(out)
+
+    # ----------------------------------------------------------- calls
+
+    @staticmethod
+    def _call_name(call: ast.Call) -> str:
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            return f.attr
+        if isinstance(f, ast.Name):
+            return f.id
+        return ""
+
+    def _call(self, call: ast.Call) -> FrozenSet[str]:
+        name = self._call_name(call)
+        arg_labels = [self._expr(a) for a in call.args]
+        kw_labels = {kw.arg: self._expr(kw.value) for kw in call.keywords
+                     if kw.arg is not None}
+        for kw in call.keywords:
+            if kw.arg is None:                   # **kwargs splat
+                arg_labels.append(self._expr(kw.value))
+        recv_labels = EMPTY
+        recv = None
+        if isinstance(call.func, ast.Attribute):
+            recv = call.func.value
+            recv_labels = self._expr(recv)
+        all_labels = frozenset().union(recv_labels, *arg_labels,
+                                       *kw_labels.values()) \
+            if (arg_labels or kw_labels or recv_labels) else EMPTY
+
+        # sanitizer: verification happened — launder the whole frame from
+        # here on (statement order IS the verify-before-sink invariant).
+        # The frame-transitive flag is only set when the sanitizer saw
+        # tainted data: verifying an unrelated object must not count as
+        # a gate for the caller's taint.
+        if name in self.ta.sanitizers:
+            self.env.clear()
+            if all_labels:
+                self.sanitized = True
+            return EMPTY
+
+        # sink: tainted data reaching a protected mutation
+        sink = self.ta.sink_fn(call)
+        if sink is not None and all_labels:
+            self.hits.append(TaintHit(sink, all_labels, self.rel,
+                                      call.lineno, (self.frame,)))
+
+        # collection store: x.put/append(tainted) taints the container —
+        # a self attr becomes a channel write, a local just gets tainted
+        if recv is not None and name in _STORE_METHODS:
+            stored = frozenset().union(*arg_labels) if arg_labels else EMPTY
+            if stored and isinstance(recv, ast.Attribute) and \
+                    isinstance(recv.value, ast.Name) and \
+                    recv.value.id == "self":
+                key = f"self.{recv.attr}"
+                self.env[key] = self.env.get(key, EMPTY) | stored
+                self._record_attr_write(recv.attr, stored)
+            elif stored and isinstance(recv, ast.Name):
+                self.env[recv.id] = self.env.get(recv.id, EMPTY) | stored
+
+        # resolved callees: substitute through their polymorphic summary
+        callees = self.ta.cg.resolve_call(self.cls, call)
+        if callees:
+            result: Set[str] = set(recv_labels)  # method of tainted object
+            sanitizes = False
+            for sub_cls, sub_fn, sub_rel in callees:
+                labels, sub_sanitizes = self._apply_summary(
+                    call, sub_cls, sub_fn, sub_rel, arg_labels, kw_labels)
+                result.update(labels)
+                sanitizes = sanitizes or sub_sanitizes
+            if sanitizes:
+                # the callee IS a verification gate (e.g. a wrapper over
+                # verify_commits_light_batch): launder this frame too
+                self.env.clear()
+                self.sanitized = True
+                return EMPTY
+            return frozenset(result)
+
+        # unresolved: conservative propagation — tainted in, tainted out
+        return all_labels
+
+    def _apply_summary(self, call: ast.Call, sub_cls: Optional[ClassInfo],
+                       sub_fn: ast.FunctionDef, sub_rel: str,
+                       arg_labels: List[FrozenSet[str]],
+                       kw_labels: Dict[str, FrozenSet[str]]
+                       ) -> Tuple[FrozenSet[str], bool]:
+        params = [a.arg for a in sub_fn.args.args]
+        is_method = sub_cls is not None and params and params[0] == "self"
+        if is_method:
+            params = params[1:]
+        subst: Dict[str, FrozenSet[str]] = {}
+        for i, labels in enumerate(arg_labels):
+            if labels and i < len(params):
+                subst[params[i]] = labels
+        for pname, labels in kw_labels.items():
+            if labels and pname in params:
+                subst[pname] = subst.get(pname, EMPTY) | labels
+        # summarize even with no tainted args: the callee can still pull
+        # taint out of a channel (a drained queue) and return it
+        summary = self.ta.summarize(sub_cls, sub_fn, sub_rel,
+                                    frozenset(subst))
+        for h in summary.hits:
+            concrete = _substitute(h.labels, subst)
+            if concrete:
+                self.hits.append(TaintHit(
+                    h.sink, concrete, h.rel, h.line,
+                    (self.frame,) + h.chain))
+        # attr writes below a self.m() call happen on OUR self
+        same_self = (isinstance(call.func, ast.Attribute) and
+                     isinstance(call.func.value, ast.Name) and
+                     call.func.value.id == "self")
+        for cname, attr, labels in summary.attr_writes:
+            concrete = _substitute(labels, subst)
+            if not concrete:
+                continue
+            self.attr_writes.setdefault((cname, attr), set()).update(concrete)
+            if same_self:
+                key = f"self.{attr}"
+                self.env[key] = self.env.get(key, EMPTY) | concrete
+        return _substitute(summary.ret, subst), summary.sanitizes

@@ -61,12 +61,20 @@ def all_rule_ids() -> List[str]:
     return sorted(load_rules())
 
 
-def run(index: RepoIndex, rule_ids: Optional[Sequence[str]] = None
+def run(index: RepoIndex, rule_ids: Optional[Sequence[str]] = None,
+        cache=None, stats: Optional[dict] = None
         ) -> Dict[str, List[Finding]]:
     """Run the requested rules (default: all) against one shared index.
     Returns {rule_id: [findings]} with an entry for every rule that ran
     (empty list = clean). Rules needing runtime imports are skipped
-    silently on non-repo indexes (synthetic fixture trees)."""
+    silently on non-repo indexes (synthetic fixture trees).
+
+    ``cache`` (a ``tmtpu.analysis.cache.ResultCache``) short-circuits
+    rules whose fingerprinted file set is unchanged; ``stats``, when a
+    dict is passed, is filled with per-rule run metadata
+    ``{rid: {"seconds", "findings", "cached"}}``."""
+    import time
+
     rules = load_rules()
     ids = list(rule_ids) if rule_ids is not None else sorted(rules)
     unknown = [i for i in ids if i not in rules]
@@ -78,12 +86,28 @@ def run(index: RepoIndex, rule_ids: Optional[Sequence[str]] = None
         r = rules[rid]
         if r.requires_import and not index.importable:
             continue
-        findings = list(r.fn(index))
-        for f in findings:
-            if f.rule != rid:
-                raise ValueError(
-                    f"rule {rid!r} emitted a finding tagged {f.rule!r}")
+        t0 = time.perf_counter()
+        cached = None
+        if cache is not None:
+            cached = cache.lookup(rid, index, r.triggers)
+        if cached is not None:
+            findings = cached
+        else:
+            findings = list(r.fn(index))
+            for f in findings:
+                if f.rule != rid:
+                    raise ValueError(
+                        f"rule {rid!r} emitted a finding tagged "
+                        f"{f.rule!r}")
+            if cache is not None:
+                cache.store(rid, index, r.triggers, findings)
         out[rid] = findings
+        if stats is not None:
+            stats[rid] = {
+                "seconds": round(time.perf_counter() - t0, 4),
+                "findings": len(findings),
+                "cached": cached is not None,
+            }
     return out
 
 

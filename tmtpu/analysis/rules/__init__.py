@@ -10,7 +10,9 @@ entry, test coverage, and baseline status).
 from tmtpu.analysis.rules import (  # noqa: F401
     blocking_lock,
     determinism,
+    exception_safety,
     failpoints,
+    jax_hygiene,
     lock_order,
     meta,
     metrics,
@@ -19,4 +21,5 @@ from tmtpu.analysis.rules import (  # noqa: F401
     sidecar,
     sigcache,
     timeline,
+    wire_taint,
 )

@@ -1,0 +1,299 @@
+"""exception-safety rule: resources that leak when a call raises.
+
+Four syntactic checks, all per-function/per-class (no interprocedural
+walk needed — the leak is visible in the frame that owns the resource):
+
+- **lock-across-raise** — ``x.acquire()`` paired with an ``x.release()``
+  that is *not* in a ``finally`` block, with call sites in between that
+  can raise: one exception and the lock is held forever. (The ``with``
+  statement form is invisible here by construction — that's the fix.)
+- **unjoined-thread** — a class stores a worker thread on ``self``
+  (``self.x = Thread(...)``), has a shutdown-path method (``stop`` /
+  ``on_stop`` / ``close`` / ...), and no method ever joins that thread:
+  shutdown returns while the worker still runs, racing teardown.
+- **unclosed-resource** — ``open(...)`` / ``socket.socket(...)`` bound
+  to a local that is never closed, never returned, never stored, and
+  never handed to another call — a guaranteed fd leak on any path.
+- **breaker-leak** — a function drives a circuit breaker probe
+  (``.allow()`` ... ``.record_success()``) with no failure path
+  (``record_failure`` / ``note_failure``): an exception between the two
+  strands the breaker half-open. Sites whose *caller* owns the failure
+  accounting are baselined with that justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tmtpu.analysis.findings import Finding
+from tmtpu.analysis.index import RepoIndex
+from tmtpu.analysis.registry import rule
+
+SHUTDOWN_METHODS = {"stop", "on_stop", "close", "shutdown", "teardown",
+                    "stop_sync", "__exit__"}
+THREAD_CTORS = {"Thread"}
+RESOURCE_CTORS = {"open", "socket"}
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # noqa: BLE001
+        return ""
+
+
+def _functions(index: RepoIndex, prefix: str = "tmtpu"):
+    """(rel, qualname, fn) for every module-level function and method."""
+    for fi in index.files(prefix):
+        if fi.tree is None:
+            continue
+        for node in fi.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield fi.rel, node.name, node
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        yield fi.rel, f"{node.name}.{sub.name}", sub
+
+
+# ------------------------------------------------------ lock-across-raise
+
+def _finally_nodes(fn: ast.AST) -> Set[int]:
+    """ids of every node nested under a ``finally`` block in ``fn``."""
+    out: Set[int] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Try):
+            for stmt in node.finalbody:
+                for sub in ast.walk(stmt):
+                    out.add(id(sub))
+    return out
+
+
+def _check_lock_across_raise(index: RepoIndex) -> List[Finding]:
+    findings = []
+    for rel, qual, fn in _functions(index):
+        in_finally = _finally_nodes(fn)
+        acquires: Dict[str, int] = {}        # receiver -> first lineno
+        releases: Dict[str, List[Tuple[int, bool]]] = {}
+        calls: List[int] = []
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call) and
+                    isinstance(node.func, ast.Attribute)):
+                continue
+            recv = _unparse(node.func.value)
+            if node.func.attr == "acquire":
+                acquires.setdefault(recv, node.lineno)
+            elif node.func.attr == "release":
+                releases.setdefault(recv, []).append(
+                    (node.lineno, id(node) in in_finally))
+            else:
+                calls.append(node.lineno)
+        for recv, acq_line in acquires.items():
+            rels = releases.get(recv)
+            if not rels:
+                continue                     # split acquire/release API
+            if any(protected for _, protected in rels):
+                continue
+            rel_line = max(line for line, _ in rels)
+            if not any(acq_line < c < rel_line for c in calls):
+                continue                     # nothing can raise in between
+            findings.append(Finding(
+                "exception-safety", rel,
+                f"{qual} holds {recv}.acquire() across raising calls with "
+                f"release() at line {rel_line} outside finally — use "
+                f"`with` or try/finally",
+                line=acq_line,
+                key=f"exception-safety::lock-across-raise::{rel}::{qual}"
+                    f"::{recv}"))
+    return findings
+
+
+# -------------------------------------------------------- unjoined-thread
+
+def _joined_attrs(fn: ast.AST) -> Set[str]:
+    """self attrs whose threads get ``.join()``ed in this function,
+    directly (``self.x.join()``), via a local alias (``t = self.x``),
+    or via iteration (``for t in self.xs``)."""
+    aliases: Dict[str, str] = {}
+    joined: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Attribute) and \
+                isinstance(node.value.value, ast.Name) and \
+                node.value.value.id == "self":
+            aliases[node.targets[0].id] = node.value.attr
+        if isinstance(node, ast.For) and \
+                isinstance(node.target, ast.Name) and \
+                isinstance(node.iter, ast.Attribute) and \
+                isinstance(node.iter.value, ast.Name) and \
+                node.iter.value.id == "self":
+            aliases[node.target.id] = node.iter.attr
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "join":
+            recv = node.func.value
+            if isinstance(recv, ast.Attribute) and \
+                    isinstance(recv.value, ast.Name) and \
+                    recv.value.id == "self":
+                joined.add(recv.attr)
+            elif isinstance(recv, ast.Name) and recv.id in aliases:
+                joined.add(aliases[recv.id])
+    return joined
+
+
+def _check_unjoined_threads(index: RepoIndex) -> List[Finding]:
+    findings = []
+    for cls in index.classes("tmtpu"):
+        thread_attrs: Dict[str, int] = {}
+        for fn in cls.methods.values():
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call):
+                    f = node.value.func
+                    ctor = f.attr if isinstance(f, ast.Attribute) else \
+                        f.id if isinstance(f, ast.Name) else ""
+                    if ctor not in THREAD_CTORS:
+                        continue
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Attribute) and \
+                                isinstance(tgt.value, ast.Name) and \
+                                tgt.value.id == "self":
+                            thread_attrs.setdefault(tgt.attr, node.lineno)
+        if not thread_attrs:
+            continue
+        if not (set(cls.methods) & SHUTDOWN_METHODS):
+            continue                         # no shutdown path to audit
+        joined: Set[str] = set()
+        for fn in cls.methods.values():
+            joined |= _joined_attrs(fn)
+        for attr, line in sorted(thread_attrs.items()):
+            if attr in joined:
+                continue
+            findings.append(Finding(
+                "exception-safety", cls.rel,
+                f"{cls.name}.{attr} worker thread is never joined — "
+                f"shutdown returns while it still runs, racing teardown",
+                line=line,
+                key=f"exception-safety::unjoined-thread::{cls.rel}"
+                    f"::{cls.name}.{attr}"))
+    return findings
+
+
+# ------------------------------------------------------ unclosed-resource
+
+def _with_nodes(fn: ast.AST) -> Set[int]:
+    out: Set[int] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                for sub in ast.walk(item.context_expr):
+                    out.add(id(sub))
+    return out
+
+
+def _check_unclosed_resources(index: RepoIndex) -> List[Finding]:
+    findings = []
+    for rel, qual, fn in _functions(index):
+        in_with = _with_nodes(fn)
+        opened: Dict[str, Tuple[int, str]] = {}
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Assign) and
+                    isinstance(node.value, ast.Call) and
+                    id(node.value) not in in_with and
+                    len(node.targets) == 1 and
+                    isinstance(node.targets[0], ast.Name)):
+                continue
+            f = node.value.func
+            ctor = f.attr if isinstance(f, ast.Attribute) else \
+                f.id if isinstance(f, ast.Name) else ""
+            if ctor in RESOURCE_CTORS:
+                opened[node.targets[0].id] = (node.lineno, ctor)
+        if not opened:
+            continue
+        escaped: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                # `f = open(...)` then `with f:` — closed on block exit
+                for item in node.items:
+                    if isinstance(item.context_expr, ast.Name):
+                        escaped.add(item.context_expr.id)
+            elif isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Attribute) and \
+                        isinstance(node.func.value, ast.Name):
+                    if node.func.attr == "close":
+                        escaped.add(node.func.value.id)
+                for arg in list(node.args) + \
+                        [kw.value for kw in node.keywords]:
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Name):
+                            escaped.add(sub.id)
+            elif isinstance(node, ast.Return) and node.value is not None:
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Name):
+                        escaped.add(sub.id)
+            elif isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, (ast.Attribute, ast.Subscript)) and \
+                            isinstance(node.value, ast.Name):
+                        escaped.add(node.value.id)
+                    elif isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                        for sub in ast.walk(node.value):
+                            if isinstance(sub, ast.Name):
+                                escaped.add(sub.id)
+        for name, (line, ctor) in sorted(opened.items()):
+            if name in escaped:
+                continue
+            findings.append(Finding(
+                "exception-safety", rel,
+                f"{qual} opens `{name} = {ctor}(...)` outside `with` and "
+                f"never closes, returns, or stores it — fd leak",
+                line=line,
+                key=f"exception-safety::unclosed-resource::{rel}::{qual}"
+                    f"::{name}"))
+    return findings
+
+
+# ----------------------------------------------------------- breaker-leak
+
+def _check_breaker_leak(index: RepoIndex) -> List[Finding]:
+    findings = []
+    for rel, qual, fn in _functions(index):
+        attrs = {n.attr for n in ast.walk(fn)
+                 if isinstance(n, ast.Attribute)}
+        names = {n.id for n in ast.walk(fn) if isinstance(n, ast.Name)}
+        if "allow" not in attrs or "record_success" not in attrs:
+            continue
+        # any *failure* token counts — the accounting may be delegated
+        # (note_pallas_failure(pbr, e) routes through the breaker policy)
+        if "trip_permanent" in attrs or \
+                any("failure" in tok for tok in attrs | names):
+            continue
+        line = next((n.lineno for n in ast.walk(fn)
+                     if isinstance(n, ast.Attribute) and
+                     n.attr == "allow"), fn.lineno)
+        findings.append(Finding(
+            "exception-safety", rel,
+            f"{qual} runs a breaker probe (allow→record_success) with no "
+            f"record_failure path — an exception strands the breaker "
+            f"half-open",
+            line=line,
+            key=f"exception-safety::breaker-leak::{rel}::{qual}"))
+    return findings
+
+
+@rule("exception-safety",
+      doc="no lock held across a raise outside finally, no worker thread "
+          "unjoined on shutdown, no fd opened without a closing guard, "
+          "no breaker probe without a failure path",
+      triggers=("tmtpu",))
+def check(index: RepoIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    findings += _check_lock_across_raise(index)
+    findings += _check_unjoined_threads(index)
+    findings += _check_unclosed_resources(index)
+    findings += _check_breaker_leak(index)
+    return findings

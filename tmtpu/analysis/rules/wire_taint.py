@@ -1,0 +1,146 @@
+"""wire-taint rule: no unverified wire bytes reach a consensus sink.
+
+The system's core safety invariant — untrusted network bytes become a
+trusted on-device tally only *through* signature verification — is
+enforced here with the interprocedural taint engine
+(``analysis/dataflow.py``):
+
+**Sources** (taint labels):
+
+- ``wire`` — the payload param of every ``Reactor.receive()`` (peer
+  gossip: votes, proposals, block parts, snapshots, evidence, txs);
+- ``rpc`` — every parameter of every public JSON-RPC handler (the
+  nested route functions of ``rpc/core.build_routes``);
+- ``statesync`` — snapshot chunk bytes entering ``Syncer.add_chunk``.
+
+**Sinks**: tally mutation (``add_verified_vote``), WAL writes
+(``.write/.write_sync`` on a WAL-ish receiver), privval signing
+(``sign_vote``/``sign_proposal``), and block execution
+(``apply_block``).
+
+**Sanitizers**: ``validate_basic``, ``verify_one`` and the
+batch-verify family. A sanitizer call launders the frame from that
+statement on — the mask-indexing that follows a batch verify is beyond
+static reach, so the invariant checked is "a verification call stands
+between the wire and the sink on every path", which is exactly how the
+code expresses it.
+
+Taint crosses the reactor-thread -> queue -> state-thread handoff via
+the engine's channel fixpoint (``self._q.put(tainted)`` re-seeds the
+methods reading ``self._q``), so the classic Tendermint shape
+(receive enqueues, ``_handle_msgs`` drains) is still covered.
+
+Grandfathered flows (WAL-before-process writes the *unverified* message
+by design) carry written justifications in the baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from tmtpu.analysis.dataflow import TaintAnalyzer, TaintHit
+from tmtpu.analysis.findings import Finding
+from tmtpu.analysis.index import RepoIndex
+from tmtpu.analysis.registry import rule
+from tmtpu.analysis.rules.recv_sync import _is_reactor
+
+# verification calls that launder a frame (see module docstring)
+SANITIZERS = {
+    "validate_basic", "verify_one", "verify", "verify_tally",
+    "verify_signature", "batch_verify_items",
+    "verify_commit", "verify_commit_light", "verify_commit_light_trusting",
+    "verify_commits_light_batch",
+}
+
+# payload-ish parameter names; fallback is the last positional param
+PAYLOAD_PARAMS = ("msg_bytes", "payload", "data", "chunk", "tx")
+
+SINK_METHODS = {
+    "add_verified_vote": "tally",
+    "sign_vote": "privval-sign",
+    "sign_proposal": "privval-sign",
+    "apply_block": "apply-block",
+}
+WAL_WRITE_METHODS = {"write", "write_sync"}
+
+
+def _sink_fn(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    label = SINK_METHODS.get(f.attr)
+    if label is not None:
+        return label
+    if f.attr in WAL_WRITE_METHODS:
+        try:
+            recv = ast.unparse(f.value).lower()
+        except Exception:  # noqa: BLE001 - unparse of odd nodes
+            recv = ""
+        if "wal" in recv:
+            return "wal-write"
+    return None
+
+
+def _payload_params(fn: ast.FunctionDef, label: str) -> Dict[str, str]:
+    params = [a.arg for a in fn.args.args if a.arg != "self"]
+    if not params:
+        return {}
+    named = [p for p in params if p in PAYLOAD_PARAMS]
+    return {p: label for p in (named or params[-1:])}
+
+
+def _seeds(index: RepoIndex):
+    # 1. reactor receive payloads
+    for cls in index.classes("tmtpu"):
+        if _is_reactor(cls) and "receive" in cls.methods:
+            fn = cls.methods["receive"]
+            labels = _payload_params(fn, "wire")
+            if labels:
+                yield cls, fn, cls.rel, labels
+    # 2. public JSON-RPC handler params (nested defs in build_routes)
+    for fi in index.files("tmtpu/rpc"):
+        if fi.tree is None:
+            continue
+        for node in fi.tree.body:
+            if isinstance(node, ast.FunctionDef) and \
+                    node.name == "build_routes":
+                for sub in node.body:
+                    if isinstance(sub, ast.FunctionDef):
+                        params = {a.arg: "rpc" for a in sub.args.args}
+                        if params:
+                            yield None, sub, fi.rel, params
+    # 3. statesync snapshot chunk bytes
+    for cls in index.classes("tmtpu/statesync"):
+        for name in ("add_chunk", "add_snapshot"):
+            fn = cls.methods.get(name)
+            if fn is not None:
+                labels = _payload_params(fn, "statesync")
+                if labels:
+                    yield cls, fn, cls.rel, labels
+
+
+def _finding(index: RepoIndex, hit: TaintHit) -> Finding:
+    labels = "+".join(sorted(hit.labels))
+    return Finding(
+        "wire-taint", hit.rel,
+        f"unverified {labels} bytes reach {hit.sink} at "
+        f"{hit.rel}:{hit.line} via {hit.via()} — insert a "
+        f"validate_basic/verify gate before the sink",
+        line=hit.line,
+        key=f"wire-taint::{hit.sink}::{labels}::{hit.rel}::{hit.chain[-1]}")
+
+
+@rule("wire-taint",
+      doc="no unverified wire/rpc/statesync bytes reach a tally, WAL, "
+          "signing, or apply_block sink (interprocedural taint)",
+      triggers=("tmtpu",))
+def check(index: RepoIndex) -> List[Finding]:
+    ta = TaintAnalyzer(index, _sink_fn, SANITIZERS)
+    findings, seen = [], set()
+    for hit in ta.propagate(_seeds(index)):
+        f = _finding(index, hit)
+        if f.key not in seen:
+            seen.add(f.key)
+            findings.append(f)
+    return findings

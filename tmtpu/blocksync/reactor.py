@@ -75,6 +75,9 @@ class BlocksyncReactor(BlockServingMixin, Reactor):
 
     def on_stop(self) -> None:
         self._stopped.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)
 
     def add_peer(self, peer: Peer) -> None:
         # reactor.go AddPeer: send our status so the peer can request

@@ -83,6 +83,9 @@ class BlocksyncReactorV1(BlockServingMixin, Reactor):
 
     def on_stop(self) -> None:
         self._stopped.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)
 
     def _enqueue(self, ev) -> None:
         if not self._pump_alive:

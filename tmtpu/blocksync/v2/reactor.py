@@ -89,6 +89,9 @@ class BlocksyncReactorV2(BlockServingMixin, Reactor):
 
     def on_stop(self) -> None:
         self._stopped.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)
 
     def _enqueue(self, ev) -> None:
         """Events are only meaningful while the pump is running; after

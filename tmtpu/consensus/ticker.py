@@ -36,6 +36,9 @@ class TimeoutTicker:
         with self._cv:
             self._stopped = True
             self._cv.notify()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)
 
     def schedule_timeout(self, ti: TimeoutInfo) -> None:
         deadline = time.time_ns() + ti.duration_ns

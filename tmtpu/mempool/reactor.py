@@ -93,6 +93,9 @@ class MempoolReactor(Reactor):
 
     def on_stop(self) -> None:
         self._stopped.set()
+        t = self._rx_thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)
 
     def _peer_seen(self, node_id: str) -> PeerSeenCache:
         with self._seen_mtx:

@@ -357,6 +357,9 @@ class SignerServer:
 
     def stop(self) -> None:
         self._stopped.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)
 
     def _dial(self) -> _Conn:
         kind, target = _parse_addr(self.addr)

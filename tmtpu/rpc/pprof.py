@@ -211,3 +211,6 @@ class PprofServer:
     def stop(self):
         self.httpd.shutdown()
         self.httpd.server_close()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)

@@ -385,3 +385,6 @@ class RPCServer:
             self._httpd.server_close()
             if self.unix_path and os.path.exists(self.unix_path):
                 os.unlink(self.unix_path)
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)

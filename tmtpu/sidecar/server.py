@@ -233,6 +233,9 @@ class SidecarServer:
             except Exception:  # noqa: BLE001 — best-effort teardown
                 pass
             self._health_httpd = None
+        ht = self._health_thread
+        if ht is not None and ht is not threading.current_thread():
+            ht.join(timeout=2.0)
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=5.0)
             self._accept_thread = None

@@ -202,3 +202,6 @@ class IndexerService:
         self._stop.set()
         if self._sub is not None:
             self.event_bus.unsubscribe(self._sub)
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)

@@ -4,6 +4,7 @@
     python tools/lint.py                  # all rules vs the baseline
     python tools/lint.py --rule lock-order --rule determinism
     python tools/lint.py --json           # machine-readable report
+    python tools/lint.py --format sarif   # SARIF 2.1.0 (CI annotations)
     python tools/lint.py --changed        # pre-commit: only rules whose
                                           # triggers intersect the diff
                                           # vs `git merge-base HEAD main`
@@ -13,6 +14,13 @@
 
 Exit codes: 0 = clean (baseline-suppressed findings allowed),
 1 = new findings, 2 = usage/runtime error.
+
+Results are cached per rule in ``.lint_cache/`` keyed by the
+(path, mtime, size) fingerprint of every file the rule can read, so a
+warm re-run does no parsing and no rule work (``--no-cache`` opts out).
+``--update-baseline`` prunes stale suppressions (with a summary of what
+it dropped) and writes run metadata — per-rule timings and finding
+counts — to ``tools/lint_meta.json`` next to the baseline.
 
 Suppressed findings stay visible under --json (``suppressed`` section);
 stale suppressions (keys matching nothing) print as warnings so dead
@@ -26,13 +34,19 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from tmtpu.analysis import baseline as baseline_mod  # noqa: E402
 from tmtpu.analysis import registry  # noqa: E402
+from tmtpu.analysis.cache import ResultCache  # noqa: E402
 from tmtpu.analysis.index import RepoIndex, default_index  # noqa: E402
+
+META_PATH = os.path.join(REPO, "tools", "lint_meta.json")
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
 
 
 def _changed_files(base: str) -> list:
@@ -51,28 +65,96 @@ def _changed_files(base: str) -> list:
     return sorted({ln for ln in lines if ln})
 
 
+def _sarif_report(rules, results, new, suppressed) -> dict:
+    """SARIF 2.1.0: one run, one driver, every finding a result.
+    Baseline-suppressed findings are included with an ``external``
+    suppression object so CI viewers show them greyed, not failing."""
+    sarif_rules = [{"id": rid,
+                    "shortDescription": {"text": rules[rid].doc}}
+                   for rid in sorted(results)]
+    sarif_results = []
+    for rid in sorted(results):
+        sup_keys = {f.key for f in suppressed.get(rid, [])}
+        for f in sorted(results[rid], key=lambda f: f.key):
+            res = {
+                "ruleId": f.rule,
+                "level": "error" if f.severity == "error" else "warning",
+                "message": {"text": f.message},
+                "partialFingerprints": {"lintKey": f.key},
+                "locations": [{
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.file},
+                        "region": {"startLine": max(f.line, 1)},
+                    },
+                }],
+            }
+            if f.key in sup_keys:
+                res["suppressions"] = [{
+                    "kind": "external",
+                    "justification": "tools/lint_baseline.json",
+                }]
+            sarif_results.append(res)
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "tmtpu-lint",
+                "informationUri": "docs/ANALYSIS.md",
+                "rules": sarif_rules,
+            }},
+            "results": sarif_results,
+        }],
+    }
+
+
+def _write_meta(stats: dict, results, suppressed, wall_s: float) -> None:
+    """Run metadata next to the baseline: per-rule timings + counts."""
+    meta = {
+        "wall_seconds": round(wall_s, 3),
+        "rules": {
+            rid: {
+                "seconds": stats.get(rid, {}).get("seconds", 0.0),
+                "cached": stats.get(rid, {}).get("cached", False),
+                "findings": len(results.get(rid, [])),
+                "suppressed": len(suppressed.get(rid, [])),
+            }
+            for rid in sorted(results)
+        },
+    }
+    with open(META_PATH, "w", encoding="utf-8") as fh:
+        json.dump(meta, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="lint.py", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("--rule", action="append", metavar="ID",
                     help="run only this rule (repeatable)")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text", help="report format (default text)")
     ap.add_argument("--json", action="store_true",
-                    help="emit a JSON report instead of text")
+                    help="shorthand for --format json")
     ap.add_argument("--baseline", metavar="PATH",
                     help="baseline file (default tools/lint_baseline.json)")
     ap.add_argument("--update-baseline", action="store_true",
                     help="rewrite the baseline from the current tree "
-                         "(new findings get a TODO reason)")
+                         "(new findings get a TODO reason; stale "
+                         "suppressions are pruned with a summary)")
     ap.add_argument("--changed", nargs="?", const="main", metavar="BASE",
                     help="run only rules whose triggers intersect the "
                          "diff vs `git merge-base HEAD BASE` "
                          "(default BASE: main)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="ignore and don't write .lint_cache/")
     ap.add_argument("--list", action="store_true",
                     help="list registered rules and exit")
     ap.add_argument("--root", default=None,
                     help="index a different tree (fixture testing)")
     args = ap.parse_args(argv)
+    fmt = "json" if args.json else args.format
 
     rules = registry.load_rules()
     if args.list:
@@ -104,11 +186,21 @@ def main(argv=None) -> int:
             return 0
 
     index = RepoIndex(args.root) if args.root else default_index()
+    # the cache only engages for the real repo tree (fixture roots churn
+    # and must not write into the checkout)
+    cache = None
+    if not args.no_cache and not args.root:
+        cache = ResultCache(index.root)
+    stats: dict = {}
+    t_run = time.perf_counter()
     try:
-        results = registry.run(index, rule_ids)
+        results = registry.run(index, rule_ids, cache=cache, stats=stats)
     except KeyError as e:
         print(f"lint: {e}", file=sys.stderr)
         return 2
+    wall_s = time.perf_counter() - t_run
+    if cache is not None:
+        cache.save()
 
     bl_path = args.baseline or baseline_mod.default_path(index.root)
     try:
@@ -117,23 +209,32 @@ def main(argv=None) -> int:
         print(f"lint: {e}", file=sys.stderr)
         return 2
 
+    new, suppressed, stale = baseline_mod.apply(bl, results)
+
     if args.update_baseline:
         updated = baseline_mod.update(bl, results)
         baseline_mod.save(updated, bl_path)
+        pruned = {rid: keys for rid, keys in sorted(stale.items()) if keys}
+        for rid, keys in pruned.items():
+            for k in keys:
+                print(f"lint: pruned stale suppression [{rid}] {k!r}")
+        n_pruned = sum(len(v) for v in pruned.values())
         n_sup = sum(len(e.get("suppressions", []))
                     for e in updated["rules"].values())
         todo = sum(1 for e in updated["rules"].values()
                    for s in e.get("suppressions", [])
                    if s["reason"] == baseline_mod.TODO_REASON)
+        _write_meta(stats, results, suppressed, wall_s)
         print(f"lint: baseline written to {bl_path} "
-              f"({n_sup} suppressions, {todo} needing justification)")
+              f"({n_sup} suppressions, {n_pruned} stale pruned, "
+              f"{todo} needing justification); run metadata in "
+              f"{os.path.relpath(META_PATH, REPO)}")
         return 0 if todo == 0 else 1
 
-    new, suppressed, stale = baseline_mod.apply(bl, results)
-
-    if args.json:
+    if fmt == "json":
         report = {
             "rules_run": sorted(results),
+            "stats": stats,
             "new": {r: [f.to_dict() for f in fs]
                     for r, fs in sorted(new.items())},
             "suppressed": {r: [f.to_dict() for f in fs]
@@ -141,6 +242,9 @@ def main(argv=None) -> int:
             "stale_suppressions": stale,
         }
         print(json.dumps(report, indent=2, sort_keys=True))
+    elif fmt == "sarif":
+        print(json.dumps(_sarif_report(rules, results, new, suppressed),
+                         indent=2, sort_keys=True))
     else:
         for rid in sorted(new):
             for f in new[rid]:
@@ -152,12 +256,14 @@ def main(argv=None) -> int:
                       file=sys.stderr)
         n_new = sum(len(v) for v in new.values())
         n_sup = sum(len(v) for v in suppressed.values())
+        n_cached = sum(1 for s in stats.values() if s.get("cached"))
+        cache_note = f", {n_cached} cached" if n_cached else ""
         if n_new:
             print(f"lint: {n_new} new finding(s) across "
                   f"{len(new)} rule(s) ({n_sup} suppressed by baseline)",
                   file=sys.stderr)
         else:
-            print(f"lint: clean — {len(results)} rule(s), "
+            print(f"lint: clean — {len(results)} rule(s){cache_note}, "
                   f"{n_sup} baseline-suppressed finding(s)")
     return 1 if any(new.values()) else 0
 

@@ -1,0 +1,15 @@
+#!/bin/sh
+# Pre-commit lint gate: only the rules whose trigger prefixes intersect
+# the diff vs the merge base, answered from .lint_cache/ when warm.
+#
+# Install:   ln -sf ../../tools/lint_precommit.sh .git/hooks/pre-commit
+# CI usage:  tools/lint_precommit.sh [BASE]   (default BASE: main)
+#
+# Exit 0 = clean (baseline-suppressed findings allowed), 1 = new
+# findings (commit blocked), 2 = driver error. See docs/ANALYSIS.md.
+set -eu
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+BASE="${1:-main}"
+
+exec python "$REPO/tools/lint.py" --changed "$BASE"
