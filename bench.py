@@ -265,16 +265,37 @@ _PHASE_KEYS = ("probe", "prepare", "transfer", "compile", "execute",
                "readback")
 
 
+def _txlat_phase() -> dict:
+    """submit→commit latency p50/p99 (ms) from this process's tx-latency
+    histogram (libs/metrics tendermint_tx_latency_submit_to_commit).
+    Zeros for the pure crypto benches — the key is part of the artifact
+    shape either way, and fills with real numbers whenever a tx path ran
+    in-process."""
+    try:
+        from tmtpu.libs import metrics as _m
+
+        return {
+            "p50": round(
+                _m.tx_latency_submit_to_commit.percentile(0.50) * 1000, 3),
+            "p99": round(
+                _m.tx_latency_submit_to_commit.percentile(0.99) * 1000, 3),
+        }
+    except Exception:
+        return {"p50": 0.0, "p99": 0.0}
+
+
 def _ensure_phases(out: dict) -> dict:
     """Guarantee every emitted line carries the six-key phase breakdown
-    (seconds). The child fills prepare/transfer/compile/execute/readback
-    from its own measurements; ``probe`` is parent territory — the sum of
-    all device-probe attempt times from ``_probe_log``. A line that never
-    reached a child still reports all six keys (zeros), so the driver's
+    (seconds) plus the ``submit_to_commit_ms`` p50/p99 object. The child
+    fills prepare/transfer/compile/execute/readback from its own
+    measurements; ``probe`` is parent territory — the sum of all
+    device-probe attempt times from ``_probe_log``. A line that never
+    reached a child still reports every key (zeros), so the driver's
     artifact parser can rely on the shape."""
     phases = out.setdefault("phases", {})
     for k in _PHASE_KEYS:
         phases.setdefault(k, 0.0)
+    phases.setdefault("submit_to_commit_ms", _txlat_phase())
     phases["probe"] = round(
         sum(float(p.get("s", 0) or 0) for p in _probe_log), 3)
     return out

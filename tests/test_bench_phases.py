@@ -1,7 +1,8 @@
 """The BENCH artifact's phase breakdown: every JSON line bench.py emits
 must carry a six-key ``phases`` object (probe, prepare, transfer,
-compile, execute, readback) so the driver can see where a slow run spent
-its time — ISSUE acceptance for the observability PR."""
+compile, execute, readback) — ISSUE acceptance for the observability
+PR — plus the ``submit_to_commit_ms`` p50/p99 object from the
+tx-lifecycle histogram (ISSUE 15)."""
 
 import json
 
@@ -10,6 +11,7 @@ import bench
 
 PHASE_KEYS = {"probe", "prepare", "transfer", "compile", "execute",
               "readback"}
+FULL_KEYS = PHASE_KEYS | {"submit_to_commit_ms"}
 
 
 def test_phase_keys_match_acceptance_list():
@@ -20,23 +22,41 @@ def test_ensure_phases_fills_all_keys(monkeypatch):
     monkeypatch.setattr(bench, "_probe_log",
                         [{"rc": 3, "s": 2.5}, {"rc": "timeout", "s": 4.0}])
     out = bench._ensure_phases({"metric": "x"})
-    assert set(out["phases"]) == PHASE_KEYS
+    assert set(out["phases"]) == FULL_KEYS
     assert out["phases"]["probe"] == 6.5
     for k in PHASE_KEYS - {"probe"}:
         assert out["phases"][k] == 0.0
+    assert set(out["phases"]["submit_to_commit_ms"]) == {"p50", "p99"}
 
 
 def test_ensure_phases_preserves_child_measurements(monkeypatch):
     """The parent must not clobber the child's measured phases — only
-    ``probe`` is parent territory."""
+    ``probe`` is parent territory; a child-reported submit_to_commit_ms
+    survives too."""
     monkeypatch.setattr(bench, "_probe_log", [])
     out = bench._ensure_phases(
-        {"phases": {"execute": 1.5, "compile": 30.0}})
+        {"phases": {"execute": 1.5, "compile": 30.0,
+                    "submit_to_commit_ms": {"p50": 120.0, "p99": 900.0}}})
     assert out["phases"]["execute"] == 1.5
     assert out["phases"]["compile"] == 30.0
     assert out["phases"]["probe"] == 0.0
-    assert set(out["phases"]) == PHASE_KEYS
+    assert out["phases"]["submit_to_commit_ms"] == {"p50": 120.0,
+                                                   "p99": 900.0}
+    assert set(out["phases"]) == FULL_KEYS
     json.dumps(out)  # emitted lines must stay serializable
+
+
+def test_txlat_phase_reflects_histogram_observations():
+    """With observations in the tx-latency histogram, the bench phase
+    object reports real (nonzero) percentiles."""
+    from tmtpu.libs import metrics as _m
+
+    before = bench._txlat_phase()
+    assert set(before) == {"p50", "p99"}
+    _m.tx_latency_submit_to_commit.observe(0.2)
+    after = bench._txlat_phase()
+    assert after["p50"] > 0.0
+    assert after["p99"] >= after["p50"]
 
 
 def test_provisional_emission_carries_phases(monkeypatch, capsys):
@@ -52,4 +72,4 @@ def test_provisional_emission_carries_phases(monkeypatch, capsys):
     line = capsys.readouterr().out.strip().splitlines()[-1]
     out = json.loads(line)
     assert out["provisional"] is True
-    assert set(out["phases"]) == PHASE_KEYS
+    assert set(out["phases"]) == FULL_KEYS

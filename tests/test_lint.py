@@ -11,8 +11,8 @@ determinism.
 
 Rule ids covered here (the meta rule asserts this list stays complete):
 blocking-lock, determinism, exception-safety, failpoints, jax-hygiene,
-lock-order, meta, metrics, recv-sync, scenarios, sidecar, sigcache,
-timeline, wire-taint.
+lock-order, meta, metrics, obs-docs, recv-sync, scenarios, sidecar,
+sigcache, timeline, wire-taint.
 """
 
 from __future__ import annotations
@@ -27,8 +27,9 @@ from tmtpu.analysis.index import RepoIndex, default_index
 
 ALL_RULES = [
     "blocking-lock", "determinism", "exception-safety", "failpoints",
-    "jax-hygiene", "lock-order", "meta", "metrics", "recv-sync",
-    "scenarios", "sidecar", "sigcache", "timeline", "wire-taint",
+    "jax-hygiene", "lock-order", "meta", "metrics", "obs-docs",
+    "recv-sync", "scenarios", "sidecar", "sigcache", "timeline",
+    "wire-taint",
 ]
 
 
@@ -92,8 +93,10 @@ def test_cli_smoke(capsys):
 
 
 def test_changed_trigger_routing():
-    # a docs-only change triggers only the meta rule
-    assert registry.affected_rules(["docs/ANALYSIS.md"]) == ["meta"]
+    # a docs-only change triggers only the rules that read docs: meta
+    # (rule catalog) and obs-docs (the OBSERVABILITY.md contract)
+    assert registry.affected_rules(["docs/ANALYSIS.md"]) \
+        == ["meta", "obs-docs"]
     assert "sidecar" in registry.affected_rules(
         ["tmtpu/sidecar/protocol.py"])
     assert "sidecar" not in registry.affected_rules(
@@ -331,6 +334,36 @@ def test_metrics_flags_dead_unknown_and_unrendered(tmp_path):
     assert "metrics::dead::live" not in keys
     assert "metrics::unknown::consensus_ghost" in keys
     assert "metrics::ctor::tmtpu/code.py::Counter" in keys
+
+
+# --------------------------------------------------------------- obs-docs
+
+
+def test_obs_docs_flags_undocumented_surface(tmp_path):
+    """A tree exporting tx-lifecycle names without OBSERVABILITY.md rows
+    is flagged per missing name; documenting them clears the findings;
+    a tree with no tx-lifecycle surface passes vacuously."""
+    files = {
+        "tmtpu/libs/metrics.py":
+            'tx_latency_x = DEFAULT.counter("tx", "latency_x_total")\n',
+        "tmtpu/libs/txlat.py":
+            'TX_STAGES = ("submit", "commit")\n',
+    }
+    idx = _tree(tmp_path, files)
+    keys = _keys(_run(idx, "obs-docs"))
+    assert "obs-docs::no-doc" in keys
+
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs/OBSERVABILITY.md").write_text(
+        "| `tendermint_tx_latency_x_total` | ... |\n"
+        "| `submit` | ... |\n")
+    keys = _keys(_run(RepoIndex(str(tmp_path)), "obs-docs"))
+    assert "obs-docs::stage::commit" in keys
+    assert "obs-docs::event::tx_latency" in keys
+    assert "obs-docs::metric::tendermint_tx_latency_x_total" not in keys
+
+    bare = _tree(tmp_path / "bare", {"tmtpu/empty.py": "x = 1\n"})
+    assert _run(bare, "obs-docs") == []
 
 
 # -------------------------------------------------------------- recv-sync

@@ -174,6 +174,105 @@ def test_histogram_bucket_boundary_inclusive_and_series_isolated():
     assert h.totals(curve="b") == (1, pytest.approx(0.1))
 
 
+def test_percentile_from_buckets_interpolation_and_clamp():
+    """The quantile helper shared by Histogram.percentile and the
+    watchdog's windowed-delta SLO math: linear interpolation inside the
+    winning bucket, exact values on rank boundaries, clamp to the last
+    finite bound when the rank lands in +Inf, zero on empty input."""
+    buckets = (1.0, 2.0, 4.0)
+    counts = (2, 2, 6, 6)  # cumulative, counts[-1] = +Inf total
+    pct = metrics.percentile_from_buckets
+    # rank exactly fills the first bucket -> its upper bound, exactly
+    assert pct(buckets, counts, 2 / 6) == pytest.approx(1.0)
+    # rank 3 of 6: one past the 2 below 2.0, a quarter into (2.0, 4.0]
+    assert pct(buckets, counts, 0.5) == pytest.approx(2.5)
+    assert pct(buckets, counts, 1.0) == pytest.approx(4.0)
+    # observations above every finite bucket clamp to the last bound
+    assert pct(buckets, (0, 0, 0, 5), 0.99) == pytest.approx(4.0)
+    # degenerate inputs are 0.0, never a crash
+    assert pct((), (), 0.5) == 0.0
+    assert pct(buckets, (0, 0, 0, 0), 0.5) == 0.0
+    # q is clamped into [0, 1]
+    assert pct(buckets, counts, -1.0) == pct(buckets, counts, 0.0)
+    assert pct(buckets, counts, 7.0) == pct(buckets, counts, 1.0)
+
+
+def test_histogram_percentile_boundary_accuracy():
+    """Percentiles land inside the bucket that holds the rank, hit bucket
+    bounds exactly when the rank fills a bucket, and stay monotone in q
+    — the accuracy contract bench.py's submit_to_commit_ms and the
+    latency SLO watchdog rely on."""
+    h = metrics.Histogram("tendermint_test_pct", "h", (),
+                          buckets=(0.01, 0.05, 0.1, 0.5, 1.0))
+    assert h.percentile(0.5) == 0.0  # no observations yet
+    assert h.bucket_counts() == ()
+    for _ in range(90):
+        h.observe(0.01)  # exactly on a bucket boundary (le inclusive)
+    for _ in range(10):
+        h.observe(0.9)
+    assert h.bucket_counts() == (90, 90, 90, 90, 100, 100)
+    # rank 90 exactly fills the first bucket
+    assert h.percentile(0.9) == pytest.approx(0.01)
+    # rank 50 interpolates inside (0, 0.01]
+    assert h.percentile(0.5) == pytest.approx(0.01 * 50 / 90)
+    # rank 99 sits 9/10ths into the (0.5, 1.0] bucket
+    assert h.percentile(0.99) == pytest.approx(0.95)
+    qs = [h.percentile(q / 100) for q in range(0, 101, 5)]
+    assert qs == sorted(qs)  # monotone in q
+    assert all(0.0 <= v <= 1.0 for v in qs)
+    # an overflow observation clamps the top quantile to the last
+    # finite bound instead of inventing a value
+    h.observe(30.0)
+    assert h.percentile(1.0) == pytest.approx(1.0)
+
+
+def test_histogram_percentile_under_concurrent_observers():
+    """percentile() snapshots the counts under the metric lock, so reads
+    racing writers always see a consistent cumulative vector: every
+    returned value is bounded by the finite buckets and the final
+    distribution is exact."""
+    h = metrics.Histogram("tendermint_test_pctrace", "h", (),
+                          buckets=(0.001, 0.01, 0.1, 1.0))
+    n_writers, per_writer = 4, 500
+    stop = threading.Event()
+    reads, read_errors = [], []
+
+    def reader():
+        try:
+            while not stop.is_set():
+                reads.append((h.percentile(0.5), h.percentile(0.99)))
+        except Exception as e:  # pragma: no cover - failure diagnostics
+            read_errors.append(e)
+
+    def writer(value):
+        for _ in range(per_writer):
+            h.observe(value)
+
+    rt = threading.Thread(target=reader)
+    rt.start()
+    # two writers per bucket: half the mass in (0.001, 0.01], half in
+    # (0.01, 0.1]
+    ws = [threading.Thread(target=writer,
+                           args=(0.005 if i % 2 == 0 else 0.05,))
+          for i in range(n_writers)]
+    for w in ws:
+        w.start()
+    for w in ws:
+        w.join()
+    stop.set()
+    rt.join()
+    assert not read_errors
+    assert all(0.0 <= p50 <= 1.0 and 0.0 <= p99 <= 1.0
+               for p50, p99 in reads)
+    total = n_writers * per_writer
+    assert h.bucket_counts() == (0, total // 2, total, total, total)
+    assert h.totals()[0] == total
+    # rank total/2 exactly fills the 0.01 bucket; p99 interpolates in
+    # (0.01, 0.1]
+    assert h.percentile(0.5) == pytest.approx(0.01)
+    assert h.percentile(0.99) == pytest.approx(0.01 + 0.98 * 0.09)
+
+
 def test_full_registry_round_trip_parses():
     """Every line the process-global registry emits must parse — the same
     property a real Prometheus scraper enforces."""

@@ -1,0 +1,200 @@
+"""Per-tx lifecycle tracking tests (tmtpu/libs/txlat.py): first-stamp-
+wins, journeys refused at post-commit stages, FIFO eviction, the
+telescoping stage decomposition (adjacent transition diffs sum exactly
+to the submit->commit span), block-memo bulk stamping with its one
+aggregate ``tx_latency`` timeline event per height, snapshot shape, and
+the ``enabled`` gate on every fast path."""
+
+import threading
+
+import pytest
+
+from tmtpu.crypto import tmhash
+from tmtpu.libs import metrics, timeline, txlat
+
+
+def test_stage_catalog_is_the_pipeline_order():
+    """The canonical checkpoint order is a public contract (docs rows,
+    fleet-report decomposition, obs-docs rule) — pin it."""
+    assert txlat.TX_STAGES == (
+        "submit", "gossip_rx", "admit_enq", "flush", "admit", "proposal",
+        "prevote_q", "precommit_q", "commit", "apply", "index")
+
+
+def test_first_stamp_wins_and_offsets_are_from_first_stamp():
+    t = txlat.TxLat()
+    t.stamp(b"k1", "submit", t_ns=1_000)
+    t.stamp(b"k1", "submit", t_ns=2_000)  # duplicate: ignored
+    t.stamp(b"k1", "admit", t_ns=5_000)
+    t.stamp(b"k1", "commit", t_ns=9_000)
+    snap = t.snapshot()
+    (j,) = snap["txs"]
+    assert j["hash"] == b"k1".hex()
+    assert j["stages"] == {"submit": 0.0, "admit": 0.004, "commit": 0.008}
+    assert j["submit_to_commit_ms"] == 0.008
+    assert snap["completed"] == 1 and snap["evicted"] == 0
+
+
+def test_journeys_never_open_at_post_commit_stages():
+    """A commit/apply/index stamp for an unknown hash (evicted, or from
+    a tx the node never check-tx'd) must not create a journey: the
+    partial record would poison the decomposition stats."""
+    t = txlat.TxLat()
+    for stage in ("commit", "apply", "index"):
+        t.stamp(b"ghost-" + stage.encode(), stage, t_ns=1)
+    snap = t.snapshot()
+    assert snap["tracked"] == 0
+    assert snap["completed"] == 0
+    assert snap["txs"] == []
+
+
+def test_fifo_eviction_bounds_the_ring():
+    evicted0 = sum(metrics.tx_latency_evicted.summary_series().values())
+    t = txlat.TxLat(capacity=16)
+    for i in range(20):
+        t.stamp(b"%02d" % i, "submit", t_ns=i + 1)
+    snap = t.snapshot()
+    assert snap["tracked"] == 16
+    assert snap["evicted"] == 4
+    evicted1 = sum(metrics.tx_latency_evicted.summary_series().values())
+    assert evicted1 - evicted0 == 4
+    # the evicted (oldest) tx can no longer complete: its commit stamp
+    # would have to open a journey at a post-commit stage
+    t.stamp(b"00", "commit", t_ns=100)
+    assert t.snapshot()["completed"] == 0
+    t.stamp(b"19", "commit", t_ns=100)
+    assert t.snapshot()["completed"] == 1
+
+
+def test_stage_transitions_telescope_to_the_submit_commit_span():
+    """The per-transition observations for one tx sum EXACTLY to its
+    submit->commit span — the property the fleet report's decomposition
+    check rides on."""
+    times = {  # ns, strictly increasing along the pipeline
+        "submit": 0, "admit_enq": 1_000_000, "flush": 3_000_000,
+        "admit": 3_500_000, "proposal": 10_000_000,
+        "prevote_q": 12_000_000, "precommit_q": 14_000_000,
+        "commit": 20_000_000,
+    }
+    stage_before = metrics.tx_latency_stage.summary_series()
+    tot_before = metrics.tx_latency_submit_to_commit.totals()
+    t = txlat.TxLat()
+    for stage, ns in times.items():
+        t.stamp(b"tele", stage, t_ns=ns)
+    stage_after = metrics.tx_latency_stage.summary_series()
+    deltas = {}
+    for key, s in stage_after.items():
+        d = s["sum"] - stage_before.get(key, {"sum": 0.0})["sum"]
+        if d:
+            deltas[key] = d
+    expect = {"stage=submit_to_admit_enq": 0.001,
+              "stage=admit_enq_to_flush": 0.002,
+              "stage=flush_to_admit": 0.0005,
+              "stage=admit_to_proposal": 0.0065,
+              "stage=proposal_to_prevote_q": 0.002,
+              "stage=prevote_q_to_precommit_q": 0.002,
+              "stage=precommit_q_to_commit": 0.006}
+    assert deltas == pytest.approx(expect)
+    assert sum(deltas.values()) == pytest.approx(0.020)  # telescoped
+    tot_after = metrics.tx_latency_submit_to_commit.totals()
+    assert tot_after[0] - tot_before[0] == 1
+    assert tot_after[1] - tot_before[1] == pytest.approx(0.020)
+
+
+def test_note_block_stamp_height_and_one_timeline_event_per_height():
+    timeline.DEFAULT.clear()
+    try:
+        t = txlat.TxLat()
+        txs = [b"tx-a", b"tx-b", b"tx-c"]
+        for tx in txs:
+            t.stamp_tx(tx, "submit")
+        t.note_block(9, txs)
+        assert t.stamp_height(9, "proposal") == 3
+        assert t.stamp_height(9, "commit") == 3
+        assert t.stamp_height(10, "commit") == 0  # never noted
+        snap = t.snapshot()
+        assert snap["completed"] == 3
+        assert snap["submit_to_commit"]["count"] == 3
+        assert {j["hash"] for j in snap["txs"]} \
+            == {tmhash.sum(tx).hex() for tx in txs}
+        (rec,) = timeline.DEFAULT.snapshot(height=9)
+        events = [e for e in rec["events"]
+                  if e["event"] == timeline.EVENT_TX_LATENCY]
+        assert len(events) == 1  # aggregate, not per tx
+        ev = events[0]
+        assert ev["count"] == 3
+        assert 0.0 <= ev["p50_ms"] <= ev["max_ms"]
+    finally:
+        timeline.DEFAULT.clear()
+
+
+def test_snapshot_limit_caps_journeys_not_stats():
+    t = txlat.TxLat()
+    for i in range(10):
+        k = b"lim-%d" % i
+        t.stamp(k, "submit", t_ns=i + 1)
+        t.stamp(k, "commit", t_ns=i + 1_000_000)
+    snap = t.snapshot(limit=4)
+    assert len(snap["txs"]) == 4
+    # the LAST four completions, and the stats still cover all ten
+    assert snap["txs"][-1]["hash"] == b"lim-9".hex()
+    stats = snap["submit_to_commit"]
+    assert stats["count"] == 10
+    assert stats["p50_ms"] <= stats["p99_ms"] <= stats["max_ms"]
+
+
+def test_disabled_gate_makes_every_path_a_noop():
+    t = txlat.TxLat()
+    t.set_enabled(False)
+    t.stamp(b"k", "submit")
+    t.stamp_tx(b"k", "submit")
+    t.note_block(3, [b"k"])
+    assert t.stamp_height(3, "commit") == 0
+    snap = t.snapshot()
+    assert snap["enabled"] is False and snap["tracked"] == 0
+    t.set_enabled(True)
+    t.stamp(b"k", "submit")
+    assert t.snapshot()["tracked"] == 1
+
+
+def test_module_fast_paths_ride_the_default_ring():
+    prev = txlat.enabled()
+    txlat.clear()
+    try:
+        txlat.set_enabled(True)
+        txlat.stamp_tx(b"module-tx", "submit")
+        txlat.stamp_tx(b"module-tx", "commit")
+        snap = txlat.snapshot()
+        assert snap["completed"] >= 1
+        assert any(j["hash"] == tmhash.sum(b"module-tx").hex()
+                   for j in snap["txs"])
+        txlat.set_enabled(False)
+        before = txlat.snapshot()["tracked"]
+        txlat.stamp_tx(b"module-other", "submit")  # gated before hashing
+        assert txlat.snapshot()["tracked"] == before
+    finally:
+        txlat.set_enabled(prev)
+        txlat.clear()
+
+
+def test_concurrent_stamping_keeps_exact_counts():
+    t = txlat.TxLat(capacity=4096)
+    n_threads, per_thread = 4, 200
+
+    def worker(tid):
+        for i in range(per_thread):
+            k = b"c-%d-%d" % (tid, i)
+            t.stamp(k, "submit")
+            t.stamp(k, "admit")
+            t.stamp(k, "commit")
+
+    threads = [threading.Thread(target=worker, args=(tid,))
+               for tid in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    snap = t.snapshot()
+    assert snap["completed"] == n_threads * per_thread
+    assert snap["evicted"] == 0
+    assert snap["submit_to_commit"]["count"] == n_threads * per_thread

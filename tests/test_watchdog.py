@@ -157,6 +157,70 @@ def test_tpu_backend_down_probe_unhealthy():
         metrics.crypto_tpu_backend_up.set(old if old is not None else 1.0)
 
 
+def _gauge_value(gauge):
+    return gauge.summary_series().get("", 0.0)
+
+
+def test_latency_slo_check_trips_after_consecutive_breaches():
+    """p99 over the SLO must persist for ``consecutive`` samples before
+    the node flips unhealthy — one slow block is a blip, a streak is an
+    incident. The gauge mirrors the rolling p99 for scrapes."""
+    breaches0 = sum(
+        metrics.health_latency_slo_breaches.summary_series().values())
+    check = wdg.latency_slo_check(slo_ms=1.0, window_s=60.0,
+                                  consecutive=3)
+    ok, _, details = check()  # seeds the baseline bucket snapshot
+    assert ok and details["observed_in_window"] == 0
+    for _ in range(20):
+        metrics.tx_latency_submit_to_commit.observe(0.25)  # 250ms >> SLO
+    ok, _, d = check()
+    assert ok and d["breach_streak"] == 1
+    assert d["p99_ms"] > 1.0
+    assert _gauge_value(metrics.health_latency_p99_ms) == d["p99_ms"]
+    ok, _, d = check()
+    assert ok and d["breach_streak"] == 2
+    ok, reason, d = check()
+    assert not ok and d["breach_streak"] == 3
+    assert "over SLO" in reason and "1ms" in reason
+    breaches1 = sum(
+        metrics.health_latency_slo_breaches.summary_series().values())
+    assert breaches1 - breaches0 == 3
+
+
+def test_latency_slo_check_quiet_window_is_healthy_and_resets_streak():
+    """No commits carrying submit-stamped txs in the window is NOT a
+    breach (an idle chain must stay healthy), and the quiet window
+    clears the breach streak: a fresh incident needs a fresh streak."""
+    check = wdg.latency_slo_check(slo_ms=1.0, window_s=0.15,
+                                  consecutive=2)
+    check()
+    metrics.tx_latency_submit_to_commit.observe(0.25)
+    ok, _, d = check()
+    assert ok and d["breach_streak"] == 1  # one short of tripping
+    time.sleep(0.2)  # the pre-spike baseline ages out of the window
+    check()  # window re-seeds with post-spike snapshots only
+    ok, _, d = check()
+    assert ok and d["observed_in_window"] == 0
+    assert _gauge_value(metrics.health_latency_p99_ms) == 0.0
+    # the old spike no longer counts toward a streak: the next breach
+    # starts at 1, so the check stays healthy (consecutive=2)
+    metrics.tx_latency_submit_to_commit.observe(0.25)
+    ok, _, d = check()
+    assert ok and d["breach_streak"] == 1
+
+
+def test_latency_slo_check_under_slo_traffic_stays_healthy():
+    check = wdg.latency_slo_check(slo_ms=10_000.0, window_s=60.0,
+                                  consecutive=1)
+    check()
+    for _ in range(10):
+        metrics.tx_latency_submit_to_commit.observe(0.002)
+    ok, _, d = check()
+    assert ok and d["breach_streak"] == 0
+    assert 0.0 < d["p99_ms"] <= 10_000.0
+    assert _gauge_value(metrics.health_latency_p99_ms) == d["p99_ms"]
+
+
 # --- Watchdog core -----------------------------------------------------------
 
 

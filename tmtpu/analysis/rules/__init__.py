@@ -16,6 +16,7 @@ from tmtpu.analysis.rules import (  # noqa: F401
     lock_order,
     meta,
     metrics,
+    obs_docs,
     recv_sync,
     scenarios,
     sidecar,

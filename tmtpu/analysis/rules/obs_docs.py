@@ -1,0 +1,91 @@
+"""obs-docs rule: the tx-lifecycle observability surface is documented.
+
+The per-tx journey ring (libs/txlat) is only useful if an operator can
+read its output, and every name it exports is an API: the checkpoint
+stages in ``TX_STAGES`` (they appear verbatim in ``txlat`` RPC
+snapshots and fleet reports), the ``tendermint_tx_latency_*`` /
+``tendermint_health_latency_*`` metric families, and the ``tx_latency``
+timeline event kind. Each one must have a row in docs/OBSERVABILITY.md
+— a stage or metric added without documentation is a dashboard nobody
+can interpret.
+
+Everything is resolved statically (metric catalog via
+``index.metric_defs()``, the stage tuple parsed out of libs/txlat.py),
+so the rule also runs on synthetic fixture trees; a tree with no
+tx-lifecycle surface at all has nothing to document and passes
+vacuously.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List
+
+from tmtpu.analysis.findings import Finding
+from tmtpu.analysis.index import RepoIndex
+from tmtpu.analysis.registry import rule
+
+DOC_PATH = "docs/OBSERVABILITY.md"
+_TXLAT_MOD = "tmtpu/libs/txlat.py"
+_METRICS_MOD = "tmtpu/libs/metrics.py"
+_PREFIXES = ("tendermint_tx_latency", "tendermint_health_latency")
+
+
+def _tx_stages(index: RepoIndex) -> List[str]:
+    """The declared txlat.TX_STAGES tuple, statically."""
+    fi = index.get(_TXLAT_MOD)
+    if fi is None or fi.tree is None:
+        return []
+    for node in fi.tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "TX_STAGES"
+                for t in node.targets):
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                return [e.value for e in node.value.elts
+                        if isinstance(e, ast.Constant) and
+                        isinstance(e.value, str)]
+    return []
+
+
+@rule("obs-docs",
+      doc="every tx-lifecycle observability name — TX_STAGES checkpoint "
+          "stages, tendermint_tx_latency_*/tendermint_health_latency_* "
+          "metrics, the tx_latency timeline event — has a "
+          "docs/OBSERVABILITY.md row",
+      triggers=("tmtpu/libs", "docs"))
+def check(index: RepoIndex) -> List[Finding]:
+    required = []  # (kind, name, source rel)
+    for prom in sorted(set(index.metric_defs().values())):
+        if prom.startswith(_PREFIXES):
+            required.append(("metric", prom, _METRICS_MOD))
+    stages = _tx_stages(index)
+    for s in stages:
+        required.append(("stage", s, _TXLAT_MOD))
+    if stages:
+        # the event kind exists exactly when the journey ring does
+        required.append(("event", "tx_latency", "tmtpu/libs/timeline.py"))
+    if not required:
+        return []  # no tx-lifecycle surface in this tree
+
+    doc_file = os.path.join(index.root, DOC_PATH)
+    if not os.path.isfile(doc_file):
+        return [Finding(
+            "obs-docs", DOC_PATH,
+            f"{DOC_PATH} is missing but the tree exports a tx-lifecycle "
+            f"observability surface ({len(required)} documented names "
+            f"required)",
+            key="obs-docs::no-doc")]
+    with open(doc_file, encoding="utf-8") as fh:
+        doc_src = fh.read()
+
+    findings = []
+    for kind, name, src in required:
+        if f"`{name}`" not in doc_src:
+            findings.append(Finding(
+                "obs-docs", DOC_PATH,
+                f"{kind} {name!r} ({src}) has no `{name}` entry in "
+                f"{DOC_PATH} — document what it measures and when it "
+                f"fires",
+                key=f"obs-docs::{kind}::{name}"))
+    return findings

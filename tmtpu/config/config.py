@@ -194,6 +194,14 @@ class InstrumentationConfig:
     prometheus: bool = False
     prometheus_listen_addr: str = ":26660"
     namespace: str = "tendermint"
+    # per-tx lifecycle latency tracking (libs/txlat.py): stamp every tx
+    # hash at its pipeline checkpoints and serve the journey via the
+    # ``txlat`` RPC / /debug/txlat. On by default like the timeline —
+    # the fast paths are one attribute read when off.
+    txlat: bool = True
+    # submit→commit p99 SLO in milliseconds for the watchdog's
+    # latency_slo_check; 0 disables the check entirely
+    latency_slo_ms: float = 0.0
 
 
 @dataclass
@@ -219,6 +227,12 @@ class HealthConfig:
     # spans longer than this count in tendermint_health_slow_spans_total;
     # 0 disables the slow-span SLO scan
     slow_span_threshold_ns: int = 1000 * MS
+    # latency SLO check (armed by [instr] latency_slo_ms > 0): rolling
+    # window the p99 is computed over, and how many CONSECUTIVE breaching
+    # watchdog samples it takes to trip unhealthy (absorbs one-block
+    # blips without disarming the check)
+    latency_slo_window_ns: int = 30_000 * MS
+    latency_slo_samples: int = 3
 
 
 @dataclass

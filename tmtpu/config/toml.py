@@ -172,6 +172,13 @@ def validate(cfg: Config) -> None:
             raise ValueError("state_sync requires trust_height > 0")
         if not cfg.state_sync.trust_hash:
             raise ValueError("state_sync requires trust_hash")
+    if cfg.instrumentation.latency_slo_ms < 0:
+        raise ValueError("instrumentation.latency_slo_ms cannot be "
+                         "negative (0 disables the SLO check)")
+    if cfg.health.latency_slo_window_ns <= 0:
+        raise ValueError("health.latency_slo_window_ns must be positive")
+    if cfg.health.latency_slo_samples < 1:
+        raise ValueError("health.latency_slo_samples must be >= 1")
     if cfg.crypto.probe_timeout_ns <= 0:
         raise ValueError("crypto.probe_timeout_ns must be positive")
     if cfg.crypto.batch_deadline_ns < 0:

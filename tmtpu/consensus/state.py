@@ -32,7 +32,7 @@ from tmtpu.consensus.types import (
 from tmtpu.consensus.wal import (
     EndHeightPB, EventRoundStatePB, MsgInfoPB, TimeoutInfoPB, WAL,
 )
-from tmtpu.libs import timeline, trace
+from tmtpu.libs import timeline, trace, txlat
 from tmtpu.libs.service import BaseService
 from tmtpu.types import pb
 from tmtpu.types.block import BlockID, Commit
@@ -873,6 +873,9 @@ class ConsensusState(BaseService):
             self.wal.write_end_height(height)
         # 2: ENDHEIGHT written, app not yet committed
         fail.fail_point("cs.finalize.post_endheight")
+        # the commit checkpoint: block saved + ENDHEIGHT is the point the
+        # tx is durably committed on this node (async apply still pending)
+        txlat.stamp_height(height, "commit")
         if self.config.async_exec and not self.replay_mode and \
                 self.wal is not None:
             # async ApplyBlock overlap: the WAL's ENDHEIGHT is the commit
@@ -1043,6 +1046,12 @@ class ConsensusState(BaseService):
             return
         data = rs.proposal_block_parts.assemble()
         rs.proposal_block = Block.decode(data)
+        # proposal checkpoint for every tx in the block — proposer and
+        # followers both complete their parts through this path; the
+        # noted hashes also serve the later height-keyed stamps
+        # (quorums, commit, apply) without re-hashing the block
+        txlat.note_block(msg.height, rs.proposal_block.txs)
+        txlat.stamp_height(msg.height, "proposal")
         if self.event_bus:
             self.event_bus.publish_complete_proposal(rs)
         prevotes = rs.votes.prevotes(rs.round)

@@ -79,6 +79,34 @@ def _esc_help(v: str) -> str:
     return str(v).replace("\\", "\\\\").replace("\n", "\\n")
 
 
+def percentile_from_buckets(buckets, counts, q: float) -> float:
+    """Estimate the q-quantile (0..1) from cumulative bucket counts:
+    ``counts[i]`` observations were <= ``buckets[i]``, ``counts[-1]`` is
+    the total (+Inf bucket). Linear interpolation inside the winning
+    bucket (lower bound 0 below the first), clamped to the last finite
+    bound when the rank lands in +Inf — the histogram_quantile
+    convention. Shared by Histogram.percentile and the watchdog's
+    windowed-delta SLO math (libs/watchdog.py latency_slo_check)."""
+    if not buckets or not counts:
+        return 0.0
+    total = counts[-1]
+    if total <= 0:
+        return 0.0
+    rank = min(max(q, 0.0), 1.0) * total
+    prev_count = 0
+    prev_bound = 0.0
+    for i, b in enumerate(buckets):
+        c = counts[i]
+        if c >= rank:
+            if c == prev_count:
+                return float(b)
+            frac = (rank - prev_count) / (c - prev_count)
+            return prev_bound + (float(b) - prev_bound) * frac
+        prev_count = c
+        prev_bound = float(b)
+    return float(buckets[-1])
+
+
 class Counter(_Metric):
     def inc(self, amount: float = 1.0, **labels) -> None:
         k = self._key(labels)
@@ -125,6 +153,26 @@ class Histogram(_Metric):
             counts = self._counts.get(k)
             return ((counts[-1] if counts else 0),
                     self._sums.get(k, 0.0))
+
+    def bucket_counts(self, **labels) -> Tuple[int, ...]:
+        """Cumulative per-bucket counts (ending with the +Inf total) for
+        one label combination — the public read backing windowed-delta
+        percentile math (watchdog SLO check) and tools."""
+        k = self._key(labels)
+        with self._lock:
+            counts = self._counts.get(k)
+            return tuple(counts) if counts else ()
+
+    def percentile(self, q: float, **labels) -> float:
+        """Bucket-interpolated q-quantile (0..1) of everything observed
+        for one label combination; 0.0 with no observations."""
+        k = self._key(labels)
+        with self._lock:
+            counts = self._counts.get(k)
+            if not counts:
+                return 0.0
+            counts = list(counts)
+        return percentile_from_buckets(self.buckets, counts, q)
 
     def render(self, kind: str) -> List[str]:
         out = [f"# HELP {self.name} {_esc_help(self.help)}",
@@ -328,6 +376,40 @@ consensus_async_apply_overlap = DEFAULT.histogram(
              0.5, 1.0, 2.5))
 
 
+# --- the tx lifecycle latency metric set (libs/txlat.py) --------------------
+#
+# Written by the per-tx stamp ring: each checkpoint stamp observes the
+# transition from the tx's previous stamp into the stage histogram
+# (labels like "submit_to_admit_enq"), and the commit stamp observes the
+# end-to-end submit→commit span. Per-tx adjacent-transition diffs
+# telescope, so one tx's stage observations sum exactly to its
+# first-stamp→commit span (stage-decomposition contract, see
+# docs/OBSERVABILITY.md).
+
+tx_latency_submit_to_commit = DEFAULT.histogram(
+    "tx", "latency_submit_to_commit_seconds",
+    "End-to-end tx latency from RPC broadcast_tx entry to block commit "
+    "on the node the client submitted to",
+    buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5,
+             10, 30))
+tx_latency_stage = DEFAULT.histogram(
+    "tx", "latency_stage_seconds",
+    "Per-tx time between adjacent lifecycle checkpoints (stage label "
+    "names the transition, e.g. submit_to_admit_enq)",
+    labels=("stage",),
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 0.5, 1, 2.5, 5, 10))
+tx_latency_tracked = DEFAULT.gauge(
+    "tx", "latency_tracked",
+    "Tx journeys currently resident in the lifecycle stamp ring")
+tx_latency_completed = DEFAULT.counter(
+    "tx", "latency_completed_total",
+    "Tx journeys that reached the commit checkpoint")
+tx_latency_evicted = DEFAULT.counter(
+    "tx", "latency_evicted_total",
+    "Tx journeys FIFO-evicted from the stamp ring before commit")
+
+
 # --- the node health engine metric set (libs/watchdog.py) -------------------
 #
 # Written by Watchdog.check_now on every evaluation pass; the per-check
@@ -351,6 +433,17 @@ health_slow_spans = DEFAULT.counter(
     "health", "slow_spans_total",
     "Trace spans whose duration exceeded the slow-span SLO threshold",
     labels=("span",))
+# latency SLO check (watchdog latency_slo_check, gated on
+# [instr] latency_slo_ms > 0): rolling-window p99 of submit→commit
+# derived from tx_latency_submit_to_commit_seconds bucket deltas
+health_latency_p99_ms = DEFAULT.gauge(
+    "health", "latency_p99_ms",
+    "Rolling-window p99 submit-to-commit tx latency (ms) as seen by "
+    "the latency SLO watchdog check")
+health_latency_slo_breaches = DEFAULT.counter(
+    "health", "latency_slo_breaches_total",
+    "Watchdog samples whose rolling p99 submit-to-commit latency "
+    "exceeded the configured SLO")
 
 # libs/sync.py deadlock-detection reports (one per acquisition that
 # blocked past the watched-lock timeout)

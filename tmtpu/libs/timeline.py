@@ -56,6 +56,11 @@ EVENT_APPLY_BLOCK = "state.apply_block"
 EVENT_BREAKER = "crypto.breaker"
 EVENT_SIGCACHE = "crypto.sigcache"
 EVENT_SIDECAR = "crypto.sidecar"
+# per-height tx-latency aggregate (libs/txlat.py commit stamp): ONE
+# event per committed height carrying count/p50/max of the
+# submit→commit spans — never one event per tx (the 512-events/height
+# cap must stay for consensus diagnostics)
+EVENT_TX_LATENCY = "tx_latency"
 
 
 class Timeline:

@@ -1,0 +1,295 @@
+"""Per-transaction lifecycle latency tracking — the submit→commit story.
+
+A bounded ring of per-tx stamp journals (keyed by tx hash) answering
+"where did this tx spend its time": each subsystem stamps the hash at a
+monotonic checkpoint and the commit stamp folds the journey into the
+``tendermint_tx_latency_*`` histograms (libs/metrics.py), a per-height
+``tx_latency`` timeline event (libs/timeline.py), and the ``txlat``
+JSON-RPC / ``GET /debug/txlat`` snapshot.
+
+Checkpoints (TX_STAGES, in canonical pipeline order):
+
+    submit       RPC broadcast_tx_* entry (the node the client hit)
+    gossip_rx    first receipt via mempool gossip (follower nodes)
+    admit_enq    enqueued into the batched CheckTx gather window
+    flush        survived the gather window's signature-verify flush
+    admit        CheckTx accepted → resident in the mempool
+    proposal     included in a proposed block (proposer + followers)
+    prevote_q    block crossed the +2/3 prevote quorum
+    precommit_q  block crossed the +2/3 precommit quorum
+    commit       block finalized (WAL ENDHEIGHT + stored)
+    apply        ABCI ApplyBlock finished (async or serial)
+    index        tx indexer wrote the result
+
+Stamps are first-write-wins and strictly time-ordered per tx (each call
+reads ``perf_counter_ns`` at stamp time), so adjacent stamp diffs
+telescope: the per-transition ``tx_latency_stage_seconds`` observations
+for one tx sum EXACTLY to its first-stamp→commit span. On the submit
+node that first stamp is ``submit`` and the decomposition equals the
+end-to-end ``tx_latency_submit_to_commit_seconds`` observation; on
+followers the journey starts at ``gossip_rx`` and no submit→commit
+total is emitted (they never saw the submit).
+
+Recording is allocation-light (one small dict per tracked tx, FIFO
+eviction at ``capacity``) and gated by the ``[instr] txlat`` knob: the
+module-level fast paths check ``enabled`` before hashing or locking, so
+a disabled node pays one attribute read per call site.
+
+NOTE: like libs/metrics and libs/timeline, the DEFAULT instance is
+process-global. In-process multi-node tests share one ring; per-node
+attribution (the fleet report) requires subprocess nodes (tmtpu/e2e).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional
+
+from tmtpu.libs import metrics as _m
+from tmtpu.libs import timeline as _timeline
+
+# canonical checkpoint order — docs/OBSERVABILITY.md catalogs every
+# entry (the analysis obs-docs rule enforces the contract)
+TX_STAGES = (
+    "submit",
+    "gossip_rx",
+    "admit_enq",
+    "flush",
+    "admit",
+    "proposal",
+    "prevote_q",
+    "precommit_q",
+    "commit",
+    "apply",
+    "index",
+)
+
+_STAGE_SET = frozenset(TX_STAGES)
+
+# tx journeys tracked before FIFO eviction; sized for a few heights of
+# saturated 10k-tx blocks without unbounded growth under flood
+_DEFAULT_CAPACITY = 8192
+
+# completed (committed) journeys kept for the snapshot/fleet report
+_DONE_CAPACITY = 4096
+
+# per-height block tx-hash memo (note_block → stamp_height), tiny: only
+# heights between proposal and apply need it
+_BLOCK_MEMO_CAP = 16
+
+
+class TxLat:
+    """Bounded per-tx stamp ring. All methods are thread-safe."""
+
+    def __init__(self, capacity: int = _DEFAULT_CAPACITY):
+        self.capacity = max(16, capacity)
+        self._entries: "OrderedDict[bytes, Dict[str, int]]" = OrderedDict()
+        self._blocks: "OrderedDict[int, List[bytes]]" = OrderedDict()
+        self._done: "deque" = deque(maxlen=_DONE_CAPACITY)
+        self._lock = threading.Lock()
+        self._enabled = True
+        self._evicted = 0
+        self._completed = 0
+
+    # -- recording ----------------------------------------------------------
+
+    def stamp(self, key: bytes, stage: str,
+              t_ns: Optional[int] = None) -> None:
+        """Record ``stage`` for tx hash ``key`` (first write wins) and
+        observe the transition-from-previous-stamp histogram. The
+        ``commit`` stamp additionally observes submit→commit."""
+        if not self._enabled:
+            return
+        now = time.perf_counter_ns() if t_ns is None else t_ns
+        with self._lock:
+            self._stamp_locked(key, stage, now)
+
+    def _stamp_locked(self, key: bytes, stage: str, now: int) -> None:
+        e = self._entries.get(key)
+        if e is None:
+            # never open a journey at a post-commit stage: an evicted or
+            # never-tracked tx would record meaningless partial journeys
+            if stage in ("commit", "apply", "index"):
+                return
+            e = {}
+            self._entries[key] = e
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self._evicted += 1
+                _m.tx_latency_evicted.inc()
+            _m.tx_latency_tracked.set(len(self._entries))
+        if stage in e:
+            return
+        # latest prior stamp → adjacent-transition observation; stamps
+        # are monotonic so prev is always <= now and the per-tx diffs
+        # telescope to the first-stamp→latest-stamp span
+        prev_stage, prev_t = None, -1
+        for s, t in e.items():
+            if t > prev_t:
+                prev_stage, prev_t = s, t
+        e[stage] = now
+        if prev_stage is not None:
+            _m.tx_latency_stage.observe(
+                max(0, now - prev_t) / 1e9,
+                stage=f"{prev_stage}_to_{stage}")
+        if stage == "commit":
+            self._completed += 1
+            _m.tx_latency_completed.inc()
+            sub = e.get("submit")
+            if sub is not None:
+                _m.tx_latency_submit_to_commit.observe(
+                    max(0, now - sub) / 1e9)
+            self._done.append((key, e))
+
+    def stamp_tx(self, tx: bytes, stage: str) -> None:
+        """Hash-then-stamp convenience for call sites that hold only the
+        raw tx bytes. Checks ``enabled`` BEFORE hashing."""
+        if not self._enabled:
+            return
+        from tmtpu.crypto import tmhash
+
+        self.stamp(tmhash.sum(tx), stage)
+
+    def note_block(self, height: int, txs) -> None:
+        """Memoize ``height``'s tx hashes so the height-keyed consensus
+        checkpoints (proposal/quorum/commit/apply) can bulk-stamp
+        without re-hashing the block at every stage."""
+        if not self._enabled or height <= 0 or not txs:
+            return
+        from tmtpu.crypto import tmhash
+
+        hashes = [tmhash.sum(tx) for tx in txs]
+        with self._lock:
+            self._blocks[height] = hashes
+            while len(self._blocks) > _BLOCK_MEMO_CAP:
+                self._blocks.popitem(last=False)
+
+    def stamp_height(self, height: int, stage: str) -> int:
+        """Stamp every tx of a noted block at ``stage`` under one lock
+        acquisition + one clock read; returns the number of txs stamped.
+        The ``commit`` stamp also emits the per-height aggregate
+        ``tx_latency`` timeline event (count/p50/max of the submit→commit
+        spans) — one event per height, never one per tx."""
+        if not self._enabled or height <= 0:
+            return 0
+        now = time.perf_counter_ns()
+        totals_ms: List[float] = []
+        with self._lock:
+            hashes = self._blocks.get(height)
+            if not hashes:
+                return 0
+            for h in hashes:
+                self._stamp_locked(h, stage, now)
+            if stage == "commit":
+                for h in hashes:
+                    e = self._entries.get(h)
+                    if e and "submit" in e and "commit" in e:
+                        totals_ms.append(
+                            (e["commit"] - e["submit"]) / 1e6)
+            n = len(hashes)
+        if stage == "commit" and totals_ms:
+            totals_ms.sort()
+            _timeline.record(
+                height, _timeline.EVENT_TX_LATENCY,
+                count=len(totals_ms),
+                p50_ms=round(totals_ms[len(totals_ms) // 2], 3),
+                max_ms=round(totals_ms[-1], 3))
+        return n
+
+    # -- reading ------------------------------------------------------------
+
+    def snapshot(self, limit: int = 64) -> Dict:
+        """The ``txlat`` JSON-RPC payload: ring counters, exact recent
+        submit→commit percentiles (over the completed-journey window,
+        not bucket-interpolated), and the most recent ``limit`` raw
+        journeys (stage → ms offset from the tx's first stamp) for
+        cross-node correlation by hash."""
+        with self._lock:
+            tracked = len(self._entries)
+            evicted = self._evicted
+            completed = self._completed
+            done = list(self._done)[-max(0, limit):]
+            totals = [(e["commit"] - e["submit"]) / 1e6
+                      for _k, e in self._done
+                      if "submit" in e and "commit" in e]
+            journeys = [(k, dict(e)) for k, e in done]
+        txs = []
+        for k, e in journeys:
+            t0 = min(e.values())
+            stages = {s: round((t - t0) / 1e6, 3)
+                      for s, t in sorted(e.items(), key=lambda kv: kv[1])}
+            j = {"hash": k.hex(), "stages": stages}
+            if "submit" in e and "commit" in e:
+                j["submit_to_commit_ms"] = round(
+                    (e["commit"] - e["submit"]) / 1e6, 3)
+            txs.append(j)
+        stats = {"count": len(totals)}
+        if totals:
+            totals.sort()
+            stats["p50_ms"] = round(
+                totals[int(0.50 * (len(totals) - 1))], 3)
+            stats["p99_ms"] = round(
+                totals[int(0.99 * (len(totals) - 1))], 3)
+            stats["max_ms"] = round(totals[-1], 3)
+        return {"enabled": self._enabled, "tracked": tracked,
+                "completed": completed, "evicted": evicted,
+                "submit_to_commit": stats, "txs": txs}
+
+    # -- control ------------------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def set_enabled(self, enabled: bool) -> None:
+        self._enabled = bool(enabled)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._blocks.clear()
+            self._done.clear()
+            self._evicted = 0
+            self._completed = 0
+
+
+DEFAULT = TxLat()
+
+
+def enabled() -> bool:
+    return DEFAULT._enabled
+
+
+def stamp(key: bytes, stage: str) -> None:
+    if DEFAULT._enabled:
+        DEFAULT.stamp(key, stage)
+
+
+def stamp_tx(tx: bytes, stage: str) -> None:
+    if DEFAULT._enabled:
+        DEFAULT.stamp_tx(tx, stage)
+
+
+def note_block(height: int, txs) -> None:
+    if DEFAULT._enabled:
+        DEFAULT.note_block(height, txs)
+
+
+def stamp_height(height: int, stage: str) -> int:
+    if DEFAULT._enabled:
+        return DEFAULT.stamp_height(height, stage)
+    return 0
+
+
+def snapshot(limit: int = 64) -> Dict:
+    return DEFAULT.snapshot(limit=limit)
+
+
+def set_enabled(enabled: bool) -> None:
+    DEFAULT.set_enabled(enabled)
+
+
+def clear() -> None:
+    DEFAULT.clear()

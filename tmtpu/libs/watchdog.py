@@ -269,6 +269,65 @@ def tpu_backend_check(window_s: float, storm_threshold: int,
     return check
 
 
+def latency_slo_check(slo_ms: float, window_s: float = 30.0,
+                      consecutive: int = 3) -> CheckFn:
+    """Unhealthy when the rolling p99 submit→commit tx latency exceeds
+    ``slo_ms`` for ``consecutive`` watchdog samples in a row. The p99 is
+    computed from windowed DELTAS of the
+    ``tendermint_tx_latency_submit_to_commit_seconds`` bucket counts
+    (cumulative snapshots pruned past ``window_s``), so one historic
+    latency spike ages out of the verdict instead of pinning it forever.
+    Quiet windows (no commits carrying submit-stamped txs) are healthy:
+    no traffic is not a latency breach. Registered only when
+    ``[instr] latency_slo_ms`` > 0 (node/node.py)."""
+    from tmtpu.libs import metrics as _m
+
+    # (t, cumulative bucket counts incl. +Inf total)
+    samples: List[Tuple[float, Tuple[int, ...]]] = []
+    streak = {"n": 0}
+
+    def check() -> Tuple[bool, str, Dict]:
+        now = time.monotonic()
+        counts = _m.tx_latency_submit_to_commit.bucket_counts()
+        if counts:
+            samples.append((now, counts))
+        while samples and samples[0][0] < now - window_s:
+            samples.pop(0)
+        details: Dict = {"slo_ms": slo_ms, "window_s": window_s,
+                         "consecutive_needed": consecutive}
+        if len(samples) < 2:
+            details["observed_in_window"] = 0
+            streak["n"] = 0
+            _m.health_latency_p99_ms.set(0.0)
+            return True, "", details
+        first, last = samples[0][1], samples[-1][1]
+        delta = [b - a for a, b in zip(first, last)]
+        observed = delta[-1]
+        details["observed_in_window"] = observed
+        if observed <= 0:
+            streak["n"] = 0
+            _m.health_latency_p99_ms.set(0.0)
+            return True, "", details
+        p99_ms = _m.percentile_from_buckets(
+            _m.tx_latency_submit_to_commit.buckets, delta, 0.99) * 1000.0
+        _m.health_latency_p99_ms.set(round(p99_ms, 3))
+        details["p99_ms"] = round(p99_ms, 3)
+        if p99_ms > slo_ms:
+            _m.health_latency_slo_breaches.inc()
+            streak["n"] += 1
+        else:
+            streak["n"] = 0
+        details["breach_streak"] = streak["n"]
+        if streak["n"] >= consecutive:
+            return (False,
+                    f"p99 submit->commit {p99_ms:.1f}ms over SLO "
+                    f"{slo_ms:.0f}ms for {streak['n']} samples",
+                    details)
+        return True, "", details
+
+    return check
+
+
 def breaker_check() -> CheckFn:
     """Unhealthy while any crypto circuit breaker sits OPEN — the node
     is alive but running degraded (CPU-serial verify), which an

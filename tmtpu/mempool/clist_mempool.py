@@ -28,6 +28,7 @@ from typing import Callable, List, Optional
 
 from tmtpu.abci import types as abci
 from tmtpu.crypto import tmhash
+from tmtpu.libs import txlat
 from tmtpu.libs.clist import CElement, CList
 
 
@@ -245,6 +246,8 @@ class BatchCheckMixin:
     # -- gather worker -------------------------------------------------------
 
     def _enqueue_admit(self, entry: _AdmitEntry) -> None:
+        # gather-window wait starts here; the "flush" stamp closes it
+        txlat.stamp_tx(entry.tx, "admit_enq")
         self._admit_q.put(entry)
         with self._admit_mtx:
             if not self._admit_running:
@@ -345,6 +348,9 @@ class BatchCheckMixin:
         # 2) pipelined ABCI: enqueue all CheckTx requests, one flush
         _m.mempool_batch_flushes.inc()
         _m.mempool_batch_txs.inc(len(survivors))
+        if txlat.enabled():
+            for en in survivors:
+                txlat.stamp_tx(en.tx, "flush")
         responses = pipelined_check_tx(self.proxy_app, [
             abci.RequestCheckTx(tx=en.tx, type=abci.CHECK_TX_TYPE_NEW)
             for en in survivors])
@@ -435,6 +441,7 @@ class CListMempool(BatchCheckMixin, AsyncRecheckMixin):
                     self._txs[key] = self._list.push_back(info)
                     self._txs_bytes += len(tx)
                     added = True
+                    txlat.stamp(key, "admit")
             else:
                 if not self.keep_invalid_txs_in_cache:
                     self.cache.remove(tx)

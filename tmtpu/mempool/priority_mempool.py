@@ -19,6 +19,7 @@ from typing import Callable, List, Optional
 
 from tmtpu.abci import types as abci
 from tmtpu.crypto import tmhash
+from tmtpu.libs import txlat
 from tmtpu.libs.clist import CElement, CList
 from tmtpu.mempool.clist_mempool import (
     AsyncRecheckMixin, BatchCheckMixin, MempoolFullError, TxCache,
@@ -114,6 +115,7 @@ class PriorityMempool(BatchCheckMixin, AsyncRecheckMixin):
             info["_el"] = self._list.push_back(info)
             self._txs[key] = info
             self._txs_bytes += len(tx)
+            txlat.stamp(key, "admit")
         # callbacks run OUTSIDE self._lock: a txs-available listener that
         # re-enters the mempool (or grabs its own lock) must not nest
         # under the admission lock

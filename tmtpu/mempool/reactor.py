@@ -21,6 +21,7 @@ from typing import Dict
 
 from tmtpu.crypto import tmhash
 from tmtpu.libs import metrics as _m
+from tmtpu.libs import txlat
 from tmtpu.libs.protoio import ProtoMessage
 from tmtpu.mempool.clist_mempool import CListMempool, MempoolFullError, \
     TxInMempoolError
@@ -123,7 +124,11 @@ class MempoolReactor(Reactor):
             tx = bytes(tx)
             # the sender obviously has this tx: record it so the
             # broadcast cursor never echoes it back
-            seen.add(tmhash.sum(tx))
+            h = tmhash.sum(tx)
+            seen.add(h)
+            # first-stamp-wins: only the FIRST gossip arrival opens the
+            # follower-side journey; re-receipts are no-ops
+            txlat.stamp(h, "gossip_rx")
             try:
                 self._rx_q.put_nowait((tx, peer.node_id))
             except queue.Full:

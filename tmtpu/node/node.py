@@ -44,6 +44,11 @@ class Node(BaseService):
                  priv_validator=None):
         super().__init__("Node")
         self.config = config
+        # [instr] txlat gates the per-tx lifecycle stamp ring before any
+        # subsystem can stamp (the module fast paths read this flag)
+        from tmtpu.libs import txlat as _txlat
+
+        _txlat.set_enabled(config.instrumentation.txlat)
         crypto_batch.set_default_backend(config.base.crypto_backend)
         # resilience knobs: probe/batch deadlines + breaker thresholds
         # ([crypto] section) flow into the shared breaker registry BEFORE
@@ -407,6 +412,15 @@ class Node(BaseService):
         wd.register("sync", wdg.sync_status_check(
             lambda: self._is_syncing() and not self.state_sync,
             lambda: self.state_sync))
+        instr = self.config.instrumentation
+        if instr.latency_slo_ms > 0 and instr.txlat:
+            # armed only when an SLO is configured AND the stamp ring is
+            # on (without txlat the histogram never moves and the check
+            # would report healthy forever while lying about coverage)
+            wd.register("latency", wdg.latency_slo_check(
+                instr.latency_slo_ms,
+                window_s=hc.latency_slo_window_ns / 1e9,
+                consecutive=hc.latency_slo_samples))
         if self.config.base.crypto_backend != "cpu":
             wd.register("crypto", wdg.tpu_backend_check(
                 hc.fallback_storm_window_ns / 1e9,

@@ -159,6 +159,9 @@ class HTTPClient:
     def metrics(self):
         return self.call("metrics")
 
+    def txlat(self, limit: int = 64):
+        return self.call("txlat", limit=str(limit))
+
     # -- unsafe scenario control (requires [rpc] unsafe on the node) --------
 
     def unsafe_net_shape(self, links: Optional[str] = None,

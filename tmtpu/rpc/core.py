@@ -9,6 +9,7 @@ from typing import Optional
 
 from tmtpu.abci import types as abci
 from tmtpu.libs import amino_json
+from tmtpu.libs import txlat
 from tmtpu.types.event_bus import EVENT_TX
 from tmtpu.version import TMCoreSemVer
 
@@ -480,18 +481,21 @@ def build_routes(env: Environment) -> dict:
 
     def broadcast_tx_async(tx):
         raw = _decode_tx(tx)
+        from tmtpu.types.tx import tx_hash
+
+        h = tx_hash(raw)
+        txlat.stamp(h, "submit")
         try:
             env.mempool.check_tx(raw)
         except Exception:
             pass
-        from tmtpu.types.tx import tx_hash
-
-        return {"code": 0, "data": "", "log": "", "hash": _hex(tx_hash(raw))}
+        return {"code": 0, "data": "", "log": "", "hash": _hex(h)}
 
     def broadcast_tx_sync(tx):
         raw = _decode_tx(tx)
         from tmtpu.types.tx import tx_hash
 
+        txlat.stamp(tx_hash(raw), "submit")
         result = {}
 
         def cb(res):
@@ -512,6 +516,7 @@ def build_routes(env: Environment) -> dict:
 
         raw = _decode_tx(tx)
         want = tx_hash(raw)
+        txlat.stamp(want, "submit")
         sub = env.event_bus.subscribe(
             f"rpc-btc-{want.hex()[:16]}",
             lambda item: item.type == EVENT_TX and
@@ -704,6 +709,14 @@ def build_routes(env: Environment) -> dict:
                 last=int(last)),
         }
 
+    def txlat_report(limit="64"):
+        """Per-tx lifecycle latency snapshot (libs/txlat): ring counters,
+        recent submit→commit percentiles, and the most recent per-tx
+        stamp journeys (stage → ms offset) keyed by tx hash — the
+        'where did this tx spend its time' answer, and the raw material
+        tools/fleet_report.py correlates across nodes."""
+        return txlat.snapshot(limit=int(limit))
+
     def health_detail():
         """Aggregated watchdog verdicts (libs/watchdog): consensus
         progress, p2p peer count, mempool drain, blocksync/statesync
@@ -784,6 +797,7 @@ def build_routes(env: Environment) -> dict:
         "unsafe_inject_fault": unsafe_inject_fault,
         "health": health, "status": status, "genesis": genesis,
         "metrics": metrics, "timeline": timeline,
+        "txlat": txlat_report,
         "health_detail": health_detail,
         "genesis_chunked": genesis_chunked, "check_tx": check_tx,
         "net_info": net_info, "blockchain": blockchain, "block": block,

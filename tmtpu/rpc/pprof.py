@@ -18,6 +18,9 @@ Python-native equivalents of the Go pprof profiles:
     /debug/timeline          per-height round timeline journal
                              (libs/timeline) as JSON; ?height=H for one
                              height, ?last=N for the trailing window
+    /debug/txlat             per-tx lifecycle latency snapshot
+                             (libs/txlat) as JSON; ?limit=N for the
+                             recent-journey window size
     /metrics                 Prometheus text exposition (libs/metrics) —
                              the scrape target standard collectors expect
     /healthz                 liveness: 200 when every watchdog check
@@ -130,7 +133,9 @@ class _Handler(BaseHTTPRequestHandler):
                 body = ("pprof endpoints: goroutine, heap, "
                         "profile?seconds=N, cmdline; trace drain at "
                         "/debug/traces[?format=jsonl][&keep=1]; timeline "
-                        "at /debug/timeline; /metrics, /healthz, /readyz\n")
+                        "at /debug/timeline; tx lifecycle latency at "
+                        "/debug/txlat[?limit=N]; /metrics, /healthz, "
+                        "/readyz\n")
             elif path == "/debug/traces":
                 body, ctype = render_traces(
                     fmt=q.get("format", ["chrome"])[0],
@@ -147,6 +152,12 @@ class _Handler(BaseHTTPRequestHandler):
                         height=int(h) if h is not None else None,
                         last=int(q.get("last", ["20"])[0])),
                 })
+                ctype = "application/json"
+            elif path == "/debug/txlat":
+                from tmtpu.libs import txlat
+
+                body = json.dumps(txlat.snapshot(
+                    limit=int(q.get("limit", ["64"])[0])))
                 ctype = "application/json"
             elif path == "/metrics":
                 from tmtpu.libs import metrics

@@ -26,6 +26,7 @@ class Evidence:
          "health": health_detail result | None,
          "metrics": metrics result | None,
          "timeline": timeline result | None,
+         "txlat": txlat result | None,
          "blocks": {height: block json}}
 
     ``samples`` is the health time-series ({"t", "node", "height",
@@ -91,6 +92,12 @@ class Evidence:
             for ev in (blk.get("evidence", {}) or {}).get("evidence", []):
                 out.append({"height": h, **ev})
         return out
+
+    def txlat_stats(self, node: str) -> Dict:
+        """One node's recent submit→commit stats ({"count", "p50_ms",
+        "p99_ms", "max_ms"}; count 0 when it submitted nothing)."""
+        snap = self.nodes.get(node, {}).get("txlat") or {}
+        return snap.get("submit_to_commit") or {"count": 0}
 
     def timeline_event_names(self, node: str) -> List[str]:
         tl = self.nodes.get(node, {}).get("timeline") or {}
@@ -209,6 +216,32 @@ def all_healthy(ev: Evidence, nodes=None) -> Tuple[bool, str]:
             sick[n] = (h or {}).get("reasons", ["no health snapshot"])
     return not sick, f"unhealthy: {sick}" if sick else \
         f"all {len(names_)} nodes healthy"
+
+
+@oracle
+def latency_p99_under_slo(ev: Evidence, slo_ms: float = 2000.0,
+                          min_count: int = 20, nodes=None) \
+        -> Tuple[bool, str]:
+    """Every node that submitted txs (txlat submit→commit count >=
+    ``min_count``) saw a recent-window p99 at or under ``slo_ms``, and
+    at least one node actually has that coverage — a latency scenario
+    whose load never landed must fail loudly, not vacuously pass."""
+    names_ = list(nodes) if nodes else ev.node_names()
+    covered, over = {}, {}
+    for n in names_:
+        stats = ev.txlat_stats(n)
+        if stats.get("count", 0) < min_count:
+            continue
+        p99 = stats.get("p99_ms")
+        covered[n] = p99
+        if p99 is None or p99 > slo_ms:
+            over[n] = p99
+    if not covered:
+        return False, (f"no node has >= {min_count} submit->commit "
+                       f"journeys (txlat off or load never landed)")
+    if over:
+        return False, f"p99 over {slo_ms}ms SLO: {over} (all: {covered})"
+    return True, f"p99 under {slo_ms}ms SLO on {covered}"
 
 
 @oracle

@@ -137,11 +137,14 @@ class BlockExecutor:
 
         if self.event_bus:
             self._fire_events(block, block_id, abci_responses, val_updates)
-        from tmtpu.libs import timeline
+        from tmtpu.libs import timeline, txlat
 
         timeline.record(block.header.height, timeline.EVENT_APPLY_BLOCK,
                         txs=len(block.txs),
                         seconds=round(_time.perf_counter() - t0, 6))
+        # apply checkpoint (async or serial executor alike): commit→apply
+        # is exactly the span the async_exec overlap hides
+        txlat.stamp_height(block.header.height, "apply")
         return new_state, retain_height
 
     def apply_block_async(self, state: State, block_id: BlockID,

@@ -28,6 +28,9 @@ class KVTxIndexer:
 
     def index(self, txr: abci.TxResult) -> None:
         h = tx_hash(txr.tx)
+        from tmtpu.libs import txlat
+
+        txlat.stamp(h, "index")
         self.db.set(b"tx:" + h, txr.encode())
         # event-attribute index: "evt:<type>.<key>=<value>:<hash>"
         for ev in txr.result.events:

@@ -308,6 +308,15 @@ class VoteSet:
                 if self.signed_msg_type == PRECOMMIT
                 else timeline.EVENT_PREVOTE_QUORUM,
                 round=self.round, power=bv.sum, quorum=quorum)
+            if self._maj23.hash:
+                # non-nil quorum: stamp every tx of the winning block
+                # (noted at proposal completion) at its quorum stage
+                from tmtpu.libs import txlat
+
+                txlat.stamp_height(
+                    self.height,
+                    "precommit_q" if self.signed_msg_type == PRECOMMIT
+                    else "prevote_q")
             # copy the winning block's votes over to the main array
             for i, v in enumerate(bv.votes):
                 if v is not None:

@@ -1,0 +1,141 @@
+"""Shared plumbing for the localnet A/B tools (tools/localnet_*_ab.py).
+
+Every A/B tool builds the same 4-node full-mesh TCP kvstore net
+(tools/localnet_ab.py lineage), boots it to height 2, drives a load
+thread, and emits the same two-layer report: one JSON line per arm on
+stderr (progress visibility while the other arm still runs) plus one
+combined JSON object on stdout (the machine-readable verdict). This
+module owns that common shape so each tool only carries the knobs under
+test and the counters it reads.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict
+
+from tmtpu.config.config import Config
+from tmtpu.node.node import Node
+from tmtpu.privval.file_pv import FilePV
+from tmtpu.types.genesis import GenesisDoc, GenesisValidator
+
+
+def make_localnet(n, tmp, chain_id, configure=None, power=10):
+    """n-node full-mesh TCP net with per-node home dirs under ``tmp``.
+    ``configure(cfg, i)`` mutates each node's Config before construction
+    — every A/B knob goes through the production config path, never a
+    post-hoc monkeypatch of node internals."""
+    pvs = []
+    for i in range(n):
+        home = tmp / f"node{i}"
+        (home / "config").mkdir(parents=True)
+        (home / "data").mkdir(parents=True)
+        cfg = Config.test_config()
+        cfg.base.home = str(home)
+        cfg.base.crypto_backend = "cpu"
+        cfg.rpc.laddr = ""
+        if configure is not None:
+            configure(cfg, i)
+        pv = FilePV.load_or_generate(
+            cfg.rooted(cfg.base.priv_validator_key_file),
+            cfg.rooted(cfg.base.priv_validator_state_file))
+        pvs.append((cfg, pv))
+    gen = GenesisDoc(
+        chain_id=chain_id, genesis_time=time.time_ns(),
+        validators=[GenesisValidator(pv.get_pub_key(), power)
+                    for _, pv in pvs],
+    )
+    nodes = []
+    for cfg, pv in pvs:
+        gen.save_as(cfg.genesis_path)
+        nodes.append(Node(cfg))
+    addrs = [f"{nd.node_id}@127.0.0.1:{nd.p2p_port}" for nd in nodes]
+    for i, nd in enumerate(nodes):
+        nd.switch.set_persistent_peers([a for j, a in enumerate(addrs)
+                                        if j != i])
+    return nodes
+
+
+def boot(nodes, height=2, timeout_s=60.0):
+    """Start every node, wait for the full mesh, then for ``height``."""
+    for nd in nodes:
+        nd.start()
+    want = len(nodes) - 1
+    while any(nd.switch.num_peers() < want for nd in nodes):
+        time.sleep(0.1)
+    for nd in nodes:
+        assert nd.consensus.wait_for_height(height, timeout=timeout_s)
+
+
+def open_loop_load(nodes, prefix=b"ab", interval_s=0.002):
+    """Round-robin check_tx flood until the returned event is set — the
+    open-loop load shape shared by the window-timed A/B arms (the
+    closed-loop load tool paces itself and does not use this)."""
+    stop = threading.Event()
+
+    def load():
+        i = 0
+        n = len(nodes)
+        while not stop.is_set():
+            try:
+                nodes[i % n].mempool.check_tx(prefix + b"-%d=%d" % (i, i))
+            except Exception:
+                pass
+            i += 1
+            time.sleep(interval_s)
+
+    threading.Thread(target=load, daemon=True).start()
+    return stop
+
+
+def run_window(nodes, duration_s, reset_counters, prefix=b"ab",
+               warm_timeout_s=60.0):
+    """Boot the net, warm to height 2 under load, reset counters, then
+    measure one steady-state window. Counters reset AFTER warmup so both
+    arms measure the same steady state, not node boot + first-height
+    noise. Returns (blocks, wall_seconds)."""
+    boot(nodes, height=2, timeout_s=warm_timeout_s)
+    stop = open_loop_load(nodes, prefix=prefix)
+    reset_counters()
+    h0 = nodes[0].block_store.height()
+    t0 = time.monotonic()
+    time.sleep(duration_s)
+    stop.set()
+    h1 = nodes[0].block_store.height()
+    return h1 - h0, time.monotonic() - t0
+
+
+def counter_value(counter) -> float:
+    """Sum a counter across all its label series."""
+    return sum(counter.summary_series().values())
+
+
+@dataclass
+class ABReport:
+    """The shared A/B report schema: arms keyed by their ``arm`` name
+    plus derived cross-arm figures, serialized as the combined stdout
+    JSON object every tools/localnet_*_ab.py consumer parses."""
+
+    metric: str
+    arms: Dict[str, dict] = field(default_factory=dict)
+    derived: Dict[str, object] = field(default_factory=dict)
+
+    def add_arm(self, out: dict) -> dict:
+        """Record one arm and echo it to stderr immediately."""
+        self.arms[out["arm"]] = out
+        print(json.dumps(out), file=sys.stderr)
+        return out
+
+    def finish(self, **derived) -> dict:
+        """Merge derived figures, print the combined object to stdout,
+        and return it."""
+        self.derived.update(derived)
+        result = {"metric": self.metric}
+        result.update(self.arms)
+        result.update(self.derived)
+        print(json.dumps(result))
+        return result

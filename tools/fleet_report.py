@@ -1,0 +1,209 @@
+"""Cross-node tx-lifecycle latency report over a real subprocess localnet
+(ISSUE 15 acceptance): boot an N-validator net through the e2e Runner
+(each node its own ``python -m tmtpu.cmd start`` process, so every
+journey ring is genuinely per-node), drive RPC load for a window, then
+pull every node's ``txlat`` / ``metrics`` / ``timeline`` RPC surface and
+merge the per-tx journeys into one fleet report:
+
+  per-node    journey-ring counters and the node-local submit→commit
+              p50/p99 (from the exact journey window, not buckets);
+  stages      fleet-wide per-transition latency table (adjacent-stamp
+              diffs: submit→admit_enq→flush→admit→proposal→prevote_q→
+              precommit_q→commit→apply→index), p50/p99/max per label;
+  correlation each committed tx keyed by hash across nodes — which node
+              ingested it (has the "submit" stamp), how many nodes saw
+              it at all (gossip coverage; per-node clocks are process-
+              local perf counters, so CROSS-node time math is never
+              attempted);
+  decomposition  for every ingest-node journey that reached commit, the
+              sum of its stage transitions vs its submit→commit total —
+              the stamps are strictly time-ordered so the telescoping
+              sum should land within tolerance for ~every tx, and the
+              report proves it (``within_tol``/``checked``).
+
+Prints one combined JSON object on stdout (per-node one-liners on
+stderr as they arrive).
+
+Run: python tools/fleet_report.py [duration_s] [rate] [validators]
+"""
+
+import json
+import pathlib
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from tmtpu.e2e.manifest import LoadSpec, Manifest, NodeSpec  # noqa: E402
+from tmtpu.e2e.runner import Runner  # noqa: E402
+
+_DECOMP_TOL = 0.05     # acceptance: stage sum within 5% of the total
+_SETTLE_S = 3.0        # let in-flight txs commit before the sweep
+
+
+def _pct(vals, q):
+    """Exact q-quantile of a sorted list (nearest-rank)."""
+    if not vals:
+        return None
+    return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+
+def _stage_stats(samples):
+    out = {}
+    for label, vals in sorted(samples.items()):
+        vals.sort()
+        out[label] = {
+            "count": len(vals),
+            "p50_ms": round(_pct(vals, 0.50), 3),
+            "p99_ms": round(_pct(vals, 0.99), 3),
+            "max_ms": round(vals[-1], 3),
+        }
+    return out
+
+
+def collect(runner, limit=512):
+    """One RPC sweep per node: txlat ring + the tx-latency metric series
+    + the per-height tx_latency timeline events."""
+    per_node = {}
+    for node in runner.nodes:
+        name = node.spec.name
+        snap = {"txlat": None, "metrics": {}, "timeline_events": 0}
+        try:
+            snap["txlat"] = node.client.txlat(limit=limit)
+            series = node.client.metrics()["metrics"]
+            snap["metrics"] = {
+                k: v["series"] for k, v in series.items()
+                if k.startswith(("tendermint_tx_latency",
+                                 "tendermint_health_latency"))
+            }
+            tl = node.client.timeline(last=200)
+            snap["timeline_events"] = sum(
+                1 for h in tl.get("heights", [])
+                for ev in h.get("events", [])
+                if ev.get("kind") == "tx_latency")
+        except Exception as e:
+            snap["error"] = str(e)
+        per_node[name] = snap
+        ring = snap.get("txlat") or {}
+        print(json.dumps({
+            "node": name,
+            "tracked": ring.get("tracked"),
+            "completed": ring.get("completed"),
+            "submit_to_commit": ring.get("submit_to_commit"),
+        }), file=sys.stderr)
+    return per_node
+
+
+def merge(per_node) -> dict:
+    """Fold the per-node journey rings into the fleet view."""
+    journeys = {}          # hash -> {node: journey}
+    for name, snap in per_node.items():
+        ring = snap.get("txlat") or {}
+        for j in ring.get("txs", []):
+            journeys.setdefault(j["hash"], {})[name] = j
+
+    stage_samples = {}     # transition label -> [ms]
+    totals = []            # fleet submit→commit, ingest-node view
+    submit_nodes = {}      # ingest node -> tx count
+    coverage = []          # nodes-that-saw-it per correlated tx
+    checked = within = 0
+
+    for _h, per in journeys.items():
+        coverage.append(len(per))
+        for name, j in per.items():
+            stages = j["stages"]
+            ordered = sorted(stages.items(), key=lambda kv: kv[1])
+            for (a, ta), (b, tb) in zip(ordered, ordered[1:]):
+                stage_samples.setdefault(f"{a}_to_{b}", []).append(tb - ta)
+            if "submit" not in stages:
+                continue
+            submit_nodes[name] = submit_nodes.get(name, 0) + 1
+            if "commit" not in stages:
+                continue
+            total = stages["commit"] - stages["submit"]
+            totals.append(total)
+            span = sum(
+                tb - ta
+                for (a, ta), (b, tb) in zip(ordered, ordered[1:])
+                if stages["submit"] <= ta and tb <= stages["commit"])
+            checked += 1
+            if abs(span - total) <= _DECOMP_TOL * max(total, 1e-9):
+                within += 1
+
+    totals.sort()
+    nodes_out = {}
+    for name, snap in per_node.items():
+        ring = snap.get("txlat") or {}
+        nodes_out[name] = {
+            "enabled": ring.get("enabled"),
+            "tracked": ring.get("tracked"),
+            "completed": ring.get("completed"),
+            "evicted": ring.get("evicted"),
+            "submit_to_commit": ring.get("submit_to_commit"),
+            "tx_latency_timeline_events": snap.get("timeline_events"),
+        }
+        if "error" in snap:
+            nodes_out[name]["error"] = snap["error"]
+
+    return {
+        "nodes": nodes_out,
+        "fleet": {
+            "txs_seen": len(journeys),
+            "txs_multi_node": sum(1 for c in coverage if c > 1),
+            "gossip_coverage_mean": round(
+                sum(coverage) / len(coverage), 2) if coverage else 0,
+            "submit_nodes": submit_nodes,
+            "stages": _stage_stats(stage_samples),
+            "submit_to_commit": {
+                "count": len(totals),
+                "p50_ms": round(_pct(totals, 0.50), 3) if totals else None,
+                "p99_ms": round(_pct(totals, 0.99), 3) if totals else None,
+                "max_ms": round(totals[-1], 3) if totals else None,
+            },
+            "decomposition": {
+                "checked": checked,
+                "within_tol": within,
+                "tol": _DECOMP_TOL,
+                "frac": round(within / checked, 4) if checked else None,
+            },
+        },
+    }
+
+
+def main(duration_s: float = 20.0, rate: float = 40.0,
+         validators: int = 4, outdir: str = ""):
+    tmp = outdir or tempfile.mkdtemp(prefix="fleet-report-")
+    manifest = Manifest(
+        chain_id="fleet-report",
+        nodes=[NodeSpec(name=f"v{i:02d}") for i in range(validators)],
+        load=LoadSpec(rate=rate, size=32),
+        target_height=3,
+        timeout_s=duration_s + 120.0,
+    )
+    runner = Runner(manifest, tmp)
+    try:
+        print(f"booting {validators}-node localnet under {tmp}...",
+              file=sys.stderr)
+        runner.setup()
+        runner.start()
+        runner.start_load()
+        time.sleep(duration_s)
+        runner.stop_load()
+        time.sleep(_SETTLE_S)
+        per_node = collect(runner)
+        report = merge(per_node)
+    finally:
+        runner.stop()
+    report["metric"] = "fleet_report"
+    report["duration_s"] = duration_s
+    report["offered_rate"] = rate
+    report["txs_offered"] = len(runner.txs_sent)
+    print(json.dumps(report))
+    return report
+
+
+if __name__ == "__main__":
+    main(duration_s=float(sys.argv[1]) if len(sys.argv) > 1 else 20.0,
+         rate=float(sys.argv[2]) if len(sys.argv) > 2 else 40.0,
+         validators=int(sys.argv[3]) if len(sys.argv) > 3 else 4)

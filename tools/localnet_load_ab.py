@@ -17,7 +17,14 @@ reach 100% fails loudly instead of flattering itself. Double-sign safety
 rides along: every committed block on every node is scanned for
 evidence, which must stay empty.
 
-Prints one JSON line per arm plus a combined summary:
+Latency rides along too (ISSUE 15): each offered tx is stamped "submit"
+in the tx-lifecycle ring (the in-process offer bypasses RPC, which would
+normally stamp it), so every arm also reports the submit→commit p50/p99
+from the ``tendermint_tx_latency_submit_to_commit`` histogram delta —
+latency vs load on the same run that measures throughput.
+
+Prints one JSON line per arm plus a combined summary
+(tools/ab_common.py schema):
 
     {"metric": "localnet_load_ab", "serial": {...}, "pipelined": {...},
      "speedup": ..., "txs": N}
@@ -35,55 +42,27 @@ import time
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 import tests.conftest  # noqa: F401  (forces jax onto CPU devices)
 
-from tmtpu.config.config import Config  # noqa: E402
 from tmtpu.crypto import sigcache  # noqa: E402
 from tmtpu.crypto.ed25519 import gen_priv_key  # noqa: E402
 from tmtpu.libs import metrics as _m  # noqa: E402
+from tmtpu.libs import txlat  # noqa: E402
 from tmtpu.mempool import signed_tx  # noqa: E402
-from tmtpu.node.node import Node  # noqa: E402
-from tmtpu.privval.file_pv import FilePV  # noqa: E402
-from tmtpu.types.genesis import GenesisDoc, GenesisValidator  # noqa: E402
+from tools import ab_common  # noqa: E402
 from tools import measure_lock  # noqa: E402
 
 
-def _mk_net_nodes(n, tmp, pipelined: bool, power=10):
-    """4-node full-mesh TCP net (tools/localnet_ab.py lineage), with the
-    throughput-tier knobs set per arm through the production config —
-    never by monkeypatching the mempool after the fact."""
-    pvs = []
-    for i in range(n):
-        home = tmp / f"node{i}"
-        (home / "config").mkdir(parents=True)
-        (home / "data").mkdir(parents=True)
-        cfg = Config.test_config()
-        cfg.base.home = str(home)
-        cfg.base.crypto_backend = "cpu"
-        cfg.rpc.laddr = ""
+def _mk_net_nodes(tmp, pipelined: bool):
+    """The shared 4-node net with the throughput-tier knobs set per arm
+    through the production config (ab_common.make_localnet configure
+    hook) — never by monkeypatching the mempool after the fact."""
+
+    def configure(cfg, _i):
         cfg.mempool.batch_check = pipelined
         cfg.mempool.gossip_seen_cache = 4096 if pipelined else 0
         cfg.consensus.async_exec = pipelined
-        pv = FilePV.load_or_generate(
-            cfg.rooted(cfg.base.priv_validator_key_file),
-            cfg.rooted(cfg.base.priv_validator_state_file))
-        pvs.append((cfg, pv))
-    gen = GenesisDoc(
-        chain_id="load-ab-chain", genesis_time=time.time_ns(),
-        validators=[GenesisValidator(pv.get_pub_key(), power)
-                    for _, pv in pvs],
-    )
-    nodes = []
-    for cfg, pv in pvs:
-        gen.save_as(cfg.genesis_path)
-        nodes.append(Node(cfg))
-    addrs = [f"{nd.node_id}@127.0.0.1:{nd.p2p_port}" for nd in nodes]
-    for i, nd in enumerate(nodes):
-        nd.switch.set_persistent_peers([a for j, a in enumerate(addrs)
-                                        if j != i])
-    return nodes
 
-
-def _cval(counter) -> float:
-    return sum(counter.summary_series().values())
+    return ab_common.make_localnet(4, tmp, "load-ab-chain",
+                                   configure=configure)
 
 
 def _app_size(node) -> int:
@@ -102,28 +81,47 @@ def _evidence_count(node) -> int:
     return total
 
 
+def _lat_delta(before):
+    """submit→commit p50/p99 (ms) over the histogram delta since
+    ``before`` — all four nodes share this process's registry, so the
+    delta is the whole arm's distribution."""
+    after = _m.tx_latency_submit_to_commit.bucket_counts()
+    if not after:
+        return {"lat_txs": 0}
+    base = before if before else (0,) * len(after)
+    delta = [a - b for a, b in zip(after, base)]
+    bounds = _m.tx_latency_submit_to_commit.buckets
+    return {
+        "lat_txs": delta[-1],
+        "submit_to_commit_p50_ms": round(
+            _m.percentile_from_buckets(bounds, delta, 0.50) * 1000, 1),
+        "submit_to_commit_p99_ms": round(
+            _m.percentile_from_buckets(bounds, delta, 0.99) * 1000, 1),
+    }
+
+
 def _run_arm(pipelined: bool, txs: list, drain_timeout_s: float) -> dict:
     arm = "pipelined" if pipelined else "serial"
     sigcache.DEFAULT.invalidate_all()
+    txlat.clear()  # fresh journey ring per arm
     tmp = pathlib.Path(tempfile.mkdtemp(prefix=f"load-ab-{arm}-"))
-    nodes = _mk_net_nodes(4, tmp, pipelined=pipelined)
+    nodes = _mk_net_nodes(tmp, pipelined=pipelined)
     n_txs = len(txs)
     try:
-        for nd in nodes:
-            nd.start()
-        while any(nd.switch.num_peers() < 3 for nd in nodes):
-            time.sleep(0.1)
-        for nd in nodes:
-            assert nd.consensus.wait_for_height(2, timeout=60)
+        ab_common.boot(nodes, height=2, timeout_s=60)
 
-        flushes0 = _cval(_m.mempool_batch_flushes)
-        dedup0 = _cval(_m.mempool_gossip_dedup_skips)
+        flushes0 = ab_common.counter_value(_m.mempool_batch_flushes)
+        dedup0 = ab_common.counter_value(_m.mempool_gossip_dedup_skips)
+        lat0 = _m.tx_latency_submit_to_commit.bucket_counts()
         t0 = time.monotonic()
 
         def offer(shard_txs, node):
             # fixed offered load: every tx in the shard is offered once;
-            # nowait = the RPC/recv-thread admission surface
+            # nowait = the RPC/recv-thread admission surface. The offer
+            # bypasses RPC, so stamp "submit" explicitly (first-stamp-
+            # wins makes the re-offer retries harmless).
             for tx in shard_txs:
+                txlat.stamp_tx(tx, "submit")
                 while True:
                     try:
                         node.mempool.check_tx_nowait(tx)
@@ -149,6 +147,7 @@ def _run_arm(pipelined: bool, txs: list, drain_timeout_s: float) -> dict:
 
         evidence = sum(_evidence_count(nd) for nd in nodes)
         heights = [nd.block_store.height() for nd in nodes]
+        latency = _lat_delta(lat0)
     finally:
         for nd in nodes:
             nd.stop()
@@ -161,12 +160,14 @@ def _run_arm(pipelined: bool, txs: list, drain_timeout_s: float) -> dict:
         "window_s": round(elapsed, 2),
         "committed_tx_per_s": round(committed / elapsed, 1),
         "blocks": max(heights),
-        "batch_flushes": int(_cval(_m.mempool_batch_flushes) - flushes0),
-        "gossip_dedup_skips": int(_cval(_m.mempool_gossip_dedup_skips)
-                                  - dedup0),
+        "batch_flushes": int(
+            ab_common.counter_value(_m.mempool_batch_flushes) - flushes0),
+        "gossip_dedup_skips": int(
+            ab_common.counter_value(_m.mempool_gossip_dedup_skips)
+            - dedup0),
         "double_sign_evidence": evidence,
     }
-    print(json.dumps(out), file=sys.stderr)
+    out.update(latency)
     return out
 
 
@@ -175,19 +176,22 @@ def main(n_txs: int = 2000):
     print(f"pre-signing {n_txs} txs...", file=sys.stderr)
     txs = [signed_tx.encode(b"ld-%d=%d" % (i, i), priv)
            for i in range(n_txs)]
+    report = ab_common.ABReport("localnet_load_ab")
     with measure_lock.hold("localnet_load_ab"):
-        serial = _run_arm(False, txs, drain_timeout_s=600.0)
-        pipelined = _run_arm(True, txs, drain_timeout_s=600.0)
-    result = {
-        "metric": "localnet_load_ab",
-        "txs": n_txs,
-        "serial": serial,
-        "pipelined": pipelined,
-        "speedup": round(pipelined["committed_tx_per_s"] /
-                         max(1e-9, serial["committed_tx_per_s"]), 2),
-    }
-    print(json.dumps(result))
-    return result
+        serial = report.add_arm(
+            _run_arm(False, txs, drain_timeout_s=600.0))
+        pipelined = report.add_arm(
+            _run_arm(True, txs, drain_timeout_s=600.0))
+    return report.finish(
+        txs=n_txs,
+        speedup=round(pipelined["committed_tx_per_s"] /
+                      max(1e-9, serial["committed_tx_per_s"]), 2),
+        latency={
+            arm: {k: v for k, v in out.items()
+                  if k.startswith("submit_to_commit") or k == "lat_txs"}
+            for arm, out in report.arms.items()
+        },
+    )
 
 
 if __name__ == "__main__":

@@ -14,7 +14,8 @@ CPU-forced host the mesh is 4 virtual XLA:CPU devices, so the numbers
 prove ROUTING and EXACTNESS, not chip speedup — the flood bench
 (``TMTPU_BENCH_FLOOD=1 python bench.py``) owns the wall-time claim.
 
-Prints one JSON line per arm plus a combined summary:
+Prints one JSON line per arm plus a combined summary
+(tools/ab_common.py schema):
 
     {"metric": "localnet_mesh_ab", "single_device": {...},
      "mesh": {...}, "mesh_dispatch_share": ...,
@@ -23,13 +24,10 @@ Prints one JSON line per arm plus a combined summary:
 Run: python tools/localnet_mesh_ab.py [window_seconds]
 """
 
-import json
 import os
 import pathlib
 import sys
 import tempfile
-import threading
-import time
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 import tests.conftest  # noqa: F401  (forces jax onto 8 CPU devices)
@@ -38,75 +36,24 @@ import tests.conftest  # noqa: F401  (forces jax onto 8 CPU devices)
 # flush is ~8 lanes — below the default device threshold)
 os.environ["TMTPU_TPU_MIN_BATCH"] = "1"
 
-from tmtpu.config.config import Config  # noqa: E402
 from tmtpu.crypto import batch as crypto_batch  # noqa: E402
 from tmtpu.libs import breaker as _bk  # noqa: E402
-from tmtpu.node.node import Node  # noqa: E402
 from tmtpu.tpu import mesh_dispatch as md  # noqa: E402
-from tmtpu.types.genesis import GenesisDoc, GenesisValidator  # noqa: E402
-from tmtpu.privval.file_pv import FilePV  # noqa: E402
+from tools import ab_common  # noqa: E402
 from tools import measure_lock  # noqa: E402
 
 
-def _mk_net_nodes(n, tmp, power=10):
-    pvs = []
-    for i in range(n):
-        home = tmp / f"node{i}"
-        (home / "config").mkdir(parents=True)
-        (home / "data").mkdir(parents=True)
-        cfg = Config.test_config()
-        cfg.base.home = str(home)
+def _mk_net_nodes(tmp):
+    def configure(cfg, _i):
         cfg.base.crypto_backend = "tpu"
-        cfg.rpc.laddr = ""
-        pv = FilePV.load_or_generate(
-            cfg.rooted(cfg.base.priv_validator_key_file),
-            cfg.rooted(cfg.base.priv_validator_state_file))
-        pvs.append((cfg, pv))
-    gen = GenesisDoc(
-        chain_id="mesh-ab-chain", genesis_time=time.time_ns(),
-        validators=[GenesisValidator(pv.get_pub_key(), power)
-                    for _, pv in pvs],
-    )
-    nodes = []
-    for cfg, pv in pvs:
-        gen.save_as(cfg.genesis_path)
-        nodes.append(Node(cfg))
-    addrs = [f"{nd.node_id}@127.0.0.1:{nd.p2p_port}" for nd in nodes]
-    for i, nd in enumerate(nodes):
-        nd.switch.set_persistent_peers([a for j, a in enumerate(addrs)
-                                        if j != i])
-    return nodes
+
+    return ab_common.make_localnet(4, tmp, "mesh-ab-chain",
+                                   configure=configure)
 
 
 def _run_window(nodes, duration_s, reset_counters):
-    for nd in nodes:
-        nd.start()
-    while any(nd.switch.num_peers() < 3 for nd in nodes):
-        time.sleep(0.1)
-    for nd in nodes:
-        assert nd.consensus.wait_for_height(2, timeout=120)
-
-    stop = threading.Event()
-
-    def load():
-        i = 0
-        while not stop.is_set():
-            try:
-                nodes[i % 4].mempool.check_tx(b"mab-%d=%d" % (i, i))
-            except Exception:
-                pass
-            i += 1
-            time.sleep(0.002)
-
-    t = threading.Thread(target=load, daemon=True)
-    t.start()
-    reset_counters()
-    h0 = nodes[0].block_store.height()
-    t0 = time.monotonic()
-    time.sleep(duration_s)
-    stop.set()
-    h1 = nodes[0].block_store.height()
-    return h1 - h0, time.monotonic() - t0
+    return ab_common.run_window(nodes, duration_s, reset_counters,
+                                prefix=b"mab", warm_timeout_s=120)
 
 
 def _run_arm(name: str, duration_s: float, mesh_devices: int,
@@ -132,7 +79,7 @@ def _run_arm(name: str, duration_s: float, mesh_devices: int,
     crypto_batch.TPUBatchVerifier._verify_pending = counting
     mesh0 = [0]
     tmp = pathlib.Path(tempfile.mkdtemp(prefix=f"mesh-ab-{name}-"))
-    nodes = _mk_net_nodes(4, tmp)
+    nodes = _mk_net_nodes(tmp)
     assert crypto_batch._default_backend == "tpu", \
         "node construction did not select the tpu backend"
     try:
@@ -166,31 +113,28 @@ def _run_arm(name: str, duration_s: float, mesh_devices: int,
         "occupancy_lanes": snap["occupancy_lanes"],
         "mesh_breaker": snap["breaker"],
     }
-    print(json.dumps(out), file=sys.stderr)
     return out
 
 
 def main(duration_s: float = 20.0):
+    report = ab_common.ABReport("localnet_mesh_ab")
     with measure_lock.hold("localnet_mesh_ab"):
-        single = _run_arm("single_device", duration_s,
-                          mesh_devices=1, shard_min_lanes=1)
-        mesh = _run_arm("mesh", duration_s,
-                        mesh_devices=4, shard_min_lanes=1)
+        single = report.add_arm(_run_arm("single_device", duration_s,
+                                         mesh_devices=1,
+                                         shard_min_lanes=1))
+        mesh = report.add_arm(_run_arm("mesh", duration_s,
+                                       mesh_devices=4,
+                                       shard_min_lanes=1))
     occ = [v for v in mesh["occupancy_lanes"].values()]
-    result = {
-        "metric": "localnet_mesh_ab",
-        "single_device": single,
-        "mesh": mesh,
-        "mesh_dispatch_share": mesh["mesh_dispatch_share"],
-        "single_arm_mesh_dispatches": single["mesh_dispatches"],
-        "block_rate_ratio": round(
+    return report.finish(
+        mesh_dispatch_share=mesh["mesh_dispatch_share"],
+        single_arm_mesh_dispatches=single["mesh_dispatches"],
+        block_rate_ratio=round(
             mesh["block_rate_per_min"] /
             max(1e-9, single["block_rate_per_min"]), 2),
-        "occupancy_lanes": mesh["occupancy_lanes"],
-        "occupancy_balanced": bool(occ and min(occ) == max(occ)),
-    }
-    print(json.dumps(result))
-    return result
+        occupancy_lanes=mesh["occupancy_lanes"],
+        occupancy_balanced=bool(occ and min(occ) == max(occ)),
+    )
 
 
 if __name__ == "__main__":

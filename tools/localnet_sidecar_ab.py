@@ -14,7 +14,8 @@ this process and multiplex one daemon connection, so the coalescing
 unit reported is requests, not distinct client_ids; run the nodes as
 separate processes against the same socket to see dispatch_clients>1.)
 
-Prints one JSON line per arm plus a combined summary:
+Prints one JSON line per arm plus a combined summary
+(tools/ab_common.py schema):
 
     {"metric": "localnet_sidecar_ab", "per_process": {...},
      "sidecar": {...}, "dispatch_reduction_pct": ...,
@@ -23,95 +24,38 @@ Prints one JSON line per arm plus a combined summary:
 Run: python tools/localnet_sidecar_ab.py [window_seconds]
 """
 
-import json
 import pathlib
 import sys
 import tempfile
-import threading
-import time
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 import tests.conftest  # noqa: F401  (forces jax onto CPU devices)
 
-from tmtpu.config.config import Config  # noqa: E402
 from tmtpu.crypto import batch as crypto_batch  # noqa: E402
 from tmtpu.libs import breaker as _bk  # noqa: E402
 from tmtpu.libs import metrics as _m  # noqa: E402
-from tmtpu.node.node import Node  # noqa: E402
 from tmtpu.sidecar.server import SidecarServer  # noqa: E402
-from tmtpu.types.genesis import GenesisDoc, GenesisValidator  # noqa: E402
-from tmtpu.privval.file_pv import FilePV  # noqa: E402
+from tools import ab_common  # noqa: E402
 from tools import measure_lock  # noqa: E402
 
 
-def _mk_net_nodes(n, tmp, power=10, backend="cpu", sidecar_addr=""):
-    """Same 4-node full-mesh TCP net as tools/localnet_ab.py, with the
-    crypto backend and the [sidecar] address as the A/B variables. Node
-    construction applies both through the production path
-    (set_default_backend + configure_sidecar), not a monkeypatch."""
-    pvs = []
-    for i in range(n):
-        home = tmp / f"node{i}"
-        (home / "config").mkdir(parents=True)
-        (home / "data").mkdir(parents=True)
-        cfg = Config.test_config()
-        cfg.base.home = str(home)
+def _mk_net_nodes(tmp, backend="cpu", sidecar_addr=""):
+    """The shared 4-node net with the crypto backend and the [sidecar]
+    address as the A/B variables. Node construction applies both through
+    the production path (set_default_backend + configure_sidecar), not a
+    monkeypatch."""
+
+    def configure(cfg, _i):
         cfg.base.crypto_backend = backend
         cfg.sidecar.addr = sidecar_addr
-        cfg.rpc.laddr = ""
-        pv = FilePV.load_or_generate(
-            cfg.rooted(cfg.base.priv_validator_key_file),
-            cfg.rooted(cfg.base.priv_validator_state_file))
-        pvs.append((cfg, pv))
-    gen = GenesisDoc(
-        chain_id="sidecar-ab-chain", genesis_time=time.time_ns(),
-        validators=[GenesisValidator(pv.get_pub_key(), power)
-                    for _, pv in pvs],
-    )
-    nodes = []
-    for cfg, pv in pvs:
-        gen.save_as(cfg.genesis_path)
-        nodes.append(Node(cfg))
-    addrs = [f"{nd.node_id}@127.0.0.1:{nd.p2p_port}" for nd in nodes]
-    for i, nd in enumerate(nodes):
-        nd.switch.set_persistent_peers([a for j, a in enumerate(addrs)
-                                        if j != i])
-    return nodes
+
+    return ab_common.make_localnet(4, tmp, "sidecar-ab-chain",
+                                   configure=configure)
 
 
 def _run_window(nodes, duration_s, reset_counters):
-    """Boot the net, warm to height 2 under load, reset counters, then
-    measure one steady-state window. Returns (blocks, wall_seconds)."""
-    for nd in nodes:
-        nd.start()
-    while any(nd.switch.num_peers() < 3 for nd in nodes):
-        time.sleep(0.1)
-    for nd in nodes:
-        assert nd.consensus.wait_for_height(2, timeout=60)
-
-    stop = threading.Event()
-
-    def load():
-        i = 0
-        while not stop.is_set():
-            try:
-                nodes[i % 4].mempool.check_tx(b"sab-%d=%d" % (i, i))
-            except Exception:
-                pass
-            i += 1
-            time.sleep(0.002)
-
-    t = threading.Thread(target=load, daemon=True)
-    t.start()
-    # counters reset AFTER warmup so both arms measure the same
-    # steady-state window, not node boot + first-height noise
-    reset_counters()
-    h0 = nodes[0].block_store.height()
-    t0 = time.monotonic()
-    time.sleep(duration_s)
-    stop.set()
-    h1 = nodes[0].block_store.height()
-    return h1 - h0, time.monotonic() - t0
+    return ab_common.run_window(nodes, duration_s, reset_counters,
+                                prefix=b"sab")
 
 
 def _run_per_process(duration_s: float) -> dict:
@@ -129,7 +73,7 @@ def _run_per_process(duration_s: float) -> dict:
 
     crypto_batch.CPUBatchVerifier._verify_pending = counting
     tmp = pathlib.Path(tempfile.mkdtemp(prefix="sidecar-ab-pp-"))
-    nodes = _mk_net_nodes(4, tmp, backend="cpu")
+    nodes = _mk_net_nodes(tmp, backend="cpu")
     try:
         def reset():
             flushes[0] = 0
@@ -151,7 +95,6 @@ def _run_per_process(duration_s: float) -> dict:
         "dispatches_per_block": round(flushes[0] / max(1, blocks), 1),
         "lanes_per_block": round(lanes[0] / max(1, blocks), 1),
     }
-    print(json.dumps(out), file=sys.stderr)
     return out
 
 
@@ -183,7 +126,7 @@ def _run_sidecar(duration_s: float) -> dict:
 
     srv.coalescer._dispatch = counting_dispatch
     fallback0 = [0.0]
-    nodes = _mk_net_nodes(4, tmp, backend="sidecar",
+    nodes = _mk_net_nodes(tmp, backend="sidecar",
                           sidecar_addr=srv.addr)
     assert crypto_batch._default_backend == "sidecar", \
         "node construction did not select the sidecar backend"
@@ -194,8 +137,8 @@ def _run_sidecar(duration_s: float) -> dict:
             dispatches[0] = 0
             requests[0] = 0
             lanes[0] = 0
-            fallback0[0] = sum(
-                _m.sidecar_client_fallback.summary_series().values())
+            fallback0[0] = ab_common.counter_value(
+                _m.sidecar_client_fallback)
 
         blocks, wall = _run_window(nodes, duration_s, reset)
     finally:
@@ -207,7 +150,7 @@ def _run_sidecar(duration_s: float) -> dict:
         crypto_batch.reset_sidecar_client()
         br.reset()
 
-    fallback = sum(_m.sidecar_client_fallback.summary_series().values()) \
+    fallback = ab_common.counter_value(_m.sidecar_client_fallback) \
         - fallback0[0]
     out = {
         "arm": "sidecar",
@@ -224,28 +167,23 @@ def _run_sidecar(duration_s: float) -> dict:
         "fallback_lanes": fallback,
         "breaker_state": br.state,
     }
-    print(json.dumps(out), file=sys.stderr)
     return out
 
 
 def main(duration_s: float = 20.0):
+    report = ab_common.ABReport("localnet_sidecar_ab")
     with measure_lock.hold("localnet_sidecar_ab"):
-        pp = _run_per_process(duration_s)
-        sc = _run_sidecar(duration_s)
+        pp = report.add_arm(_run_per_process(duration_s))
+        sc = report.add_arm(_run_sidecar(duration_s))
     reduction = 1.0 - (sc["dispatches_per_block"] /
                        max(1e-9, pp["dispatches_per_block"]))
-    result = {
-        "metric": "localnet_sidecar_ab",
-        "per_process": pp,
-        "sidecar": sc,
-        "dispatch_reduction_pct": round(reduction * 100, 1),
-        "mean_requests_per_dispatch": sc["mean_requests_per_dispatch"],
-        "block_rate_ratio": round(
+    return report.finish(
+        dispatch_reduction_pct=round(reduction * 100, 1),
+        mean_requests_per_dispatch=sc["mean_requests_per_dispatch"],
+        block_rate_ratio=round(
             sc["block_rate_per_min"] / max(1e-9, pp["block_rate_per_min"]),
             2),
-    }
-    print(json.dumps(result))
-    return result
+    )
 
 
 if __name__ == "__main__":
