@@ -1155,12 +1155,11 @@ def main():
         "structures": {k: round(v, 1) for k, v in structures.items()},
         "lanes": lanes,
         "phases": {k: round(v, 4) for k, v in phases.items()},
-        "probe": {"attempts": len(_probe_log), "log": _probe_log[-6:],
-                  "budget_s": PROBE_BUDGET_S,
-                  "rpc_rtt_ms": round(rpc_ms, 1)},
+        # _probe_dict, not an inline subset: a wedged-tunnel round must
+        # carry wedged=true + the tunnel's stderr tail in THIS line too
+        # (it is the one the driver parses when the child ran to here)
+        "probe": dict(_probe_dict(), rpc_rtt_ms=round(rpc_ms, 1)),
     }
-    if SKIP_PROBE:
-        out["probe"]["skipped"] = True
     if failed:
         # machine-readable degradation marker: the headline was picked
         # from a reduced structure set

@@ -1,0 +1,347 @@
+"""Trace-context wire safety (ISSUE 16 satellite): the cross-process
+context must be impossible to weaponise — truncated / garbage /
+oversized bytes on any transport decode to None (untraced), never an
+exception; an untraced node (``trace_sample = 0``) neither mints nor
+adopts contexts so its wire output is byte-identical to a pre-tracing
+build; and the sidecar Hello version skew degrades gracefully in BOTH
+directions (old client ↔ new daemon, new client ↔ old daemon)."""
+
+import socket
+import threading
+
+import pytest
+
+from tmtpu.consensus import msgs as cm
+from tmtpu.crypto import ed25519 as ed
+from tmtpu.libs import trace
+from tmtpu.libs.trace import TraceContext, height_trace_id
+from tmtpu.mempool.reactor import TxsPB
+from tmtpu.sidecar import protocol as proto
+from tmtpu.sidecar.client import SidecarClient
+from tmtpu.sidecar.server import SidecarServer
+
+# a canonical valid wire context to mutate from
+_CTX = TraceContext("00ff00ff00ff00ff", parent_span_id=0x1234, origin="v07")
+_RAW = _CTX.encode()
+
+
+def _garbage_samples():
+    """Every malformed-wire shape a hostile or confused peer could send."""
+    out = [b"", b"\x00", b"\x01", b"\xff" * 19, b"A" * 200,
+           _RAW + b"x",                      # trailing junk vs origin_len
+           bytes([99]) + _RAW[1:],           # unknown wire version
+           _RAW[:-1] + b"\xff" if _RAW[-1:] else _RAW,  # origin_len lies
+           b"\x01" + b"\x00" * 17 + b"\x30",  # origin_len > remaining
+           _RAW * 5]                         # oversized (> 64 bytes)
+    out.extend(_RAW[:k] for k in range(len(_RAW)))  # every truncation
+    return out
+
+
+# --- wire form ------------------------------------------------------------
+
+
+def test_context_roundtrip():
+    raw = _RAW
+    assert len(raw) <= trace.CTX_MAX_WIRE_BYTES
+    dec = TraceContext.decode(raw)
+    assert dec is not None
+    assert dec.trace_id == _CTX.trace_id
+    assert dec.parent_span_id == 0x1234
+    assert dec.origin == "v07"
+    assert dec.sampled
+
+
+def test_context_decode_is_total():
+    for raw in _garbage_samples():
+        assert TraceContext.decode(raw) is None, raw.hex()
+    # and the one valid sample still decodes (the loop above includes
+    # every strict prefix of it, but not the full thing)
+    assert TraceContext.decode(_RAW) is not None
+
+
+def test_context_encode_clamps_hostile_fields():
+    # non-hex trace id, huge parent, oversized non-ascii origin: encode
+    # must not raise and must stay within the wire cap, and the result
+    # must still strictly decode
+    ctx = TraceContext("not hex at all", parent_span_id=2 ** 80,
+                       origin="ø" * 300, flags=0xABC)
+    raw = ctx.encode()
+    assert len(raw) <= trace.CTX_MAX_WIRE_BYTES
+    dec = TraceContext.decode(raw)
+    assert dec is not None
+    assert dec.parent_span_id == (2 ** 80) & (2 ** 64 - 1)
+    assert dec.flags == 0xBC
+
+
+def test_height_trace_id_deterministic():
+    a = height_trace_id("chain-a", 42)
+    assert a == height_trace_id("chain-a", 42)
+    assert len(a) == 16 and int(a, 16) >= 0
+    assert a != height_trace_id("chain-a", 43)
+    assert a != height_trace_id("chain-b", 42)
+
+
+def test_sampling_agrees_across_nodes():
+    """Sampling is derived from the trace id, so two differently-named
+    nodes keep/drop exactly the same heights at the same rate."""
+    t1, t2 = trace.Tracer(64), trace.Tracer(64)
+    t1.configure(node_id="v00", chain_id="c", sample_rate=0.25)
+    t2.configure(node_id="v01", chain_id="c", sample_rate=0.25)
+    kept = 0
+    for h in range(1, 201):
+        c1, c2 = t1.height_context(h), t2.height_context(h)
+        assert (c1 is None) == (c2 is None)
+        if c1 is not None:
+            assert c1.trace_id == c2.trace_id
+            kept += 1
+    assert 0 < kept < 200  # the rate actually samples
+
+
+# --- trace_sample = 0: fully untraced node --------------------------------
+
+
+def test_sample_zero_never_mints_nor_adopts():
+    t = trace.Tracer(64)
+    t.configure(node_id="v00", chain_id="c", sample_rate=0.0)
+    assert t.height_context(7) is None
+    assert t.wire_context(7) == b""       # absent field on the wire
+    assert t.adopt(_RAW) is None          # peers cannot poison it
+    assert t.mark_height(7, "height.commit") is None
+    assert t.snapshot() == []             # nothing recorded at all
+
+
+def test_adopt_is_total():
+    t = trace.Tracer(64)
+    t.configure(node_id="v00", chain_id="c", sample_rate=1.0)
+    for raw in _garbage_samples():
+        assert t.adopt(raw) is None, raw.hex()
+    assert t.adopt(_RAW) is not None
+
+
+# --- gossip envelopes -----------------------------------------------------
+
+
+def _consensus_env(trace_ctx=b""):
+    return cm.ConsensusMessagePB(
+        new_round_step=cm.NewRoundStepPB(height=5, round=1, step=3,
+                                         seconds_since_start_time=2,
+                                         last_commit_round=0),
+        trace_ctx=trace_ctx)
+
+
+def test_untraced_consensus_envelope_is_byte_identical():
+    """empty trace_ctx is omitted on encode: an untraced node's gossip
+    is indistinguishable from a pre-tracing build."""
+    bare = cm.ConsensusMessagePB(
+        new_round_step=cm.NewRoundStepPB(height=5, round=1, step=3,
+                                         seconds_since_start_time=2,
+                                         last_commit_round=0))
+    assert _consensus_env(b"").encode() == bare.encode()
+    assert TxsPB(txs=[b"t1", b"t2"]).encode() == \
+        TxsPB(txs=[b"t1", b"t2"], trace_ctx=b"").encode()
+
+
+def test_consensus_envelope_fuzzed_ctx_never_crashes():
+    t = trace.Tracer(64)
+    t.configure(node_id="v00", chain_id="c", sample_rate=1.0)
+    for raw in _garbage_samples():
+        env = cm.ConsensusMessagePB.decode(_consensus_env(raw).encode())
+        # the oneof still dispatches correctly...
+        assert env.which() == "new_round_step"
+        assert env.new_round_step.height == 5
+        # ...and the receive-path adopt is a clean None, not a crash
+        assert t.adopt(bytes(env.trace_ctx)) is None
+    # a valid context survives the roundtrip
+    env = cm.ConsensusMessagePB.decode(_consensus_env(_RAW).encode())
+    ctx = t.adopt(bytes(env.trace_ctx))
+    assert ctx is not None and ctx.trace_id == _CTX.trace_id
+
+
+def test_txs_envelope_fuzzed_ctx_never_crashes():
+    t = trace.Tracer(64)
+    t.configure(node_id="v00", chain_id="c", sample_rate=1.0)
+    for raw in _garbage_samples():
+        m = TxsPB.decode(TxsPB(txs=[b"tx-a"], trace_ctx=raw).encode())
+        assert list(m.txs) == [b"tx-a"]
+        assert t.adopt(bytes(m.trace_ctx)) is None
+
+
+# --- sidecar version skew (both directions) -------------------------------
+
+
+def _lanes(n, bad=(), tag=b"tc", power=1000):
+    out = []
+    for i in range(n):
+        priv = ed.gen_priv_key_from_secret(b"%s-%d" % (tag, i))
+        msg = b"%s msg %d" % (tag, i)
+        sig = priv.sign(msg)
+        if i in bad:
+            flip = bytearray(sig)
+            flip[0] ^= 0xFF
+            sig = bytes(flip)
+        out.append((priv.pub_key().bytes(), msg, sig, power))
+    return out
+
+
+@pytest.fixture
+def server(tmp_path):
+    srv = SidecarServer(f"unix://{tmp_path}/sc.sock", backend="cpu")
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _connect_raw(addr):
+    kind, target = proto.parse_addr(addr)
+    s = socket.socket(socket.AF_UNIX if kind == "unix"
+                      else socket.AF_INET, socket.SOCK_STREAM)
+    s.settimeout(5.0)
+    s.connect(target)
+    return s
+
+
+def _handshake(sock, version):
+    proto.write_frame(sock.makefile("wb"), proto.Hello(
+        version=version, client_id="skew-test", features=["verify"]))
+    return proto.FrameReader(sock.makefile("rb")).read_msg()
+
+
+def test_new_daemon_serves_old_v1_client(server):
+    """Old client direction: a v1 Hello against the v2 daemon is served
+    at v1 — and a v1 VerifyRequest (no trace_ctx field at all on the
+    wire) verifies exactly as before."""
+    s = _connect_raw(server.addr)
+    try:
+        wfile = s.makefile("wb")
+        reader = proto.FrameReader(s.makefile("rb"))
+        proto.write_frame(wfile, proto.Hello(
+            version=1, client_id="old-client", features=["verify"]))
+        ack = reader.read_msg()
+        assert isinstance(ack, proto.HelloAck)
+        assert ack.version == 1       # negotiated down, not rejected
+        lanes = _lanes(3, bad={1})
+        proto.write_frame(wfile, proto.VerifyRequest(
+            request_id=7, curve="ed25519", tally=False,
+            lanes=[proto.Lane(pub_key=pk, msg=m, sig=sig, power=p)
+                   for pk, m, sig, p in lanes]))
+        resp = reader.read_msg()
+        assert isinstance(resp, proto.VerifyResponse)
+        assert resp.status == proto.STATUS_OK
+        assert proto.unpack_mask(resp.mask, resp.lane_count) == \
+            [True, False, True]
+    finally:
+        s.close()
+
+
+def test_new_daemon_verify_with_garbage_ctx(server):
+    """A hostile/corrupt trace_ctx on a v2 VerifyRequest must not affect
+    the verdict — the daemon drops the context and verifies normally."""
+    for raw in (b"\xff" * 30, _RAW[:5], b"A" * 200):
+        s = _connect_raw(server.addr)
+        try:
+            wfile = s.makefile("wb")
+            reader = proto.FrameReader(s.makefile("rb"))
+            proto.write_frame(wfile, proto.Hello(
+                version=proto.PROTOCOL_VERSION, client_id="fuzz",
+                features=["verify"]))
+            ack = reader.read_msg()
+            assert isinstance(ack, proto.HelloAck)
+            assert ack.version == proto.PROTOCOL_VERSION
+            lanes = _lanes(2)
+            proto.write_frame(wfile, proto.VerifyRequest(
+                request_id=9, curve="ed25519", tally=False,
+                lanes=[proto.Lane(pub_key=pk, msg=m, sig=sig, power=p)
+                       for pk, m, sig, p in lanes],
+                trace_ctx=raw))
+            resp = reader.read_msg()
+            assert isinstance(resp, proto.VerifyResponse)
+            assert resp.status == proto.STATUS_OK
+            assert proto.unpack_mask(resp.mask, resp.lane_count) == \
+                [True, True]
+        finally:
+            s.close()
+
+
+class _FakeV1Daemon:
+    """A pre-v2 daemon: hard-rejects any Hello.version != 1 with
+    ERR_VERSION and closes the connection (old daemons knew no
+    negotiation), acks version 1 otherwise."""
+
+    def __init__(self, path):
+        self.addr = f"unix://{path}"
+        self.rejected = 0
+        self._srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._srv.bind(str(path))
+        self._srv.listen(4)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            try:
+                hello = proto.FrameReader(conn.makefile("rb")).read_msg()
+                wfile = conn.makefile("wb")
+                if not isinstance(hello, proto.Hello) or \
+                        hello.version != 1:
+                    self.rejected += 1
+                    proto.write_frame(wfile, proto.ErrorReply(
+                        request_id=0, code=proto.ERR_VERSION,
+                        message="unsupported protocol version"))
+                    conn.close()    # old daemons drop rejected conns
+                    continue
+                proto.write_frame(wfile, proto.HelloAck(
+                    version=1, server_id="fake-v1", backend="cpu",
+                    max_lanes=1024, max_frame_bytes=1 << 20))
+                # keep the accepted conn open so the client stays up
+                self._stop.wait(30.0)
+            except Exception:
+                pass
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=5)
+
+
+def test_new_client_downgrades_to_old_daemon(tmp_path):
+    """New client direction: the v2 client's first Hello is rejected by
+    the v1 daemon, the client reconnects at v1 and must then NEVER
+    attach trace contexts (trace_ctx_supported() false)."""
+    daemon = _FakeV1Daemon(tmp_path / "old.sock")
+    client = SidecarClient(daemon.addr, client_id="new-client")
+    try:
+        client._ensure_connected()
+        assert daemon.rejected == 1          # the v2 Hello was refused
+        assert client.hello_ack is not None
+        assert client.hello_ack.version == 1
+        assert not client.trace_ctx_supported()
+    finally:
+        client.close()
+        daemon.stop()
+
+
+def test_new_client_new_daemon_speaks_v2(server):
+    client = SidecarClient(server.addr, client_id="v2-client")
+    try:
+        client._ensure_connected()
+        assert client.hello_ack.version == proto.PROTOCOL_VERSION
+        assert client.trace_ctx_supported()
+        mask, tallied, _info = client.verify("ed25519", _lanes(3),
+                                             tally=True)
+        assert mask == [True, True, True]
+        assert tallied == 3000
+    finally:
+        client.close()

@@ -1,19 +1,23 @@
-"""obs-docs rule: the tx-lifecycle observability surface is documented.
+"""obs-docs rule: the tx-lifecycle + tracing observability surface is
+documented.
 
-The per-tx journey ring (libs/txlat) is only useful if an operator can
-read its output, and every name it exports is an API: the checkpoint
-stages in ``TX_STAGES`` (they appear verbatim in ``txlat`` RPC
-snapshots and fleet reports), the ``tendermint_tx_latency_*`` /
-``tendermint_health_latency_*`` metric families, and the ``tx_latency``
-timeline event kind. Each one must have a row in docs/OBSERVABILITY.md
-— a stage or metric added without documentation is a dashboard nobody
+The per-tx journey ring (libs/txlat) and the causal-trace span names
+(libs/trace) are only useful if an operator can read their output, and
+every name they export is an API: the checkpoint stages in
+``TX_STAGES`` (they appear verbatim in ``txlat`` RPC snapshots and
+fleet reports), the causal milestone/hop marks in ``TRACE_MARKS``
+(served by the ``traces`` RPC and joined by tools/critical_path.py),
+the ``tendermint_tx_latency_*`` / ``tendermint_health_latency_*`` /
+``tendermint_trace_*`` metric families, and the ``tx_latency`` timeline
+event kind. Each one must have a row in docs/OBSERVABILITY.md — a
+stage, mark or metric added without documentation is a dashboard nobody
 can interpret.
 
 Everything is resolved statically (metric catalog via
-``index.metric_defs()``, the stage tuple parsed out of libs/txlat.py),
-so the rule also runs on synthetic fixture trees; a tree with no
-tx-lifecycle surface at all has nothing to document and passes
-vacuously.
+``index.metric_defs()``, the stage/mark tuples parsed out of
+libs/txlat.py / libs/trace.py), so the rule also runs on synthetic
+fixture trees; a tree with no tx-lifecycle surface at all has nothing
+to document and passes vacuously.
 """
 
 from __future__ import annotations
@@ -28,18 +32,20 @@ from tmtpu.analysis.registry import rule
 
 DOC_PATH = "docs/OBSERVABILITY.md"
 _TXLAT_MOD = "tmtpu/libs/txlat.py"
+_TRACE_MOD = "tmtpu/libs/trace.py"
 _METRICS_MOD = "tmtpu/libs/metrics.py"
-_PREFIXES = ("tendermint_tx_latency", "tendermint_health_latency")
+_PREFIXES = ("tendermint_tx_latency", "tendermint_health_latency",
+             "tendermint_trace")
 
 
-def _tx_stages(index: RepoIndex) -> List[str]:
-    """The declared txlat.TX_STAGES tuple, statically."""
-    fi = index.get(_TXLAT_MOD)
+def _str_tuple(index: RepoIndex, mod: str, var: str) -> List[str]:
+    """A module-level tuple/list of string constants, statically."""
+    fi = index.get(mod)
     if fi is None or fi.tree is None:
         return []
     for node in fi.tree.body:
         if isinstance(node, ast.Assign) and any(
-                isinstance(t, ast.Name) and t.id == "TX_STAGES"
+                isinstance(t, ast.Name) and t.id == var
                 for t in node.targets):
             if isinstance(node.value, (ast.Tuple, ast.List)):
                 return [e.value for e in node.value.elts
@@ -49,8 +55,9 @@ def _tx_stages(index: RepoIndex) -> List[str]:
 
 
 @rule("obs-docs",
-      doc="every tx-lifecycle observability name — TX_STAGES checkpoint "
-          "stages, tendermint_tx_latency_*/tendermint_health_latency_* "
+      doc="every tx-lifecycle/tracing observability name — TX_STAGES "
+          "checkpoint stages, TRACE_MARKS causal marks, tendermint_tx_"
+          "latency_*/tendermint_health_latency_*/tendermint_trace_* "
           "metrics, the tx_latency timeline event — has a "
           "docs/OBSERVABILITY.md row",
       triggers=("tmtpu/libs", "docs"))
@@ -59,9 +66,11 @@ def check(index: RepoIndex) -> List[Finding]:
     for prom in sorted(set(index.metric_defs().values())):
         if prom.startswith(_PREFIXES):
             required.append(("metric", prom, _METRICS_MOD))
-    stages = _tx_stages(index)
+    stages = _str_tuple(index, _TXLAT_MOD, "TX_STAGES")
     for s in stages:
         required.append(("stage", s, _TXLAT_MOD))
+    for m in _str_tuple(index, _TRACE_MOD, "TRACE_MARKS"):
+        required.append(("mark", m, _TRACE_MOD))
     if stages:
         # the event kind exists exactly when the journey ring does
         required.append(("event", "tx_latency", "tmtpu/libs/timeline.py"))

@@ -202,6 +202,13 @@ class InstrumentationConfig:
     # submit→commit p99 SLO in milliseconds for the watchdog's
     # latency_slo_check; 0 disables the check entirely
     latency_slo_ms: float = 0.0
+    # fraction of heights that root a fleet-joinable trace (libs/trace
+    # contexts piggybacked on gossip/sidecar/ABCI boundaries). Sampling
+    # is derived from the deterministic per-height trace id, so every
+    # node keeps the same heights. 0 ⇒ fully untraced: the node neither
+    # mints nor adopts contexts and its wire messages carry no context
+    # field (byte-identical to pre-tracing builds).
+    trace_sample: float = 1.0
 
 
 @dataclass

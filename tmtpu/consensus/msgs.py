@@ -82,7 +82,11 @@ class VoteSetBitsPB(ProtoMessage):
 class ConsensusMessagePB(ProtoMessage):
     """The channel envelope (oneof)."""
 
-    FIELDS = [
+    # field 10 is NOT part of the oneof: an optional piggybacked trace
+    # context (libs/trace.py wire form). Old peers skip the unknown
+    # field; empty bytes are omitted on encode, so untraced envelopes
+    # are byte-identical to pre-tracing builds.
+    _ONEOF = [
         (1, "new_round_step", ("msg", NewRoundStepPB)),
         (2, "new_valid_block", ("msg", NewValidBlockPB)),
         (3, "proposal", ("msg", ProposalPB)),
@@ -93,9 +97,10 @@ class ConsensusMessagePB(ProtoMessage):
         (8, "vote_set_maj23", ("msg", VoteSetMaj23PB)),
         (9, "vote_set_bits", ("msg", VoteSetBitsPB)),
     ]
+    FIELDS = _ONEOF + [(10, "trace_ctx", "bytes")]
 
     def which(self) -> str:
-        for _, name, _s in self.FIELDS:
+        for _, name, _s in self._ONEOF:
             if getattr(self, name) is not None:
                 return name
         return ""

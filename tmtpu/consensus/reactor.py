@@ -20,6 +20,8 @@ from tmtpu.consensus.state import ConsensusState
 from tmtpu.consensus.types import (
     STEP_COMMIT, STEP_NEW_HEIGHT, STEP_PRECOMMIT, STEP_PREVOTE,
 )
+from tmtpu.libs import metrics as _metrics
+from tmtpu.libs import trace as _trace
 from tmtpu.libs.bits import BitArray
 from tmtpu.p2p.conn.connection import ChannelDescriptor
 from tmtpu.p2p.switch import Peer, Reactor
@@ -276,6 +278,28 @@ class ConsensusReactor(Reactor):
     def remove_peer(self, peer: Peer, reason) -> None:
         self._peer_threads.pop(peer.node_id, None)
 
+    def _wire_ctx(self, height: int) -> bytes:
+        """Encoded trace context for an outbound envelope of ``height``
+        (b"" when the height is unsampled — field stays absent)."""
+        raw = _trace.wire_context(height)
+        if raw:
+            _metrics.trace_context_tx.inc(transport="gossip")
+        return raw
+
+    @staticmethod
+    def _rx_ctx(m: "cm.ConsensusMessagePB"):
+        """Adopt the envelope's piggybacked context; garbage decodes to
+        None (untraced) and is counted, never raised."""
+        raw = bytes(m.trace_ctx) if m.trace_ctx else b""
+        if not raw:
+            return None
+        ctx = _trace.adopt(raw)
+        if ctx is None:
+            _metrics.trace_context_invalid.inc(transport="gossip")
+        else:
+            _metrics.trace_context_rx.inc(transport="gossip")
+        return ctx
+
     def receive(self, channel_id: int, peer: Peer, msg_bytes: bytes) -> None:
         m = cm.ConsensusMessagePB.decode(msg_bytes)
         ps: Optional[PeerState] = peer.get("consensus_peer_state")
@@ -329,13 +353,22 @@ class ConsensusReactor(Reactor):
             if self.wait_sync:
                 return
             if kind == "proposal":
-                self.cs.add_proposal(Proposal.from_proto(m.proposal.proposal),
-                                     peer.node_id)
+                prop = Proposal.from_proto(m.proposal.proposal)
+                ctx = self._rx_ctx(m)
+                if ctx is not None:
+                    _trace.mark("gossip.proposal_rx", ctx=ctx,
+                                height=prop.height, peer=peer.node_id)
+                self.cs.add_proposal(prop, peer.node_id)
                 with ps.lock:
                     ps.proposal = True
             elif kind == "block_part":
                 bp = m.block_part
                 part = Part.from_proto(bp.part)
+                ctx = self._rx_ctx(m)
+                if ctx is not None:
+                    _trace.mark("gossip.block_part_rx", ctx=ctx,
+                                height=bp.height, index=part.index,
+                                peer=peer.node_id)
                 ps.set_has_part(bp.height, part.index, part.proof.total)
                 self.cs.add_block_part(bp.height, bp.round, part,
                                        peer.node_id)
@@ -344,6 +377,11 @@ class ConsensusReactor(Reactor):
                 return
             if kind == "vote":
                 vote = Vote.from_proto(m.vote.vote)
+                ctx = self._rx_ctx(m)
+                if ctx is not None:
+                    _trace.mark("gossip.vote_rx", ctx=ctx,
+                                height=vote.height, type=vote.type,
+                                peer=peer.node_id)
                 vals = self.cs.round_state_nolock().validators
                 n = vals.size() if vals else 0
                 ps.set_has_vote(vote.height, vote.round, vote.type,
@@ -431,7 +469,12 @@ class ConsensusReactor(Reactor):
     def _broadcast_own_vote(self, vote: Vote) -> None:
         if self.switch is None:
             return
-        msg = cm.ConsensusMessagePB(vote=cm.VotePB(vote=vote.to_proto()))
+        ctx = self._wire_ctx(vote.height)
+        if ctx:
+            _trace.mark_height(vote.height, "gossip.vote_tx",
+                               type=vote.type)
+        msg = cm.ConsensusMessagePB(vote=cm.VotePB(vote=vote.to_proto()),
+                                    trace_ctx=ctx)
         self.switch.broadcast(VOTE_CHANNEL, msg.encode())
         # HasVote announcement rides the event-driven
         # _has_vote_broadcast_routine (adding the vote published a Vote
@@ -440,13 +483,19 @@ class ConsensusReactor(Reactor):
     def _broadcast_own_proposal(self, proposal: Proposal, parts) -> None:
         if self.switch is None:
             return
+        ctx = self._wire_ctx(proposal.height)
+        if ctx:
+            _trace.mark_height(proposal.height, "gossip.proposal_tx",
+                               parts=parts.total)
         self.switch.broadcast(DATA_CHANNEL, cm.ConsensusMessagePB(
-            proposal=cm.ProposalPB(proposal=proposal.to_proto())).encode())
+            proposal=cm.ProposalPB(proposal=proposal.to_proto()),
+            trace_ctx=ctx).encode())
         for i in range(parts.total):
             self.switch.broadcast(DATA_CHANNEL, cm.ConsensusMessagePB(
                 block_part=cm.BlockPartPB(
                     height=proposal.height, round=proposal.round,
-                    part=parts.get_part(i).to_proto())).encode())
+                    part=parts.get_part(i).to_proto()),
+                trace_ctx=ctx).encode())
 
     # -- gossip routines (reactor.go:559 gossipDataRoutine, :716
     # gossipVotesRoutine) ---------------------------------------------------
@@ -475,9 +524,20 @@ class ConsensusReactor(Reactor):
             # RoundState snapshot is shallow)
             proposal = rs.proposal
             if proposal is not None and not has_proposal:
+                ctx = self._wire_ctx(proposal.height)
+                if ctx:
+                    # the data routine can beat _broadcast_own_proposal
+                    # to the wire (the state machine WAL-writes and adds
+                    # its own parts first) — stamp every departure so
+                    # the causal tx anchor is the EARLIEST send, not the
+                    # own-broadcast hook
+                    _trace.mark_height(proposal.height,
+                                       "gossip.proposal_tx",
+                                       peer=peer.node_id)
                 peer.try_send(DATA_CHANNEL, cm.ConsensusMessagePB(
                     proposal=cm.ProposalPB(
-                        proposal=proposal.to_proto())).encode())
+                        proposal=proposal.to_proto()),
+                    trace_ctx=ctx).encode())
                 with ps.lock:
                     ps.proposal = True
             parts = rs.proposal_block_parts
@@ -494,7 +554,9 @@ class ConsensusReactor(Reactor):
                             DATA_CHANNEL, cm.ConsensusMessagePB(
                                 block_part=cm.BlockPartPB(
                                     height=rs.height, round=rs.round,
-                                    part=part.to_proto())).encode()):
+                                    part=part.to_proto()),
+                                trace_ctx=self._wire_ctx(
+                                    rs.height)).encode()):
                         ps.set_has_part(rs.height, idx, total)
                         continue  # keep pushing without sleeping
             time.sleep(GOSSIP_SLEEP_S)
@@ -603,7 +665,8 @@ class ConsensusReactor(Reactor):
 
     def _send_vote(self, peer: Peer, ps: PeerState, vote: Vote) -> bool:
         ok = peer.try_send(VOTE_CHANNEL, cm.ConsensusMessagePB(
-            vote=cm.VotePB(vote=vote.to_proto())).encode())
+            vote=cm.VotePB(vote=vote.to_proto()),
+            trace_ctx=self._wire_ctx(vote.height)).encode())
         if ok:
             ps.set_has_vote(vote.height, vote.round, vote.type,
                             vote.validator_index)

@@ -385,18 +385,25 @@ class ConsensusState(BaseService):
                     return  # stop sentinel
                 msgs, timeouts = batch
                 with self._mtx:
-                    for mi in msgs:
-                        self._wal_write_msg(mi)
-                    self._handle_msgs(msgs)
-                    for ti in timeouts:
-                        if self.wal is not None:
-                            self.wal.write(self.wal.make(
-                                timeout=TimeoutInfoPB(
-                                    duration_ns=ti.duration_ns,
-                                    height=ti.height, round=ti.round,
-                                    step=ti.step)))
-                        self._handle_timeout(ti)
-                    self._flush_pending_parts()
+                    # the whole handling cycle runs under the current
+                    # height's root trace context: every span recorded
+                    # on this thread (step transitions, batch verifies,
+                    # sidecar client requests) carries the height's
+                    # trace id — None (unsampled) is a no-op
+                    with trace.activate(
+                            trace.height_context(self.rs.height)):
+                        for mi in msgs:
+                            self._wal_write_msg(mi)
+                        self._handle_msgs(msgs)
+                        for ti in timeouts:
+                            if self.wal is not None:
+                                self.wal.write(self.wal.make(
+                                    timeout=TimeoutInfoPB(
+                                        duration_ns=ti.duration_ns,
+                                        height=ti.height, round=ti.round,
+                                        step=ti.step)))
+                            self._handle_timeout(ti)
+                        self._flush_pending_parts()
             except Exception:
                 # consensus failures halt the node by design
                 # (state.go:722-735); keep the WAL so the operator can replay
@@ -876,6 +883,8 @@ class ConsensusState(BaseService):
         # the commit checkpoint: block saved + ENDHEIGHT is the point the
         # tx is durably committed on this node (async apply still pending)
         txlat.stamp_height(height, "commit")
+        trace.mark_height(height, "height.commit",
+                          round=rs.commit_round, txs=len(block.txs))
         if self.config.async_exec and not self.replay_mode and \
                 self.wal is not None:
             # async ApplyBlock overlap: the WAL's ENDHEIGHT is the commit
@@ -1052,6 +1061,10 @@ class ConsensusState(BaseService):
         # (quorums, commit, apply) without re-hashing the block
         txlat.note_block(msg.height, rs.proposal_block.txs)
         txlat.stamp_height(msg.height, "proposal")
+        # per-node proposal-complete milestone on the height's root trace
+        # (the causal chain's first on-node edge endpoint)
+        trace.mark_height(msg.height, "height.proposal",
+                          txs=len(rs.proposal_block.txs))
         if self.event_bus:
             self.event_bus.publish_complete_proposal(rs)
         prevotes = rs.votes.prevotes(rs.round)

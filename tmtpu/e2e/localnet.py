@@ -1,0 +1,89 @@
+"""One shared boot path for subprocess localnets.
+
+Three consumers used to hand-roll the same Manifest + Runner ceremony —
+tools/fleet_report.py (fleet latency report), tmtpu/scenario/net.py
+(adversarial scenario nets) and the tools/ that grew out of them
+(tools/critical_path.py, via tools/ab_common.py's re-export). Each one
+re-invented "N validators named v00..vNN, full mesh, a LoadSpec, setup/
+start/start_load, then tear it all down". This module owns that shape:
+
+    make_manifest()  the declarative half — one place that knows how a
+                     name list + per-node config dicts become NodeSpecs;
+    booted()         the process half — a context manager guaranteeing
+                     runner.stop() (and so SIGTERM to every node child)
+                     on every exit path, load threads included.
+
+Scenario nets keep their own Runner subclass and fault timeline; they
+share only make_manifest. Report tools use both.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+from typing import Callable, Dict, Iterable, Optional
+
+from tmtpu.e2e.manifest import LoadSpec, Manifest, NodeSpec
+from tmtpu.e2e.runner import Runner
+
+
+def validator_names(n: int) -> list:
+    """The canonical localnet name scheme: v00, v01, ..."""
+    return [f"v{i:02d}" for i in range(n)]
+
+
+def make_manifest(chain_id: str,
+                  names: Iterable[str],
+                  *,
+                  base_config: Optional[Dict] = None,
+                  node_config: Optional[Dict[str, Dict]] = None,
+                  key_type: str = "ed25519",
+                  misbehaviors: Optional[Dict[str, Dict]] = None,
+                  start_at: Optional[Callable[[str, bool], int]] = None,
+                  load_rate: float = 0.0,
+                  load_size: int = 32,
+                  target_height: int = 3,
+                  timeout_s: float = 120.0) -> Manifest:
+    """Build the Manifest every subprocess localnet shares.
+
+    Node names starting with ``v`` are validators (the e2e convention);
+    anything else is a full node. ``base_config`` ("section.key" ->
+    value) applies to every node, ``node_config[name]`` layers per-node
+    overrides on top. ``start_at(name, validator)`` may defer or
+    manual-gate individual nodes (return -1 to provision without
+    starting, the scenario engine's joiner convention).
+    """
+    nodes = []
+    for name in names:
+        validator = name.startswith("v")
+        cfg = dict(base_config or {})
+        cfg.update((node_config or {}).get(name, {}))
+        nodes.append(NodeSpec(
+            name=name, validator=validator,
+            start_at=start_at(name, validator) if start_at else 0,
+            key_type=key_type, config=cfg,
+            misbehaviors=dict((misbehaviors or {}).get(name, {}))))
+    return Manifest(
+        chain_id=chain_id, nodes=nodes,
+        load=LoadSpec(rate=load_rate, size=load_size),
+        target_height=target_height, timeout_s=timeout_s)
+
+
+@contextlib.contextmanager
+def booted(manifest: Manifest, outdir: str, *, load: bool = False,
+           verbose: bool = True):
+    """setup() + start() a Runner over ``manifest``, optionally start
+    the tx load, and guarantee stop() (load threads joined, SIGTERM to
+    every node subprocess) on every exit path."""
+    runner = Runner(manifest, outdir)
+    if verbose:
+        print(f"booting {len(manifest.nodes)}-node localnet "
+              f"under {outdir}...", file=sys.stderr)
+    try:
+        runner.setup()
+        runner.start()
+        if load:
+            runner.start_load()
+        yield runner
+    finally:
+        runner.stop()

@@ -329,6 +329,14 @@ def observe_step_duration(step: int, seconds: float) -> None:
 
 
 p2p_peers = DEFAULT.gauge("p2p", "peers", "Number of connected peers")
+# A reactor's receive() raised on an inbound message — the peer is
+# stopped for error (switch._on_peer_receive). Persistent nonzero growth
+# on one channel means a peer is sending frames that channel's decoder
+# rejects: version skew or a hostile/corrupting link.
+p2p_recv_errors = DEFAULT.counter(
+    "p2p", "recv_errors_total",
+    "Inbound messages whose reactor receive() raised (peer stopped)",
+    labels=("channel",))
 
 # p2p/shaping.py + p2p/fuzz.py link emulation: writes perturbed by the
 # shaper — kind=loss counts writes swallowed by sampled WAN loss,
@@ -408,6 +416,39 @@ tx_latency_completed = DEFAULT.counter(
 tx_latency_evicted = DEFAULT.counter(
     "tx", "latency_evicted_total",
     "Tx journeys FIFO-evicted from the stamp ring before commit")
+
+
+# --- the distributed-tracing metric set (libs/trace.py context tier) --------
+#
+# Written by the gossip reactors, the sidecar client/server, and the
+# traces RPC exporter. transport ∈ {gossip, sidecar}; every name needs a
+# docs/OBSERVABILITY.md row (obs-docs rule).
+
+trace_spans_exported = DEFAULT.counter(
+    "trace", "spans_exported_total",
+    "Spans served to remote readers via the traces JSON-RPC method or "
+    "GET /debug/traces")
+trace_spans_dropped = DEFAULT.counter(
+    "trace", "spans_dropped_total",
+    "Spans evicted from the ring buffer between exports (observed at "
+    "export time; the ring itself never blocks)")
+trace_context_tx = DEFAULT.counter(
+    "trace", "context_tx_total",
+    "Trace contexts attached to outbound messages",
+    labels=("transport",))
+trace_context_rx = DEFAULT.counter(
+    "trace", "context_rx_total",
+    "Valid trace contexts decoded from inbound messages",
+    labels=("transport",))
+trace_context_invalid = DEFAULT.counter(
+    "trace", "context_invalid_total",
+    "Inbound trace-context fields that failed strict decode (truncated, "
+    "oversized, or garbage) and were treated as untraced",
+    labels=("transport",))
+trace_clock_offset_ms = DEFAULT.gauge(
+    "trace", "clock_offset_ms",
+    "Last wall-clock offset estimate (reader minus this node, ms) "
+    "reported by a traces RPC caller that supplied its own clock")
 
 
 # --- the node health engine metric set (libs/watchdog.py) -------------------

@@ -33,9 +33,11 @@ Drained over RPC at ``/debug/traces`` on the pprof server
 
 from __future__ import annotations
 
+import hashlib
 import itertools
 import json
 import os
+import struct
 import threading
 import time
 from collections import deque
@@ -44,6 +46,120 @@ from typing import Dict, List, Optional
 
 _DEFAULT_CAPACITY = int(os.environ.get("TMTPU_TRACE_CAPACITY", "8192"))
 
+# -- trace context (fleet-joinable causal tracing) ---------------------------
+#
+# A TraceContext names a causal chain that crosses process boundaries:
+# it rides p2p gossip envelopes, the sidecar wire protocol, and the ABCI
+# handoff as an optional bytes field (absent ⇒ untraced). Root traces are
+# derived deterministically from (chain_id, height), so every node in the
+# fleet lands the SAME trace_id for the same height without coordination
+# — tools/critical_path.py joins the per-node span buffers on it.
+
+CTX_WIRE_VERSION = 1
+CTX_MAX_WIRE_BYTES = 64          # hard cap; anything bigger is garbage
+_CTX_ORIGIN_MAX = 40             # node ids are 40 hex chars
+FLAG_SAMPLED = 0x01
+
+# Causal-chain mark names. Every name here (and every
+# ``tendermint_trace_*`` metric) must have a docs/OBSERVABILITY.md row —
+# the obs-docs analysis rule parses this tuple statically.
+TRACE_MARKS = (
+    "height.proposal",
+    "height.prevote_quorum",
+    "height.precommit_quorum",
+    "height.commit",
+    "height.apply",
+    "abci.handoff",
+    "gossip.proposal_tx",
+    "gossip.proposal_rx",
+    "gossip.block_part_rx",
+    "gossip.vote_tx",
+    "gossip.vote_rx",
+    "gossip.txs_tx",
+    "gossip.txs_rx",
+    "sidecar.verify",
+    "sidecar.dispatch",
+)
+
+
+class TraceContext:
+    """Compact cross-process trace context.
+
+    ``trace_id`` is 16 lowercase hex chars (8 bytes on the wire);
+    ``parent_span_id`` is the sender-side span id (0 = root);
+    ``origin`` is the node id of whoever minted/forwarded the context;
+    ``flags`` bit 0 = sampled.
+    """
+
+    __slots__ = ("trace_id", "parent_span_id", "origin", "flags")
+
+    def __init__(self, trace_id: str, parent_span_id: int = 0,
+                 origin: str = "", flags: int = FLAG_SAMPLED):
+        self.trace_id = trace_id
+        self.parent_span_id = parent_span_id
+        self.origin = origin
+        self.flags = flags
+
+    @property
+    def sampled(self) -> bool:
+        return bool(self.flags & FLAG_SAMPLED)
+
+    def child(self, parent_span_id: int, origin: str = "") -> "TraceContext":
+        """Same trace, re-parented on ``parent_span_id`` (for forwarding
+        a context with the local hop recorded as the new parent)."""
+        return TraceContext(self.trace_id, parent_span_id,
+                            origin or self.origin, self.flags)
+
+    def encode(self) -> bytes:
+        """Wire form: version(1) || trace_id(8) || parent_span_id(8, BE)
+        || flags(1) || origin_len(1) || origin. Always ≤
+        CTX_MAX_WIRE_BYTES; raises nothing (fields are clamped)."""
+        try:
+            tid = bytes.fromhex(self.trace_id)[:8]
+        except ValueError:
+            tid = b""
+        tid = tid.ljust(8, b"\x00")
+        origin = self.origin.encode("ascii", "replace")[:_CTX_ORIGIN_MAX]
+        return (bytes([CTX_WIRE_VERSION]) + tid
+                + struct.pack(">Q", self.parent_span_id & (2 ** 64 - 1))
+                + bytes([self.flags & 0xFF, len(origin)]) + origin)
+
+    @classmethod
+    def decode(cls, raw: bytes) -> Optional["TraceContext"]:
+        """Strict, total decode: any truncated / oversized / garbage
+        input returns None (untraced) — a malformed context must never
+        crash a receive path."""
+        try:
+            if (not raw or not isinstance(raw, (bytes, bytearray))
+                    or len(raw) > CTX_MAX_WIRE_BYTES or len(raw) < 19
+                    or raw[0] != CTX_WIRE_VERSION):
+                return None
+            olen = raw[18]
+            if olen > _CTX_ORIGIN_MAX or len(raw) != 19 + olen:
+                return None
+            origin = raw[19:19 + olen].decode("ascii")
+            return cls(raw[1:9].hex(), struct.unpack(">Q", raw[9:17])[0],
+                       origin, raw[17])
+        except Exception:
+            return None
+
+    def to_dict(self) -> Dict:
+        return {"trace": self.trace_id, "parent": self.parent_span_id,
+                "origin": self.origin, "flags": self.flags}
+
+    def __repr__(self):
+        return (f"TraceContext({self.trace_id}, parent={self.parent_span_id},"
+                f" origin={self.origin!r}, flags={self.flags:#x})")
+
+
+def height_trace_id(chain_id: str, height: int) -> str:
+    """Deterministic root trace id for a committed height: every node
+    derives the same id, so fleet joins need no context at all for the
+    height milestones — propagation adds the *edges*."""
+    h = hashlib.sha256(b"tmtpu.height|%s|%d"
+                       % (chain_id.encode("utf-8", "replace"), height))
+    return h.hexdigest()[:16]
+
 
 class Span:
     """One completed (or in-flight) timed region. Times are
@@ -51,7 +167,8 @@ class Span:
     in-process; ``wall_time`` anchors the trace to the epoch clock."""
 
     __slots__ = ("name", "span_id", "parent_id", "thread_id", "thread_name",
-                 "start_s", "end_s", "attrs")
+                 "start_s", "end_s", "attrs", "trace_id", "ctx_parent",
+                 "origin")
 
     def __init__(self, name: str, span_id: int, parent_id: Optional[int],
                  thread_id: int, thread_name: str, start_s: float,
@@ -64,6 +181,10 @@ class Span:
         self.start_s = start_s
         self.end_s: Optional[float] = None
         self.attrs = attrs
+        # cross-process causal identity (None/0/"" ⇒ untraced span)
+        self.trace_id: Optional[str] = None
+        self.ctx_parent: int = 0
+        self.origin: str = ""
 
     @property
     def duration_s(self) -> float:
@@ -76,7 +197,7 @@ class Span:
         self.attrs.update(attrs)
 
     def to_dict(self) -> Dict:
-        return {
+        d = {
             "name": self.name, "id": self.span_id,
             "parent": self.parent_id, "tid": self.thread_id,
             "thread": self.thread_name,
@@ -84,6 +205,11 @@ class Span:
             "dur_s": round(self.duration_s, 9),
             "attrs": self.attrs,
         }
+        if self.trace_id:
+            d["trace"] = self.trace_id
+            d["ctx_parent"] = self.ctx_parent
+            d["origin"] = self.origin
+        return d
 
     def __repr__(self):
         return (f"Span({self.name!r}, {self.duration_s * 1e3:.3f}ms, "
@@ -102,6 +228,10 @@ class Tracer:
         self._tls = threading.local()
         self._enabled = True
         self._dropped = 0
+        # fleet identity + sampling for cross-process contexts
+        self._node_id = ""
+        self._chain_id = ""
+        self._sample_rate = 1.0
 
     # -- control ------------------------------------------------------------
 
@@ -110,6 +240,27 @@ class Tracer:
 
     def enabled(self) -> bool:
         return self._enabled
+
+    def configure(self, node_id: Optional[str] = None,
+                  chain_id: Optional[str] = None,
+                  sample_rate: Optional[float] = None) -> None:
+        """Wire the fleet identity (origin node, chain) and the
+        ``[instr] trace_sample`` knob. sample_rate 0 ⇒ this node never
+        mints nor adopts contexts (fully untraced, spans stay local)."""
+        if node_id is not None:
+            self._node_id = str(node_id)
+        if chain_id is not None:
+            self._chain_id = str(chain_id)
+        if sample_rate is not None:
+            self._sample_rate = max(0.0, min(1.0, float(sample_rate)))
+
+    @property
+    def node_id(self) -> str:
+        return self._node_id
+
+    @property
+    def sample_rate(self) -> float:
+        return self._sample_rate
 
     @property
     def dropped(self) -> int:
@@ -137,6 +288,11 @@ class Tracer:
         sp = Span(name, next(self._ids),
                   stack[-1].span_id if stack else None,
                   t.ident or 0, t.name, time.perf_counter(), dict(attrs))
+        ctx = self.current_context()
+        if ctx is not None:
+            sp.trace_id = ctx.trace_id
+            sp.ctx_parent = ctx.parent_span_id
+            sp.origin = ctx.origin
         stack.append(sp)
         try:
             yield sp
@@ -167,6 +323,99 @@ class Tracer:
             return wrapper
 
         return deco
+
+    # -- cross-process contexts ---------------------------------------------
+
+    def _ctx_stack(self) -> list:
+        st = getattr(self._tls, "ctx", None)
+        if st is None:
+            st = self._tls.ctx = []
+        return st
+
+    def current_context(self) -> Optional[TraceContext]:
+        st = getattr(self._tls, "ctx", None)
+        return st[-1] if st else None
+
+    @contextmanager
+    def activate(self, ctx: Optional[TraceContext]):
+        """Make ``ctx`` the thread's current context: spans and marks
+        recorded inside pick up its trace identity. None is a no-op."""
+        if ctx is None:
+            yield None
+            return
+        st = self._ctx_stack()
+        st.append(ctx)
+        try:
+            yield ctx
+        finally:
+            st.pop()
+
+    def height_context(self, height: int) -> Optional[TraceContext]:
+        """Deterministic per-height root context, or None when the height
+        is sampled out (or sampling is off). Sampling is derived from the
+        trace id, so every node keeps/drops the SAME heights."""
+        rate = self._sample_rate
+        if rate <= 0.0:
+            return None
+        tid = height_trace_id(self._chain_id, int(height))
+        if rate < 1.0:
+            # first 8 hex chars as a uniform draw in [0, 1)
+            if int(tid[:8], 16) / float(0x100000000) >= rate:
+                return None
+        return TraceContext(tid, 0, self._node_id, FLAG_SAMPLED)
+
+    def mark(self, name: str, ctx: Optional[TraceContext] = None,
+             **attrs) -> Optional[Span]:
+        """Record an instant (zero-duration) span tagged with ``ctx`` (or
+        the thread's current context). The causal-chain milestones and
+        every gossip/sidecar rx/tx hook use this — ~1 µs, lock-bounded."""
+        if not self._enabled:
+            return None
+        ctx = ctx if ctx is not None else self.current_context()
+        t = threading.current_thread()
+        now = time.perf_counter()
+        sp = Span(name, next(self._ids), None, t.ident or 0, t.name,
+                  now, dict(attrs))
+        sp.end_s = now
+        if ctx is not None:
+            sp.trace_id = ctx.trace_id
+            sp.ctx_parent = ctx.parent_span_id
+            sp.origin = ctx.origin
+        with self._lock:
+            if len(self._buf) == self._buf.maxlen:
+                self._dropped += 1
+            self._buf.append(sp)
+        return sp
+
+    def mark_height(self, height: int, name: str, **attrs) -> Optional[Span]:
+        """Milestone mark on the height's deterministic root trace; no-op
+        when the height is unsampled."""
+        ctx = self.height_context(height)
+        if ctx is None:
+            return None
+        return self.mark(name, ctx=ctx, height=int(height), **attrs)
+
+    def wire_context(self, height: int) -> bytes:
+        """Encoded context for outbound wire messages of ``height``
+        (b"" ⇒ leave the optional field absent: untraced)."""
+        ctx = self.height_context(height)
+        return ctx.encode() if ctx is not None else b""
+
+    def adopt(self, raw: bytes) -> Optional[TraceContext]:
+        """Decode a received wire context. Returns None — never raises —
+        on absent/garbage input, and also when this node samples at 0
+        (an untraced node must not be poisoned into tracing by peers)."""
+        if not raw or self._sample_rate <= 0.0:
+            return None
+        return TraceContext.decode(raw)
+
+    def clock_anchor(self) -> Dict:
+        """A (wall, perf) clock pair read back-to-back: lets a remote
+        reader map this process's perf_counter span times onto the epoch
+        clock (refined by RPC round-trip offset estimation)."""
+        return {"wall_time": time.time(), "perf_time": time.perf_counter(),
+                "node_id": self._node_id, "chain_id": self._chain_id,
+                "sample_rate": self._sample_rate}
 
     # -- reading ------------------------------------------------------------
 
@@ -226,12 +475,16 @@ def to_chrome_trace(spans: List[Span]) -> Dict:
     per thread. Span ids/parents ride in args for tooling."""
     events = []
     for sp in spans:
+        args = dict(sp.attrs, span_id=sp.span_id, parent_id=sp.parent_id)
+        if sp.trace_id:
+            args["trace"] = sp.trace_id
+            args["ctx_parent"] = sp.ctx_parent
+            args["origin"] = sp.origin
         events.append({
             "name": sp.name, "ph": "X", "pid": os.getpid(),
             "tid": sp.thread_id, "ts": sp.start_s * 1e6,
             "dur": sp.duration_s * 1e6,
-            "args": dict(sp.attrs, span_id=sp.span_id,
-                         parent_id=sp.parent_id),
+            "args": args,
         })
         # thread name metadata rows render once per tid in the viewer;
         # duplicates are harmless
@@ -282,3 +535,41 @@ def summary() -> Dict:
 
 def set_enabled(flag: bool) -> None:
     DEFAULT.set_enabled(flag)
+
+
+def configure(node_id: Optional[str] = None, chain_id: Optional[str] = None,
+              sample_rate: Optional[float] = None) -> None:
+    DEFAULT.configure(node_id=node_id, chain_id=chain_id,
+                      sample_rate=sample_rate)
+
+
+def current_context() -> Optional[TraceContext]:
+    return DEFAULT.current_context()
+
+
+def activate(ctx: Optional[TraceContext]):
+    return DEFAULT.activate(ctx)
+
+
+def height_context(height: int) -> Optional[TraceContext]:
+    return DEFAULT.height_context(height)
+
+
+def mark(name: str, ctx: Optional[TraceContext] = None, **attrs):
+    return DEFAULT.mark(name, ctx=ctx, **attrs)
+
+
+def mark_height(height: int, name: str, **attrs):
+    return DEFAULT.mark_height(height, name, **attrs)
+
+
+def wire_context(height: int) -> bytes:
+    return DEFAULT.wire_context(height)
+
+
+def adopt(raw: bytes) -> Optional[TraceContext]:
+    return DEFAULT.adopt(raw)
+
+
+def clock_anchor() -> Dict:
+    return DEFAULT.clock_anchor()

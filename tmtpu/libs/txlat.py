@@ -88,6 +88,11 @@ class TxLat:
         self._entries: "OrderedDict[bytes, Dict[str, int]]" = OrderedDict()
         self._blocks: "OrderedDict[int, List[bytes]]" = OrderedDict()
         self._done: "deque" = deque(maxlen=_DONE_CAPACITY)
+        # tx hash -> commit height, bounded alongside _done; lets the
+        # snapshot tag each completed journey with the height that
+        # committed it (tools/critical_path.py joins per-height trace
+        # edges against per-height txlat totals by this key)
+        self._commit_heights: "OrderedDict[bytes, int]" = OrderedDict()
         self._lock = threading.Lock()
         self._enabled = True
         self._evicted = 0
@@ -184,6 +189,10 @@ class TxLat:
                 self._stamp_locked(h, stage, now)
             if stage == "commit":
                 for h in hashes:
+                    self._commit_heights[h] = height
+                while len(self._commit_heights) > _DONE_CAPACITY:
+                    self._commit_heights.popitem(last=False)
+                for h in hashes:
                     e = self._entries.get(h)
                     if e and "submit" in e and "commit" in e:
                         totals_ms.append(
@@ -214,13 +223,16 @@ class TxLat:
             totals = [(e["commit"] - e["submit"]) / 1e6
                       for _k, e in self._done
                       if "submit" in e and "commit" in e]
-            journeys = [(k, dict(e)) for k, e in done]
+            journeys = [(k, dict(e), self._commit_heights.get(k))
+                        for k, e in done]
         txs = []
-        for k, e in journeys:
+        for k, e, commit_h in journeys:
             t0 = min(e.values())
             stages = {s: round((t - t0) / 1e6, 3)
                       for s, t in sorted(e.items(), key=lambda kv: kv[1])}
             j = {"hash": k.hex(), "stages": stages}
+            if commit_h is not None:
+                j["height"] = commit_h
             if "submit" in e and "commit" in e:
                 j["submit_to_commit_ms"] = round(
                     (e["commit"] - e["submit"]) / 1e6, 3)
@@ -251,6 +263,7 @@ class TxLat:
             self._entries.clear()
             self._blocks.clear()
             self._done.clear()
+            self._commit_heights.clear()
             self._evicted = 0
             self._completed = 0
 

@@ -412,6 +412,12 @@ class CListMempool(BatchCheckMixin, AsyncRecheckMixin):
     # -- Mempool interface (mempool/mempool.go:30) --------------------------
     # check_tx / check_tx_nowait provided by BatchCheckMixin.
 
+    @property
+    def height(self) -> int:
+        """Last height this mempool was updated against (0 pre-genesis);
+        the gossip reactor tags tx batches with height+1's trace."""
+        return self._height
+
     def _precheck_admit(self, tx: bytes) -> None:
         with self._lock:
             if len(self._txs) >= self.max_txs or \

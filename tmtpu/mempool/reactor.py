@@ -21,6 +21,7 @@ from typing import Dict
 
 from tmtpu.crypto import tmhash
 from tmtpu.libs import metrics as _m
+from tmtpu.libs import trace as _trace
 from tmtpu.libs import txlat
 from tmtpu.libs.protoio import ProtoMessage
 from tmtpu.mempool.clist_mempool import CListMempool, MempoolFullError, \
@@ -32,9 +33,15 @@ MEMPOOL_CHANNEL = 0x30
 
 
 class TxsPB(ProtoMessage):
-    """proto/tendermint/mempool/types.proto Txs."""
+    """proto/tendermint/mempool/types.proto Txs.
 
-    FIELDS = [(1, "txs", ("rep", "bytes"))]
+    Field 2 is an optional piggybacked trace context (libs/trace.py wire
+    form) naming the in-flight height's root trace at the sender; old
+    peers skip it, empty is omitted (absent ⇒ untraced batch).
+    """
+
+    FIELDS = [(1, "txs", ("rep", "bytes")),
+              (2, "trace_ctx", "bytes")]
 
 
 class PeerSeenCache:
@@ -119,6 +126,16 @@ class MempoolReactor(Reactor):
 
     def receive(self, channel_id: int, peer: Peer, msg_bytes: bytes) -> None:
         m = TxsPB.decode(msg_bytes)
+        if m.trace_ctx:
+            # one mark per traced batch, never per tx; garbage decodes
+            # to None and is only counted
+            ctx = _trace.adopt(bytes(m.trace_ctx))
+            if ctx is not None:
+                _m.trace_context_rx.inc(transport="gossip")
+                _trace.mark("gossip.txs_rx", ctx=ctx, txs=len(m.txs),
+                            peer=peer.node_id)
+            else:
+                _m.trace_context_invalid.inc(transport="gossip")
         seen = self._peer_seen(peer.node_id)
         for tx in m.txs:
             tx = bytes(tx)
@@ -181,10 +198,19 @@ class MempoolReactor(Reactor):
                         keys.append(key)
                 last = cur
                 cur = cur.next
-            if batch and not peer.send(MEMPOOL_CHANNEL,
-                                       TxsPB(txs=batch).encode()):
-                time.sleep(0.05)  # send queue full: retry same position
-                continue
+            if batch:
+                # tag the batch with the in-flight height's root trace
+                # (the height these txs are racing to land in)
+                next_h = self.mempool.height + 1
+                ctx = _trace.wire_context(next_h)
+                if ctx:
+                    _m.trace_context_tx.inc(transport="gossip")
+                    _trace.mark_height(next_h, "gossip.txs_tx",
+                                       txs=len(batch), peer=peer.node_id)
+                if not peer.send(MEMPOOL_CHANNEL,
+                                 TxsPB(txs=batch, trace_ctx=ctx).encode()):
+                    time.sleep(0.05)  # send queue full: retry same position
+                    continue
             # only a handed-off batch counts as delivered to the peer's
             # send queue — a failed send must stay eligible for retry
             for key in keys:

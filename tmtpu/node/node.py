@@ -46,9 +46,14 @@ class Node(BaseService):
         self.config = config
         # [instr] txlat gates the per-tx lifecycle stamp ring before any
         # subsystem can stamp (the module fast paths read this flag)
+        from tmtpu.libs import trace as _trace
         from tmtpu.libs import txlat as _txlat
 
         _txlat.set_enabled(config.instrumentation.txlat)
+        # [instr] trace_sample gates cross-process trace contexts the
+        # same way (0 ⇒ the node neither mints nor adopts contexts);
+        # node/chain identity lands below once known
+        _trace.configure(sample_rate=config.instrumentation.trace_sample)
         crypto_batch.set_default_backend(config.base.crypto_backend)
         # resilience knobs: probe/batch deadlines + breaker thresholds
         # ([crypto] section) flow into the shared breaker registry BEFORE
@@ -76,6 +81,7 @@ class Node(BaseService):
         )
         self.genesis_doc = genesis_doc or GenesisDoc.from_file(
             config.genesis_path)
+        _trace.configure(chain_id=self.genesis_doc.chain_id)
         state = self.state_store.load()
         if state is None:
             state = state_from_genesis(self.genesis_doc)
@@ -231,6 +237,7 @@ class Node(BaseService):
             self.node_key = NodeKey.load_or_gen(
                 config.rooted(config.base.node_key_file))
             self.node_id = self.node_key.node_id
+            _trace.configure(node_id=self.node_id)
             node_info = NodeInfo(
                 node_id=self.node_key.node_id,
                 listen_addr=config.p2p.laddr,

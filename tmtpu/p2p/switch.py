@@ -295,6 +295,9 @@ class Switch(BaseService):
         try:
             reactor.receive(channel_id, peer, msg)
         except Exception as e:  # noqa: BLE001
+            from tmtpu.libs import metrics as _m
+
+            _m.p2p_recv_errors.inc(channel=f"0x{channel_id:02x}")
             self.stop_peer_for_error(peer, e)
 
     # -- broadcast (switch.go:306) ------------------------------------------

@@ -162,6 +162,19 @@ class HTTPClient:
     def txlat(self, limit: int = 64):
         return self.call("txlat", limit=str(limit))
 
+    def traces(self, limit: int = 4096, keep: bool = True,
+               trace_id: Optional[str] = None,
+               client_wall: Optional[float] = None):
+        """Span-buffer export with node/clock metadata (the fleet-join
+        surface). Pass ``client_wall=time.time()`` so the node records a
+        clock-offset estimate for its side of the conversation."""
+        p = {"limit": str(limit), "keep": "1" if keep else "0"}
+        if trace_id is not None:
+            p["trace_id"] = trace_id
+        if client_wall is not None:
+            p["client_wall"] = repr(float(client_wall))
+        return self.call("traces", **p)
+
     # -- unsafe scenario control (requires [rpc] unsafe on the node) --------
 
     def unsafe_net_shape(self, links: Optional[str] = None,

@@ -693,6 +693,54 @@ def build_routes(env: Environment) -> dict:
 
         return {"metrics": _m.summary(), "traces": _t.summary()}
 
+    def traces(limit="4096", keep="1", trace_id=None, client_wall=None):
+        """Span-buffer export with node + clock metadata — the raw
+        material tools/critical_path.py joins across the fleet. Spans
+        carry their cross-process trace context (``trace`` /
+        ``ctx_parent`` / ``origin`` fields when traced); the ``clock``
+        anchor (a back-to-back wall/perf pair) plus the caller's RPC
+        round-trip midpoint turn per-node perf_counter times into one
+        fleet timeline. ``keep=0`` drains the ring; ``trace_id`` filters
+        to one causal chain; ``client_wall`` (the caller's time.time())
+        records a clock-offset estimate gauge."""
+        from tmtpu.libs import metrics as _m
+        from tmtpu.libs import trace as _t
+
+        anchor = _t.clock_anchor()
+        if keep is not None and str(keep) in ("0", "false", "False"):
+            dropped = _t.DEFAULT.dropped
+            spans = _t.drain()
+        else:
+            dropped = _t.DEFAULT.dropped
+            spans = _t.snapshot()
+        if trace_id:
+            spans = [sp for sp in spans if sp.trace_id == str(trace_id)]
+        lim = int(limit)
+        if lim > 0:
+            spans = spans[-lim:]
+        _m.trace_spans_exported.inc(len(spans))
+        if dropped:
+            _m.trace_spans_dropped.inc(dropped)
+        if client_wall is not None:
+            try:
+                offset_ms = (float(client_wall)
+                             - anchor["wall_time"]) * 1000.0
+                _m.trace_clock_offset_ms.set(offset_ms)
+            except (TypeError, ValueError):
+                pass
+        return {
+            "node": {
+                "node_id": getattr(node, "node_id", ""),
+                "moniker": node.config.base.moniker,
+                "chain_id": node.genesis_doc.chain_id,
+            },
+            "clock": anchor,
+            "sample_rate": _t.DEFAULT.sample_rate,
+            "buffered": len(spans),
+            "dropped": dropped,
+            "spans": [sp.to_dict() for sp in spans],
+        }
+
     def timeline(height=None, last="20"):
         """Per-height round timeline journal (libs/timeline): proposal
         arrival, quorum crossings, batch-verify flushes, step entries,
@@ -797,6 +845,7 @@ def build_routes(env: Environment) -> dict:
         "unsafe_inject_fault": unsafe_inject_fault,
         "health": health, "status": status, "genesis": genesis,
         "metrics": metrics, "timeline": timeline,
+        "traces": traces,
         "txlat": txlat_report,
         "health_detail": health_detail,
         "genesis_chunked": genesis_chunked, "check_tx": check_tx,

@@ -13,8 +13,10 @@ Python-native equivalents of the Go pprof profiles:
     /debug/pprof/cmdline     process argv
     /debug/traces            drain the span ring (libs/trace) as Chrome
                              trace-event JSON; ?format=jsonl for line-
-                             delimited spans, ?keep=1 to snapshot without
-                             draining
+                             delimited spans, ?format=fleet for spans
+                             wrapped with node identity + clock anchor
+                             (cross-node join input), ?keep=1 to
+                             snapshot without draining
     /debug/timeline          per-height round timeline journal
                              (libs/timeline) as JSON; ?height=H for one
                              height, ?last=N for the trailing window
@@ -50,10 +52,19 @@ from tmtpu.libs import trace
 
 def render_traces(fmt: str = "chrome", keep: bool = False):
     """Body + content-type for /debug/traces: drains the global span ring
-    (or snapshots it with ``keep``) in the requested export format."""
+    (or snapshots it with ``keep``) in the requested export format.
+    ``format=fleet`` wraps the spans with the node identity and a clock
+    anchor (wall/perf pair) so a cross-node joiner — tools/critical_path —
+    can align this node's monotonic timestamps against its peers'."""
     spans = trace.snapshot() if keep else trace.drain()
     if fmt == "jsonl":
         return trace.to_jsonl(spans), "application/x-ndjson"
+    if fmt == "fleet":
+        return (json.dumps({
+            "clock": trace.clock_anchor(),
+            "buffered": len(spans),
+            "spans": [sp.to_dict() for sp in spans],
+        }), "application/json")
     return (json.dumps(trace.to_chrome_trace(spans)),
             "application/json")
 
@@ -132,7 +143,8 @@ class _Handler(BaseHTTPRequestHandler):
             if path in ("", "/debug/pprof"):
                 body = ("pprof endpoints: goroutine, heap, "
                         "profile?seconds=N, cmdline; trace drain at "
-                        "/debug/traces[?format=jsonl][&keep=1]; timeline "
+                        "/debug/traces[?format=jsonl|fleet][&keep=1]; "
+                        "timeline "
                         "at /debug/timeline; tx lifecycle latency at "
                         "/debug/txlat[?limit=N]; /metrics, /healthz, "
                         "/readyz\n")

@@ -29,7 +29,8 @@ import sys
 import time
 
 from tmtpu.config import toml as cfg_toml
-from tmtpu.e2e.manifest import LoadSpec, Manifest, NodeSpec
+from tmtpu.e2e.localnet import make_manifest
+from tmtpu.e2e.manifest import Manifest
 from tmtpu.e2e.runner import Runner, _hold_port, _REPO_ROOT
 from tmtpu.scenario.spec import ScenarioSpec
 
@@ -41,34 +42,33 @@ _STALL_TIMEOUT_NS = 5 * 10**9
 
 def build_manifest(spec: ScenarioSpec, sidecar_addr: str = "") -> Manifest:
     """Translate a ScenarioSpec into the e2e Manifest the Runner
-    understands. Perturbations stay empty — the engine drives its own
-    wall-clock fault timeline instead of the Runner's height-triggered
-    one."""
-    nodes = []
-    for name in spec.node_names():
-        validator = name.startswith("v")
-        cfg = {
-            "rpc.unsafe": True,
-            "health.consensus_stall_timeout_ns": _STALL_TIMEOUT_NS,
-        }
-        if spec.links:
-            cfg["p2p.shape_links"] = spec.links
-            cfg["p2p.shape_seed"] = spec.seed
-        if spec.sidecar:
-            cfg["base.crypto_backend"] = "sidecar"
-            cfg["sidecar.addr"] = sidecar_addr
-        cfg.update(spec.config)
-        cfg.update(spec.node_config.get(name, {}))
-        start_at = 0
+    understands (shared boot path: tmtpu/e2e/localnet.py). Perturbations
+    stay empty — the engine drives its own wall-clock fault timeline
+    instead of the Runner's height-triggered one."""
+    base = {
+        "rpc.unsafe": True,
+        "health.consensus_stall_timeout_ns": _STALL_TIMEOUT_NS,
+    }
+    if spec.links:
+        base["p2p.shape_links"] = spec.links
+        base["p2p.shape_seed"] = spec.seed
+    if spec.sidecar:
+        base["base.crypto_backend"] = "sidecar"
+        base["sidecar.addr"] = sidecar_addr
+    base.update(spec.config)
+
+    def start_at(name, validator):
+        # -1 = provisioned, never auto-started (manual joiners)
         if not validator and spec.full_node_start == "manual":
-            start_at = -1  # provisioned, never auto-started
-        nodes.append(NodeSpec(
-            name=name, validator=validator, start_at=start_at,
-            key_type=spec.key_type, config=cfg,
-            misbehaviors=dict(spec.misbehaviors.get(name, {}))))
-    return Manifest(
-        chain_id=f"scenario-{spec.name}", nodes=nodes,
-        load=LoadSpec(rate=spec.load_rate, size=spec.load_size),
+            return -1
+        return 0
+
+    return make_manifest(
+        f"scenario-{spec.name}", spec.node_names(),
+        base_config=base, node_config=spec.node_config,
+        key_type=spec.key_type, misbehaviors=spec.misbehaviors,
+        start_at=start_at, load_rate=spec.load_rate,
+        load_size=spec.load_size, target_height=12,
         timeout_s=spec.timeout_s)
 
 

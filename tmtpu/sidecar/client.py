@@ -132,6 +132,30 @@ class SidecarClient:
             version=proto.PROTOCOL_VERSION, client_id=self.client_id,
             features=["verify", "tally"])))
         ack = reader.read_msg()
+        if isinstance(ack, proto.ErrorReply) and \
+                ack.code == proto.ERR_VERSION and \
+                proto.PROTOCOL_VERSION > min(proto.SUPPORTED_VERSIONS):
+            # version-skew tolerance: an old daemon hard-rejects a newer
+            # Hello (pre-v2 daemons knew no negotiation), so retry the
+            # handshake once at the oldest version we still speak. The
+            # old daemon closes the rejected connection, so reconnect.
+            try:
+                sock.close()
+            except OSError:
+                pass
+            if kind == "unix":
+                sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            else:
+                sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            sock.settimeout(self._connect_timeout_s)
+            sock.connect(target)
+            rfile = sock.makefile("rb")
+            reader = proto.FrameReader(rfile, self._max_frame_bytes)
+            sock.sendall(proto.encode_frame(proto.Hello(
+                version=min(proto.SUPPORTED_VERSIONS),
+                client_id=self.client_id,
+                features=["verify", "tally"])))
+            ack = reader.read_msg()
         if isinstance(ack, proto.ErrorReply):
             raise SidecarUnavailable(
                 f"sidecar rejected handshake (code {ack.code}): "
@@ -226,6 +250,13 @@ class SidecarClient:
 
     # --- public API ---
 
+    def trace_ctx_supported(self) -> bool:
+        """True when the daemon acked a version that knows the v2
+        trace-context fields (never attach them to an older daemon)."""
+        ack = self.hello_ack
+        return ack is not None and \
+            ack.version >= proto.TRACE_CTX_MIN_VERSION
+
     def verify(self, curve: str, lanes: List[Tuple[bytes, bytes, bytes,
                                                    int]],
                tally: bool = False,
@@ -233,17 +264,31 @@ class SidecarClient:
                                                             int, Dict]:
         """Ship lanes to the daemon; returns (mask, tallied, dispatch
         info). Raises :class:`SidecarOverloaded` on backpressure and
-        :class:`SidecarUnavailable` on everything else non-OK."""
+        :class:`SidecarUnavailable` on everything else non-OK.
+
+        When the calling thread has an active trace context
+        (libs.trace.activate) and the daemon speaks v2, the context
+        rides the request so the daemon's joint dispatch is attributable
+        to the height that caused it."""
         from tmtpu.libs import metrics as _m
+        from tmtpu.libs import trace as _trace
 
         deadline_s = deadline_s or self._request_deadline_s
         self._ensure_connected()
         rid = next(self._seq)
+        ctx = _trace.current_context()
+        ctx_bytes = b""
+        if ctx is not None and self.trace_ctx_supported():
+            ctx_bytes = ctx.encode()
+            _m.trace_context_tx.inc(transport="sidecar")
+            _trace.mark("sidecar.verify", ctx=ctx, curve=curve,
+                        lanes=len(lanes))
         req = proto.VerifyRequest(
             request_id=rid, curve=curve, tally=tally,
             deadline_ms=int(deadline_s * 1000),
             lanes=[proto.Lane(pub_key=pk, msg=m, sig=s, power=p)
-                   for pk, m, s, p in lanes])
+                   for pk, m, s, p in lanes],
+            trace_ctx=ctx_bytes)
         t0 = time.perf_counter()
         try:
             reply = self._roundtrip(rid, req, deadline_s)
@@ -271,7 +316,8 @@ class SidecarClient:
         mask = proto.unpack_mask(reply.mask, reply.lane_count)
         info = {"dispatch_id": reply.dispatch_id,
                 "dispatch_lanes": reply.dispatch_lanes,
-                "dispatch_clients": reply.dispatch_clients}
+                "dispatch_clients": reply.dispatch_clients,
+                "dispatch_traces": reply.dispatch_traces}
         return mask, reply.tallied, info
 
     def ping(self, deadline_s: Optional[float] = None) -> proto.Pong:

@@ -48,10 +48,11 @@ class PendingRequest:
     __slots__ = ("client_id", "curve", "items", "tally", "deadline",
                  "enqueued_at", "done", "mask", "tallied", "error",
                  "failure", "dispatch_id", "dispatch_lanes",
-                 "dispatch_clients")
+                 "dispatch_clients", "trace_ctx", "dispatch_traces")
 
     def __init__(self, client_id: str, curve: str, items: List[tuple],
-                 tally: bool, deadline: Optional[float]):
+                 tally: bool, deadline: Optional[float],
+                 trace_ctx=None):
         self.client_id = client_id
         self.curve = curve
         self.items = items
@@ -66,6 +67,10 @@ class PendingRequest:
         self.dispatch_id = 0
         self.dispatch_lanes = 0
         self.dispatch_clients = 0
+        # distributed-tracing: the request's TraceContext (or None) and,
+        # after dispatch, how many traced requests shared the dispatch
+        self.trace_ctx = trace_ctx
+        self.dispatch_traces = 0
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self.done.wait(timeout)
@@ -123,17 +128,19 @@ class Coalescer:
     # --- client side ---
 
     def submit(self, client_id: str, curve: str, items: List[tuple],
-               tally: bool, deadline_s: Optional[float] = None
-               ) -> PendingRequest:
+               tally: bool, deadline_s: Optional[float] = None,
+               trace_ctx=None) -> PendingRequest:
         """Enqueue; returns a waitable :class:`PendingRequest`. Raises
         :class:`Overloaded` when queues are full (never queues partial
-        requests)."""
+        requests). ``trace_ctx`` (a libs.trace.TraceContext or None)
+        tags the joint dispatch this request ends up riding."""
         from tmtpu.libs import metrics as _m
 
         req = PendingRequest(
             client_id, curve, items, tally,
             None if deadline_s is None
-            else time.monotonic() + deadline_s)
+            else time.monotonic() + deadline_s,
+            trace_ctx=trace_ctx)
         with self._cond:
             if not self._running:
                 raise Overloaded("coalescer not running")
@@ -305,6 +312,23 @@ class Coalescer:
                            clients=clients, requests=len(live),
                            mesh_shards=shards,
                            seconds=round(dt, 6))
+        # tag the joint dispatch with every context it served: one
+        # sidecar.dispatch mark per distinct trace, so a fleet join sees
+        # exactly which heights shared this device flush
+        traced = [req.trace_ctx for req in live
+                  if req.trace_ctx is not None]
+        if traced:
+            from tmtpu.libs import trace as _trace
+
+            seen_tids = set()
+            for ctx in traced:
+                if ctx.trace_id in seen_tids:
+                    continue
+                seen_tids.add(ctx.trace_id)
+                _trace.mark("sidecar.dispatch", ctx=ctx,
+                            dispatch_id=dispatch_id, lanes=len(joint),
+                            clients=clients, requests=len(live),
+                            seconds=round(dt, 6))
         if len(mask) != len(joint):
             for req in live:
                 req.error = (f"verify engine returned {len(mask)} verdicts "
@@ -325,5 +349,6 @@ class Coalescer:
             req.dispatch_id = dispatch_id
             req.dispatch_lanes = len(joint)
             req.dispatch_clients = clients
+            req.dispatch_traces = len(traced)
             off += n
             req.done.set()

@@ -15,11 +15,21 @@ raise the cap in lockstep with ``max_lanes_per_dispatch``; the default
 covers the 10k-validator north-star with headroom.
 
 Handshake: client sends :class:`Hello` first; server answers
-:class:`HelloAck` on version match or :class:`ErrorReply`
-(``ERR_VERSION``) and closes on mismatch. Anything else as a first
-message is a protocol error. ``PROTOCOL_VERSION`` bumps on any wire
-change — there is no negotiation, a sidecar daemon and its clients ship
-from the same tree.
+:class:`HelloAck` carrying the NEGOTIATED version (min of both sides,
+``SUPPORTED_VERSIONS`` only) or :class:`ErrorReply` (``ERR_VERSION``)
+and closes on an unsupported version. Anything else as a first message
+is a protocol error. ``PROTOCOL_VERSION`` bumps on any wire change;
+since v2 the daemon keeps serving v1 clients (version-skew tolerance:
+an old client on a new daemon just never sees the v2-only optional
+fields), and a v2 client that gets ``ERR_VERSION`` from a v1 daemon
+retries the handshake at version 1.
+
+Version history:
+- v1: Hello/HelloAck/Verify/Ping/Stats base protocol.
+- v2: optional distributed-tracing context — ``VerifyRequest.trace_ctx``
+  (libs/trace.py wire form) and ``VerifyResponse.dispatch_traces``
+  (how many traced requests the joint dispatch coalesced). Both fields
+  are additive; a v1 peer skips them as unknown fields.
 
 Verify masks travel bit-packed (:func:`pack_mask`/:func:`unpack_mask`):
 lane i's verdict is bit ``i & 7`` of byte ``i >> 3``, LSB-first —
@@ -37,7 +47,12 @@ from tmtpu.libs.protoio import (
     encode_uvarint,
 )
 
-PROTOCOL_VERSION = 1
+PROTOCOL_VERSION = 2
+# every version this tree still speaks; the daemon accepts any of them
+# and the negotiated version is min(client, server)
+SUPPORTED_VERSIONS = (1, 2)
+# first version carrying trace-context fields
+TRACE_CTX_MIN_VERSION = 2
 
 # Hard ceiling on one frame; configurable per server/client but both
 # sides always enforce *some* cap so a corrupt length prefix can't OOM.
@@ -59,7 +74,7 @@ STATUS_NAMES = {
 }
 
 # --- ErrorReply.code ---
-ERR_VERSION = 1        # Hello.version != PROTOCOL_VERSION
+ERR_VERSION = 1        # Hello.version not in SUPPORTED_VERSIONS
 ERR_PROTOCOL = 2       # bad frame / unexpected message sequence
 ERR_INTERNAL = 3       # server bug; connection stays usable
 
@@ -101,6 +116,9 @@ class VerifyRequest(ProtoMessage):
         (3, "tally", "bool"),
         (4, "deadline_ms", "uint32"),        # 0 = server default
         (5, "lanes", ("rep", ("msg", Lane))),
+        # v2: optional trace context (libs/trace.py wire form; empty =
+        # untraced). Clients only attach it when the daemon acked v2.
+        (6, "trace_ctx", "bytes"),
     ]
 
 
@@ -115,6 +133,9 @@ class VerifyResponse(ProtoMessage):
         (7, "dispatch_lanes", "uint32"),     # …total lanes it carried
         (8, "dispatch_clients", "uint32"),   # …distinct clients coalesced
         (9, "error", "string"),
+        # v2: how many traced requests the joint dispatch served — the
+        # coalescer's dispatch span carries the trace ids themselves
+        (10, "dispatch_traces", "uint32"),
     ]
 
 

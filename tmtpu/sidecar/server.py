@@ -309,7 +309,7 @@ class SidecarServer:
 
         reader = proto.FrameReader(rfile, self._max_frame_bytes)
         try:
-            # handshake: Hello first, version must match exactly
+            # handshake: Hello first, version within SUPPORTED_VERSIONS
             try:
                 first = reader.read_msg()
             except proto.ProtocolError as exc:
@@ -327,18 +327,23 @@ class SidecarServer:
                     message=f"expected Hello, got "
                             f"{type(first).__name__}"))
                 return
-            if first.version != proto.PROTOCOL_VERSION:
+            if first.version not in proto.SUPPORTED_VERSIONS:
                 _m.sidecar_server_protocol_errors.inc(
                     kind="version-mismatch")
                 send(proto.ErrorReply(
                     code=proto.ERR_VERSION,
-                    message=f"protocol version {first.version} != "
-                            f"server {proto.PROTOCOL_VERSION}"))
+                    message=f"protocol version {first.version} not in "
+                            f"server-supported "
+                            f"{list(proto.SUPPORTED_VERSIONS)}"))
                 return
+            # version-skew tolerance: serve old clients at their version
+            # (they never see v2-only optional fields anyway — unknown
+            # fields are skipped — but the ack tells THEM not to send any)
+            negotiated = min(first.version, proto.PROTOCOL_VERSION)
             client_id = first.client_id or "anon"
             _m.sidecar_server_requests.inc(type="hello")
             send(proto.HelloAck(
-                version=proto.PROTOCOL_VERSION,
+                version=negotiated,
                 server_id=self.server_id,
                 backend=self.backend_name(),
                 max_lanes=self._max_lanes_per_dispatch,
@@ -406,10 +411,22 @@ class SidecarServer:
                  for ln in req.lanes]
         deadline_s = (req.deadline_ms / 1000.0 if req.deadline_ms
                       else self._default_deadline_s)
+        # v2 piggybacked trace context: strict decode, garbage ⇒ untraced
+        # (never rejected — the context is advisory, not load-bearing)
+        trace_ctx = None
+        if req.trace_ctx:
+            from tmtpu.libs import metrics as _m
+            from tmtpu.libs import trace as _trace
+
+            trace_ctx = _trace.adopt(bytes(req.trace_ctx))
+            if trace_ctx is None:
+                _m.trace_context_invalid.inc(transport="sidecar")
+            else:
+                _m.trace_context_rx.inc(transport="sidecar")
         try:
             pending = self.coalescer.submit(
                 client_id, req.curve, items, req.tally,
-                deadline_s=deadline_s)
+                deadline_s=deadline_s, trace_ctx=trace_ctx)
         except Overloaded as exc:
             reject(proto.STATUS_OVERLOADED, str(exc))
             return
@@ -441,7 +458,8 @@ class SidecarServer:
                     tallied=pending.tallied,
                     dispatch_id=pending.dispatch_id,
                     dispatch_lanes=pending.dispatch_lanes,
-                    dispatch_clients=pending.dispatch_clients))
+                    dispatch_clients=pending.dispatch_clients,
+                    dispatch_traces=pending.dispatch_traces))
             except OSError:
                 pass  # client gone; the dispatch already happened
 

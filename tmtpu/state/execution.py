@@ -103,6 +103,12 @@ class BlockExecutor:
 
         t0 = _time.perf_counter()
         self.validate_block(state, block)
+        # ABCI-handoff stamp on the height's root trace: the instant the
+        # committed block crosses into the application
+        from tmtpu.libs import trace as _trace
+
+        _trace.mark_height(block.header.height, "abci.handoff",
+                           txs=len(block.txs))
         abci_responses = self._exec_block_on_proxy_app(state, block)
         # execution.go:149 — after exec, before saving
         fail.fail_point("exec.post_exec")
@@ -145,6 +151,8 @@ class BlockExecutor:
         # apply checkpoint (async or serial executor alike): commit→apply
         # is exactly the span the async_exec overlap hides
         txlat.stamp_height(block.header.height, "apply")
+        _trace.mark_height(block.header.height, "height.apply",
+                           txs=len(block.txs))
         return new_state, retain_height
 
     def apply_block_async(self, state: State, block_id: BlockID,

@@ -308,6 +308,12 @@ class VoteSet:
                 if self.signed_msg_type == PRECOMMIT
                 else timeline.EVENT_PREVOTE_QUORUM,
                 round=self.round, power=bv.sum, quorum=quorum)
+            trace.mark_height(
+                self.height,
+                "height.precommit_quorum"
+                if self.signed_msg_type == PRECOMMIT
+                else "height.prevote_quorum",
+                round=self.round, power=bv.sum)
             if self._maj23.hash:
                 # non-nil quorum: stamp every tx of the winning block
                 # (noted at proposal completion) at its quorum stage

@@ -19,6 +19,13 @@ from dataclasses import dataclass, field
 from typing import Dict
 
 from tmtpu.config.config import Config
+# Subprocess-localnet boot (one node = one ``python -m tmtpu.cmd start``
+# child) is shared fleet plumbing, not an A/B-tools special: re-exported
+# here so tools needing genuinely per-process state (span rings, journey
+# rings — tools/critical_path.py, tools/fleet_report.py) boot through
+# the same path as the scenario engine. See tmtpu/e2e/localnet.py.
+from tmtpu.e2e.localnet import (booted, make_manifest,  # noqa: F401
+                                validator_names)
 from tmtpu.node.node import Node
 from tmtpu.privval.file_pv import FilePV
 from tmtpu.types.genesis import GenesisDoc, GenesisValidator

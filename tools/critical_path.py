@@ -1,0 +1,445 @@
+"""Per-height critical-path attribution over a real subprocess localnet
+(ISSUE 16 acceptance): boot an N-validator net through the shared
+localnet path (tools/ab_common.py → tmtpu/e2e/localnet.py — each node
+its own process, so every span ring is genuinely per-node), drive RPC
+load, drain every node's ``traces`` RPC while the net runs, then join
+the fleet's spans by trace id and answer, per committed height, "where
+did the time go":
+
+  clock alignment   per-node wall/perf anchors from the ``traces`` RPC
+                    plus a min-RTT round-trip offset estimate put every
+                    node's monotonic span timestamps on one shared
+                    wall-clock axis (same-node edge math never crosses
+                    clocks; only the wire-hop edge does);
+  causal chain      the deterministic per-height root trace
+                    (libs/trace.height_trace_id — same id on every
+                    node) joins each height's milestone marks across
+                    the fleet: proposal seen → prevote quorum →
+                    precommit quorum → commit → apply, per node, plus
+                    the propagated gossip/sidecar hop marks;
+  edges             mempool_wait  txlat submit→proposal on the ingest
+                                  node (queue wait);
+                    proposal_gossip  proposer's gossip.proposal_tx →
+                                  follower gossip.proposal_rx, cross-
+                                  node aligned (wire hop; per-height
+                                  value = median follower);
+                    prevote_wait / precommit_wait / commit_wait /
+                    apply         adjacent milestone diffs, node-local
+                                  perf clock (no alignment error);
+                    sidecar_flush joint-dispatch marks attributed to
+                                  the height's trace (only when the
+                                  net runs the sidecar backend);
+  report            per-height rows (edges, dominant edge, nodes
+                    joined), fleet p50/p99 per edge, a decomposition
+                    check — mempool_wait + consensus edges vs the
+                    txlat-measured submit→commit per (height, ingest
+                    node), tolerance 10% — and one fully-joined
+                    exemplar height exported as Chrome trace-event
+                    JSON (chrome://tracing / Perfetto).
+
+Prints one combined JSON object on stdout (per-node drain one-liners on
+stderr as they arrive).
+
+Run: python tools/critical_path.py [duration_s] [rate] [validators]
+"""
+
+import json
+import pathlib
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from tools.ab_common import (booted, make_manifest,  # noqa: E402
+                             validator_names)
+from tmtpu.libs.trace import height_trace_id  # noqa: E402
+
+_DECOMP_TOL = 0.10    # acceptance: edge sum within 10% of txlat total
+_SETTLE_S = 3.0       # let in-flight heights finish before final drain
+_POLL_S = 2.5         # mid-run drain cadence (ring cap is 8192 spans)
+
+# the fleet latency table rows, in causal order; proposal_gossip is the
+# only cross-clock edge
+EDGES = ("mempool_wait", "proposal_gossip", "prevote_wait",
+         "precommit_wait", "commit_wait", "apply", "sidecar_flush")
+
+# dominant-edge classification buckets for the report
+EDGE_KIND = {
+    "mempool_wait": "queue-wait",
+    "proposal_gossip": "wire-hop",
+    "prevote_wait": "quorum-wait",
+    "precommit_wait": "quorum-wait",
+    "commit_wait": "execution",
+    "apply": "execution",
+    "sidecar_flush": "sidecar-flush",
+}
+
+
+def _pct(vals, q):
+    """Exact q-quantile of a sorted list (nearest-rank)."""
+    if not vals:
+        return None
+    return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+
+def _median(vals):
+    if not vals:
+        return None
+    vals = sorted(vals)
+    return vals[len(vals) // 2]
+
+
+def estimate_offset(client, probes: int = 7):
+    """This node's wall clock minus ours, from the round trip with the
+    least RTT (NTP-style midpoint: the anchor was read somewhere inside
+    the round trip, so offset error is bounded by rtt/2)."""
+    best_rtt, best_off = None, 0.0
+    for _ in range(probes):
+        t0 = time.time()
+        r = client.traces(limit=1, keep=True)
+        t1 = time.time()
+        rtt = t1 - t0
+        off = r["clock"]["wall_time"] - (t0 + t1) / 2.0
+        if best_rtt is None or rtt < best_rtt:
+            best_rtt, best_off = rtt, off
+    return {"rtt_ms": round(best_rtt * 1e3, 3),
+            "offset_ms": round(best_off * 1e3, 3)}
+
+
+def drain_spans(runner, acc, clocks, final: bool = False):
+    """One ``traces`` sweep: drain every node's span ring into ``acc``
+    and remember its latest wall/perf clock anchor (any anchor maps that
+    process's perf timeline to wall time; the freshest wins)."""
+    for node in runner.nodes:
+        name = node.spec.name
+        try:
+            r = node.client.traces(
+                limit=16384, keep=False,
+                client_wall=time.time() if final else None)
+        except Exception as e:
+            if final:
+                print(json.dumps({"node": name, "error": str(e)}),
+                      file=sys.stderr)
+            continue
+        acc.setdefault(name, []).extend(r.get("spans", []))
+        clocks[name] = r["clock"]
+        if final:
+            print(json.dumps({
+                "node": name, "spans": len(acc[name]),
+                "dropped": r.get("dropped"),
+                "sample_rate": r.get("sample_rate"),
+            }), file=sys.stderr)
+
+
+def _align(clocks, offsets):
+    """Per-node ``start_s (perf) -> collector wall`` converters."""
+    fns = {}
+    for name, clock in clocks.items():
+        off = offsets.get(name, {}).get("offset_ms", 0.0) / 1e3
+        wall0 = clock["wall_time"] - clock["perf_time"] - off
+
+        def fn(t, base=wall0):
+            return base + t
+        fns[name] = fn
+    return fns
+
+
+def _mark_t(spans, name):
+    """Earliest node-local perf time of mark ``name`` (first occurrence
+    is the causal one; re-gossip can repeat a mark)."""
+    ts = [sp["start_s"] for sp in spans if sp["name"] == name]
+    return min(ts) if ts else None
+
+
+def join_heights(acc, chain_id):
+    """Group every node's spans by committed height via the
+    deterministic root trace id."""
+    max_h = 0
+    for spans in acc.values():
+        for sp in spans:
+            h = sp.get("attrs", {}).get("height")
+            if isinstance(h, int) and h > max_h:
+                max_h = h
+    tid_to_h = {height_trace_id(chain_id, h): h
+                for h in range(1, max_h + 2)}
+    by_height = {}   # h -> node -> [span]
+    for name, spans in acc.items():
+        for sp in spans:
+            h = tid_to_h.get(sp.get("trace", ""))
+            if h is None:
+                continue
+            by_height.setdefault(h, {}).setdefault(name, []).append(sp)
+    return by_height, max_h
+
+
+def height_edges(h, per_node, align_fns, mempool_wait_ms):
+    """One height's causal chain → edge table (ms) + dominant edge."""
+    # proposer = the node that broadcast its OWN proposal (that mark
+    # carries the ``parts`` attr; data-routine departure marks carry
+    # ``peer``); its tx anchor = the earliest departure on any path.
+    # Fall back to the earliest aligned height.proposal sighting.
+    proposer, prop_tx_t = None, None
+    for name, spans in per_node.items():
+        own = [sp for sp in spans if sp["name"] == "gossip.proposal_tx"
+               and "parts" in sp.get("attrs", {})]
+        if own:
+            proposer = name
+            prop_tx_t = _mark_t(spans, "gossip.proposal_tx")
+            break
+    if proposer is None:
+        best = None
+        for name, spans in per_node.items():
+            t = _mark_t(spans, "height.proposal")
+            if t is None:
+                continue
+            w = align_fns[name](t)
+            if best is None or w < best[0]:
+                best = (w, name, t)
+        if best is not None:
+            _, proposer, prop_tx_t = best
+
+    edges = {}
+    if mempool_wait_ms is not None:
+        edges["mempool_wait"] = round(mempool_wait_ms, 3)
+
+    # wire hop: proposer tx mark → each follower's rx mark, aligned;
+    # the per-height value is the median follower (robust against one
+    # straggler's scheduling noise)
+    if proposer is not None and prop_tx_t is not None:
+        tx_wall = align_fns[proposer](prop_tx_t)
+        hops = []
+        for name, spans in per_node.items():
+            if name == proposer:
+                continue
+            t = _mark_t(spans, "gossip.proposal_rx")
+            if t is not None:
+                hops.append((align_fns[name](t) - tx_wall) * 1e3)
+        if hops:
+            edges["proposal_gossip"] = round(_median(hops), 3)
+
+    # consensus edges: node-local adjacent milestone diffs (one clock,
+    # zero alignment error); per-height value = median across nodes
+    chain = (("height.proposal", "height.prevote_quorum", "prevote_wait"),
+             ("height.prevote_quorum", "height.precommit_quorum",
+              "precommit_wait"),
+             ("height.precommit_quorum", "height.commit", "commit_wait"),
+             ("height.commit", "height.apply", "apply"))
+    for a, b, label in chain:
+        diffs = []
+        for spans in per_node.values():
+            ta, tb = _mark_t(spans, a), _mark_t(spans, b)
+            if ta is not None and tb is not None and tb >= ta:
+                diffs.append((tb - ta) * 1e3)
+        if diffs:
+            edges[label] = round(_median(diffs), 3)
+
+    # sidecar attribution: joint-dispatch flush time the daemon charged
+    # to this height's trace (only present under the sidecar backend)
+    flush = [sp.get("attrs", {}).get("seconds", 0.0)
+             for spans in per_node.values() for sp in spans
+             if sp["name"] == "sidecar.dispatch"]
+    if flush:
+        edges["sidecar_flush"] = round(sum(flush) * 1e3, 3)
+
+    dominant = max(edges, key=edges.get) if edges else None
+    return {
+        "proposer": proposer,
+        "edges": edges,
+        "dominant": dominant,
+        "dominant_kind": EDGE_KIND.get(dominant),
+    }
+
+
+def txlat_by_height(runner):
+    """Per (height, ingest node): submit→proposal and submit→commit ms
+    from that node's journey ring (journeys carry their commit height)."""
+    out = {}   # h -> node -> {"waits": [...], "totals": [...]}
+    for node in runner.nodes:
+        name = node.spec.name
+        try:
+            ring = node.client.txlat(limit=512)
+        except Exception:
+            continue
+        for j in ring.get("txs", []):
+            h = j.get("height")
+            st = j.get("stages", {})
+            if h is None or "submit" not in st:
+                continue
+            rec = out.setdefault(h, {}).setdefault(
+                name, {"waits": [], "totals": []})
+            if "proposal" in st:
+                rec["waits"].append(st["proposal"] - st["submit"])
+            if "submit_to_commit_ms" in j:
+                rec["totals"].append(j["submit_to_commit_ms"])
+    return out
+
+
+def decompose(h, per_node, lat_nodes):
+    """The honesty check: on each ingest node, txlat's measured
+    submit→commit total vs mempool_wait (txlat submit→proposal) + the
+    TRACE-measured consensus edges on that same node. Two independent
+    instrumentation systems stamping adjacent lines — they must agree."""
+    checks = []
+    for name, rec in lat_nodes.items():
+        total = _median(rec["totals"])
+        wait = _median(rec["waits"])
+        spans = per_node.get(name)
+        if total is None or wait is None or not spans:
+            continue
+        tp = _mark_t(spans, "height.proposal")
+        tc = _mark_t(spans, "height.commit")
+        if tp is None or tc is None:
+            continue
+        edge_sum = wait + (tc - tp) * 1e3
+        checks.append({
+            "node": name,
+            "txlat_total_ms": round(total, 3),
+            "edge_sum_ms": round(edge_sum, 3),
+            "within_tol": abs(edge_sum - total) <=
+            _DECOMP_TOL * max(total, 1e-9),
+        })
+    return checks
+
+
+def chrome_exemplar(h, per_node, align_fns):
+    """One fully-joined height as Chrome trace-event JSON: each node a
+    process row, milestone marks as instant events, timed spans as X
+    events — all on the aligned wall-clock axis."""
+    events = []
+    t0 = None
+    for name, spans in per_node.items():
+        for sp in spans:
+            w = align_fns[name](sp["start_s"])
+            if t0 is None or w < t0:
+                t0 = w
+    for pid, (name, spans) in enumerate(sorted(per_node.items())):
+        events.append({"ph": "M", "pid": pid, "tid": 0,
+                       "name": "process_name", "args": {"name": name}})
+        for sp in spans:
+            ts = (align_fns[name](sp["start_s"]) - t0) * 1e6
+            args = dict(sp.get("attrs", {}), origin=sp.get("origin", ""))
+            if sp.get("dur_s", 0) > 0:
+                events.append({"name": sp["name"], "ph": "X", "pid": pid,
+                               "tid": sp.get("tid", 0), "ts": ts,
+                               "dur": sp["dur_s"] * 1e6, "args": args})
+            else:
+                events.append({"name": sp["name"], "ph": "i", "pid": pid,
+                               "tid": sp.get("tid", 0), "ts": ts,
+                               "s": "p", "args": args})
+    return {"displayTimeUnit": "ms", "traceEvents": events,
+            "otherData": {"height": h}}
+
+
+def main(duration_s: float = 25.0, rate: float = 30.0,
+         validators: int = 3, outdir: str = ""):
+    tmp = outdir or tempfile.mkdtemp(prefix="critical-path-")
+    manifest = make_manifest(
+        "critical-path", validator_names(validators),
+        load_rate=rate, load_size=32, target_height=3,
+        timeout_s=duration_s + 120.0)
+    acc, clocks = {}, {}
+    with booted(manifest, tmp, load=True) as runner:
+        deadline = time.monotonic() + duration_s
+        while time.monotonic() < deadline:
+            time.sleep(min(_POLL_S, max(0.1,
+                                        deadline - time.monotonic())))
+            drain_spans(runner, acc, clocks)
+        runner.stop_load()
+        time.sleep(_SETTLE_S)
+        offsets = {}
+        for node in runner.nodes:
+            try:
+                offsets[node.spec.name] = estimate_offset(node.client)
+            except Exception as e:
+                offsets[node.spec.name] = {"error": str(e)}
+        drain_spans(runner, acc, clocks, final=True)
+        lat = txlat_by_height(runner)
+
+    align_fns = _align(clocks, offsets)
+    chain_id = manifest.chain_id
+    by_height, max_h = join_heights(acc, chain_id)
+
+    n_nodes = len(manifest.nodes)
+    committed = sorted(
+        h for h, per in by_height.items()
+        if any(_mark_t(spans, "height.commit") is not None
+               for spans in per.values()))
+    joined = [h for h in committed if len(by_height[h]) == n_nodes]
+
+    heights_out = []
+    edge_samples = {}
+    checked = within = 0
+    exemplar_candidates = []
+    for h in committed:
+        per_node = by_height[h]
+        lat_nodes = lat.get(h, {})
+        waits = [w for rec in lat_nodes.values() for w in rec["waits"]]
+        row = height_edges(h, per_node, align_fns, _median(waits))
+        row["height"] = h
+        row["nodes_joined"] = len(per_node)
+        checks = decompose(h, per_node, lat_nodes)
+        if checks:
+            row["decomposition"] = checks
+            checked += len(checks)
+            within += sum(1 for c in checks if c["within_tol"])
+            if len(per_node) == n_nodes:
+                exemplar_candidates.append(h)
+        for label, ms in row["edges"].items():
+            edge_samples.setdefault(label, []).append(ms)
+        heights_out.append(row)
+
+    fleet_edges = {}
+    for label in EDGES:
+        vals = sorted(edge_samples.get(label, []))
+        if vals:
+            fleet_edges[label] = {
+                "kind": EDGE_KIND[label],
+                "heights": len(vals),
+                "p50_ms": round(_pct(vals, 0.50), 3),
+                "p99_ms": round(_pct(vals, 0.99), 3),
+            }
+
+    # exemplar: a mid-run fully-joined height with txlat coverage (boot
+    # and tail heights under-represent steady state)
+    exemplar_path = None
+    exemplar_h = exemplar_candidates[len(exemplar_candidates) // 2] \
+        if exemplar_candidates else (joined[-1] if joined else None)
+    if exemplar_h is not None:
+        exemplar_path = str(pathlib.Path(tmp) /
+                            f"critical_path_h{exemplar_h}.json")
+        with open(exemplar_path, "w") as f:
+            json.dump(chrome_exemplar(exemplar_h, by_height[exemplar_h],
+                                      align_fns), f)
+
+    report = {
+        "metric": "critical_path",
+        "duration_s": duration_s,
+        "offered_rate": rate,
+        "validators": validators,
+        "max_height": max_h,
+        "join": {
+            "committed_heights": len(committed),
+            "fully_joined": len(joined),
+            "frac": round(len(joined) / len(committed), 4)
+            if committed else None,
+        },
+        "fleet_edges": fleet_edges,
+        "decomposition": {
+            "checked": checked,
+            "within_tol": within,
+            "tol": _DECOMP_TOL,
+            "frac": round(within / checked, 4) if checked else None,
+        },
+        "clock": offsets,
+        "exemplar": {"height": exemplar_h, "path": exemplar_path},
+        "heights": heights_out,
+    }
+    print(json.dumps(report))
+    return report
+
+
+if __name__ == "__main__":
+    main(duration_s=float(sys.argv[1]) if len(sys.argv) > 1 else 25.0,
+         rate=float(sys.argv[2]) if len(sys.argv) > 2 else 30.0,
+         validators=int(sys.argv[3]) if len(sys.argv) > 3 else 3)

@@ -35,8 +35,8 @@ import time
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-from tmtpu.e2e.manifest import LoadSpec, Manifest, NodeSpec  # noqa: E402
-from tmtpu.e2e.runner import Runner  # noqa: E402
+from tmtpu.e2e.localnet import (booted, make_manifest,  # noqa: E402
+                                validator_names)
 
 _DECOMP_TOL = 0.05     # acceptance: stage sum within 5% of the total
 _SETTLE_S = 3.0        # let in-flight txs commit before the sweep
@@ -174,27 +174,16 @@ def merge(per_node) -> dict:
 def main(duration_s: float = 20.0, rate: float = 40.0,
          validators: int = 4, outdir: str = ""):
     tmp = outdir or tempfile.mkdtemp(prefix="fleet-report-")
-    manifest = Manifest(
-        chain_id="fleet-report",
-        nodes=[NodeSpec(name=f"v{i:02d}") for i in range(validators)],
-        load=LoadSpec(rate=rate, size=32),
-        target_height=3,
-        timeout_s=duration_s + 120.0,
-    )
-    runner = Runner(manifest, tmp)
-    try:
-        print(f"booting {validators}-node localnet under {tmp}...",
-              file=sys.stderr)
-        runner.setup()
-        runner.start()
-        runner.start_load()
+    manifest = make_manifest(
+        "fleet-report", validator_names(validators),
+        load_rate=rate, load_size=32, target_height=3,
+        timeout_s=duration_s + 120.0)
+    with booted(manifest, tmp, load=True) as runner:
         time.sleep(duration_s)
         runner.stop_load()
         time.sleep(_SETTLE_S)
         per_node = collect(runner)
         report = merge(per_node)
-    finally:
-        runner.stop()
     report["metric"] = "fleet_report"
     report["duration_s"] = duration_s
     report["offered_rate"] = rate

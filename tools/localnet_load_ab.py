@@ -30,6 +30,19 @@ Prints one JSON line per arm plus a combined summary
      "speedup": ..., "txs": N}
 
 Run: python tools/localnet_load_ab.py [num_txs]
+
+Sweep mode (the committed-vs-offered knee curve for PERF.md): ONE
+pipelined-arm net, a fixed-rate OPEN-loop offer window per rate — txs
+are paced at the offered rate whether or not the net keeps up, a full
+mempool drops the offer — so each row reports how much of the offered
+load actually committed and at what latency. The knee is the first rate
+where commit_rate falls off and p99 inflates:
+
+    python tools/localnet_load_ab.py --sweep 50,100,200,400 [window_s]
+
+    {"metric": "localnet_load_sweep", "rows": [{"offered_rate": ...,
+     "committed_tx_per_s": ..., "commit_rate": ...,
+     "submit_to_commit_p99_ms": ...}, ...]}
 """
 
 import json
@@ -171,6 +184,77 @@ def _run_arm(pipelined: bool, txs: list, drain_timeout_s: float) -> dict:
     return out
 
 
+def _paced_offer(nodes, txs, rate: float, window_s: float) -> int:
+    """Open-loop offer: pace ``txs`` at ``rate`` tx/s round-robin for
+    ``window_s``, never waiting on commit progress. A full mempool drops
+    the offer (that IS the over-the-knee signal, surfaced as
+    commit_rate < 1), unlike the closed-loop arms' re-offer retry."""
+    interval = 1.0 / max(1e-9, rate)
+    t0 = time.monotonic()
+    offered = 0
+    n = len(nodes)
+    for i, tx in enumerate(txs):
+        target = t0 + i * interval
+        now = time.monotonic()
+        if now - t0 >= window_s:
+            break
+        if now < target:
+            time.sleep(target - now)
+        txlat.stamp_tx(tx, "submit")
+        try:
+            nodes[i % n].mempool.check_tx_nowait(tx)
+        except Exception:
+            pass
+        offered += 1
+    return offered
+
+
+def sweep(rates, window_s: float = 12.0, settle_s: float = 4.0):
+    """One pipelined net, one open-loop window per offered rate; emits
+    one knee-curve row per rate (stderr as they land, combined JSON on
+    stdout)."""
+    priv = gen_priv_key()
+    budget = [int(r * window_s) + 8 for r in rates]
+    n_total = sum(budget)
+    print(f"pre-signing {n_total} txs for {len(rates)}-rate sweep...",
+          file=sys.stderr)
+    txs = [signed_tx.encode(b"sw-%d=%d" % (i, i), priv)
+           for i in range(n_total)]
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="load-sweep-"))
+    nodes = _mk_net_nodes(tmp, pipelined=True)
+    rows = []
+    with measure_lock.hold("localnet_load_sweep"):
+        try:
+            ab_common.boot(nodes, height=2, timeout_s=60)
+            idx = 0
+            for r, n_arm in zip(rates, budget):
+                txlat.clear()
+                lat0 = _m.tx_latency_submit_to_commit.bucket_counts()
+                size0 = _app_size(nodes[0])
+                shard = txs[idx:idx + n_arm]
+                idx += n_arm
+                offered = _paced_offer(nodes, shard, r, window_s)
+                time.sleep(settle_s)  # let the tail commit (or not)
+                committed = _app_size(nodes[0]) - size0
+                row = {
+                    "offered_rate": r,
+                    "offered_txs": offered,
+                    "committed_txs": committed,
+                    "committed_tx_per_s": round(committed / window_s, 1),
+                    "commit_rate": round(committed / max(1, offered), 4),
+                }
+                row.update(_lat_delta(lat0))
+                rows.append(row)
+                print(json.dumps(row), file=sys.stderr)
+        finally:
+            for nd in nodes:
+                nd.stop()
+    out = {"metric": "localnet_load_sweep", "window_s": window_s,
+           "rows": rows}
+    print(json.dumps(out))
+    return out
+
+
 def main(n_txs: int = 2000):
     priv = gen_priv_key()
     print(f"pre-signing {n_txs} txs...", file=sys.stderr)
@@ -195,4 +279,8 @@ def main(n_txs: int = 2000):
 
 
 if __name__ == "__main__":
-    main(int(sys.argv[1]) if len(sys.argv) > 1 else 2000)
+    if len(sys.argv) > 1 and sys.argv[1] == "--sweep":
+        sweep([float(r) for r in sys.argv[2].split(",")],
+              window_s=float(sys.argv[3]) if len(sys.argv) > 3 else 12.0)
+    else:
+        main(int(sys.argv[1]) if len(sys.argv) > 1 else 2000)
