@@ -51,6 +51,26 @@ def test_generator_invariants():
             assert n.key_type in ("ed25519", "sr25519", "secp256k1")
 
 
+def test_large_topology_respects_node_cap(monkeypatch):
+    """The 'large' ceiling derives from the host's cores, is overridable
+    via TMTPU_E2E_MAX_NODES, and every draw stays under it."""
+    from tmtpu.e2e import generate as gen
+
+    monkeypatch.setenv("TMTPU_E2E_MAX_NODES", "7")
+    assert gen.max_nodes() == 7
+    rng = random.Random(5)
+    for _ in range(30):
+        m = gen.generate_manifest(rng, "large")
+        assert 4 <= len(m.nodes) <= 7
+    # same seed + same cap -> identical draws (determinism holds under
+    # the env override too)
+    a = [len(m.nodes) for m in gen.generate(seed=9, groups=2)]
+    b = [len(m.nodes) for m in gen.generate(seed=9, groups=2)]
+    assert a == b
+    monkeypatch.delenv("TMTPU_E2E_MAX_NODES")
+    assert 6 <= gen.max_nodes() <= 16
+
+
 @pytest.mark.parametrize("topology", TOPOLOGIES)
 def test_generated_testnet_runs(topology):
     rng = random.Random(42)

@@ -1,0 +1,342 @@
+"""Per-validator forensics ledger tests (tmtpu/libs/valstats.py): the
+ISSUE acceptance battery — arrival-offset bookkeeping stays correct
+under out-of-order votes, the scorecard decay math matches the spec,
+equivocation/amnesia flags fire, memory stays bounded under 10k
+validators, and the disabled gate is a true no-op."""
+
+from collections import OrderedDict
+
+from tmtpu.libs import metrics, timeline, valstats
+from tmtpu.libs.valstats import ValStats
+
+MS = 10**6  # ns per ms
+
+
+# Duck-typed stand-ins: valstats only reads height/round/type/
+# validator_address/validator_index and block_id.is_zero()/key(), so
+# tests need neither crypto nor the real Vote class.
+class _BlockID:
+    def __init__(self, key=""):
+        self._key = key
+
+    def is_zero(self):
+        return not self._key
+
+    def key(self):
+        return self._key
+
+
+class _Vote:
+    def __init__(self, height=1, round_=0, type_=1, addr=b"\xaa" * 20,
+                 index=0, block="B"):
+        self.height, self.round, self.type = height, round_, type_
+        self.validator_address = addr
+        self.validator_index = index
+        self.block_id = _BlockID(block)
+
+
+class _Val:
+    def __init__(self, addr, power=10):
+        self.address = addr
+        self.voting_power = power
+
+
+class _ValSet:
+    def __init__(self, vals):
+        self.validators = vals
+
+
+class _Precommits:
+    """get_by_index surface of a decided round's VoteSet."""
+
+    def __init__(self, by_index):
+        self._by_index = by_index
+
+    def get_by_index(self, idx):
+        return self._by_index.get(idx)
+
+
+def _finalize(vs, height, voted_indices, addrs):
+    """Roll up one height: validators in ``voted_indices`` precommitted."""
+    val_set = _ValSet([_Val(a) for a in addrs])
+    pre = _Precommits({i: _Vote(height=height, type_=2, addr=addrs[i],
+                                index=i)
+                       for i in voted_indices})
+    vs.finalize_height(height, 0, val_set, pre)
+
+
+def test_valstats_events_pinned():
+    """The obs-docs rule parses VALSTATS_EVENTS statically and the
+    timeline module mirrors the constant — drift breaks dashboards."""
+    assert valstats.VALSTATS_EVENTS == ("quorum.laggard",)
+    assert valstats.EVENT_QUORUM_LAGGARD == timeline.EVENT_QUORUM_LAGGARD
+
+
+def test_arrival_offsets_anchor_on_step_start():
+    vs = ValStats()
+    t0 = 1_000_000_000
+    vs.begin_step(5, 0, "prevote", t_ns=t0)
+    vs.on_vote(_Vote(height=5, type_=1, addr=b"\x01" * 20), 10,
+               t_ns=t0 + 3 * MS)
+    vs.on_vote(_Vote(height=5, type_=1, addr=b"\x02" * 20), 10,
+               t_ns=t0 + 10 * MS)
+    snap = vs.snapshot()
+    a = snap["validators"][("01" * 20)]
+    b = snap["validators"][("02" * 20)]
+    assert a["recent"][0]["offset_ms"] == 3.0
+    assert a["recent"][0]["rank"] == 1
+    assert b["recent"][0]["offset_ms"] == 10.0
+    assert b["recent"][0]["rank"] == 2
+    assert a["lag_ewma_ms"] == 3.0  # first observation seeds the EWMA
+
+
+def test_out_of_order_votes_anchor_on_first_arrival():
+    """Gossip can outrun the local step transition: the first vote's
+    arrival then anchors the offsets, and a later begin_step must NOT
+    move the anchor (first write wins)."""
+    vs = ValStats()
+    t0 = 2_000_000_000
+    vs.on_vote(_Vote(height=9, type_=2, addr=b"\x01" * 20), 10, t_ns=t0)
+    vs.on_vote(_Vote(height=9, type_=2, addr=b"\x02" * 20), 10,
+               t_ns=t0 + 4 * MS)
+    vs.begin_step(9, 0, "precommit", t_ns=t0 + 50 * MS)  # late, ignored
+    vs.on_vote(_Vote(height=9, type_=2, addr=b"\x03" * 20), 10,
+               t_ns=t0 + 6 * MS)
+    snap = vs.snapshot()
+    assert snap["validators"]["01" * 20]["recent"][0]["offset_ms"] == 0.0
+    assert snap["validators"]["02" * 20]["recent"][0]["offset_ms"] == 4.0
+    assert snap["validators"]["03" * 20]["recent"][0]["offset_ms"] == 6.0
+    ranks = [snap["validators"][f"{i:02x}" * 20]["recent"][0]["rank"]
+             for i in (1, 2, 3)]
+    assert ranks == [1, 2, 3]
+
+
+def test_votes_after_quorum_carry_the_straggler_offset():
+    vs = ValStats()
+    t0 = 3_000_000_000
+    vs.begin_step(4, 0, "prevote", t_ns=t0)
+    for i in range(3):
+        vs.on_vote(_Vote(height=4, type_=1, addr=bytes([i]) * 20,
+                         index=i), 10, t_ns=t0 + i * MS)
+    vs.on_quorum(_Vote(height=4, type_=1, addr=b"\x02" * 20, index=2),
+                 t_ns=t0 + 2 * MS)
+    vs.on_vote(_Vote(height=4, type_=1, addr=b"\x03" * 20, index=3), 10,
+               t_ns=t0 + 9 * MS)
+    snap = vs.snapshot()
+    late = snap["validators"]["03" * 20]["recent"][0]
+    assert late["after_quorum_ms"] == 7.0
+    assert late["offset_ms"] == 9.0
+
+
+def test_quorum_records_laggard_timeline_event():
+    vs = ValStats()
+    h = 777_001  # unique height: the timeline journal is process-global
+    t0 = 4_000_000_000
+    vs.begin_step(h, 2, "precommit", t_ns=t0)
+    vs.on_vote(_Vote(height=h, round_=2, type_=2, addr=b"\xbb" * 20),
+               10, t_ns=t0 + 5 * MS)
+    vs.on_quorum(_Vote(height=h, round_=2, type_=2, addr=b"\xbb" * 20),
+                 t_ns=t0 + 5 * MS)
+    try:
+        recs = timeline.snapshot(height=h)
+        assert recs, "no timeline record for the quorum height"
+        evs = [e for e in recs[0]["events"]
+               if e["event"] == timeline.EVENT_QUORUM_LAGGARD]
+        assert len(evs) == 1
+        assert evs[0]["address"] == "bb" * 20
+        assert evs[0]["type"] == "precommit"
+        assert evs[0]["round"] == 2
+        assert evs[0]["rank"] == 1
+        assert evs[0]["lag_ms"] == 5.0
+    finally:
+        timeline.DEFAULT.clear()
+
+
+def test_scorecard_decay_math():
+    """score_h = 0.8*score + 0.2*participated, innocent-until-absent."""
+    vs = ValStats()
+    addrs = [b"\x01" * 20, b"\x02" * 20]
+    _finalize(vs, 1, {0, 1}, addrs)          # both vote
+    snap = vs.snapshot()
+    assert snap["validators"]["01" * 20]["score"] == 1.0
+    _finalize(vs, 2, {0}, addrs)             # v2 misses
+    _finalize(vs, 3, {0}, addrs)             # v2 misses again
+    snap = vs.snapshot()
+    # 0.8*(0.8*1.0 + 0.2*0) + 0.2*0 = 0.64
+    assert abs(snap["validators"]["02" * 20]["score"] - 0.64) < 1e-9
+    assert snap["validators"]["02" * 20]["missed_votes"] == 2
+    assert snap["validators"]["01" * 20]["score"] == 1.0
+    # worst-first ordering + the strict laggard verdict
+    assert snap["worst"][0]["address"] == "02" * 20
+    assert snap["laggard"] == "02" * 20
+    # recovery: participation folds back toward 1.0
+    _finalize(vs, 4, {0, 1}, addrs)
+    snap = vs.snapshot()
+    assert abs(snap["validators"]["02" * 20]["score"]
+               - (0.8 * 0.64 + 0.2)) < 1e-9
+
+
+def test_no_laggard_verdict_on_a_tie():
+    vs = ValStats()
+    addrs = [b"\x01" * 20, b"\x02" * 20]
+    _finalize(vs, 1, {0, 1}, addrs)
+    assert vs.snapshot()["laggard"] is None  # both 1.0 — no verdict
+
+
+def test_flap_counting_on_participation_edges():
+    """A flap is a participation STATE CHANGE between consecutive
+    rollups — steady presence and steady absence both count zero."""
+    vs = ValStats()
+    addrs = [b"\x01" * 20, b"\x02" * 20]
+    pattern = [True, False, True, False, True]  # v2 oscillates
+    for h, up in enumerate(pattern, start=1):
+        _finalize(vs, h, {0, 1} if up else {0}, addrs)
+    flaps = vs.flap_counts()
+    assert flaps["01" * 20] == 0
+    assert flaps["02" * 20] == len(pattern) - 1  # every edge after h1
+
+
+def test_finalize_is_idempotent_per_height():
+    """WAL replay re-finalizes heights; only the first pass counts."""
+    vs = ValStats()
+    addrs = [b"\x01" * 20, b"\x02" * 20]
+    _finalize(vs, 1, {0, 1}, addrs)
+    _finalize(vs, 2, {0}, addrs)
+    _finalize(vs, 2, {0}, addrs)             # replayed
+    _finalize(vs, 1, {0, 1}, addrs)          # replayed, older
+    snap = vs.snapshot()
+    assert snap["validators"]["02" * 20]["missed_votes"] == 1
+    assert snap["heights_finalized"] == 2
+    assert snap["finalized_height"] == 2
+
+
+def test_equivocation_flag():
+    vs = ValStats()
+    before = metrics.validator_equivocations.summary_series().get("", 0.0)
+    vs.on_equivocation(_Vote(height=3, type_=1, addr=b"\xee" * 20))
+    snap = vs.snapshot()
+    rec = snap["validators"]["ee" * 20]
+    assert rec["equivocations"] == 1
+    assert rec["recent"][0]["type"] == "equivocation"
+    after = metrics.validator_equivocations.summary_series().get("", 0.0)
+    assert after == before + 1
+
+
+def test_amnesia_flag_on_cross_round_conflicting_precommits():
+    """A non-nil precommit for a DIFFERENT block than the validator's
+    earlier-round non-nil precommit at the same height = amnesia. Same
+    block re-precommitted or a later height is NOT."""
+    vs = ValStats()
+    a = b"\xcc" * 20
+    vs.on_vote(_Vote(height=6, round_=0, type_=2, addr=a, block="X"), 10,
+               t_ns=0)
+    vs.on_vote(_Vote(height=6, round_=2, type_=2, addr=a, block="X"), 10,
+               t_ns=MS)  # same block: lock kept, no flag
+    assert vs.snapshot()["validators"]["cc" * 20]["amnesia"] == 0
+    vs.on_vote(_Vote(height=6, round_=3, type_=2, addr=a, block="Y"), 10,
+               t_ns=2 * MS)  # different block: forgot the lock
+    assert vs.snapshot()["validators"]["cc" * 20]["amnesia"] == 1
+    vs.on_vote(_Vote(height=7, round_=0, type_=2, addr=a, block="Z"), 10,
+               t_ns=3 * MS)  # fresh height: no flag
+    assert vs.snapshot()["validators"]["cc" * 20]["amnesia"] == 1
+
+
+def test_missed_proposal_and_proposal_credit():
+    vs = ValStats()
+    t0 = 5_000_000_000
+    vs.begin_step(3, 0, "propose", t_ns=t0)
+    vs.on_proposal(3, 0, b"\x0a" * 20, t_ns=t0 + 2 * MS)
+    vs.on_missed_proposal(4, 0, b"\x0b" * 20)
+    snap = vs.snapshot()
+    prop = snap["validators"]["0a" * 20]
+    assert prop["proposals"] == 1
+    assert prop["recent"][0]["offset_ms"] == 2.0
+    missed = snap["validators"]["0b" * 20]
+    assert missed["missed_proposals"] == 1
+
+
+def test_bounded_memory_under_10k_validators():
+    """10k distinct validators against a small LRU cap: the ledger
+    never grows past the cap and counts what it evicted. The in-flight
+    round contexts stay FIFO-bounded no matter how many heights open."""
+    vs = ValStats(validator_cap=64)
+    for i in range(10_000):
+        addr = i.to_bytes(20, "big")
+        vs.on_vote(_Vote(height=1 + i % 3, type_=1, addr=addr, index=i),
+                   10, t_ns=i)
+    assert len(vs._vals) == 64
+    snap = vs.snapshot(limit=10_000)
+    assert snap["count"] == 64
+    assert snap["evicted"] == 10_000 - 64
+    # round contexts: thousands of distinct heights, bounded ring
+    for h in range(1000, 3000):
+        vs.begin_step(h, 0, "prevote", t_ns=h)
+    assert len(vs._rounds) <= 64
+
+
+def test_snapshot_limit_caps_records_but_not_count():
+    vs = ValStats()
+    for i in range(32):
+        vs.on_vote(_Vote(type_=1, addr=bytes([i]) * 20, index=i), 10,
+                   t_ns=i)
+    snap = vs.snapshot(limit=4)
+    assert len(snap["validators"]) == 4
+    assert snap["count"] == 32
+    assert len(snap["worst"]) == 8
+
+
+def test_disabled_gate_is_a_noop(monkeypatch):
+    """With [instr] valstats off, the module fast paths never touch the
+    ledger, the metrics, or the timeline."""
+    fresh = ValStats()
+    monkeypatch.setattr(valstats, "DEFAULT", fresh)
+    valstats.set_enabled(False)
+    lag_before = metrics.validator_vote_lag.summary_series()
+    valstats.begin_step(2, 0, "prevote")
+    valstats.on_vote(_Vote(height=2), 10)
+    valstats.on_quorum(_Vote(height=2))
+    valstats.on_proposal(2, 0, b"\x01" * 20)
+    valstats.on_missed_proposal(2, 0, b"\x01" * 20)
+    valstats.on_equivocation(_Vote(height=2))
+    valstats.finalize_height(2, 0, _ValSet([_Val(b"\x01" * 20)]),
+                             _Precommits({}))
+    assert valstats.flap_counts() == {}
+    assert not fresh._vals and not fresh._rounds
+    assert metrics.validator_vote_lag.summary_series() == lag_before
+    valstats.set_enabled(True)
+    assert valstats.enabled()
+
+
+def test_vote_lag_metric_rank_buckets():
+    vs = ValStats()
+    h = _unique_height()
+    lag = metrics.validator_vote_lag
+    before = lag.totals(type="prevote", rank="1")[0]
+    before2 = lag.totals(type="prevote", rank="2-4")[0]
+    vs.begin_step(h, 0, "prevote", t_ns=0)
+    for i in range(3):
+        vs.on_vote(_Vote(height=h, type_=1, addr=bytes([i]) * 20,
+                         index=i), 10, t_ns=(i + 1) * MS)
+    assert lag.totals(type="prevote", rank="1")[0] == before + 1
+    assert lag.totals(type="prevote", rank="2-4")[0] == before2 + 2
+
+
+_next_h = [900_000]
+
+
+def _unique_height():
+    _next_h[0] += 1
+    return _next_h[0]
+
+
+def test_snapshot_orders_validators_as_ordereddict_worst_first():
+    """The JSON payload's validators mapping iterates worst-first —
+    operators reading the raw JSON see the offender at the top."""
+    vs = ValStats()
+    addrs = [b"\x01" * 20, b"\x02" * 20, b"\x03" * 20]
+    _finalize(vs, 1, {0, 1, 2}, addrs)
+    _finalize(vs, 2, {0, 2}, addrs)
+    snap = vs.snapshot()
+    first = next(iter(snap["validators"]))
+    assert first == "02" * 20
+    assert isinstance(snap["validators"], (dict, OrderedDict))

@@ -449,3 +449,123 @@ def test_real_consensus_stall_detected():
         wd.stop()
         stop_all(nodes)
         timeline.DEFAULT.clear()
+
+
+def test_validator_flap_check_unit(monkeypatch):
+    """Flap deltas are measured inside the sliding window against the
+    oldest retained sample: steady counts stay healthy, a burst crossing
+    the threshold flips the verdict and names the validator, and counts
+    that aged out of the window stop counting against it."""
+    from tmtpu.libs import valstats
+
+    counts = {"aa" * 20: 0, "bb" * 20: 0}
+    monkeypatch.setattr(valstats, "flap_counts", lambda: dict(counts))
+    clock = [100.0]
+    monkeypatch.setattr(wdg.time, "monotonic", lambda: clock[0])
+
+    check = wdg.validator_flap_check(window_s=60.0, threshold=3)
+    ok, _, details = check()  # baseline sample
+    assert ok and details["flaps_in_window"] == 0
+
+    clock[0] += 10.0
+    counts["aa" * 20] = 2  # below threshold
+    ok, _, details = check()
+    assert ok
+    assert details["flaps_in_window"] == 2
+    assert details["validator"] == "aa" * 20
+
+    clock[0] += 10.0
+    counts["aa" * 20] = 3  # 3 flaps since the 100.0s baseline
+    ok, reason, details = check()
+    assert not ok
+    assert "aa" * 20 in reason and "3 times" in reason
+    assert details == {"window_s": 60.0, "threshold": 3,
+                       "flaps_in_window": 3, "validator": "aa" * 20}
+
+    # the burst ages out: once every pre-burst sample leaves the window
+    # the baseline becomes the burst itself and the delta collapses
+    clock[0] += 61.0
+    ok, _, details = check()
+    assert ok and details["flaps_in_window"] == 0
+
+
+def test_validator_flap_storm_flips_healthz():
+    """Scenario 3: a validator oscillates in and out of the active set.
+    Real valstats ledger, real watchdog, real /healthz — the flap check
+    must trip and the probe body must name the offender."""
+    from tmtpu.libs import valstats
+    from tmtpu.rpc.pprof import PprofServer
+
+    class _BlockID:
+        def is_zero(self):
+            return False
+
+        def key(self):
+            return "B"
+
+    class _Vote:
+        def __init__(self, height, addr, index):
+            self.height, self.round, self.type = height, 0, 2
+            self.validator_address = addr
+            self.validator_index = index
+            self.block_id = _BlockID()
+
+    class _Val:
+        def __init__(self, addr):
+            self.address = addr
+            self.voting_power = 10
+
+    class _ValSet:
+        def __init__(self, addrs):
+            self.validators = [_Val(a) for a in addrs]
+
+    class _Precommits:
+        def __init__(self, votes):
+            self._votes = votes
+
+        def get_by_index(self, idx):
+            return self._votes.get(idx)
+
+    addrs = [b"\x01" * 20, b"\x02" * 20]
+    orig_default, orig_enabled = valstats.DEFAULT, valstats.enabled()
+    valstats.DEFAULT = ledger = valstats.ValStats()
+    valstats.set_enabled(True)
+
+    wd = wdg.Watchdog(interval_s=0.05, logger=log.NopLogger())
+    wd.register("validator",
+                wdg.validator_flap_check(window_s=60.0, threshold=3))
+    srv = PprofServer("tcp://127.0.0.1:0", health=wd.liveness)
+    srv.start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        wd.check_now()  # baseline: no flaps yet
+        assert wd.healthy()[0]
+        status, _ = _get(f"{base}/healthz")
+        assert status == 200
+
+        # validator 02 oscillates across finalized heights: present,
+        # absent, present, absent -> 3 participation edges = 3 flaps
+        for h, up in enumerate([True, False, True, False], start=1):
+            voted = {0: _Vote(h, addrs[0], 0)}
+            if up:
+                voted[1] = _Vote(h, addrs[1], 1)
+            ledger.finalize_height(h, 0, _ValSet(addrs),
+                                   _Precommits(voted))
+        assert ledger.flap_counts()[("02" * 20)] == 3
+
+        wd.check_now()
+        ok, reasons = wd.healthy()
+        assert not ok, "flap storm never detected"
+        assert "02" * 20 in reasons[0] and "flapped 3 times" in reasons[0]
+
+        status, body = _get(f"{base}/healthz")
+        assert status == 503
+        assert body["checks"]["validator"]["details"]["validator"] == \
+            "02" * 20
+        assert body["checks"]["validator"]["details"]["flaps_in_window"] \
+            == 3
+    finally:
+        wd.stop()
+        srv.stop()
+        valstats.DEFAULT = orig_default
+        valstats.set_enabled(orig_enabled)

@@ -1,17 +1,19 @@
 """obs-docs rule: the tx-lifecycle + tracing observability surface is
 documented.
 
-The per-tx journey ring (libs/txlat) and the causal-trace span names
-(libs/trace) are only useful if an operator can read their output, and
-every name they export is an API: the checkpoint stages in
-``TX_STAGES`` (they appear verbatim in ``txlat`` RPC snapshots and
-fleet reports), the causal milestone/hop marks in ``TRACE_MARKS``
-(served by the ``traces`` RPC and joined by tools/critical_path.py),
-the ``tendermint_tx_latency_*`` / ``tendermint_health_latency_*`` /
-``tendermint_trace_*`` metric families, and the ``tx_latency`` timeline
-event kind. Each one must have a row in docs/OBSERVABILITY.md — a
-stage, mark or metric added without documentation is a dashboard nobody
-can interpret.
+The per-tx journey ring (libs/txlat), the causal-trace span names
+(libs/trace), and the per-validator forensics ledger (libs/valstats)
+are only useful if an operator can read their output, and every name
+they export is an API: the checkpoint stages in ``TX_STAGES`` (they
+appear verbatim in ``txlat`` RPC snapshots and fleet reports), the
+causal milestone/hop marks in ``TRACE_MARKS`` (served by the
+``traces`` RPC and joined by tools/critical_path.py), the
+``tendermint_tx_latency_*`` / ``tendermint_health_latency_*`` /
+``tendermint_trace_*`` / ``tendermint_validator_*`` metric families,
+the ``tx_latency`` timeline event kind, and the forensics timeline
+events in ``VALSTATS_EVENTS``. Each one must have a row in
+docs/OBSERVABILITY.md — a stage, mark, event or metric added without
+documentation is a dashboard nobody can interpret.
 
 Everything is resolved statically (metric catalog via
 ``index.metric_defs()``, the stage/mark tuples parsed out of
@@ -34,8 +36,9 @@ DOC_PATH = "docs/OBSERVABILITY.md"
 _TXLAT_MOD = "tmtpu/libs/txlat.py"
 _TRACE_MOD = "tmtpu/libs/trace.py"
 _METRICS_MOD = "tmtpu/libs/metrics.py"
+_VALSTATS_MOD = "tmtpu/libs/valstats.py"
 _PREFIXES = ("tendermint_tx_latency", "tendermint_health_latency",
-             "tendermint_trace")
+             "tendermint_trace", "tendermint_validator")
 
 
 def _str_tuple(index: RepoIndex, mod: str, var: str) -> List[str]:
@@ -55,11 +58,12 @@ def _str_tuple(index: RepoIndex, mod: str, var: str) -> List[str]:
 
 
 @rule("obs-docs",
-      doc="every tx-lifecycle/tracing observability name — TX_STAGES "
-          "checkpoint stages, TRACE_MARKS causal marks, tendermint_tx_"
-          "latency_*/tendermint_health_latency_*/tendermint_trace_* "
-          "metrics, the tx_latency timeline event — has a "
-          "docs/OBSERVABILITY.md row",
+      doc="every tx-lifecycle/tracing/validator-forensics observability "
+          "name — TX_STAGES checkpoint stages, TRACE_MARKS causal marks, "
+          "tendermint_tx_latency_*/tendermint_health_latency_*/"
+          "tendermint_trace_*/tendermint_validator_* metrics, the "
+          "tx_latency timeline event, VALSTATS_EVENTS forensics events "
+          "— has a docs/OBSERVABILITY.md row",
       triggers=("tmtpu/libs", "docs"))
 def check(index: RepoIndex) -> List[Finding]:
     required = []  # (kind, name, source rel)
@@ -74,6 +78,8 @@ def check(index: RepoIndex) -> List[Finding]:
     if stages:
         # the event kind exists exactly when the journey ring does
         required.append(("event", "tx_latency", "tmtpu/libs/timeline.py"))
+    for e in _str_tuple(index, _VALSTATS_MOD, "VALSTATS_EVENTS"):
+        required.append(("event", e, _VALSTATS_MOD))
     if not required:
         return []  # no tx-lifecycle surface in this tree
 

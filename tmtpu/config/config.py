@@ -202,6 +202,11 @@ class InstrumentationConfig:
     # submit→commit p99 SLO in milliseconds for the watchdog's
     # latency_slo_check; 0 disables the check entirely
     latency_slo_ms: float = 0.0
+    # per-validator consensus forensics (libs/valstats.py): the vote
+    # arrival/miss/scorecard ledger behind the ``validator_stats`` RPC
+    # and the tendermint_validator_* metrics. On by default like txlat —
+    # the fast paths are one attribute read when off.
+    valstats: bool = True
     # fraction of heights that root a fleet-joinable trace (libs/trace
     # contexts piggybacked on gossip/sidecar/ABCI boundaries). Sampling
     # is derived from the deterministic per-height trace id, so every
@@ -240,6 +245,11 @@ class HealthConfig:
     # blips without disarming the check)
     latency_slo_window_ns: int = 30_000 * MS
     latency_slo_samples: int = 3
+    # validator flap check (armed by [instr] valstats): a validator
+    # whose participation state changed at least this many times inside
+    # the trailing window flags the fleet as flapping; 0 disables
+    validator_flap_window_ns: int = 60_000 * MS
+    validator_flap_threshold: int = 3
 
 
 @dataclass

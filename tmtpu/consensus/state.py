@@ -33,6 +33,7 @@ from tmtpu.consensus.wal import (
     EndHeightPB, EventRoundStatePB, MsgInfoPB, TimeoutInfoPB, WAL,
 )
 from tmtpu.libs import timeline, trace, txlat
+from tmtpu.libs import valstats as _valstats
 from tmtpu.libs.service import BaseService
 from tmtpu.types import pb
 from tmtpu.types.block import BlockID, Commit
@@ -552,6 +553,13 @@ class ConsensusState(BaseService):
         elif ti.step == STEP_PROPOSE:
             if self.event_bus:
                 self.event_bus.publish_timeout_propose(rs)
+            if rs.proposal is None:
+                # the scheduled proposer never delivered: charge the
+                # missed proposal to it (validator forensics ledger)
+                prop = rs.validators.get_proposer()
+                if prop is not None:
+                    _valstats.on_missed_proposal(rs.height, rs.round,
+                                                 prop.address)
             self._enter_prevote(ti.height, ti.round)
         elif ti.step == STEP_PREVOTE_WAIT:
             if self.event_bus:
@@ -613,6 +621,7 @@ class ConsensusState(BaseService):
         rs.round = round
         rs.step = STEP_PROPOSE
         timeline.record(height, "consensus.enter_propose", round=round)
+        _valstats.begin_step(height, round, "propose")
         self._new_step()
         # propose-step timeout -> prevote nil
         self.ticker.schedule_timeout(TimeoutInfo(
@@ -692,6 +701,7 @@ class ConsensusState(BaseService):
         rs.round = round
         rs.step = STEP_PREVOTE
         timeline.record(height, "consensus.enter_prevote", round=round)
+        _valstats.begin_step(height, round, "prevote")
         self._new_step()
         # sign and broadcast prevote (defaultDoPrevote :1252)
         if rs.locked_block is not None:
@@ -732,6 +742,7 @@ class ConsensusState(BaseService):
         rs.round = round
         rs.step = STEP_PRECOMMIT
         timeline.record(height, "consensus.enter_precommit", round=round)
+        _valstats.begin_step(height, round, "precommit")
         self._new_step()
         prevotes = rs.votes.prevotes(round)
         block_id, has_polka = (prevotes.two_thirds_majority()
@@ -944,6 +955,17 @@ class ConsensusState(BaseService):
                              rs.commit_round, new_state)
         timeline.record(height, "consensus.finalize_commit",
                         round=rs.commit_round, txs=len(block.txs))
+        # per-validator rollup, deferred ONE height: judge height-1 from
+        # last_commit, which kept absorbing straggler precommits through
+        # this height's commit wait (_try_add_votes). Judging the current
+        # height's own vote set here would charge the unneeded-for-quorum
+        # 4th..Nth precommits still in flight as misses and smear honest
+        # validators (missed-vote counters + scorecard, libs/valstats).
+        if rs.last_commit is not None:
+            _valstats.finalize_height(rs.last_commit.height,
+                                      rs.last_commit.round,
+                                      rs.last_commit.val_set,
+                                      rs.last_commit)
         self.update_to_state(new_state)
         self._schedule_round0()
         self._done_first_block.set()
@@ -1006,6 +1028,7 @@ class ConsensusState(BaseService):
         rs.proposal = proposal
         timeline.record(rs.height, timeline.EVENT_PROPOSAL_RECEIVED,
                         round=rs.round)
+        _valstats.on_proposal(rs.height, rs.round, proposer.address)
         if rs.proposal_block_parts is None:
             rs.proposal_block_parts = PartSet(
                 proposal.block_id.parts_total, proposal.block_id.parts_hash)

@@ -5,7 +5,11 @@ probSetChoice over topology, node options, and perturbations).
 Differences from the reference, by design:
 
 - Scaled to this box: the reference caps "large" nets for CPU reasons
-  (generate.go:88 FIXME); on a single core we cap harder (<=6 nodes).
+  (generate.go:88 FIXME). The cap here derives from ``os.cpu_count()``
+  (~2 subprocess nodes per core, floor 6 so a single-core box keeps
+  the historic ceiling, hard ceiling 16); ``TMTPU_E2E_MAX_NODES``
+  overrides it outright for CI boxes whose cgroup quota belies their
+  visible core count.
 - Curve mix is a first-class axis: each validator's key draws from
   ed25519/sr25519/secp256k1 (the reference's codec handles only two
   curves; BASELINE.md "mixed-curve valsets" row).
@@ -19,6 +23,7 @@ reproducible from the seed recorded in its chain_id.
 
 from __future__ import annotations
 
+import os
 import random
 
 from tmtpu.e2e.manifest import LoadSpec, Manifest, NodeSpec, Perturbation
@@ -35,6 +40,21 @@ _BLOCKSYNCS = ["v0", "v0", "v1", "v2"]
 _PERTURBATIONS = {"kill": 0.1, "restart": 0.1, "pause": 0.1}
 
 
+def max_nodes() -> int:
+    """Ceiling on a generated net's node count. Every node is its own
+    subprocess, so the ceiling tracks the host: ~2 nodes per visible
+    core, floored at 6 (the historic single-core cap) and hard-capped
+    at 16 (past that, full-mesh p2p dominates and the net measures the
+    scheduler, not consensus). ``TMTPU_E2E_MAX_NODES`` overrides the
+    derivation for hosts whose cgroup CPU quota is smaller than the
+    core count Python reports. Same seed + same cap -> same manifests."""
+    env = os.environ.get("TMTPU_E2E_MAX_NODES", "")
+    if env:
+        return max(1, int(env))
+    cores = os.cpu_count() or 1
+    return max(6, min(16, cores * 2))
+
+
 def generate_manifest(rng: random.Random, topology: str | None = None,
                       seed_tag: str = "") -> Manifest:
     """One random testnet manifest."""
@@ -43,8 +63,10 @@ def generate_manifest(rng: random.Random, topology: str | None = None,
         n_validators, n_fulls = 1, 0
     elif topology == "quad":
         n_validators, n_fulls = 4, 0
-    else:  # large (bounded: 1 CPU core runs every node as a subprocess)
-        n_validators, n_fulls = 4 + rng.randrange(2), rng.randrange(2)
+    else:  # large (bounded by max_nodes(): each node is a subprocess)
+        cap = max_nodes()
+        n_validators = 4 + rng.randrange(max(1, cap - 4))
+        n_fulls = rng.randrange(min(2, max(0, cap - n_validators)) + 1)
 
     m = Manifest(chain_id=f"gen-{seed_tag or topology}",
                  target_height=8 + rng.randrange(4),

@@ -494,6 +494,58 @@ sync_lock_stall = DEFAULT.counter(
     labels=("lock",))
 
 
+# --- the validator forensics metric set (libs/valstats.py) ------------------
+#
+# Written by the per-validator behavior ledger fed from types/vote_set.py
+# and consensus/state.py. type ∈ {prevote, precommit}; rank is the
+# arrival-rank bucket ("1", "2-4", … ">256") so cardinality stays
+# bounded at 10k-validator sets; the scorecard gauge is per validator
+# address (bounded by the validator set, like the reference's
+# consensus_validator_power). Every name needs a docs/OBSERVABILITY.md
+# row (obs-docs rule).
+
+validator_vote_lag = DEFAULT.histogram(
+    "validator", "vote_lag_seconds",
+    "Per-vote arrival offset from the local prevote/precommit step "
+    "start, labeled by vote type and arrival-rank bucket",
+    labels=("type", "rank"),
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1, 2.5, 5, 10))
+validator_vote_after_quorum = DEFAULT.histogram(
+    "validator", "vote_after_quorum_seconds",
+    "Straggler lag: how far behind the +2/3 crossing a vote arrived "
+    "(only votes landing after quorum observe)",
+    labels=("type",),
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1, 2.5, 5, 10))
+validator_missed_votes = DEFAULT.counter(
+    "validator", "missed_votes_total",
+    "Validator seats absent from the decided round's vote set at "
+    "finalize (one increment per absent validator per height)",
+    labels=("type",))
+validator_missed_proposals = DEFAULT.counter(
+    "validator", "missed_proposals_total",
+    "Propose steps that timed out with no proposal from the scheduled "
+    "proposer")
+validator_equivocations = DEFAULT.counter(
+    "validator", "equivocations_total",
+    "Verified conflicting-block vote pairs observed (one per "
+    "conflicting vote surfaced by the vote set)")
+validator_amnesia = DEFAULT.counter(
+    "validator", "amnesia_total",
+    "Cross-round lock amnesia flags: a validator precommitted two "
+    "different non-nil blocks at the same height in different rounds")
+validator_scorecard = DEFAULT.gauge(
+    "validator", "scorecard",
+    "Decaying per-validator liveness score (1.0 = voted every recent "
+    "height, decays toward 0.0 while absent), refreshed per finalized "
+    "height",
+    labels=("address",))
+validator_tracked = DEFAULT.gauge(
+    "validator", "tracked",
+    "Validators currently resident in the forensics ledger")
+
+
 # --- the crypto batch-verify pipeline metric set ----------------------------
 #
 # Observed at every batch call site: the per-curve device paths

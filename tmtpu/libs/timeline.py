@@ -61,6 +61,10 @@ EVENT_SIDECAR = "crypto.sidecar"
 # submit→commit spans — never one event per tx (the 512-events/height
 # cap must stay for consensus diagnostics)
 EVENT_TX_LATENCY = "tx_latency"
+# validator forensics (libs/valstats.py): one event per +2/3 crossing
+# naming the validator whose vote completed the quorum — the slowest
+# quorum-completing validator — with its arrival rank and step lag
+EVENT_QUORUM_LAGGARD = "quorum.laggard"
 
 
 class Timeline:

@@ -1,0 +1,506 @@
+"""Per-validator consensus forensics — the accountability ledger.
+
+A bounded per-validator behavior ledger answering "WHICH validator is
+costing us": fed from types/vote_set.py (per-vote arrivals, quorum
+crossings, equivocation pairs) and consensus/state.py (step starts,
+proposals, per-height rollups), it records per height×round each
+validator's prevote/precommit arrival offset relative to the step start
+and to the quorum instant, missed votes and missed proposals, nil-vote
+rates, and observed equivocation/amnesia flags — rolled up into a
+decaying liveness/timeliness scorecard per validator.
+
+Surfaces (all riding the existing observability plumbing):
+
+  * ``tendermint_validator_*`` metrics (libs/metrics.py): vote-lag
+    histograms labeled by arrival-rank bucket, missed-vote /
+    missed-proposal / equivocation / amnesia counters, a per-address
+    scorecard gauge;
+  * one ``quorum.laggard`` timeline event per quorum crossing naming
+    the validator whose vote completed the +2/3 (libs/timeline.py);
+  * the ``validator_stats`` JSON-RPC method and ``GET
+    /debug/validators`` (rpc/core.py, rpc/pprof.py);
+  * ``tools/validator_report.py`` joins per-node snapshots by validator
+    address fleet-wide, and the ``laggard_identified`` scenario oracle
+    (tmtpu/scenario/oracles.py) turns the snapshot into a machine
+    verdict.
+
+Scorecard semantics: per finalized height every validator in the set
+contributes one observation — 1.0 if its precommit made the decided
+round's vote set, 0.0 if it was absent — folded into an EWMA with decay
+``_DEFAULT_DECAY`` (a freshly-seen validator starts at 1.0, innocent
+until absent). Timeliness is a separate EWMA of vote arrival offsets
+from the step start, in ms. Participation *state changes* between
+consecutive finalized heights count as flaps (the watchdog
+``validator_flap_check`` windows these).
+
+Bounded like libs/txlat: validators are an LRU-capped OrderedDict
+(``_DEFAULT_VALIDATOR_CAP``, each record O(1) aggregates plus a tiny
+recent-votes deque), in-flight (height, round) contexts are FIFO-capped
+at ``_DEFAULT_ROUND_CAP``. Gated by the ``[instr] valstats`` knob: the
+module-level fast paths check ``enabled`` before touching anything, so
+a disabled node pays one attribute read per call site.
+
+NOTE: like libs/metrics and libs/timeline, the DEFAULT instance is
+process-global. In-process multi-node tests share one ledger; per-node
+attribution (the fleet report, the scenario oracle) requires subprocess
+nodes (tmtpu/e2e).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional, Tuple
+
+from tmtpu.libs import metrics as _m
+from tmtpu.libs import timeline as _timeline
+
+# timeline event names this module records — the analysis obs-docs rule
+# parses this tuple statically; every entry needs a backticked
+# docs/OBSERVABILITY.md row
+VALSTATS_EVENTS = ("quorum.laggard",)
+
+# per-validator aggregate records kept before LRU eviction; sized for
+# the paper's 10k-validator sets with headroom (one record is O(1))
+_DEFAULT_VALIDATOR_CAP = 16384
+
+# in-flight (height, round) step/arrival contexts; rounds resolve within
+# a couple of heights, so this is heights×rounds of lookback
+_DEFAULT_ROUND_CAP = 64
+
+# recent per-vote detail entries kept per validator for the snapshot
+_RECENT_PER_VALIDATOR = 8
+
+# per-height EWMA decay of the liveness scorecard: score_h =
+# decay*score + (1-decay)*participated. 0.8 ≈ 3 missed heights take a
+# healthy validator under 0.52 — far below any live peer
+_DEFAULT_DECAY = 0.8
+
+# EWMA decay of the arrival-offset timeliness figure (per vote)
+_LAG_DECAY = 0.8
+
+# vote types (types/vote.py SignedMsgType values) — kept as a local map
+# so this module stays an import leaf like txlat/timeline
+_TYPE_NAMES = {1: "prevote", 2: "precommit"}
+
+# consensus steps whose start instants anchor arrival offsets
+_VOTE_STEPS = {1: "prevote", 2: "precommit"}
+
+
+def _rank_bucket(rank: int) -> str:
+    """Arrival-rank label with bounded cardinality at 10k validators."""
+    if rank <= 1:
+        return "1"
+    if rank <= 4:
+        return "2-4"
+    if rank <= 16:
+        return "5-16"
+    if rank <= 64:
+        return "17-64"
+    if rank <= 256:
+        return "65-256"
+    return ">256"
+
+
+def _type_name(t: int) -> str:
+    return _TYPE_NAMES.get(t, f"type{t}")
+
+
+def _addr_hex(address) -> str:
+    if isinstance(address, bytes):
+        return address.hex()
+    return str(address)
+
+
+class _RoundCtx:
+    """Per-(height, round) timing context: step starts, arrival ranks,
+    quorum instants. Tiny and FIFO-evicted."""
+
+    __slots__ = ("steps", "arrivals", "quorum_t")
+
+    def __init__(self):
+        self.steps: Dict[str, int] = {}        # step name -> t_ns
+        self.arrivals: Dict[int, int] = {}     # vote type -> count
+        self.quorum_t: Dict[int, int] = {}     # vote type -> t_ns
+
+
+def _new_val(address: str) -> Dict:
+    return {
+        "address": address,
+        "index": -1,
+        "power": 0,
+        "votes": 0,
+        "nil_votes": 0,
+        "missed_votes": 0,
+        "proposals": 0,
+        "missed_proposals": 0,
+        "equivocations": 0,
+        "amnesia": 0,
+        "flaps": 0,
+        "score": 1.0,
+        "lag_ewma_ms": None,
+        "last_height": 0,
+        "last_voted": None,          # participation at the last rollup
+        "last_precommit": None,      # (height, round, block_key) non-nil
+        "recent": deque(maxlen=_RECENT_PER_VALIDATOR),
+    }
+
+
+class ValStats:
+    """Bounded per-validator forensics ledger. All methods thread-safe."""
+
+    def __init__(self, validator_cap: int = _DEFAULT_VALIDATOR_CAP,
+                 decay: float = _DEFAULT_DECAY):
+        self.validator_cap = max(16, validator_cap)
+        self.decay = min(max(decay, 0.0), 0.999)
+        self._vals: "OrderedDict[str, Dict]" = OrderedDict()
+        self._rounds: "OrderedDict[Tuple[int, int], _RoundCtx]" = \
+            OrderedDict()
+        self._lock = threading.Lock()
+        self._enabled = True
+        self._evicted = 0
+        self._finalized_height = 0
+        self._heights_finalized = 0
+
+    # -- internal helpers ---------------------------------------------------
+
+    def _round_ctx(self, height: int, round_: int) -> _RoundCtx:
+        key = (height, round_)
+        ctx = self._rounds.get(key)
+        if ctx is None:
+            ctx = _RoundCtx()
+            self._rounds[key] = ctx
+            while len(self._rounds) > _DEFAULT_ROUND_CAP:
+                self._rounds.popitem(last=False)
+        return ctx
+
+    def _val(self, address: str) -> Dict:
+        rec = self._vals.get(address)
+        if rec is None:
+            rec = _new_val(address)
+            self._vals[address] = rec
+            while len(self._vals) > self.validator_cap:
+                self._vals.popitem(last=False)
+                self._evicted += 1
+        else:
+            self._vals.move_to_end(address)
+        return rec
+
+    # -- recording (consensus/state.py hooks) -------------------------------
+
+    def begin_step(self, height: int, round_: int, step: str,
+                   t_ns: Optional[int] = None) -> None:
+        """Anchor ``step``'s start for (height, round) — the baseline
+        every vote arrival offset is measured from. First write wins
+        (WAL replay / catchup re-entry must not move the anchor)."""
+        if not self._enabled or height <= 0:
+            return
+        now = time.perf_counter_ns() if t_ns is None else t_ns
+        with self._lock:
+            self._round_ctx(height, round_).steps.setdefault(step, now)
+
+    def on_vote(self, vote, power: int,
+                t_ns: Optional[int] = None) -> None:
+        """One freshly-added verified vote (types/vote_set.py
+        ``_add_verified``, fresh-add branch). Records the arrival offset
+        from the step start (falling back to first-arrival when votes
+        outran the step transition — out-of-order gossip), the arrival
+        rank, nil-ness, and the cross-round amnesia check."""
+        if not self._enabled or vote.height <= 0:
+            return
+        now = time.perf_counter_ns() if t_ns is None else t_ns
+        tname = _type_name(vote.type)
+        is_nil = vote.block_id.is_zero()
+        addr = _addr_hex(vote.validator_address)
+        with self._lock:
+            ctx = self._round_ctx(vote.height, vote.round)
+            # votes can outrun the local step transition (gossip from a
+            # faster peer): the first arrival then anchors the offset
+            t0 = ctx.steps.setdefault(_VOTE_STEPS.get(vote.type, tname),
+                                      now)
+            offset_s = max(0, now - t0) / 1e9
+            rank = ctx.arrivals.get(vote.type, 0) + 1
+            ctx.arrivals[vote.type] = rank
+            quorum_t = ctx.quorum_t.get(vote.type)
+
+            rec = self._val(addr)
+            rec["index"] = vote.validator_index
+            rec["power"] = power
+            rec["votes"] += 1
+            if is_nil:
+                rec["nil_votes"] += 1
+            ms = offset_s * 1e3
+            prev = rec["lag_ewma_ms"]
+            rec["lag_ewma_ms"] = ms if prev is None else \
+                _LAG_DECAY * prev + (1.0 - _LAG_DECAY) * ms
+            detail = {"height": vote.height, "round": vote.round,
+                      "type": tname, "offset_ms": round(ms, 3),
+                      "rank": rank, "nil": is_nil}
+            if quorum_t is not None:
+                detail["after_quorum_ms"] = round(
+                    max(0, now - quorum_t) / 1e6, 3)
+            rec["recent"].append(detail)
+
+            # amnesia flag: a non-nil precommit for a DIFFERENT block
+            # than an earlier-round non-nil precommit at the same height
+            # (the validator "forgot" its lock; same-round conflicts are
+            # equivocation and handled separately)
+            if vote.type == 2 and not is_nil:
+                key = vote.block_id.key()
+                last = rec["last_precommit"]
+                if last is not None and last[0] == vote.height and \
+                        last[1] < vote.round and last[2] != key:
+                    rec["amnesia"] += 1
+                    _m.validator_amnesia.inc()
+                rec["last_precommit"] = (vote.height, vote.round, key)
+        _m.validator_vote_lag.observe(offset_s, type=tname,
+                                      rank=_rank_bucket(rank))
+        if quorum_t is not None:
+            _m.validator_vote_after_quorum.observe(
+                max(0, now - quorum_t) / 1e9, type=tname)
+
+    def on_quorum(self, vote, t_ns: Optional[int] = None) -> None:
+        """The +2/3 crossing (types/vote_set.py): ``vote`` is the vote
+        that completed the quorum, so its signer is the slowest
+        quorum-completing validator — named in one ``quorum.laggard``
+        timeline event per crossing."""
+        if not self._enabled or vote.height <= 0:
+            return
+        now = time.perf_counter_ns() if t_ns is None else t_ns
+        tname = _type_name(vote.type)
+        addr = _addr_hex(vote.validator_address)
+        with self._lock:
+            ctx = self._round_ctx(vote.height, vote.round)
+            ctx.quorum_t.setdefault(vote.type, now)
+            t0 = ctx.steps.get(_VOTE_STEPS.get(vote.type, tname), now)
+            rank = ctx.arrivals.get(vote.type, 0)
+        _timeline.record(
+            vote.height, EVENT_QUORUM_LAGGARD, round=vote.round,
+            type=tname, address=addr, rank=rank,
+            lag_ms=round(max(0, now - t0) / 1e6, 3))
+
+    def on_proposal(self, height: int, round_: int, proposer_address,
+                    t_ns: Optional[int] = None) -> None:
+        """A complete, signature-valid proposal was accepted
+        (consensus/state.py ``_set_proposal``); credit the proposer and
+        record its lateness relative to the propose step start."""
+        if not self._enabled or height <= 0:
+            return
+        now = time.perf_counter_ns() if t_ns is None else t_ns
+        addr = _addr_hex(proposer_address)
+        with self._lock:
+            ctx = self._round_ctx(height, round_)
+            t0 = ctx.steps.get("propose", now)
+            rec = self._val(addr)
+            rec["proposals"] += 1
+            rec["recent"].append(
+                {"height": height, "round": round_, "type": "proposal",
+                 "offset_ms": round(max(0, now - t0) / 1e6, 3)})
+
+    def on_missed_proposal(self, height: int, round_: int,
+                           proposer_address) -> None:
+        """The propose step timed out with no proposal on the floor
+        (consensus/state.py ``_handle_timeout`` STEP_PROPOSE): the
+        scheduled proposer never delivered."""
+        if not self._enabled or height <= 0:
+            return
+        addr = _addr_hex(proposer_address)
+        with self._lock:
+            rec = self._val(addr)
+            rec["missed_proposals"] += 1
+            rec["recent"].append({"height": height, "round": round_,
+                                  "type": "missed_proposal"})
+        _m.validator_missed_proposals.inc()
+
+    def on_equivocation(self, vote) -> None:
+        """A verified conflicting-block vote pair surfaced
+        (types/vote_set.py ``add_votes``); flag the signer."""
+        if not self._enabled or vote.height <= 0:
+            return
+        addr = _addr_hex(vote.validator_address)
+        with self._lock:
+            rec = self._val(addr)
+            rec["equivocations"] += 1
+            rec["recent"].append(
+                {"height": vote.height, "round": vote.round,
+                 "type": "equivocation",
+                 "vote_type": _type_name(vote.type)})
+        _m.validator_equivocations.inc()
+
+    def finalize_height(self, height: int, round_: int, val_set,
+                        precommits) -> None:
+        """Per-height rollup at finalize-commit: for every validator in
+        the set, did its precommit make the decided round's vote set?
+        Misses count, participation folds into the decaying scorecard,
+        participation EDGES count as flaps, and the per-address
+        scorecard gauge is refreshed. Idempotent per height (WAL replay
+        re-finalizes heights; only the first pass counts)."""
+        if not self._enabled or height <= 0 or val_set is None or \
+                precommits is None:
+            return
+        decay = self.decay
+        seats = []  # (addr_hex, power, voted, nil)
+        for idx, v in enumerate(val_set.validators):
+            vote = precommits.get_by_index(idx)
+            seats.append((_addr_hex(v.address), v.voting_power,
+                          vote is not None,
+                          vote is not None and vote.block_id.is_zero()))
+        scores = []
+        missed = 0
+        with self._lock:
+            if height <= self._finalized_height:
+                return
+            self._finalized_height = height
+            self._heights_finalized += 1
+            for addr, power, voted, is_nil in seats:
+                rec = self._val(addr)
+                rec["power"] = power
+                rec["last_height"] = height
+                if not voted:
+                    rec["missed_votes"] += 1
+                    missed += 1
+                last = rec["last_voted"]
+                if last is not None and last != voted:
+                    rec["flaps"] += 1
+                rec["last_voted"] = voted
+                rec["score"] = decay * rec["score"] + \
+                    (1.0 - decay) * (1.0 if voted else 0.0)
+                scores.append((addr, rec["score"]))
+            # drop round contexts this height can no longer need
+            while self._rounds and next(iter(self._rounds))[0] <= height:
+                self._rounds.popitem(last=False)
+            tracked = len(self._vals)
+        for _ in range(missed):
+            _m.validator_missed_votes.inc(type="precommit")
+        for addr, score in scores:
+            _m.validator_scorecard.set(round(score, 6), address=addr)
+        _m.validator_tracked.set(tracked)
+
+    # -- reading ------------------------------------------------------------
+
+    def flap_counts(self) -> Dict[str, int]:
+        """{address: cumulative participation flaps} — the watchdog
+        ``validator_flap_check`` windows deltas of this."""
+        with self._lock:
+            return {a: r["flaps"] for a, r in self._vals.items()}
+
+    def snapshot(self, limit: int = 256) -> Dict:
+        """The ``validator_stats`` JSON-RPC payload: per-validator
+        aggregates ordered worst-scorecard-first (capped at ``limit``),
+        the worst-offender shortlist, and the named laggard. Pure local
+        observation — every node answers from its own ledger, so a
+        fleet join (tools/validator_report.py) cross-checks that honest
+        nodes agree."""
+        with self._lock:
+            recs = [dict(r, recent=list(r["recent"]))
+                    for r in self._vals.values()]
+            finalized = self._finalized_height
+            heights = self._heights_finalized
+            evicted = self._evicted
+        for r in recs:
+            if r["lag_ewma_ms"] is not None:
+                r["lag_ewma_ms"] = round(r["lag_ewma_ms"], 3)
+            r["score"] = round(r["score"], 6)
+            r.pop("last_precommit", None)
+        # worst first: lowest score, then most misses, then address
+        recs.sort(key=lambda r: (r["score"], -r["missed_votes"],
+                                 r["address"]))
+        worst = [{"address": r["address"], "score": r["score"],
+                  "missed_votes": r["missed_votes"],
+                  "missed_proposals": r["missed_proposals"],
+                  "equivocations": r["equivocations"],
+                  "amnesia": r["amnesia"], "flaps": r["flaps"],
+                  "lag_ewma_ms": r["lag_ewma_ms"]}
+                 for r in recs[:8]]
+        laggard = None
+        if len(recs) >= 2 and recs[0]["score"] < recs[1]["score"]:
+            laggard = recs[0]["address"]
+        elif len(recs) == 1:
+            laggard = recs[0]["address"]
+        return {"enabled": self._enabled,
+                "validators": {r["address"]: r
+                               for r in recs[:max(0, limit)]},
+                "count": len(recs), "evicted": evicted,
+                "finalized_height": finalized,
+                "heights_finalized": heights,
+                "worst": worst, "laggard": laggard}
+
+    # -- control ------------------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def set_enabled(self, enabled: bool) -> None:
+        self._enabled = bool(enabled)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._vals.clear()
+            self._rounds.clear()
+            self._evicted = 0
+            self._finalized_height = 0
+            self._heights_finalized = 0
+
+
+EVENT_QUORUM_LAGGARD = VALSTATS_EVENTS[0]
+
+DEFAULT = ValStats()
+
+
+def enabled() -> bool:
+    return DEFAULT._enabled
+
+
+def begin_step(height: int, round_: int, step: str) -> None:
+    if DEFAULT._enabled:
+        DEFAULT.begin_step(height, round_, step)
+
+
+def on_vote(vote, power: int) -> None:
+    if DEFAULT._enabled:
+        DEFAULT.on_vote(vote, power)
+
+
+def on_quorum(vote) -> None:
+    if DEFAULT._enabled:
+        DEFAULT.on_quorum(vote)
+
+
+def on_proposal(height: int, round_: int, proposer_address) -> None:
+    if DEFAULT._enabled:
+        DEFAULT.on_proposal(height, round_, proposer_address)
+
+
+def on_missed_proposal(height: int, round_: int, proposer_address) -> None:
+    if DEFAULT._enabled:
+        DEFAULT.on_missed_proposal(height, round_, proposer_address)
+
+
+def on_equivocation(vote) -> None:
+    if DEFAULT._enabled:
+        DEFAULT.on_equivocation(vote)
+
+
+def finalize_height(height: int, round_: int, val_set, precommits) -> None:
+    if DEFAULT._enabled:
+        DEFAULT.finalize_height(height, round_, val_set, precommits)
+
+
+def flap_counts() -> Dict[str, int]:
+    if DEFAULT._enabled:
+        return DEFAULT.flap_counts()
+    return {}
+
+
+def snapshot(limit: int = 256) -> Dict:
+    return DEFAULT.snapshot(limit=limit)
+
+
+def set_enabled(enabled: bool) -> None:
+    DEFAULT.set_enabled(enabled)
+
+
+def clear() -> None:
+    DEFAULT.clear()

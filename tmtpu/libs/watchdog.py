@@ -289,8 +289,13 @@ def latency_slo_check(slo_ms: float, window_s: float = 30.0,
     def check() -> Tuple[bool, str, Dict]:
         now = time.monotonic()
         counts = _m.tx_latency_submit_to_commit.bucket_counts()
-        if counts:
-            samples.append((now, counts))
+        if not counts:
+            # nothing observed yet: seed an all-zero baseline so the
+            # FIRST real traffic after startup is judged against it
+            # instead of waiting one extra tick for a second snapshot
+            counts = (0,) * (len(_m.tx_latency_submit_to_commit.buckets)
+                             + 1)
+        samples.append((now, counts))
         while samples and samples[0][0] < now - window_s:
             samples.pop(0)
         details: Dict = {"slo_ms": slo_ms, "window_s": window_s,
@@ -322,6 +327,50 @@ def latency_slo_check(slo_ms: float, window_s: float = 30.0,
             return (False,
                     f"p99 submit->commit {p99_ms:.1f}ms over SLO "
                     f"{slo_ms:.0f}ms for {streak['n']} samples",
+                    details)
+        return True, "", details
+
+    return check
+
+
+def validator_flap_check(window_s: float = 60.0,
+                         threshold: int = 3) -> CheckFn:
+    """Unhealthy when any tracked validator's participation state
+    flip-flopped at least ``threshold`` times within the trailing
+    ``window_s``. Flap counts come from the per-validator forensics
+    ledger (libs/valstats.py): one flap is recorded at each height
+    rollup where a validator's voted/missed state differs from the
+    previous rollup, so a validator oscillating between present and
+    absent — crash-looping, link-flapping, or being throttled — trips
+    this check while a cleanly-down or cleanly-up validator does not.
+    The reason names the flappiest validator so /healthz carries the
+    attribution. Registered only when ``[instr] valstats`` is on and
+    ``[health] validator_flap_threshold`` > 0 (node/node.py)."""
+    from tmtpu.libs import valstats as _vs
+
+    # (t, cumulative per-address flap counts)
+    samples: List[Tuple[float, Dict[str, int]]] = []
+
+    def check() -> Tuple[bool, str, Dict]:
+        now = time.monotonic()
+        counts = _vs.flap_counts()
+        samples.append((now, dict(counts)))
+        while samples and samples[0][0] < now - window_s:
+            samples.pop(0)
+        base = samples[0][1]
+        worst_addr, worst_delta = "", 0
+        for addr, total in counts.items():
+            delta = total - base.get(addr, 0)
+            if delta > worst_delta:
+                worst_addr, worst_delta = addr, delta
+        details: Dict = {"window_s": window_s, "threshold": threshold,
+                         "flaps_in_window": worst_delta}
+        if worst_addr:
+            details["validator"] = worst_addr
+        if worst_delta >= threshold:
+            return (False,
+                    f"validator {worst_addr} flapped {worst_delta} times "
+                    f"in {window_s:.0f}s (threshold {threshold})",
                     details)
         return True, "", details
 

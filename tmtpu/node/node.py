@@ -48,8 +48,12 @@ class Node(BaseService):
         # subsystem can stamp (the module fast paths read this flag)
         from tmtpu.libs import trace as _trace
         from tmtpu.libs import txlat as _txlat
+        from tmtpu.libs import valstats as _valstats
 
         _txlat.set_enabled(config.instrumentation.txlat)
+        # [instr] valstats gates the per-validator forensics ledger the
+        # same way (off ⇒ every vote-path hook is one attribute read)
+        _valstats.set_enabled(config.instrumentation.valstats)
         # [instr] trace_sample gates cross-process trace contexts the
         # same way (0 ⇒ the node neither mints nor adopts contexts);
         # node/chain identity lands below once known
@@ -428,6 +432,12 @@ class Node(BaseService):
                 instr.latency_slo_ms,
                 window_s=hc.latency_slo_window_ns / 1e9,
                 consecutive=hc.latency_slo_samples))
+        if instr.valstats and hc.validator_flap_threshold > 0:
+            # armed only when the forensics ledger is on (without it the
+            # flap counts never move and the check would idle forever)
+            wd.register("validator", wdg.validator_flap_check(
+                window_s=hc.validator_flap_window_ns / 1e9,
+                threshold=hc.validator_flap_threshold))
         if self.config.base.crypto_backend != "cpu":
             wd.register("crypto", wdg.tpu_backend_check(
                 hc.fallback_storm_window_ns / 1e9,

@@ -162,6 +162,9 @@ class HTTPClient:
     def txlat(self, limit: int = 64):
         return self.call("txlat", limit=str(limit))
 
+    def validator_stats(self, limit: int = 256):
+        return self.call("validator_stats", limit=str(limit))
+
     def traces(self, limit: int = 4096, keep: bool = True,
                trace_id: Optional[str] = None,
                client_wall: Optional[float] = None):

@@ -765,6 +765,31 @@ def build_routes(env: Environment) -> dict:
         tools/fleet_report.py correlates across nodes."""
         return txlat.snapshot(limit=int(limit))
 
+    def validator_stats(limit="256"):
+        """Per-validator consensus forensics snapshot (libs/valstats):
+        decaying liveness/timeliness scorecards, vote-arrival lag EWMAs,
+        missed-vote/missed-proposal counters, equivocation and amnesia
+        flags, and recent per-vote arrival details keyed by validator
+        address — worst-scored validators first, with the node's
+        ``laggard`` verdict when one validator is strictly worst. The
+        ``node`` envelope carries this node's own validator address so
+        tools/validator_report.py can join per-node views (and the
+        scenario oracle can map a node name to the address every honest
+        peer should blame) from public RPC evidence alone."""
+        from tmtpu.libs import valstats as _vs
+
+        pub = node.priv_validator.get_pub_key() if node.priv_validator \
+            else None
+        snap = _vs.snapshot(limit=int(limit))
+        snap["node"] = {
+            "node_id": getattr(node, "node_id", ""),
+            "moniker": node.config.base.moniker,
+            # lowercase hex, NOT _hex(): this field exists to be joined
+            # against the ledger's validator keys (bytes.hex())
+            "validator_address": pub.address().hex() if pub else "",
+        }
+        return snap
+
     def health_detail():
         """Aggregated watchdog verdicts (libs/watchdog): consensus
         progress, p2p peer count, mempool drain, blocksync/statesync
@@ -847,6 +872,7 @@ def build_routes(env: Environment) -> dict:
         "metrics": metrics, "timeline": timeline,
         "traces": traces,
         "txlat": txlat_report,
+        "validator_stats": validator_stats,
         "health_detail": health_detail,
         "genesis_chunked": genesis_chunked, "check_tx": check_tx,
         "net_info": net_info, "blockchain": blockchain, "block": block,

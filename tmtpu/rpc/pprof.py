@@ -23,6 +23,11 @@ Python-native equivalents of the Go pprof profiles:
     /debug/txlat             per-tx lifecycle latency snapshot
                              (libs/txlat) as JSON; ?limit=N for the
                              recent-journey window size
+    /debug/validators        per-validator consensus forensics ledger
+                             (libs/valstats) as JSON — scorecards,
+                             vote-lag EWMAs, missed votes/proposals,
+                             equivocation/amnesia flags; ?limit=N caps
+                             the validator records returned
     /metrics                 Prometheus text exposition (libs/metrics) —
                              the scrape target standard collectors expect
     /healthz                 liveness: 200 when every watchdog check
@@ -146,7 +151,8 @@ class _Handler(BaseHTTPRequestHandler):
                         "/debug/traces[?format=jsonl|fleet][&keep=1]; "
                         "timeline "
                         "at /debug/timeline; tx lifecycle latency at "
-                        "/debug/txlat[?limit=N]; /metrics, /healthz, "
+                        "/debug/txlat[?limit=N]; validator forensics at "
+                        "/debug/validators[?limit=N]; /metrics, /healthz, "
                         "/readyz\n")
             elif path == "/debug/traces":
                 body, ctype = render_traces(
@@ -170,6 +176,12 @@ class _Handler(BaseHTTPRequestHandler):
 
                 body = json.dumps(txlat.snapshot(
                     limit=int(q.get("limit", ["64"])[0])))
+                ctype = "application/json"
+            elif path == "/debug/validators":
+                from tmtpu.libs import valstats
+
+                body = json.dumps(valstats.snapshot(
+                    limit=int(q.get("limit", ["256"])[0])))
                 ctype = "application/json"
             elif path == "/metrics":
                 from tmtpu.libs import metrics

@@ -214,7 +214,7 @@ class ScenarioEngine:
         for node in self.net.nodes:
             snap = {"final_height": -1, "running": node.running,
                     "health": None, "metrics": None, "timeline": None,
-                    "txlat": None, "blocks": {}}
+                    "txlat": None, "validator_stats": None, "blocks": {}}
             if node.proc is not None:
                 try:
                     st = node.client.status()
@@ -224,6 +224,8 @@ class ScenarioEngine:
                     snap["metrics"] = node.client.metrics()
                     snap["timeline"] = node.client.timeline(last=100)
                     snap["txlat"] = node.client.txlat(limit=256)
+                    snap["validator_stats"] = \
+                        node.client.validator_stats(limit=256)
                     snap["blocks"] = self._fetch_blocks(
                         node, snap["final_height"])
                 except Exception as e:

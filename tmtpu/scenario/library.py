@@ -249,6 +249,77 @@ def crash_restart_wal() -> ScenarioSpec:
         ])
 
 
+def laggard() -> ScenarioSpec:
+    """SIGSTOP one validator for 10 s under load — the classic 'one box
+    went dark' incident. The majority keeps committing (3/4 power); the
+    forensics ledgers on every honest node must accumulate the frozen
+    validator's missed votes into the worst scorecard, so judge time
+    names the exact validator from public RPC evidence alone
+    (laggard_identified). The pause starts only after the net is
+    demonstrably committing so the ledgers have a participation
+    baseline to decay from.
+
+    Runs with a real commit wait (production profile) instead of the
+    e2e fast profile's skip_timeout_commit: the forensics rollup judges
+    height H from last_commit when H+1 commits, and last_commit only
+    absorbs straggler precommits during the NEW_HEIGHT wait — with a
+    zero wait a fast node charges the quorum-surplus 4th precommit as
+    a miss and the scorecards smear across honest validators."""
+    return ScenarioSpec(
+        name="laggard",
+        description="SIGSTOP a validator 10s: every honest forensics "
+                    "ledger names it as the laggard",
+        validators=4, load_rate=10.0, duration_s=24.0, settle_s=5.0,
+        config={
+            "consensus.skip_timeout_commit": False,
+            "consensus.timeout_commit_ns": SECOND_NS // 4,
+        },
+        faults=[
+            FaultAction(6.0, "pause", node="v03",
+                        params={"for_s": 10.0}),
+        ],
+        oracles=[
+            OracleSpec("laggard_identified", {"node": "v03",
+                                              "min_reporters": 2}),
+            OracleSpec("chain_agreement"),
+            OracleSpec("height_min", {"min": 6,
+                                      "nodes": ["v00", "v01", "v02"]}),
+        ])
+
+
+def amnesia() -> ScenarioSpec:
+    """Wipe a validator's double-sign protection (privval last-sign
+    state) twice under load — the amnesiac validator from the
+    fork-accountability literature. Each wipe is a SIGKILL + state
+    delete + restart, so the amnesiac misses votes across both
+    downtimes and flaps its participation state; every honest node's
+    forensics ledger must pin the worst scorecard on it (amnesiac
+    named from public RPC evidence) while the chain stays in perfect
+    agreement — amnesia must never fork state on a net that keeps
+    2/3+ honest. Same commit-wait profile as ``laggard`` (see there:
+    straggler absorption needs a real NEW_HEIGHT window)."""
+    return ScenarioSpec(
+        name="amnesia",
+        description="double privval-state wipe: honest ledgers name the "
+                    "amnesiac, zero divergence",
+        validators=4, load_rate=10.0, duration_s=22.0, settle_s=6.0,
+        config={
+            "consensus.skip_timeout_commit": False,
+            "consensus.timeout_commit_ns": SECOND_NS // 4,
+        },
+        faults=[
+            FaultAction(6.0, "amnesia", node="v03"),
+            FaultAction(13.0, "amnesia", node="v03"),
+        ],
+        oracles=[
+            OracleSpec("laggard_identified", {"node": "v03",
+                                              "min_reporters": 2}),
+            OracleSpec("chain_agreement"),
+            OracleSpec("height_min", {"min": 6,
+                                      "nodes": ["v00", "v01", "v02"]}),
+        ])
+
+
 SCENARIOS = {
     "split_brain": split_brain,
     "sidecar_crash_storm": sidecar_crash_storm,
@@ -259,6 +330,8 @@ SCENARIOS = {
     "statesync_join": statesync_join,
     "latency_under_load": latency_under_load,
     "crash_restart_wal": crash_restart_wal,
+    "laggard": laggard,
+    "amnesia": amnesia,
 }
 
 # cheap enough for tier-1 (the ``scenarios`` pytest marker)

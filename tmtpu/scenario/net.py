@@ -21,6 +21,7 @@ scenarios need on top:
 
 from __future__ import annotations
 
+import json
 import os
 import signal
 import socket
@@ -245,15 +246,20 @@ class ScenarioNet(Runner):
     def amnesia(self, name: str) -> None:
         """Crash ``name`` and wipe its double-sign protection (the
         privval last-signed state) before restarting — the amnesiac
-        validator from the fork-accountability literature."""
+        validator from the fork-accountability literature. The state
+        file is RESET to the zeroed watermark, not deleted: FilePV.load
+        refuses to start when the file is missing outright (a missing
+        file is indistinguishable from corruption), while a height-0
+        watermark is exactly what a validator that forgot everything it
+        signed looks like."""
         node = self.node(name)
         node.signal(signal.SIGKILL)
         if node.proc is not None:
             node.proc.wait(10)
         cfg = self._node_config(node)
         state = cfg.rooted(cfg.base.priv_validator_state_file)
-        if os.path.exists(state):
-            os.unlink(state)
+        with open(state, "w") as f:
+            json.dump({"height": "0", "round": 0, "step": 0}, f)
         node.start()
 
     def stop(self):

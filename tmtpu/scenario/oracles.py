@@ -27,6 +27,7 @@ class Evidence:
          "metrics": metrics result | None,
          "timeline": timeline result | None,
          "txlat": txlat result | None,
+         "validator_stats": validator_stats result | None,
          "blocks": {height: block json}}
 
     ``samples`` is the health time-series ({"t", "node", "height",
@@ -98,6 +99,23 @@ class Evidence:
         "p99_ms", "max_ms"}; count 0 when it submitted nothing)."""
         snap = self.nodes.get(node, {}).get("txlat") or {}
         return snap.get("submit_to_commit") or {"count": 0}
+
+    def validator_address(self, node: str) -> str:
+        """The validator address ``node`` itself reports in its
+        validator_stats envelope ('' when unavailable)."""
+        snap = self.nodes.get(node, {}).get("validator_stats") or {}
+        return (snap.get("node") or {}).get("validator_address", "")
+
+    def blamed_validator(self, node: str) -> Optional[str]:
+        """The validator address ``node``'s forensics ledger names as
+        the net's laggard: the strictly-worst scorecard when the ledger
+        has a clear verdict, else the head of the worst-scored list."""
+        snap = self.nodes.get(node, {}).get("validator_stats") or {}
+        blamed = snap.get("laggard")
+        if not blamed:
+            worst = snap.get("worst") or []
+            blamed = worst[0]["address"] if worst else None
+        return blamed
 
     def timeline_event_names(self, node: str) -> List[str]:
         tl = self.nodes.get(node, {}).get("timeline") or {}
@@ -329,6 +347,41 @@ def no_evidence(ev: Evidence) -> Tuple[bool, str]:
             for n, v in hits.items() if v}
     return not hits, f"unexpected evidence: {hits}" if hits else \
         "no evidence committed"
+
+
+@oracle
+def laggard_identified(ev: Evidence, node: str, min_reporters: int = 2) \
+        -> Tuple[bool, str]:
+    """Every honest node's validator-forensics ledger independently
+    blames the validator operated by ``node`` — attribution from public
+    RPC evidence alone. The expected address comes out of the accused
+    node's own ``validator_stats`` envelope (each node reports its own
+    validator address there), so the oracle never peeks at process
+    internals; every other honest node's ledger must name that address
+    as its worst-scored laggard, and at least ``min_reporters`` of them
+    must have reached a verdict."""
+    expected = ev.validator_address(node)
+    if not expected:
+        return False, (f"{node} reported no validator address in its "
+                       f"validator_stats envelope")
+    verdicts: Dict[str, str] = {}
+    for n in ev.honest():
+        if n == node:
+            continue
+        blamed = ev.blamed_validator(n)
+        if blamed:
+            verdicts[n] = blamed
+    agree = sorted(n for n, a in verdicts.items() if a == expected)
+    wrong = {n: a[:12] for n, a in verdicts.items() if a != expected}
+    if wrong:
+        return False, (f"disagreement: {wrong} blame someone other than "
+                       f"{node} ({expected[:12]}…); agreeing: {agree}")
+    if len(agree) < min_reporters:
+        return False, (f"only {len(agree)} honest nodes reached a "
+                       f"laggard verdict (need {min_reporters}); "
+                       f"verdicts: {verdicts}")
+    return True, (f"{len(agree)} honest nodes independently name {node} "
+                  f"({expected[:12]}…) as the laggard: {agree}")
 
 
 # -- metrics / timeline -------------------------------------------------------

@@ -20,6 +20,7 @@ from typing import Dict, List, Optional, Tuple
 from tmtpu.crypto import batch as crypto_batch
 from tmtpu.libs import metrics as _metrics
 from tmtpu.libs import timeline, trace
+from tmtpu.libs import valstats as _valstats
 from tmtpu.libs.bits import BitArray
 from tmtpu.types.block import BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT, \
     BLOCK_ID_FLAG_NIL, BlockID, Commit, CommitSig
@@ -193,8 +194,14 @@ class VoteSet:
                     if added and fused:
                         applied_power += val.voting_power
                     results[i] = added
-                    if conflicting is not None and conflict is None:
-                        conflict = ErrVoteConflictingVotes(conflicting, vote)
+                    if conflicting is not None:
+                        # equivocation flag BEFORE the single-raise
+                        # fold: every conflicting pair is ledgered even
+                        # when several land in one batch
+                        _valstats.on_equivocation(vote)
+                        if conflict is None:
+                            conflict = ErrVoteConflictingVotes(
+                                conflicting, vote)
                 if fused:
                     # every valid lane was a fresh add, so the device sum IS
                     # the _sum delta; a divergence from the host bookkeeping
@@ -278,6 +285,9 @@ class VoteSet:
             self._votes_bit_array.set_index(idx, True)
             if not defer_sum:
                 self._sum += val.voting_power
+            # per-validator forensics: arrival offset/rank for this
+            # fresh vote (disabled: one attribute read)
+            _valstats.on_vote(vote, val.voting_power)
 
         bv = self._votes_by_block.get(key)
         if bv is not None:
@@ -323,6 +333,9 @@ class VoteSet:
                     self.height,
                     "precommit_q" if self.signed_msg_type == PRECOMMIT
                     else "prevote_q")
+            # the vote that crossed the +2/3 names the slowest
+            # quorum-completing validator (quorum.laggard event)
+            _valstats.on_quorum(vote)
             # copy the winning block's votes over to the main array
             for i, v in enumerate(bv.votes):
                 if v is not None:

@@ -10,10 +10,13 @@ samples.json under the outdir for post-mortems.
     python tools/scenario_run.py --list
     python tools/scenario_run.py all --outdir /tmp/scn
     python tools/scenario_run.py fast --seed 7 --json
+    python tools/scenario_run.py laggard --sweep-seeds 5
 
 Exit 0 = every requested scenario passed, 1 = any verdict failed,
 2 = usage error. ``fast`` expands to the tier-1 pair, ``all`` to the
-whole library.
+whole library. ``--sweep-seeds N`` is the flake hunt: each scenario
+runs N times across consecutive seeds and the digest separates
+deterministic failures from flaky ones.
 """
 
 from __future__ import annotations
@@ -57,6 +60,11 @@ def main() -> int:
                     help="evidence root (default: a fresh tmp dir)")
     ap.add_argument("--seed", type=int, default=None,
                     help="override the spec seed")
+    ap.add_argument("--sweep-seeds", type=int, default=0, metavar="N",
+                    help="flake hunt: run each scenario N times with "
+                         "seeds base..base+N-1 (base = --seed or the "
+                         "spec default) and aggregate the verdicts; "
+                         "exit 1 if ANY seed failed")
     ap.add_argument("--json", action="store_true",
                     help="print full verdicts as JSON")
     ap.add_argument("--no-validate", action="store_true",
@@ -96,22 +104,29 @@ def main() -> int:
             return 2
 
     outroot = args.outdir or tempfile.mkdtemp(prefix="tmtpu-scenario-")
+    sweep = max(0, args.sweep_seeds)
     verdicts = []
     for name in names:
-        spec = library.get(name)
-        if args.seed is not None:
-            spec.seed = args.seed
-        outdir = os.path.join(outroot, name)
-        t0 = time.monotonic()
-        try:
-            v = run_scenario(spec, outdir, log=lambda m: print(f"  {m}"))
-        except Exception as e:  # noqa: BLE001 — report, keep going
-            v = {"scenario": name, "pass": False, "oracles": [],
-                 "error": f"{type(e).__name__}: {e}",
-                 "wall_s": round(time.monotonic() - t0, 3),
-                 "outdir": outdir}
-            print(f"  engine error: {v['error']}", file=sys.stderr)
-        verdicts.append(v)
+        base = args.seed if args.seed is not None \
+            else library.get(name).seed
+        seeds = [base + i for i in range(sweep)] if sweep else [base]
+        for seed in seeds:
+            spec = library.get(name)
+            spec.seed = seed
+            outdir = os.path.join(outroot, name) if not sweep else \
+                os.path.join(outroot, name, f"seed{seed}")
+            t0 = time.monotonic()
+            try:
+                v = run_scenario(spec, outdir,
+                                 log=lambda m: print(f"  {m}"))
+            except Exception as e:  # noqa: BLE001 — report, keep going
+                v = {"scenario": name, "seed": seed, "pass": False,
+                     "oracles": [],
+                     "error": f"{type(e).__name__}: {e}",
+                     "wall_s": round(time.monotonic() - t0, 3),
+                     "outdir": outdir}
+                print(f"  engine error: {v['error']}", file=sys.stderr)
+            verdicts.append(v)
 
     if args.json:
         print(json.dumps(verdicts, indent=2, sort_keys=True))
@@ -123,9 +138,28 @@ def main() -> int:
             bad = [o["name"] for o in oracles if not o["pass"]]
             extra = f" (failed: {', '.join(bad)})" if bad else ""
             extra += f" — {v['error']}" if v.get("error") else ""
-            print(f"{mark} {v['scenario']:22s} "
+            label = v["scenario"] + (f"@seed{v.get('seed')}"
+                                     if sweep else "")
+            print(f"{mark} {label:22s} "
                   f"{len(oracles) - len(bad)}/{len(oracles)} oracles, "
                   f"{v.get('wall_s', '?')}s{extra}")
+        if sweep:
+            # the flake-hunt digest: pass rate per scenario, seeds that
+            # failed, and whether the failures look flaky (mixed
+            # verdicts) or deterministic (every seed failed)
+            print()
+            for name in names:
+                vs = [v for v in verdicts if v["scenario"] == name]
+                failed = [v.get("seed") for v in vs if not v["pass"]]
+                rate = f"{len(vs) - len(failed)}/{len(vs)}"
+                if not failed:
+                    print(f"SWEEP {name:22s} {rate} seeds passed")
+                elif len(failed) == len(vs):
+                    print(f"SWEEP {name:22s} {rate} — fails on EVERY "
+                          f"seed (deterministic)")
+                else:
+                    print(f"SWEEP {name:22s} {rate} — FLAKY, failing "
+                          f"seeds: {sorted(failed)}")
         print(f"\nevidence under {outroot}")
     return 0 if all(v["pass"] for v in verdicts) else 1
 
