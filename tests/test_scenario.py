@@ -8,8 +8,9 @@ sidecar_crash_storm, wan_200ms, ...) run on demand via
 import pytest
 
 from tmtpu.scenario import library
-from tmtpu.scenario.engine import run_scenario
-from tmtpu.scenario.spec import FaultAction, OracleSpec, ScenarioSpec
+from tmtpu.scenario.engine import ScenarioEngine, run_scenario
+from tmtpu.scenario.spec import (CompositionError, FaultAction,
+                                 OracleSpec, ScenarioSpec, compose)
 
 
 # --- spec validation (pure unit) ---------------------------------------------
@@ -52,6 +53,165 @@ def test_validate_rejects_action_past_duration():
 def test_validate_requires_oracles():
     spec = ScenarioSpec(name="x", description="d")
     assert any("oracle" in p for p in spec.validate())
+
+
+# --- composition (pure unit) -------------------------------------------------
+
+
+def _layer(name, **kw):
+    kw.setdefault("oracles", [OracleSpec("height_min", {"min": 1})])
+    return ScenarioSpec(name=name, description=name, **kw)
+
+
+def test_compose_merges_nodes_load_and_durations():
+    fault = _layer("fault", validators=3, load_rate=10.0,
+                   duration_s=16.0, settle_s=4.0,
+                   faults=[FaultAction(5.0, "kill", node="v01"),
+                           FaultAction(7.0, "start", node="v01")])
+    wan = _layer("wan", validators=4, load_rate=5.0, duration_s=30.0,
+                 settle_s=8.0, links="*:latency_ms=200")
+    load = _layer("load", validators=3, load_rate=25.0, load_size=64,
+                  duration_s=24.0, settle_s=5.0)
+    spec = compose("c", fault, wan, load)
+    assert spec.validators == 4                 # union by name space
+    assert spec.duration_s == 30.0 and spec.settle_s == 8.0
+    assert (spec.load_rate, spec.load_size) == (25.0, 64)  # load tier wins
+    assert spec.links == "*:latency_ms=200"     # single writer
+    assert spec.layers == ["fault", "wan", "load"]
+    assert sorted(spec.composition) == sorted(spec.layers)
+    assert all(fa.layer == "fault" for fa in spec.faults)
+    assert all(o.layer for o in spec.oracles)
+    assert spec.validate() == []
+
+
+def test_compose_dedupes_oracles_keeps_first_layer():
+    a = _layer("a", oracles=[OracleSpec("chain_agreement"),
+                             OracleSpec("height_min", {"min": 3})])
+    b = _layer("b", oracles=[OracleSpec("chain_agreement"),
+                             OracleSpec("height_min", {"min": 6})])
+    spec = compose("c", a, b)
+    names = [(o.name, o.params.get("min"), o.layer) for o in spec.oracles]
+    assert ("chain_agreement", None, "a") in names
+    assert ("chain_agreement", None, "b") not in names
+    # different params = different invariants, both kept
+    assert ("height_min", 3, "a") in names
+    assert ("height_min", 6, "b") in names
+
+
+def test_compose_detects_config_conflicts_and_reports_all():
+    a = _layer("a", config={"k1": 1, "k2": "x"})
+    b = _layer("b", config={"k1": 2, "k2": "y"})
+    with pytest.raises(CompositionError) as ei:
+        compose("boom", a, b)
+    assert len(ei.value.problems) == 2
+    assert all("conflict" in p for p in ei.value.problems)
+
+
+def test_compose_overrides_applied_last_and_recorded():
+    a = _layer("a", load_rate=30.0, config={"k": 1})
+    b = _layer("b")
+    spec = compose("c", a, b, overrides={"load_rate": 7.0})
+    assert spec.load_rate == 7.0        # shrink for the host, post-merge
+    assert spec.composition["__overrides__"] == {"load_rate": 7.0}
+    assert spec.validate() == []
+
+
+def test_compose_rejects_unknown_override_field():
+    with pytest.raises(CompositionError, match="unknown field"):
+        compose("c", _layer("a"), _layer("b"),
+                overrides={"no_such_field": 1})
+
+
+def test_compose_rejects_nested_and_short():
+    inner = compose("inner", _layer("a"), _layer("b"))
+    with pytest.raises(CompositionError, match="flatten"):
+        compose("outer", inner, _layer("c"))
+    with pytest.raises(CompositionError, match="two layers"):
+        compose("solo", _layer("a"))
+
+
+def test_compose_timeline_deterministic_and_collision_free():
+    # exact cross-layer ties: the seeded jitter must separate them the
+    # same way on every call
+    a = _layer("a", faults=[FaultAction(5.0, "heal"),
+                            FaultAction(9.0, "heal")])
+    b = _layer("b", faults=[FaultAction(5.0, "heal"),
+                            FaultAction(9.0, "heal")])
+    s1 = compose("c", a, b, seed=11)
+    s2 = compose("c", _layer("a", faults=[FaultAction(5.0, "heal"),
+                                          FaultAction(9.0, "heal")]),
+                 _layer("b", faults=[FaultAction(5.0, "heal"),
+                                     FaultAction(9.0, "heal")]),
+                 seed=11)
+    assert s1.to_dict() == s2.to_dict()
+    times = [fa.at_s for fa in s1.faults]
+    assert len(set(times)) == len(times), "double-booked instant"
+    assert times == sorted(times)
+    assert s1.duration_s >= max(times)
+
+
+def test_composed_library_entries_are_tagged_and_clean():
+    for name in library.COMPOSED:
+        spec = library.get(name)
+        assert spec.layers, name
+        assert spec.validate() == [], name
+        assert all(fa.layer in spec.layers for fa in spec.faults), name
+        assert all(o.layer in spec.layers for o in spec.oracles), name
+
+
+def test_scale_rung_profile_scales_with_net_size():
+    # the big-net profile exists because a 25-node single-host net dies
+    # two ways: propose timeouts below vote-diffusion time (round
+    # churn) and 10ms idle gossip polling (~50k wakeups/s against one
+    # GIL). Pin the knobs so a refactor can't silently hand big nets
+    # the small-net profile back.
+    big = library.scale_rung(25)
+    small = library.scale_rung(4)
+    second = 1_000_000_000
+    assert big.config["consensus.timeout_propose_ns"] == 15 * second
+    assert big.config["consensus.gossip_sleep_ns"] == second // 4
+    assert small.config["consensus.gossip_sleep_ns"] == second // 100
+    assert big.oracles[0].params == {"min": 2}
+    assert small.oracles[0].params == {"min": 3}
+    assert big.duration_s > small.duration_s
+    from tmtpu.config.config import ConsensusConfig
+    assert hasattr(ConsensusConfig(), "gossip_sleep_ns")
+
+
+def test_validate_catches_tampered_layer_tags():
+    spec = compose("c", _layer("a"), _layer("b"))
+    spec.faults.append(FaultAction(1.0, "heal", layer="ghost"))
+    assert any("ghost" in p for p in spec.validate())
+    spec.faults.pop()
+    spec.composition["phantom"] = {}
+    assert any("phantom" in p or "provenance" in p
+               for p in spec.validate())
+
+
+def test_layer_attribution_buckets_events_and_verdicts(tmp_path):
+    spec = compose(
+        "attr",
+        _layer("fault", faults=[FaultAction(1.0, "kill", node="v00"),
+                                FaultAction(2.0, "start", node="v00")]),
+        _layer("wan"))
+    eng = ScenarioEngine(spec, str(tmp_path))
+    eng.events = [
+        {"t": 1.0, "op": "kill", "node": "v00", "ok": True,
+         "detail": "killed", "layer": "fault"},
+        {"t": 2.0, "op": "start", "node": "v00", "ok": False,
+         "detail": "boom", "layer": "fault"},
+    ]
+    verdicts = [
+        {"name": "height_min", "pass": True, "layer": "fault"},
+        {"name": "height_min", "pass": False, "layer": "wan"},
+    ]
+    att = eng._layer_attribution(verdicts)
+    assert att["fault"]["faults_executed"] == 2
+    assert att["fault"]["fault_errors"] == [
+        {"t": 2.0, "op": "start", "detail": "boom"}]
+    assert att["fault"]["oracles_failed"] == []
+    assert att["wan"]["faults_executed"] == 0
+    assert att["wan"]["oracles_failed"] == ["height_min"]
 
 
 # --- the FAST pair, end to end -----------------------------------------------

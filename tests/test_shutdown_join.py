@@ -81,3 +81,110 @@ def test_blocksync_reactor_stop_joins_pool_routine():
     r._thread.start()
     r.on_stop()
     assert not r._thread.is_alive()
+
+
+# -- scenario engine / chaos soak (the graceful-abort surface) ----------------
+#
+# The soak driver (tools/chaos_soak.py) keeps a net alive for minutes;
+# a SIGTERM mid-epoch must drain through ScenarioEngine.shutdown() with
+# the sampler thread JOINED, never abandoned mid-RPC against a net that
+# teardown is about to SIGTERM. These run on an UN-booted engine (no
+# subprocesses): the join guarantees are pure thread mechanics.
+
+
+def _tiny_spec():
+    from tmtpu.scenario.spec import OracleSpec, ScenarioSpec
+
+    return ScenarioSpec(name="join_t", description="t", validators=2,
+                        oracles=[OracleSpec("height_min", {"min": 1})])
+
+
+def test_engine_stop_sampler_joins_thread():
+    import tempfile
+
+    from tmtpu.scenario.engine import ScenarioEngine
+
+    with tempfile.TemporaryDirectory() as d:
+        eng = ScenarioEngine(_tiny_spec(), d)
+        eng.start_sampler()
+        assert eng._sampler_thread.is_alive()
+        t0 = time.monotonic()
+        assert eng.stop_sampler()
+        # the nap is event-based: the join returns within one sampling
+        # quantum, not after the full interval x retries
+        assert time.monotonic() - t0 < 5.0
+        assert not eng._sampler_thread.is_alive()
+        assert eng.stop_sampler()          # idempotent
+
+
+def test_engine_shutdown_idempotent_without_boot():
+    import tempfile
+
+    from tmtpu.scenario.engine import ScenarioEngine
+
+    with tempfile.TemporaryDirectory() as d:
+        eng = ScenarioEngine(_tiny_spec(), d)
+        eng.start_sampler()
+        eng.shutdown()
+        assert not eng._sampler_thread.is_alive()
+        assert eng._timers == []
+        eng.shutdown()                     # second call must be a no-op
+
+
+def test_soak_driver_sigterm_requests_drain():
+    import os
+    import signal as sig
+    import sys
+    import tempfile
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    from chaos_soak import SoakDriver, build_soak_spec
+
+    spec = build_soak_spec(4, sidecar=False)
+    old = {s: sig.getsignal(s) for s in (sig.SIGTERM, sig.SIGINT)}
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            driver = SoakDriver(spec, d, epochs=2)
+            driver.install_signal_handlers()
+            os.kill(os.getpid(), sig.SIGTERM)
+            deadline = time.monotonic() + 5.0
+            while not driver._stop.is_set():
+                assert time.monotonic() < deadline, "SIGTERM not seen"
+                time.sleep(0.01)
+            assert driver.drained_by == "SIGTERM"
+            assert not driver._wait(10.0)  # draining: no more napping
+            # engine teardown after the drain joins clean
+            driver.engine.start_sampler()
+            driver.engine.shutdown()
+            assert not driver.engine._sampler_thread.is_alive()
+    finally:
+        for s, h in old.items():
+            sig.signal(s, h)
+
+
+def test_soak_driver_request_stop_interrupts_wait():
+    import os
+    import sys
+    import tempfile
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    from chaos_soak import SoakDriver, build_soak_spec
+
+    with tempfile.TemporaryDirectory() as d:
+        driver = SoakDriver(build_soak_spec(4, sidecar=False), d,
+                            epochs=1)
+        out = {}
+        waiter = threading.Thread(
+            target=lambda: out.update(kept=driver._wait(30.0)),
+            daemon=True)
+        waiter.start()
+        time.sleep(0.05)
+        driver.request_stop("test")
+        waiter.join(2.0)
+        assert not waiter.is_alive(), "_wait ignored the stop event"
+        assert out["kept"] is False
+        assert driver.drained_by == "test"
+        driver.request_stop("later")       # first reason wins
+        assert driver.drained_by == "test"

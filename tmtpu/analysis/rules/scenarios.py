@@ -52,12 +52,40 @@ def check(index: RepoIndex) -> List[Finding]:
                 f"marker would collect nothing",
                 f"scenarios::fast::{fast}")
 
+    for comp in library.COMPOSED:
+        if comp not in library.SCENARIOS:
+            add(f"COMPOSED names unknown scenario {comp!r}",
+                f"scenarios::composed::{comp}")
+        elif not library.get(comp).layers:
+            add(f"COMPOSED lists {comp!r} but its spec has no layers "
+                f"— it is a plain spec, not a composition",
+                f"scenarios::composed-flat::{comp}")
+
     for name in library.names():
         spec = library.get(name)
         where = f"scenario {name!r}"
         for problem in spec.validate():
             add(f"{where}: {problem}",
                 f"scenarios::validate::{name}::{problem}")
+        if spec.layers:
+            # composed-spec contract: validate() already re-derives
+            # cross-layer merge collisions from the provenance; here we
+            # pin the attribution surface — an UNTAGGED fault in a
+            # composed timeline executes fine but its failure can never
+            # be attributed to a layer in the verdict
+            for action in spec.faults:
+                if not action.layer:
+                    add(f"{where}: composed fault {action.op!r} at "
+                        f"t={action.at_s} carries no layer tag — its "
+                        f"verdict attribution is lost",
+                        f"scenarios::untagged::{name}::{action.op}"
+                        f"::{action.at_s}")
+            untagged_oracles = [o.name for o in spec.oracles
+                                if not o.layer]
+            if untagged_oracles:
+                add(f"{where}: composed oracles {untagged_oracles} "
+                    f"carry no layer tag — a FAIL would name no layer",
+                    f"scenarios::untagged-oracle::{name}")
         for action in spec.faults:
             if action.op == "inject":
                 site = action.params.get("site", "")

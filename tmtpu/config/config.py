@@ -27,6 +27,14 @@ class ConsensusConfig:
     timeout_precommit_delta_ns: int = 500 * MS
     timeout_commit_ns: int = 1000 * MS
     skip_timeout_commit: bool = False
+    # peerGossipSleepDuration: idle-poll interval of the per-peer gossip
+    # routines. The hot path is unaffected (a routine that sent a vote
+    # loops again without sleeping) — this only paces idle wakeups, which
+    # dominate GIL time on big single-host nets (~2 polling loops per
+    # peer-end; a 25-node chord net runs ~500 of them, so 10 ms polling
+    # is 50k wakeups/s against one core). Big-net scenario profiles
+    # raise it (see scenario/library.py scale_rung).
+    gossip_sleep_ns: int = 10 * MS
     create_empty_blocks: bool = True
     create_empty_blocks_interval_ns: int = 0
     double_sign_check_height: int = 0

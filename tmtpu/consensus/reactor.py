@@ -166,6 +166,11 @@ class ConsensusReactor(Reactor):
         super().__init__("CONSENSUS")
         self.cs = cs
         self.wait_sync = wait_sync  # true while block sync is running
+        # idle-poll pace of the gossip routines, from config so big-net
+        # profiles can slow it (the send path never sleeps, so this only
+        # trades idle-wakeup CPU against worst-case relay latency)
+        self.gossip_sleep_s = getattr(
+            cs.config, "gossip_sleep_ns", int(GOSSIP_SLEEP_S * 1e9)) / 1e9
         self._peer_threads: Dict[str, list] = {}
         self._stopped = threading.Event()
         # outbound hooks from the state machine
@@ -508,16 +513,16 @@ class ConsensusReactor(Reactor):
                 has_proposal = ps.proposal
                 peer_parts = ps.proposal_block_parts
             if prs_h == 0:
-                time.sleep(GOSSIP_SLEEP_S)
+                time.sleep(self.gossip_sleep_s)
                 continue
             # catchup: peer is on an older height -> send stored block parts
             if 0 < prs_h < rs.height and \
                     prs_h >= self.cs.block_store.base():
                 self._gossip_catchup_part(peer, ps, prs_h)
-                time.sleep(GOSSIP_SLEEP_S)
+                time.sleep(self.gossip_sleep_s)
                 continue
             if prs_h != rs.height:
-                time.sleep(GOSSIP_SLEEP_S)
+                time.sleep(self.gossip_sleep_s)
                 continue
             # same height: proposal + parts. Local refs throughout: the
             # consensus thread may null these fields while we work (the
@@ -559,7 +564,7 @@ class ConsensusReactor(Reactor):
                                     rs.height)).encode()):
                         ps.set_has_part(rs.height, idx, total)
                         continue  # keep pushing without sleeping
-            time.sleep(GOSSIP_SLEEP_S)
+            time.sleep(self.gossip_sleep_s)
 
     def _gossip_catchup_part(self, peer: Peer, ps: PeerState,
                              height: int) -> None:
@@ -635,7 +640,7 @@ class ConsensusReactor(Reactor):
                             sent = True
                         break
             if not sent:
-                time.sleep(GOSSIP_SLEEP_S)
+                time.sleep(self.gossip_sleep_s)
 
     QUERY_MAJ23_SLEEP_S = 2.0  # reactor.go:849 queryMaj23Routine cadence
 

@@ -20,7 +20,9 @@ share only make_manifest. Report tools use both.
 from __future__ import annotations
 
 import contextlib
+import os
 import sys
+import time
 from typing import Callable, Dict, Iterable, Optional
 
 from tmtpu.e2e.manifest import LoadSpec, Manifest, NodeSpec
@@ -32,12 +34,183 @@ def validator_names(n: int) -> list:
     return [f"v{i:02d}" for i in range(n)]
 
 
+# A full mesh is fine for the nets this repo grew up on (3-8 nodes) but
+# quadratic in gossip threads: every connection runs its own data- and
+# vote-gossip routines, so 25 nodes x 24 peers x 2+ threads is ~2400
+# wakeup loops fighting one GIL per process host — observed to starve
+# consensus so badly a 25-validator net never commits height 1. Big
+# nets dial a CHORD graph instead: node i dials i+1, i+2, i+4, ... 2^k
+# (mod n). Degree is O(log n) (counting inbound, ~2 log n), the graph
+# is vertex-transitive and connected, and any vote crosses it in at
+# most log2(n) gossip hops.
+#
+# Above SPARSE_CHORD_NODES the offset list is capped at {1, 2, 4}: on a
+# shared host every connection's threads occupy runqueue slots whether
+# or not they poll often (a thread waiting for the GIL is runnable to
+# the kernel), so message-hop latency scales with TOTAL thread count,
+# not hop count. Degree 6 instead of ~2 log2 n trades a longer greedy
+# route (~n/8 hops worst case) for ~40% fewer p2p threads net-wide —
+# the better side of the trade once scheduling latency per hop runs
+# into seconds.
+
+MESH_MAX_NODES = 12
+SPARSE_CHORD_NODES = 20
+_SPARSE_OFFSETS = (1, 2, 4)
+
+
+def chord_peer_names(names: Iterable[str]) -> Dict[str, list]:
+    """Per-node dial plan: ``{name: [names it should dial]}``. Full
+    mesh up to MESH_MAX_NODES (historic behavior for every small net);
+    power-of-two chord offsets above it, capped at _SPARSE_OFFSETS for
+    nets past SPARSE_CHORD_NODES."""
+    names = list(names)
+    n = len(names)
+    if n <= MESH_MAX_NODES:
+        return {a: [b for b in names if b != a] for a in names}
+    offsets = []
+    d = 1
+    while d < n:
+        offsets.append(d)
+        d *= 2
+    if n > SPARSE_CHORD_NODES:
+        offsets = [o for o in offsets if o in _SPARSE_OFFSETS]
+    return {names[i]: [names[(i + o) % n] for o in offsets]
+            for i in range(n)}
+
+
+# -- pooled / staggered startup (the 10-50 validator rung) --------------------
+#
+# Launching 25+ subprocess nodes simultaneously makes every one of them
+# fight the same cores through interpreter startup + module import, the
+# most CPU-hungry seconds of a node's life — observed to stretch a
+# 25-node boot several-fold and trip RPC-up deadlines that a staggered
+# launch sails through. Instead: launch in WAVES sized to the host
+# (same cpu-derived cap as the generated-net ceiling, so one env knob —
+# TMTPU_E2E_MAX_NODES — governs both how big a net may be and how many
+# nodes may boot at once), gate each wave on its nodes accepting RPC
+# within a per-node budget, then gate the whole net on /readyz (live
+# AND caught up) instead of fixed sleeps.
+
+BOOT_WAVE_ENV = "TMTPU_E2E_BOOT_WAVE"
+BOOT_BUDGET_ENV = "TMTPU_E2E_BOOT_BUDGET_S"
+
+
+def boot_wave_size() -> int:
+    """Nodes launched per wave. ``TMTPU_E2E_BOOT_WAVE`` pins it;
+    otherwise the generated-net node cap (cpu-derived,
+    ``TMTPU_E2E_MAX_NODES``-overridable) doubles as the wave size — a
+    net small enough to generate is small enough to launch at once."""
+    env = os.environ.get(BOOT_WAVE_ENV, "")
+    if env:
+        return max(1, int(env))
+    from tmtpu.e2e.generate import max_nodes
+    return max_nodes()
+
+
+def per_node_boot_budget_s() -> float:
+    """Per-node readiness budget (seconds) for each boot phase;
+    ``TMTPU_E2E_BOOT_BUDGET_S`` overrides."""
+    env = os.environ.get(BOOT_BUDGET_ENV, "")
+    return float(env) if env else 30.0
+
+
+def wait_rpc_up(nodes, budget_s: float) -> None:
+    """Every node in the wave must accept RPC within ``budget_s`` of
+    the call (the wave was just launched, so this is its boot budget).
+    Raises TimeoutError naming the first node that blew the budget."""
+    deadline = time.monotonic() + budget_s
+    pending = list(nodes)
+    while pending:
+        pending = [n for n in pending if n.height() < 0]
+        if not pending:
+            return
+        if time.monotonic() > deadline:
+            worst = pending[0]
+            raise TimeoutError(
+                f"{worst.spec.name} RPC not up within {budget_s:.0f}s "
+                f"boot budget (see {worst.home}/node.log)")
+        time.sleep(0.2)
+
+
+def wait_ready(nodes, budget_s: float) -> None:
+    """Readiness barrier: every node answers /readyz 200 (live AND
+    caught up — consensus committing, watchdog green) within
+    ``budget_s``. Nodes converge concurrently, so the budget is one
+    shared window, not a per-node sum. Falls back to RPC-up for nodes
+    without a pprof listener."""
+    deadline = time.monotonic() + budget_s
+    pending = list(nodes)
+    while pending:
+        pending = [n for n in pending if not n.ready()]
+        if not pending:
+            return
+        if time.monotonic() > deadline:
+            names = [n.spec.name for n in pending]
+            raise TimeoutError(
+                f"nodes never ready within {budget_s:.0f}s: {names} "
+                f"(see {pending[0].home}/node.log)")
+        time.sleep(0.3)
+
+
+def staggered_start(nodes, *, wave_size: Optional[int] = None,
+                    budget_s: Optional[float] = None,
+                    ready_gate: Optional[bool] = None,
+                    log: Optional[Callable[[str], None]] = None) -> None:
+    """Launch ``nodes`` in pooled waves with readiness gating (see the
+    section comment above). ``ready_gate`` defaults to on for multi-wave
+    nets — exactly the nets whose first commit is slow enough that
+    'RPC up' is not 'net live'; single-wave nets keep the historic
+    cheap barrier unless explicitly asked."""
+    nodes = list(nodes)
+    wave_size = wave_size or boot_wave_size()
+    budget_s = budget_s if budget_s is not None \
+        else per_node_boot_budget_s()
+    waves = [nodes[i:i + wave_size]
+             for i in range(0, len(nodes), wave_size)]
+    if ready_gate is None:
+        ready_gate = len(waves) > 1
+    for i, wave in enumerate(waves):
+        if log and len(waves) > 1:
+            log(f"boot wave {i + 1}/{len(waves)}: "
+                f"{[n.spec.name for n in wave]}")
+        for node in wave:
+            node.start()
+        # later waves launch into a host already running every earlier
+        # wave's consensus loops: surcharge the budget per live process
+        # or wave 3 of a 25-node net times out on interpreter startup
+        wave_window = budget_s + 2.0 * (len(wave) + i * wave_size)
+        try:
+            wait_rpc_up(wave, wave_window)
+        except TimeoutError as exc:
+            # the wave gate PACES the launch (never 25 cold interpreters
+            # at once); when the readiness barrier follows, it is the
+            # correctness gate, so a slow-to-bind straggler is a log
+            # line, not an abort. Without the barrier (single-wave
+            # historic contract) RPC-up is the only gate: stay fatal.
+            if not ready_gate:
+                raise
+            if log:
+                log(f"boot wave {i + 1} straggler: {exc} "
+                    f"(continuing; readiness gate will enforce)")
+    if ready_gate:
+        # first commit on a big single-host net is the slow part —
+        # quorum lands mid-boot and consensus competes with the last
+        # waves' interpreter startup for the same cores. One shared
+        # window, surcharged per node.
+        window = budget_s + 5.0 * len(nodes)
+        if log:
+            log(f"readiness gate: waiting /readyz on {len(nodes)} "
+                f"nodes (window {window:.0f}s)")
+        wait_ready(nodes, window)
+
+
 def make_manifest(chain_id: str,
                   names: Iterable[str],
                   *,
                   base_config: Optional[Dict] = None,
                   node_config: Optional[Dict[str, Dict]] = None,
                   key_type: str = "ed25519",
+                  key_types: Optional[Dict[str, str]] = None,
                   misbehaviors: Optional[Dict[str, Dict]] = None,
                   start_at: Optional[Callable[[str, bool], int]] = None,
                   load_rate: float = 0.0,
@@ -49,8 +222,9 @@ def make_manifest(chain_id: str,
     Node names starting with ``v`` are validators (the e2e convention);
     anything else is a full node. ``base_config`` ("section.key" ->
     value) applies to every node, ``node_config[name]`` layers per-node
-    overrides on top. ``start_at(name, validator)`` may defer or
-    manual-gate individual nodes (return -1 to provision without
+    overrides on top. ``key_types[name]`` overrides ``key_type`` per
+    node (mixed-curve valsets). ``start_at(name, validator)`` may defer
+    or manual-gate individual nodes (return -1 to provision without
     starting, the scenario engine's joiner convention).
     """
     nodes = []
@@ -61,7 +235,7 @@ def make_manifest(chain_id: str,
         nodes.append(NodeSpec(
             name=name, validator=validator,
             start_at=start_at(name, validator) if start_at else 0,
-            key_type=key_type, config=cfg,
+            key_type=(key_types or {}).get(name, key_type), config=cfg,
             misbehaviors=dict((misbehaviors or {}).get(name, {}))))
     return Manifest(
         chain_id=chain_id, nodes=nodes,

@@ -48,6 +48,9 @@ class _Node:
         self.home = home
         self.p2p_port, self._p2p_hold = _hold_port()
         self.rpc_port, self._rpc_hold = _hold_port()
+        # pprof serves /healthz + /readyz — the readiness surface the
+        # pooled boot path gates big nets on (localnet.staggered_start)
+        self.pprof_port, self._pprof_hold = _hold_port()
         self.proc: subprocess.Popen | None = None
         self.client = HTTPClient(f"http://127.0.0.1:{self.rpc_port}",
                                  timeout=5.0)
@@ -58,7 +61,7 @@ class _Node:
         return self.proc is not None and self.proc.poll() is None
 
     def _release_ports(self):
-        for attr in ("_p2p_hold", "_rpc_hold"):
+        for attr in ("_p2p_hold", "_rpc_hold", "_pprof_hold"):
             sock = getattr(self, attr)
             if sock is not None:
                 try:
@@ -66,6 +69,22 @@ class _Node:
                 except OSError:
                     pass
                 setattr(self, attr, None)
+
+    def ready(self) -> bool:
+        """/readyz verdict: live AND caught up (200). Falls back to
+        plain RPC-up when the node runs without a pprof listener."""
+        import urllib.request
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{self.pprof_port}/readyz",
+                    timeout=2.0) as resp:
+                return resp.status == 200
+        except urllib.error.HTTPError:
+            return False          # 503: serving but not ready
+        except OSError:
+            # no pprof listener (disabled, or still booting): degrade
+            # to "committed at least one block" over plain RPC
+            return self.height() >= 1
 
     def start(self):
         self._release_ports()
@@ -124,14 +143,18 @@ class Runner:
     def setup(self):
         """Generate one home dir per node, full-mesh persistent peers,
         single genesis (validators only). Reference: test/e2e/runner/setup.go
-        + cmd/tendermint testnet."""
+        + cmd/tendermint testnet. Each node's Config is generated ONCE
+        and reused for both the key bootstrap and the final write —
+        config generation is pure CPU and used to run twice per node,
+        which big pooled nets (10-50 validators) notice."""
         pvs = {}
+        cfgs = {}
         for spec in self.m.nodes:
             home = os.path.join(self.outdir, spec.name)
             os.makedirs(os.path.join(home, "config"), exist_ok=True)
             os.makedirs(os.path.join(home, "data"), exist_ok=True)
             node = _Node(spec, home)
-            cfg = self._node_config(node)
+            cfg = cfgs[spec.name] = self._node_config(node)
             pv = FilePV.load_or_generate(
                 cfg.rooted(cfg.base.priv_validator_key_file),
                 cfg.rooted(cfg.base.priv_validator_state_file),
@@ -153,12 +176,14 @@ class Runner:
             consensus_params=ConsensusParams(
                 block_max_bytes=self.m.block_max_bytes),
         )
+        from tmtpu.e2e.localnet import chord_peer_names
         peers = {n.spec.name: f"{n.node_id}@127.0.0.1:{n.p2p_port}"
                  for n in self.nodes}
+        plan = chord_peer_names([n.spec.name for n in self.nodes])
         for node in self.nodes:
-            cfg = self._node_config(node)
+            cfg = cfgs[node.spec.name]
             cfg.p2p.persistent_peers = ",".join(
-                p for name, p in peers.items() if name != node.spec.name)
+                peers[name] for name in plan[node.spec.name])
             gen.save_as(cfg.genesis_path)
             cfg_toml.write_config(
                 cfg, os.path.join(node.home, "config", "config.toml"))
@@ -170,6 +195,9 @@ class Runner:
         cfg.base.crypto_backend = "cpu"
         cfg.p2p.laddr = f"tcp://127.0.0.1:{node.p2p_port}"
         cfg.rpc.laddr = f"tcp://127.0.0.1:{node.rpc_port}"
+        # /healthz + /readyz on every e2e node: the pooled boot path
+        # and the chaos-soak sampler gate on readiness, not sleeps
+        cfg.rpc.pprof_laddr = f"tcp://127.0.0.1:{node.pprof_port}"
         # e2e profile: fast rounds so tests finish in seconds
         test = Config.test_config()
         cfg.consensus = test.consensus
@@ -182,22 +210,14 @@ class Runner:
             setattr(getattr(cfg, section), name, value)
         return cfg
 
-    def start(self):
+    def start(self, log=None):
         """Start nodes whose start_at is 0; late nodes join from
-        _perturb_loop once the net reaches their height."""
-        for node in self.nodes:
-            if node.spec.start_at == 0:
-                node.start()
-        deadline = time.monotonic() + 60
-        for node in self.nodes:
-            if node.spec.start_at:
-                continue
-            while node.height() < 0:
-                if time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"{node.spec.name} RPC not up "
-                        f"(see {node.home}/node.log)")
-                time.sleep(0.2)
+        run_perturbations once the net reaches their height. Nets
+        bigger than one boot wave launch staggered with readiness
+        gating (tmtpu/e2e/localnet.py — the 10-50 validator rung)."""
+        from tmtpu.e2e.localnet import staggered_start
+        staggered_start(
+            [n for n in self.nodes if n.spec.start_at == 0], log=log)
 
     def start_load(self):
         """Offer ``load.rate`` tx/s round-robin over the validators. Above

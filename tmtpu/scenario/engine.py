@@ -20,6 +20,19 @@ One run is four phases on a single clock (t=0 at net start):
 
 The verdict (and the evidence the judgment used, minus block bodies)
 is persisted under the run's outdir for post-mortems.
+
+Composed scenarios (spec.compose) run through the same four phases;
+every fault action and oracle carries its contributing layer, and the
+verdict adds a per-layer attribution block so a failed composed run
+names which layer's faults misfired and which layer's invariants broke.
+
+The engine's lifecycle is also consumable piecewise — ``boot()``,
+``execute_action()``, ``gather_evidence()``, ``judge()``,
+``shutdown()`` — which is how tools/chaos_soak.py drives an open-ended
+rotating fault schedule with periodic verdicts instead of one fixed
+timeline. ``shutdown()`` is idempotent and joins the sampler thread
+(the PR-14 shutdown-join guarantees extended to the engine), so a
+SIGTERM mid-run drains cleanly.
 """
 
 from __future__ import annotations
@@ -49,12 +62,19 @@ class ScenarioEngine:
         self.events: list = []
         self._t0 = 0.0
         self._sampling = threading.Event()
+        self._sampling_stopped = threading.Event()
+        self._sampler_thread = None
         self._timers: list = []
+        self._booted = False
 
     # -- clock ---------------------------------------------------------------
 
     def _now(self) -> float:
         return time.monotonic() - self._t0
+
+    def now(self) -> float:
+        """Seconds since net start (0.0 before boot)."""
+        return self._now() if self._booted else 0.0
 
     # -- sampling ------------------------------------------------------------
 
@@ -79,11 +99,64 @@ class ScenarioEngine:
     def _sampler(self) -> None:
         while self._sampling.is_set():
             self._sample_once()
-            time.sleep(_SAMPLE_INTERVAL_S)
+            # wait on the event, not a bare sleep: shutdown() flips the
+            # flag and the thread exits within one RPC round, so the
+            # join in shutdown() is bounded by sampling work, not naps
+            self._sampling_stopped.wait(_SAMPLE_INTERVAL_S)
+
+    def start_sampler(self) -> None:
+        if self._sampler_thread is not None and \
+                self._sampler_thread.is_alive():
+            return
+        self._sampling.set()
+        self._sampling_stopped = threading.Event()
+        self._sampler_thread = threading.Thread(
+            target=self._sampler, name="scenario-sampler", daemon=True)
+        self._sampler_thread.start()
+
+    def stop_sampler(self, timeout: float = 10.0) -> bool:
+        """Stop and JOIN the health sampler; True when the thread is
+        down. Bounded by one in-flight RPC sweep (5 s client timeout
+        per call), never by the sampling nap."""
+        self._sampling.clear()
+        if getattr(self, "_sampling_stopped", None) is not None:
+            self._sampling_stopped.set()
+        t = self._sampler_thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+        return t is None or not t.is_alive()
+
+    def trim_samples(self, keep: int) -> None:
+        """Drop all but the newest ``keep`` sample rows — long soaks
+        sample for hours and judge on a rolling window, so the full
+        time-series would only grow the process."""
+        if keep >= 0 and len(self.samples) > keep:
+            del self.samples[:len(self.samples) - keep]
 
     # -- fault execution -----------------------------------------------------
 
-    def _execute(self, action) -> str:
+    def execute_action(self, action) -> dict:
+        """Execute one FaultAction NOW (its ``at_s`` is recorded, not
+        waited on) and append the outcome to the event log. Returns the
+        event row. This is the public single-step surface the timeline
+        loop and the chaos-soak scheduler share."""
+        t = round(self._now(), 3)
+        try:
+            detail = self._dispatch(action)
+            ok = True
+        except Exception as e:
+            detail, ok = f"{type(e).__name__}: {e}", False
+        self._log(f"[{t:7.2f}s] {action.op} {action.node or '*'}"
+                  + (f" [{action.layer}]" if action.layer else "")
+                  + f": {detail}")
+        event = {"t": t, "op": action.op, "node": action.node,
+                 "ok": ok, "detail": detail}
+        if action.layer:
+            event["layer"] = action.layer
+        self.events.append(event)
+        return event
+
+    def _dispatch(self, action) -> str:
         net, p = self.net, action.params
         op = action.op
         if op == "kill":
@@ -192,53 +265,55 @@ class ScenarioEngine:
             delay = action.at_s - self._now()
             if delay > 0:
                 time.sleep(delay)
-            t = round(self._now(), 3)
-            try:
-                detail = self._execute(action)
-                ok = True
-            except Exception as e:
-                detail, ok = f"{type(e).__name__}: {e}", False
-            self._log(f"[{t:7.2f}s] {action.op} {action.node or '*'}: "
-                      f"{detail}")
-            self.events.append({"t": t, "op": action.op,
-                                "node": action.node, "ok": ok,
-                                "detail": detail})
+            self.execute_action(action)
         tail = self.spec.duration_s - self._now()
         if tail > 0:
             time.sleep(tail)
 
     # -- evidence ------------------------------------------------------------
 
-    def _gather(self) -> Evidence:
+    def gather_evidence(self, block_cap: int = _BLOCK_FETCH_CAP) \
+            -> Evidence:
+        return self._gather(block_cap)
+
+    def _gather(self, block_cap: int = _BLOCK_FETCH_CAP) -> Evidence:
         nodes = {}
         for node in self.net.nodes:
             snap = {"final_height": -1, "running": node.running,
                     "health": None, "metrics": None, "timeline": None,
                     "txlat": None, "validator_stats": None, "blocks": {}}
             if node.proc is not None:
-                try:
-                    st = node.client.status()
-                    snap["final_height"] = int(
-                        st["sync_info"]["latest_block_height"])
-                    snap["health"] = node.client.health_detail()
-                    snap["metrics"] = node.client.metrics()
-                    snap["timeline"] = node.client.timeline(last=100)
-                    snap["txlat"] = node.client.txlat(limit=256)
-                    snap["validator_stats"] = \
-                        node.client.validator_stats(limit=256)
-                    snap["blocks"] = self._fetch_blocks(
-                        node, snap["final_height"])
-                except Exception as e:
-                    snap["error"] = str(e)
+                # two attempts: on a big starved net a single RPC
+                # timeout is routine, and one failed status() must not
+                # erase the node's whole snapshot (an absent snapshot
+                # reads as "no evidence" to every oracle downstream)
+                for attempt in (0, 1):
+                    try:
+                        st = node.client.status()
+                        snap["final_height"] = int(
+                            st["sync_info"]["latest_block_height"])
+                        snap["health"] = node.client.health_detail()
+                        snap["metrics"] = node.client.metrics()
+                        snap["timeline"] = node.client.timeline(last=100)
+                        snap["txlat"] = node.client.txlat(limit=256)
+                        snap["validator_stats"] = \
+                            node.client.validator_stats(limit=256)
+                        snap["blocks"] = self._fetch_blocks(
+                            node, snap["final_height"], block_cap)
+                        snap.pop("error", None)
+                        break
+                    except Exception as e:
+                        snap["error"] = str(e)
             nodes[node.spec.name] = snap
         return Evidence(self.spec, self.events, self.samples, nodes,
                         sidecar_kills=self.net.sidecar_kills)
 
     @staticmethod
-    def _fetch_blocks(node, top: int) -> dict:
+    def _fetch_blocks(node, top: int,
+                      block_cap: int = _BLOCK_FETCH_CAP) -> dict:
         if top < 2:
             return {}
-        lo = max(2, top - _BLOCK_FETCH_CAP + 1)
+        lo = max(2, top - block_cap + 1)
         heights = list(range(lo, top + 1))
         blocks = {}
         for i in range(0, len(heights), 25):
@@ -250,6 +325,86 @@ class ScenarioEngine:
                     blocks[h] = res["block"]
         return blocks
 
+    # -- lifecycle -----------------------------------------------------------
+
+    def boot(self) -> None:
+        """Provision and start the net (sidecar first when the spec
+        wants one), zero the scenario clock, start the health sampler
+        and the tx load. After boot() the engine is live: drive it with
+        execute_action()/gather_evidence()/judge(), then shutdown()."""
+        spec = self.spec
+        self._log(f"scenario {spec.name!r}: {spec.validators} validators"
+                  + (f" + {spec.full_nodes} full nodes"
+                     if spec.full_nodes else "")
+                  + (" + sidecar" if spec.sidecar else "")
+                  + (f", layers {spec.layers}" if spec.layers else "")
+                  + f", seed {spec.seed}")
+        self.net.setup()
+        if spec.sidecar:
+            self.net.start_sidecar()
+        self.net.start(log=self._log)
+        self._t0 = time.monotonic()
+        self._booted = True
+        self.start_sampler()
+        if spec.load_rate > 0:
+            self.net.start_load()
+
+    def shutdown(self) -> None:
+        """Tear everything down in join-clean order: sampler thread
+        joined (not abandoned), pending SIGCONT timers cancelled, load
+        threads joined, every node SIGTERMed. Idempotent — safe from
+        run()'s finally AND from a SIGINT/SIGTERM handler that fires
+        mid-phase."""
+        self.stop_sampler()
+        for timer in self._timers:
+            timer.cancel()
+        self._timers = []
+        self.net.stop()
+
+    # -- judging -------------------------------------------------------------
+
+    def judge(self, evidence: Evidence, oracle_specs=None) -> list:
+        """Render every oracle's verdict over ``evidence``; composed
+        specs keep each oracle's layer tag on its verdict row."""
+        verdicts = []
+        for ospec in (oracle_specs if oracle_specs is not None
+                      else self.spec.oracles):
+            fn = oracle_mod.get(ospec.name)
+            try:
+                ok, detail = fn(evidence, **ospec.params)
+            except Exception as e:
+                ok, detail = False, f"oracle crashed: " \
+                    f"{type(e).__name__}: {e}"
+            row = {"name": ospec.name, "params": dict(ospec.params),
+                   "pass": bool(ok), "detail": detail}
+            if getattr(ospec, "layer", ""):
+                row["layer"] = ospec.layer
+            verdicts.append(row)
+            self._log(f"  {'PASS' if ok else 'FAIL'} {ospec.name}"
+                      + (f" [{ospec.layer}]" if row.get("layer") else "")
+                      + f": {detail}")
+        return verdicts
+
+    def _layer_attribution(self, verdicts: list) -> dict:
+        """Per-layer rollup for composed specs: which layer's fault
+        actions errored and which layer's invariants failed. A composed
+        FAIL therefore names the contributing layer(s), not just the
+        oracle."""
+        layers = {}
+        for name in self.spec.layers:
+            evs = [e for e in self.events if e.get("layer") == name]
+            vs = [v for v in verdicts if v.get("layer") == name]
+            layers[name] = {
+                "faults_executed": len(evs),
+                "fault_errors": [
+                    {"t": e["t"], "op": e["op"], "detail": e["detail"]}
+                    for e in evs if not e["ok"]],
+                "oracles": len(vs),
+                "oracles_failed": [v["name"] for v in vs
+                                   if not v["pass"]],
+            }
+        return layers
+
     # -- the run -------------------------------------------------------------
 
     def run(self) -> dict:
@@ -258,51 +413,21 @@ class ScenarioEngine:
         if problems:
             raise ValueError(f"invalid scenario: {problems}")
         started_unix = time.time()
-        self._log(f"scenario {spec.name!r}: {spec.validators} validators"
-                  + (f" + {spec.full_nodes} full nodes"
-                     if spec.full_nodes else "")
-                  + (" + sidecar" if spec.sidecar else "")
-                  + f", seed {spec.seed}")
         try:
-            self.net.setup()
-            if spec.sidecar:
-                self.net.start_sidecar()
-            self.net.start()
-            self._t0 = time.monotonic()
-            self._sampling.set()
-            sampler = threading.Thread(target=self._sampler, daemon=True)
-            sampler.start()
-            if spec.load_rate > 0:
-                self.net.start_load()
+            self.boot()
             self._run_timeline()
             self.net.stop_load()
             if spec.settle_s > 0:
                 self._log(f"[{self._now():7.2f}s] settling "
                           f"{spec.settle_s}s before judging")
                 time.sleep(spec.settle_s)
-            self._sampling.clear()
-            sampler.join(3)
+            self.stop_sampler()
             self._sample_once()        # one last row at judge time
             evidence = self._gather()
         finally:
-            self._sampling.clear()
-            for timer in self._timers:
-                timer.cancel()
-            self.net.stop()
+            self.shutdown()
 
-        verdicts = []
-        for ospec in spec.oracles:
-            fn = oracle_mod.get(ospec.name)
-            try:
-                ok, detail = fn(evidence, **ospec.params)
-            except Exception as e:
-                ok, detail = False, f"oracle crashed: " \
-                    f"{type(e).__name__}: {e}"
-            verdicts.append({"name": ospec.name,
-                             "params": dict(ospec.params),
-                             "pass": bool(ok), "detail": detail})
-            self._log(f"  {'PASS' if ok else 'FAIL'} {ospec.name}: "
-                      f"{detail}")
+        verdicts = self.judge(evidence)
         verdict = {
             "scenario": spec.name,
             "seed": spec.seed,
@@ -315,6 +440,8 @@ class ScenarioEngine:
             "wall_s": round(time.time() - started_unix, 3),
             "outdir": self.outdir,
         }
+        if spec.layers:
+            verdict["layers"] = self._layer_attribution(verdicts)
         self._persist(verdict)
         self._log(f"verdict: {'PASS' if verdict['pass'] else 'FAIL'} "
                   f"({verdict['wall_s']}s)")

@@ -14,9 +14,21 @@ partition can be.
 
 from __future__ import annotations
 
-from tmtpu.scenario.spec import FaultAction, OracleSpec, ScenarioSpec
+from tmtpu.scenario.spec import (FaultAction, OracleSpec, ScenarioSpec,
+                                 compose)
 
 SECOND_NS = 10**9
+
+# deterministic mixed-curve assignment for scale-rung nets: every third
+# node draws off ed25519 so big nets exercise the multi-curve verify
+# paths without making the slowest curve the whole net's cadence
+_CURVE_CYCLE = ("ed25519", "ed25519", "sr25519", "ed25519", "secp256k1")
+
+
+def mixed_key_types(names) -> dict:
+    return {n: _CURVE_CYCLE[i % len(_CURVE_CYCLE)]
+            for i, n in enumerate(names)
+            if _CURVE_CYCLE[i % len(_CURVE_CYCLE)] != "ed25519"}
 
 
 def split_brain() -> ScenarioSpec:
@@ -139,7 +151,16 @@ def wan_200ms() -> ScenarioSpec:
             "consensus.timeout_prevote_ns": SECOND_NS,
             "consensus.timeout_precommit_ns": SECOND_NS,
             "consensus.timeout_commit_ns": SECOND_NS // 2,
+            # production timeouts need the production commit WAIT too:
+            # skipping it charges the quorum-surplus straggler (always
+            # late at 200 ms RTT) as a participation miss and the flap
+            # watchdog smears across honest validators (see laggard)
+            "consensus.skip_timeout_commit": False,
             "health.consensus_stall_timeout_ns": 20 * SECOND_NS,
+            # even with the wait, 5% loss flaps real participation;
+            # window the check tighter and absorb WAN-tail stragglers
+            "health.validator_flap_window_ns": 30 * SECOND_NS,
+            "health.validator_flap_threshold": 8,
         },
         oracles=[
             OracleSpec("height_min", {"min": 3}),
@@ -320,6 +341,198 @@ def amnesia() -> ScenarioSpec:
         ])
 
 
+# -- composition layers & composed scenarios ----------------------------------
+#
+# Layers below exist to be composed (spec.compose): each is a valid
+# standalone spec, but its real job is contributing one concern — a
+# fault storm, a network shape, a load tier — to a composed run whose
+# verdict attributes failures back to the layer.
+
+
+def lan_50ms() -> ScenarioSpec:
+    """Mild 50 ms / 1%-loss shaping on every link — enough to move
+    every message off the loopback fast path without dragging commit
+    cadence below the fast-profile timeouts. The cheap WAN-ish layer
+    for composed runs that must stay inside a CI budget."""
+    return ScenarioSpec(
+        name="lan_50ms",
+        description="50ms/1%-loss shaping: liveness holds on the fast "
+                    "profile",
+        validators=3, load_rate=5.0, duration_s=16.0, settle_s=4.0,
+        links="*:latency_ms=50,jitter_ms=10,drop=0.01",
+        config={
+            "health.consensus_stall_timeout_ns": 10 * SECOND_NS,
+        },
+        oracles=[
+            OracleSpec("height_min", {"min": 3}),
+            OracleSpec("metric_min",
+                       {"name": "tendermint_p2p_shape_delay_seconds",
+                        "min": 5, "nodes": "sum"}),
+        ])
+
+
+def scale_rung(validators: int = 25) -> ScenarioSpec:
+    """The 10-50 validator rung as a composable base layer: a big
+    mixed-curve net booted through the pooled/staggered path, judged on
+    the floor that matters at this size: the net COMMITS, in agreement,
+    with every validator inside the spread.
+
+    Timeouts scale with the net. Per-height work is ~N^2 (every node
+    verifies every vote, every vote crosses every gossip hop) and the
+    whole net shares one host, so vote diffusion for one height runs
+    tens of seconds at 25 validators. A propose timeout below the
+    diffusion time is a round-churn machine: nodes nil-prevote before
+    the proposal reaches them, every round restarts the diffusion, and
+    the net only commits ~10 minutes later when the per-round timeout
+    escalation finally overtakes diffusion (observed). Giving round 0
+    room to finish beats churning to round 40."""
+    names = [f"v{i:02d}" for i in range(validators)]
+    big = validators >= 16
+    return ScenarioSpec(
+        name=f"scale_{validators}v",
+        description=f"{validators}-validator mixed-curve net boots "
+                    f"pooled and commits",
+        validators=validators, load_rate=0.0,
+        # the 25v floor: first commit lands ~6 min after the readiness
+        # gate (~N^2 verify work + thread-scheduling latency per gossip
+        # hop on one shared core), and each following height costs
+        # minutes again. 12 min of injected runtime is what "commits,
+        # in agreement" needs; small rungs keep the 1-min profile.
+        duration_s=720.0 if big else 60.0,
+        settle_s=15.0 if big else 10.0, timeout_s=900.0,
+        key_types=mixed_key_types(names),
+        # NO shared sidecar here: on a single-host net this size the
+        # round trip runs ~900ms under the VoteSet lock (the daemon
+        # shares the same starved core), an order of magnitude worse
+        # than the 20-78ms in-process verify it replaces. Sidecar
+        # compositions live in the smaller-net scenarios.
+        config={
+            "consensus.timeout_propose_ns":
+                (15 if big else 5) * SECOND_NS,
+            "consensus.timeout_prevote_ns":
+                (8 if big else 2) * SECOND_NS,
+            "consensus.timeout_precommit_ns":
+                (8 if big else 2) * SECOND_NS,
+            "consensus.timeout_commit_ns":
+                (2 if big else 1) * SECOND_NS,
+            "consensus.skip_timeout_commit": False,
+            # idle gossip polling is the other big-net killer: ~2 loops
+            # per peer-end at the default 10ms pace is ~50k wakeups/s on
+            # a 25-node chord net, all against one GIL. 250ms adds at
+            # most ~sleep x log2(n) hops of relay latency (the send path
+            # never sleeps) — noise against 15s propose timeouts.
+            "consensus.gossip_sleep_ns":
+                (SECOND_NS // 4) if big else (SECOND_NS // 100),
+            "health.consensus_stall_timeout_ns":
+                (180 if big else 60) * SECOND_NS,
+        },
+        oracles=[
+            OracleSpec("height_min", {"min": 2 if big else 3}),
+            OracleSpec("height_spread", {"max": 3}),
+            OracleSpec("chain_agreement"),
+        ])
+
+
+def trickle_load(rate: float = 4.0,
+                 slo_ms: float = 30_000.0) -> ScenarioSpec:
+    """Low-rate open-loop load tier for compositions whose other
+    layers already saturate the host: keeps real txs flowing through
+    the mempool/commit path (and the per-tx journey rings populated)
+    without the throughput tier's cadence pressure. ``slo_ms`` is the
+    p99 submit->commit budget — calibrate it to the composed net's
+    block cadence (a 25-validator single-host net commits in minutes,
+    not seconds)."""
+    return ScenarioSpec(
+        name="trickle_load",
+        description=f"{rate} tx/s trickle: journeys complete under a "
+                    "relaxed SLO",
+        validators=3, load_rate=rate, load_size=32,
+        duration_s=20.0, settle_s=5.0,
+        oracles=[
+            OracleSpec("latency_p99_under_slo",
+                       {"slo_ms": slo_ms, "min_count": 5}),
+            OracleSpec("chain_agreement"),
+        ])
+
+
+def storm_under_wan_load() -> ScenarioSpec:
+    """The ROADMAP composition, literally: sidecar crash storm UNDER
+    WAN reshaping UNDER throughput-tier load, one net, one verdict.
+    Every layer's oracles must hold simultaneously: fallback lanes
+    cover every daemon kill while 200 ms/5%-loss shaping stretches the
+    gossip fabric and the load tier keeps per-tx p99 under its SLO."""
+    return compose(
+        "storm_under_wan_load",
+        sidecar_crash_storm(), wan_200ms(), latency_under_load(),
+        description="sidecar crash storm ∘ wan 200ms ∘ throughput "
+                    "load: all three layers' invariants hold at once",
+        overrides={
+            # three layers on one host: hold the throughput tier's
+            # rate but widen its p99 SLO to the WAN cadence (the
+            # un-composed entries budget for loopback block intervals)
+            "load_rate": 25.0,
+            "timeout_s": 300.0,
+        })
+
+
+def churn_under_wan() -> ScenarioSpec:
+    """Process churn composed onto WAN shaping: rolling validator
+    restarts and a mid-run validator-set rotation tx, all under
+    200 ms/5%-loss links. Restarted nodes must blocksync back through
+    the shaped fabric and the set change must still reach every node."""
+    return compose(
+        "churn_under_wan",
+        churn_rotation(), wan_200ms(),
+        description="rolling restarts + valset rotation ∘ wan 200ms",
+        overrides={"timeout_s": 300.0})
+
+
+def wal_under_lan() -> ScenarioSpec:
+    """The FAST composed pair-member: crash_restart_wal's double
+    SIGKILL composed onto mild 50 ms shaping and a tx trickle — cheap
+    enough to ride tier-1, while still exercising the full composition
+    machinery (three layers, interleaved timeline, per-layer verdict
+    attribution) on every CI run."""
+    return compose(
+        "wal_under_lan",
+        crash_restart_wal(), lan_50ms(), trickle_load(),
+        description="kill -9 twice ∘ lan 50ms ∘ trickle load: WAL "
+                    "replay rejoins through a shaped fabric")
+
+
+def scale_rung_25() -> ScenarioSpec:
+    """The scale acceptance rung: a 25-validator mixed-curve net under
+    trickle load, with one mid-run validator restart. Boots via pooled
+    waves + /readyz gating; PASS = commits land in agreement on all 25
+    with the restarted node back inside the spread.
+
+    No shaping layer here, deliberately: per-connection shaping threads
+    on top of ~125 chord connections starve the single-core host so
+    thoroughly that even health RPCs time out and prevote quorum never
+    aggregates (every node frozen at 1/0/Prevote for the whole run).
+    Shaped compositions live in the smaller-net scenarios
+    (storm_under_wan_load, churn_under_wan); this rung exists to prove
+    the 10-50 validator floor boots and commits."""
+    base = scale_rung(25)
+    # p99 budget = a few of the big net's minute-scale block intervals
+    # (the first block sweeps up every tx submitted while it diffused)
+    load = trickle_load(1.0, slo_ms=900_000.0)
+    spec = compose(
+        "scale_rung_25", base, load,
+        description="25 validators ∘ trickle load: the 10-50 rung "
+                    "boots pooled and commits",
+        overrides={"settle_s": 15.0, "load_rate": 1.0})
+    # restart lands mid-run: late enough that the first commits are
+    # down, early enough that the node must rejoin before the judge
+    spec.faults.append(FaultAction(180.0, "restart", node="v24",
+                                   params={"down_s": 1.0},
+                                   layer=base.name))
+    return spec
+
+
+COMPOSED = ("storm_under_wan_load", "churn_under_wan", "wal_under_lan",
+            "scale_rung_25")
+
 SCENARIOS = {
     "split_brain": split_brain,
     "sidecar_crash_storm": sidecar_crash_storm,
@@ -332,10 +545,15 @@ SCENARIOS = {
     "crash_restart_wal": crash_restart_wal,
     "laggard": laggard,
     "amnesia": amnesia,
+    "lan_50ms": lan_50ms,
+    "scale_rung_25": scale_rung_25,
+    "storm_under_wan_load": storm_under_wan_load,
+    "churn_under_wan": churn_under_wan,
+    "wal_under_lan": wal_under_lan,
 }
 
 # cheap enough for tier-1 (the ``scenarios`` pytest marker)
-FAST = ("equivocation", "crash_restart_wal")
+FAST = ("equivocation", "wal_under_lan")
 
 
 def names() -> list:

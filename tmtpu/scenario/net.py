@@ -67,7 +67,8 @@ def build_manifest(spec: ScenarioSpec, sidecar_addr: str = "") -> Manifest:
     return make_manifest(
         f"scenario-{spec.name}", spec.node_names(),
         base_config=base, node_config=spec.node_config,
-        key_type=spec.key_type, misbehaviors=spec.misbehaviors,
+        key_type=spec.key_type, key_types=spec.key_types,
+        misbehaviors=spec.misbehaviors,
         start_at=start_at, load_rate=spec.load_rate,
         load_size=spec.load_size, target_height=12,
         timeout_s=spec.timeout_s)
@@ -208,11 +209,13 @@ class ScenarioNet(Runner):
     def _rewrite_config(self, node, mutate) -> None:
         """Regenerate a down node's config.toml through the same path
         setup() used, apply ``mutate(cfg)``, and persist."""
+        from tmtpu.e2e.localnet import chord_peer_names
         cfg = self._node_config(node)
         peers = {n.spec.name: f"{n.node_id}@127.0.0.1:{n.p2p_port}"
                  for n in self.nodes}
+        plan = chord_peer_names([n.spec.name for n in self.nodes])
         cfg.p2p.persistent_peers = ",".join(
-            p for name, p in peers.items() if name != node.spec.name)
+            peers[name] for name in plan[node.spec.name])
         mutate(cfg)
         cfg_toml.write_config(
             cfg, os.path.join(node.home, "config", "config.toml"))

@@ -19,6 +19,7 @@ Conventions:
 
 from __future__ import annotations
 
+import random
 from dataclasses import dataclass, field
 
 # Every fault op the engine knows how to execute. check_scenarios lints
@@ -44,6 +45,9 @@ FAULT_OPS = (
     "join_statesync",   # configure state_sync from live RPC, then start
 )
 
+# curves a spec may assign per node via ``key_types``
+KEY_TYPES = ("ed25519", "sr25519", "secp256k1")
+
 
 @dataclass
 class FaultAction:
@@ -51,19 +55,29 @@ class FaultAction:
     op: str
     node: str = ""                       # target node name ("" = net-wide)
     params: dict = field(default_factory=dict)
+    # composed scenarios tag every action with the layer that
+    # contributed it, so verdicts attribute failures per layer
+    layer: str = ""
 
     def to_dict(self) -> dict:
-        return {"at_s": self.at_s, "op": self.op, "node": self.node,
-                "params": dict(self.params)}
+        d = {"at_s": self.at_s, "op": self.op, "node": self.node,
+             "params": dict(self.params)}
+        if self.layer:
+            d["layer"] = self.layer
+        return d
 
 
 @dataclass
 class OracleSpec:
     name: str
     params: dict = field(default_factory=dict)
+    layer: str = ""                      # contributing layer (composed)
 
     def to_dict(self) -> dict:
-        return {"name": self.name, "params": dict(self.params)}
+        d = {"name": self.name, "params": dict(self.params)}
+        if self.layer:
+            d["layer"] = self.layer
+        return d
 
 
 @dataclass
@@ -90,9 +104,15 @@ class ScenarioSpec:
     oracles: list = field(default_factory=list)    # [OracleSpec]
     timeout_s: float = 180.0             # hard ceiling on the whole run
     key_type: str = "ed25519"
+    # node name -> curve, overriding key_type per node (mixed-curve nets)
+    key_types: dict = field(default_factory=dict)
     # full nodes start with the net by default; "manual" waits for a
     # start/join_statesync action
     full_node_start: str = "auto"
+    # composed scenarios (see compose()): ordered layer names, plus the
+    # per-layer provenance the scenarios lint rule re-checks offline
+    layers: list = field(default_factory=list)
+    composition: dict = field(default_factory=dict)
 
     # -- naming --------------------------------------------------------------
 
@@ -162,16 +182,76 @@ class ScenarioSpec:
                 parse_links(self.links)
             except ValueError as e:
                 problems.append(f"{self.name}: bad links spec: {e}")
+        for node, curve in self.key_types.items():
+            if node not in names:
+                problems.append(
+                    f"{self.name}: key_types names unknown node {node!r}")
+            if curve not in KEY_TYPES:
+                problems.append(
+                    f"{self.name}: key_types[{node!r}] = {curve!r} is not "
+                    f"one of {sorted(KEY_TYPES)}")
         if not self.oracles:
             problems.append(f"{self.name}: no oracles — nothing to judge")
         if any(f.op.startswith("sidecar") for f in self.faults) \
                 and not self.sidecar:
             problems.append(
                 f"{self.name}: sidecar fault ops but sidecar=False")
+        problems.extend(self.composition_problems())
+        return problems
+
+    def composition_problems(self) -> list:
+        """Consistency of the composed-spec metadata (empty for plain
+        specs). compose() can never emit these; they catch hand-edited
+        composed specs whose layer tags or provenance drifted."""
+        problems = []
+        if not self.layers and not self.composition:
+            for fa in self.faults:
+                if fa.layer:
+                    problems.append(
+                        f"{self.name}: fault {fa.op!r} carries layer tag "
+                        f"{fa.layer!r} but the spec has no layers")
+            return problems
+        if sorted(set(self.layers)) != sorted(self.layers):
+            problems.append(f"{self.name}: duplicate layer names "
+                            f"{self.layers}")
+        known = set(self.layers)
+        prov_keys = {k for k in self.composition
+                     if not k.startswith("__")}
+        if prov_keys != known:
+            problems.append(
+                f"{self.name}: composition provenance keys "
+                f"{sorted(prov_keys)} != layers {self.layers}")
+        for fa in self.faults:
+            if fa.layer and fa.layer not in known:
+                problems.append(
+                    f"{self.name}: fault {fa.op!r} at t={fa.at_s} tagged "
+                    f"with unknown layer {fa.layer!r}")
+        for osp in self.oracles:
+            if osp.layer and osp.layer not in known:
+                problems.append(
+                    f"{self.name}: oracle {osp.name!r} tagged with "
+                    f"unknown layer {osp.layer!r}")
+        # cross-layer collisions: two layers claiming the same config
+        # key, misbehaving node, node_config node, or per-node curve
+        # would have been a merge conflict at compose() time —
+        # re-derive from provenance
+        seen: dict = {}
+        for layer in self.layers:
+            prov = self.composition.get(layer) or {}
+            for kind in ("config_keys", "node_config", "misbehaviors",
+                         "key_types"):
+                for item in prov.get(kind, ()):
+                    prior = seen.get((kind, item))
+                    if prior is not None:
+                        problems.append(
+                            f"{self.name}: layers {prior!r} and "
+                            f"{layer!r} both claim {kind} {item!r} — "
+                            f"unresolved merge collision")
+                    seen[(kind, item)] = layer
         return problems
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "name": self.name, "description": self.description,
             "validators": self.validators, "full_nodes": self.full_nodes,
             "sidecar": self.sidecar, "load_rate": self.load_rate,
@@ -182,3 +262,182 @@ class ScenarioSpec:
             "faults": [f.to_dict() for f in self.faults],
             "oracles": [o.to_dict() for o in self.oracles],
         }
+        if self.key_types:
+            d["key_types"] = dict(self.key_types)
+        if self.layers:
+            d["layers"] = list(self.layers)
+            d["composition"] = {k: dict(v) for k, v in
+                                self.composition.items()}
+        return d
+
+
+class CompositionError(ValueError):
+    """compose() found merge conflicts; ``problems`` lists all of them
+    (the exception renders the full list, not just the first)."""
+
+    def __init__(self, name: str, problems: list):
+        self.problems = list(problems)
+        super().__init__(
+            f"cannot compose {name!r}: " + "; ".join(self.problems))
+
+
+def compose(name: str, *layer_specs: ScenarioSpec,
+            description: str = "", seed: int = None,
+            overrides: dict = None) -> ScenarioSpec:
+    """Merge layer specs into one judged scenario — ``fault ∘ wan ∘
+    load`` runs as a single net with a single verdict.
+
+    Merge semantics, per field:
+
+    - **nodes**: union by canonical name — ``validators``/``full_nodes``
+      take the max across layers (layers address the same ``v00…`` name
+      space, so a 3-validator fault layer composes onto a 4-validator
+      WAN layer and targets the first three).
+    - **load**: the layer offering the highest ``load_rate`` supplies
+      rate and size (the throughput tier wins).
+    - **durations**: ``duration_s``/``settle_s``/``timeout_s`` take the
+      max — every layer's timeline must fit.
+    - **config / node_config / misbehaviors / links / key_types /
+      key_type / full_node_start**: union with conflict DETECTION — two
+      layers writing different values to the same key is a
+      ``CompositionError``, never a silent last-writer-wins (resolve
+      explicitly via ``overrides``).
+    - **faults**: every action is copied and tagged with its layer
+      name; the merged timeline is sorted by ``at_s`` with exact
+      cross-layer ties broken by a deterministic seeded jitter
+      (0.05–0.5 s) so composed runs replay identically for a seed and
+      no two layers race the same scheduling slot.
+    - **oracles**: union, de-duplicated by (name, params); first
+      contributing layer keeps the tag. Every layer's invariants are
+      judged over the composed run.
+    - **overrides**: applied last onto the merged spec (e.g. shrink
+      ``load_rate`` for a CI box) and recorded in the provenance.
+
+    The returned spec carries ``layers`` (order matters: later layers
+    are "under" earlier ones only in name — merge is symmetric except
+    for conflicts) and ``composition`` provenance that
+    ``composition_problems()`` and the scenarios lint rule re-check.
+    """
+    if len(layer_specs) < 2:
+        raise CompositionError(name, ["need at least two layers"])
+    names = [sp.name for sp in layer_specs]
+    problems = []
+    if len(set(names)) != len(names):
+        problems.append(f"duplicate layer names {names}")
+    if any(sp.layers for sp in layer_specs):
+        nested = [sp.name for sp in layer_specs if sp.layers]
+        problems.append(f"layers {nested} are themselves composed — "
+                        f"flatten before composing")
+
+    out = ScenarioSpec(
+        name=name,
+        description=description or " ∘ ".join(names),
+        validators=max(sp.validators for sp in layer_specs),
+        full_nodes=max(sp.full_nodes for sp in layer_specs),
+        sidecar=any(sp.sidecar for sp in layer_specs),
+        duration_s=max(sp.duration_s for sp in layer_specs),
+        settle_s=max(sp.settle_s for sp in layer_specs),
+        timeout_s=max(sp.timeout_s for sp in layer_specs),
+        seed=seed if seed is not None else layer_specs[0].seed,
+    )
+    loader = max(layer_specs, key=lambda sp: sp.load_rate)
+    out.load_rate, out.load_size = loader.load_rate, loader.load_size
+
+    # single-writer fields: at most one layer may deviate from default
+    def single(field_name, default):
+        setters = [(sp.name, getattr(sp, field_name))
+                   for sp in layer_specs
+                   if getattr(sp, field_name) != default]
+        values = {v for _, v in setters}
+        if len(values) > 1:
+            problems.append(
+                f"{field_name} conflict: " +
+                ", ".join(f"{n}={v!r}" for n, v in setters))
+        return setters[0][1] if setters else default
+
+    out.links = single("links", "")
+    out.key_type = single("key_type", "ed25519")
+    out.full_node_start = single("full_node_start", "auto")
+
+    provenance: dict = {}
+    owner: dict = {}           # (kind, key) -> (layer, value)
+
+    def claim(layer, kind, key, value):
+        prior = owner.get((kind, key))
+        if prior is not None and prior[1] != value:
+            problems.append(
+                f"{kind} conflict on {key!r}: {prior[0]}="
+                f"{prior[1]!r} vs {layer}={value!r}")
+            return False
+        owner[(kind, key)] = (layer, value)
+        return prior is None
+
+    for sp in layer_specs:
+        prov = {"config_keys": [], "node_config": [], "misbehaviors": [],
+                "key_types": [],
+                "faults": len(sp.faults), "oracles": len(sp.oracles),
+                "validators": sp.validators, "load_rate": sp.load_rate}
+        for key, val in sp.config.items():
+            if claim(sp.name, "config_keys", key, val):
+                out.config[key] = val
+                prov["config_keys"].append(key)
+        for node, nc in sp.node_config.items():
+            if claim(sp.name, "node_config", node,
+                     tuple(sorted(nc.items()))):
+                out.node_config[node] = dict(nc)
+                prov["node_config"].append(node)
+        for node, roster in sp.misbehaviors.items():
+            if claim(sp.name, "misbehaviors", node,
+                     tuple(sorted(roster.items()))):
+                out.misbehaviors[node] = dict(roster)
+                prov["misbehaviors"].append(node)
+        for node, curve in sp.key_types.items():
+            if claim(sp.name, "key_types", node, curve):
+                out.key_types[node] = curve
+                prov["key_types"].append(node)
+        provenance[sp.name] = prov
+
+    # interleave the fault timelines: stable at_s order, cross-layer
+    # exact ties broken by seeded jitter so the composed schedule is
+    # deterministic for a seed and never double-books an instant
+    rng = random.Random(f"compose:{name}:{out.seed}")
+    merged = []
+    for sp in layer_specs:
+        for fa in sp.faults:
+            merged.append(FaultAction(fa.at_s, fa.op, fa.node,
+                                      dict(fa.params), layer=sp.name))
+    merged.sort(key=lambda fa: fa.at_s)
+    taken: set = set()
+    for fa in merged:
+        while round(fa.at_s, 3) in taken:
+            fa.at_s = round(fa.at_s + rng.uniform(0.05, 0.5), 3)
+        taken.add(round(fa.at_s, 3))
+    out.faults = sorted(merged, key=lambda fa: fa.at_s)
+    if out.faults:        # jitter may push a tail tie past the window
+        out.duration_s = max(out.duration_s, out.faults[-1].at_s)
+
+    seen_oracles: set = set()
+    for sp in layer_specs:
+        for osp in sp.oracles:
+            key = (osp.name, tuple(sorted(
+                (k, repr(v)) for k, v in osp.params.items())))
+            if key in seen_oracles:
+                continue
+            seen_oracles.add(key)
+            out.oracles.append(OracleSpec(osp.name, dict(osp.params),
+                                          layer=sp.name))
+
+    out.layers = list(names)
+    out.composition = provenance
+    for key, val in (overrides or {}).items():
+        if not hasattr(out, key):
+            problems.append(f"override targets unknown field {key!r}")
+            continue
+        setattr(out, key, val)
+    if overrides:
+        out.composition["__overrides__"] = dict(overrides)
+        # provenance keys must mirror layers exactly; park overrides
+        # under a reserved name the consistency check skips
+    if problems:
+        raise CompositionError(name, problems)
+    return out

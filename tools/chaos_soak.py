@@ -1,0 +1,522 @@
+#!/usr/bin/env python
+"""Chaos soak: an open-ended rotating fault schedule under load.
+
+Where tools/scenario_run.py executes one FIXED fault timeline and judges
+once at the end, the soak driver keeps a localnet under open-loop load
+for MINUTES while a seeded schedule rotates through the registered fault
+ops — kill/restart, SIGSTOP, partition/heal, link reshape, sidecar
+crash bursts, privval amnesia — one fault EPOCH at a time, judging a
+rolling-window verdict checkpoint at the end of every epoch:
+
+    epoch i:  [inject ... recover]  [stabilize]  [checkpoint]
+              <------------------ epoch_s ------------------->
+
+A checkpoint gathers fresh RPC evidence and judges the always-on
+invariants (chain agreement, height spread, watchdog health, per-tx p99
+SLO) plus forward progress since the previous checkpoint, and persists
+itself to ``<outdir>/checkpoints/epoch_NNN.json`` — a soak that dies at
+minute 40 leaves 39 minutes of verdicts behind. The final digest
+aggregates every epoch with per-fault-epoch attribution: which epoch's
+fault broke which invariant.
+
+SIGTERM/SIGINT drain gracefully: the schedule stops, the in-flight
+epoch is abandoned, load stops, and a PARTIAL verdict (everything
+judged so far plus one last evidence sweep) is persisted before the
+net is torn down join-clean.
+
+    python tools/chaos_soak.py --validators 10 --minutes 10 --seed 1
+    python tools/chaos_soak.py --validators 4 --minutes 2 --epoch-s 24
+    python tools/chaos_soak.py --list-ops
+
+Exit 0 = every checkpoint and the final judgment passed, 1 = any
+failed, 2 = usage error. All timing/fault choices derive from --seed,
+so a failing soak replays deterministically (modulo scheduler jitter).
+
+Built on the same shared harness as everything else: the ScenarioEngine
+piecewise lifecycle (boot / execute_action / gather_evidence / judge /
+shutdown) over the tmtpu/e2e/localnet.py boot path — big nets come up
+through pooled waves with /readyz gating, not fixed sleeps.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import signal
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tmtpu.scenario.engine import ScenarioEngine  # noqa: E402
+from tmtpu.scenario.library import SECOND_NS, mixed_key_types  # noqa: E402
+from tmtpu.scenario.spec import (FaultAction, OracleSpec,  # noqa: E402
+                                 ScenarioSpec)
+
+_SAMPLE_INTERVAL_S = 0.7            # engine sampler cadence (engine.py)
+_CHECKPOINT_BLOCK_CAP = 40          # rolling window, not the full chain
+
+
+# -- the soak net --------------------------------------------------------------
+
+def build_soak_spec(validators: int, *, seed: int = 1,
+                    load_rate: float = 5.0, sidecar: bool = True,
+                    mixed_curves: bool = True,
+                    slo_ms: float = 30_000.0) -> ScenarioSpec:
+    """The soak net as a ScenarioSpec: mixed-curve validators on
+    production-shaped consensus timeouts once the net is big enough
+    that the fast profile's 400 ms propose window would churn rounds
+    on a shared host. The spec's own oracles are the FINAL judgment
+    set; checkpoints use the rolling set below."""
+    names = [f"v{i:02d}" for i in range(validators)]
+    big = validators >= 8
+    config = {
+        # soak faults legitimately stall pockets of the net; the
+        # watchdog must flag them DURING the epoch and recover by the
+        # checkpoint, so the leash sits between the two. Big nets get
+        # a longer leash: block intervals are ~N^2-scaled on a shared
+        # host, so one post-fault catch-up pocket (a node rejoining
+        # through residual backlog) runs 30-50s without anything
+        # being wrong — observed at 10 validators after a reshape
+        # epoch while every other node stayed green
+        "health.consensus_stall_timeout_ns":
+            (60 if big else 30) * SECOND_NS,
+        # forensics need a real NEW_HEIGHT wait: with the fast
+        # profile's skip_timeout_commit a node charges the
+        # quorum-surplus straggler precommit as a miss and the flap
+        # watchdog smears across honest validators (see the laggard
+        # scenario's profile note in tmtpu/scenario/library.py)
+        "consensus.skip_timeout_commit": False,
+        "consensus.timeout_commit_ns": SECOND_NS // 4,
+        # a soak epoch legitimately flaps its target validator (kill,
+        # pause, amnesia all toggle participation); checkpoints judge
+        # the net AFTER recovery, so the flap window must age a fault
+        # epoch out before its checkpoint and the threshold must
+        # absorb blocksync-tail stragglers
+        "health.validator_flap_window_ns": 30 * SECOND_NS,
+        "health.validator_flap_threshold": 8,
+    }
+    if big:
+        config.update({
+            "consensus.timeout_propose_ns": 5 * SECOND_NS,
+            "consensus.timeout_prevote_ns": 2 * SECOND_NS,
+            "consensus.timeout_precommit_ns": 2 * SECOND_NS,
+            "consensus.timeout_commit_ns": SECOND_NS,
+            # reference-pace idle gossip polling (100ms, vs the test
+            # profile's 10ms): a 10-node full mesh runs ~180 polling
+            # loops and the idle wakeups alone eat a visible slice of
+            # the single shared core (see scale_rung for the math)
+            "consensus.gossip_sleep_ns": SECOND_NS // 10,
+        })
+    return ScenarioSpec(
+        name=f"chaos_soak_{validators}v",
+        description=f"{validators}-validator rotating-fault soak",
+        validators=validators,
+        sidecar=sidecar,
+        load_rate=load_rate, load_size=32,
+        duration_s=0.0,                 # driven open-ended, not timed
+        settle_s=10.0,
+        timeout_s=0.0,
+        seed=seed,
+        key_types=mixed_key_types(names) if mixed_curves else {},
+        config=config,
+        oracles=[
+            OracleSpec("chain_agreement"),
+            OracleSpec("height_spread", {"max": 4}),
+            OracleSpec("all_healthy"),
+            OracleSpec("latency_p99_under_slo",
+                       {"slo_ms": slo_ms, "min_count": 5}),
+        ])
+
+
+def checkpoint_oracles(slo_ms: float = 30_000.0) -> list:
+    """The rolling-window invariant set judged at every epoch end."""
+    return [
+        OracleSpec("chain_agreement"),
+        OracleSpec("height_spread", {"max": 4}),
+        OracleSpec("all_healthy"),
+        OracleSpec("latency_p99_under_slo",
+                   {"slo_ms": slo_ms, "min_count": 5}),
+    ]
+
+
+# -- the rotating fault schedule -----------------------------------------------
+#
+# Each epoch op is a builder: (rng, spec) -> [(offset_s, FaultAction)].
+# Offsets are relative to epoch start; every op recovers well before
+# the epoch's stabilize window so the checkpoint judges a healed net.
+# Faults carry layer="soak:<op>" so engine events attribute to the
+# epoch op that caused them, same mechanism as composed-spec layers.
+
+def _pick(rng: random.Random, spec: ScenarioSpec) -> str:
+    """A random validator that is NOT v00 — the load path and the
+    statesync/trust anchors prefer the first node, so the soak leaves
+    one stable observer."""
+    return f"v{rng.randrange(1, spec.validators):02d}"
+
+
+def _op_kill_restart(rng, spec):
+    node = _pick(rng, spec)
+    down = round(rng.uniform(2.0, 5.0), 1)
+    lay = "soak:kill_restart"
+    return [(0.0, FaultAction(0.0, "kill", node=node, layer=lay)),
+            (down, FaultAction(down, "start", node=node, layer=lay))]
+
+
+def _op_pause(rng, spec):
+    node = _pick(rng, spec)
+    for_s = round(rng.uniform(5.0, 10.0), 1)
+    return [(0.0, FaultAction(0.0, "pause", node=node,
+                              params={"for_s": for_s},
+                              layer="soak:pause"))]
+
+
+def _op_partition(rng, spec):
+    victim = _pick(rng, spec)
+    rest = [n for n in spec.node_names() if n != victim]
+    hold = round(rng.uniform(8.0, 12.0), 1)
+    lay = "soak:partition"
+    return [(0.0, FaultAction(0.0, "partition",
+                              params={"groups": [rest, [victim]]},
+                              layer=lay)),
+            (hold, FaultAction(hold, "heal", layer=lay))]
+
+
+def _op_reshape(rng, spec):
+    ms = rng.randrange(100, 250)
+    hold = round(rng.uniform(8.0, 12.0), 1)
+    links = f"*:latency_ms={ms},jitter_ms={ms // 5},drop=0.02"
+    lay = "soak:reshape"
+    return [(0.0, FaultAction(0.0, "shape", params={"links": links},
+                              layer=lay)),
+            (hold, FaultAction(hold, "clear_shape", layer=lay))]
+
+
+def _op_sidecar_storm(rng, spec):
+    lay = "soak:sidecar_storm"
+    out, t = [], 0.0
+    for _ in range(rng.randrange(2, 4)):
+        out.append((t, FaultAction(t, "sidecar_kill", node="sidecar",
+                                   layer=lay)))
+        t += 2.0
+        out.append((t, FaultAction(t, "sidecar_restart", node="sidecar",
+                                   layer=lay)))
+        t += round(rng.uniform(1.0, 3.0), 1)
+    return out
+
+
+def _op_amnesia(rng, spec):
+    return [(0.0, FaultAction(0.0, "amnesia", node=_pick(rng, spec),
+                              layer="soak:amnesia"))]
+
+
+FAULT_OPS = {
+    "kill_restart": _op_kill_restart,
+    "pause": _op_pause,
+    "partition": _op_partition,
+    "reshape": _op_reshape,
+    "sidecar_storm": _op_sidecar_storm,
+    "amnesia": _op_amnesia,
+}
+
+
+def epoch_plan(spec: ScenarioSpec, epochs: int, *,
+               ops=None) -> list:
+    """The seeded rotating schedule: shuffle the op names once, cycle
+    through the rotation for ``epochs`` epochs, and give each epoch its
+    own rng substream so fault parameters replay per-epoch regardless
+    of how many epochs actually ran before a drain."""
+    names = sorted(ops or FAULT_OPS)
+    if not spec.sidecar:
+        names = [n for n in names if n != "sidecar_storm"]
+    rotation = list(names)
+    random.Random(f"soak:{spec.seed}:rotation").shuffle(rotation)
+    plan = []
+    for i in range(epochs):
+        op = rotation[i % len(rotation)]
+        rng = random.Random(f"soak:{spec.seed}:epoch{i}:{op}")
+        plan.append({"epoch": i, "op": op,
+                     "timeline": FAULT_OPS[op](rng, spec)})
+    return plan
+
+
+# -- the driver ----------------------------------------------------------------
+
+class SoakDriver:
+    """Owns one soak run: engine lifecycle, the epoch loop, rolling
+    checkpoints, signal-drained partial verdicts, the final digest.
+
+    All waiting goes through ``self._stop.wait()`` so a SIGTERM (or a
+    test calling ``request_stop()``) interrupts any phase within one
+    wait quantum and the drain path runs exactly once."""
+
+    def __init__(self, spec: ScenarioSpec, outdir: str, *,
+                 epoch_s: float = 90.0, epochs: int = 5,
+                 slo_ms: float = 30_000.0, log=None):
+        self.spec = spec
+        self.outdir = outdir
+        self.epoch_s = epoch_s
+        self.epochs = epochs
+        self.slo_ms = slo_ms
+        self._log = log or (lambda m: None)
+        self.engine = ScenarioEngine(spec, outdir, log=self._log)
+        self.plan = epoch_plan(spec, epochs)
+        self.checkpoints: list = []
+        self.drained_by: str = ""
+        self._stop = threading.Event()
+        self._last_heights: dict = {}
+
+    # -- control ------------------------------------------------------
+
+    def request_stop(self, reason: str = "stop") -> None:
+        """Ask the soak to drain: the epoch loop exits at its next wait
+        quantum and run() finishes with a partial verdict. Safe from
+        signal handlers and other threads; first reason wins."""
+        if not self.drained_by:
+            self.drained_by = reason
+        self._stop.set()
+
+    def install_signal_handlers(self) -> None:
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, lambda signum, frame: self.request_stop(
+                signal.Signals(signum).name))
+
+    def _wait(self, seconds: float) -> bool:
+        """Interruptible sleep; True = keep going, False = draining."""
+        return not self._stop.wait(max(0.0, seconds))
+
+    # -- checkpoints --------------------------------------------------
+
+    def _checkpoint(self, epoch: dict, t_start: float,
+                    events_from: int) -> dict:
+        """Judge the rolling-window invariants NOW and persist the
+        result. ``events_from`` indexes the engine event log at epoch
+        start, so the checkpoint carries exactly this epoch's faults."""
+        ev = self.engine.gather_evidence(
+            block_cap=_CHECKPOINT_BLOCK_CAP)
+        verdicts = self.engine.judge(
+            ev, oracle_specs=checkpoint_oracles(self.slo_ms))
+        heights = ev.final_heights()
+        progressed = (not self._last_heights or
+                      max(heights.values(), default=-1) >
+                      max(self._last_heights.values(), default=-1))
+        self._last_heights = heights
+        forensics = {n: ev.blamed_validator(n)
+                     for n in ev.node_names()
+                     if ev.blamed_validator(n)}
+        cp = {
+            "epoch": epoch["epoch"], "op": epoch["op"],
+            "t_start": round(t_start, 3),
+            "t_end": round(self.engine.now(), 3),
+            "events": self.engine.events[events_from:],
+            "oracles": verdicts,
+            "progress": {"heights": heights, "ok": progressed},
+            "forensics": forensics,
+            "pass": progressed and all(v["pass"] for v in verdicts),
+        }
+        self.checkpoints.append(cp)
+        self._persist_checkpoint(cp)
+        mark = "PASS" if cp["pass"] else "FAIL"
+        bad = [v["name"] for v in verdicts if not v["pass"]]
+        self._log(f"checkpoint {mark} epoch {epoch['epoch']} "
+                  f"[{epoch['op']}]"
+                  + (f" failed={bad}" if bad else "")
+                  + ("" if progressed else " NO PROGRESS"))
+        # rolling window: keep ~2 epochs of samples so long soaks
+        # don't grow without bound
+        self.engine.trim_samples(
+            int(2 * self.epoch_s / _SAMPLE_INTERVAL_S)
+            * max(1, self.spec.validators))
+        return cp
+
+    def _persist_checkpoint(self, cp: dict) -> None:
+        try:
+            d = os.path.join(self.outdir, "checkpoints")
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(d, f"epoch_{cp['epoch']:03d}.json")
+            with open(path, "w") as f:
+                json.dump(cp, f, indent=2, sort_keys=True)
+        except OSError:
+            pass        # judging stands; persistence is best-effort
+
+    # -- epochs -------------------------------------------------------
+
+    def _run_epoch(self, epoch: dict) -> None:
+        t_start = self.engine.now()
+        events_from = len(self.engine.events)
+        self._log(f"epoch {epoch['epoch']}/{self.epochs - 1} "
+                  f"[{epoch['op']}] at t={t_start:.1f}s")
+        elapsed = 0.0
+        for offset, action in epoch["timeline"]:
+            if not self._wait(offset - elapsed):
+                return
+            elapsed = offset
+            self.engine.execute_action(action)
+        # stabilize: the rest of the epoch belongs to recovery
+        if not self._wait(self.epoch_s - elapsed):
+            return
+        self._checkpoint(epoch, t_start, events_from)
+
+    # -- verdicts -----------------------------------------------------
+
+    def _final_verdict(self, partial: bool) -> dict:
+        """One last settle + full-evidence judgment, then the digest:
+        per-fault-epoch attribution over every checkpoint plus the
+        spec's own final oracle set."""
+        self.engine.net.stop_load()
+        if self.spec.settle_s > 0 and not partial:
+            self._log(f"settling {self.spec.settle_s}s before the "
+                      f"final judgment")
+            time.sleep(self.spec.settle_s)
+        self.engine.stop_sampler()
+        ev = self.engine.gather_evidence()
+        final = self.engine.judge(ev)
+        epochs_failed = [
+            {"epoch": c["epoch"], "op": c["op"],
+             "oracles_failed": [v["name"] for v in c["oracles"]
+                                if not v["pass"]],
+             "progress_ok": c["progress"]["ok"]}
+            for c in self.checkpoints if not c["pass"]]
+        verdict = {
+            "soak": self.spec.name,
+            "seed": self.spec.seed,
+            "partial": partial,
+            "drained_by": self.drained_by,
+            "epochs_planned": self.epochs,
+            "epochs_judged": len(self.checkpoints),
+            "epochs_failed": epochs_failed,
+            "epoch_ops": [c["op"] for c in self.checkpoints],
+            "final_oracles": final,
+            "final_heights": ev.final_heights(),
+            "events_total": len(self.engine.events),
+            "sidecar_kills": self.engine.net.sidecar_kills,
+            "pass": (all(c["pass"] for c in self.checkpoints)
+                     and all(v["pass"] for v in final)),
+            "outdir": self.outdir,
+        }
+        try:
+            name = "soak_partial.json" if partial else \
+                "soak_verdict.json"
+            os.makedirs(self.outdir, exist_ok=True)
+            with open(os.path.join(self.outdir, name), "w") as f:
+                json.dump(verdict, f, indent=2, sort_keys=True)
+        except OSError:
+            pass
+        return verdict
+
+    # -- the run ------------------------------------------------------
+
+    def run(self) -> dict:
+        problems = self.spec.validate()
+        if problems:
+            raise ValueError(f"invalid soak spec: {problems}")
+        t_wall = time.monotonic()
+        try:
+            self.engine.boot()
+            # let the net commit a baseline before the first fault
+            self._wait(5.0)
+            for epoch in self.plan:
+                if self._stop.is_set():
+                    break
+                self._run_epoch(epoch)
+            partial = self._stop.is_set()
+            if partial:
+                self._log(f"draining ({self.drained_by}): judging "
+                          f"partial verdict")
+            verdict = self._final_verdict(partial)
+        finally:
+            self.engine.shutdown()
+        verdict["wall_s"] = round(time.monotonic() - t_wall, 3)
+        return verdict
+
+
+# -- CLI -----------------------------------------------------------------------
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="rotating-fault chaos soak under open-loop load")
+    ap.add_argument("--validators", type=int, default=10)
+    ap.add_argument("--minutes", type=float, default=10.0,
+                    help="total soak duration (epochs = duration / "
+                         "epoch-s, min 1)")
+    ap.add_argument("--epoch-s", type=float, default=90.0,
+                    help="seconds per fault epoch (inject + recover + "
+                         "stabilize + checkpoint)")
+    ap.add_argument("--epochs", type=int, default=0,
+                    help="exact epoch count (overrides --minutes)")
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--load", type=float, default=5.0,
+                    help="open-loop tx/s offered for the whole soak")
+    ap.add_argument("--slo-ms", type=float, default=0.0,
+                    help="per-tx p99 submit->commit SLO at checkpoints "
+                         "(default: 30s up to 7 validators, 60s from 8 "
+                         "— block cadence scales ~N^2 on one host)")
+    ap.add_argument("--no-sidecar", action="store_true",
+                    help="run without the verification sidecar (drops "
+                         "sidecar_storm from the rotation)")
+    ap.add_argument("--uniform-curves", action="store_true",
+                    help="all-ed25519 validators instead of the mixed-"
+                         "curve cycle")
+    ap.add_argument("--outdir", default="",
+                    help="evidence root (default: a fresh tmp dir)")
+    ap.add_argument("--list-ops", action="store_true",
+                    help="list the fault-op rotation and exit")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full verdict as JSON")
+    args = ap.parse_args()
+
+    if args.list_ops:
+        for name in sorted(FAULT_OPS):
+            print(name)
+        return 0
+    if args.validators < 4:
+        print("need >= 4 validators (partition epochs isolate one "
+              "and the rest must keep quorum)", file=sys.stderr)
+        return 2
+
+    epochs = args.epochs or max(1, int(args.minutes * 60.0
+                                       / args.epoch_s))
+    slo_ms = args.slo_ms or \
+        (60_000.0 if args.validators >= 8 else 30_000.0)
+    spec = build_soak_spec(
+        args.validators, seed=args.seed, load_rate=args.load,
+        sidecar=not args.no_sidecar,
+        mixed_curves=not args.uniform_curves, slo_ms=slo_ms)
+    outdir = args.outdir or tempfile.mkdtemp(prefix="tmtpu-soak-")
+    driver = SoakDriver(spec, outdir, epoch_s=args.epoch_s,
+                        epochs=epochs, slo_ms=slo_ms,
+                        log=lambda m: print(f"  {m}", flush=True))
+    driver.install_signal_handlers()
+    print(f"chaos soak: {args.validators} validators, {epochs} epochs "
+          f"x {args.epoch_s:.0f}s, seed {args.seed}, "
+          f"evidence under {outdir}", flush=True)
+    verdict = driver.run()
+
+    if args.json:
+        print(json.dumps(verdict, indent=2, sort_keys=True))
+    else:
+        mark = "PASS" if verdict["pass"] else "FAIL"
+        kind = "PARTIAL " if verdict["partial"] else ""
+        print(f"\n{kind}{mark}: {verdict['epochs_judged']}/"
+              f"{verdict['epochs_planned']} epochs judged "
+              f"({', '.join(verdict['epoch_ops']) or 'none'})")
+        for failed in verdict["epochs_failed"]:
+            print(f"  epoch {failed['epoch']} [{failed['op']}] "
+                  f"failed: {failed['oracles_failed'] or 'no progress'}")
+        bad = [v["name"] for v in verdict["final_oracles"]
+               if not v["pass"]]
+        print(f"  final oracles: "
+              f"{len(verdict['final_oracles']) - len(bad)}/"
+              f"{len(verdict['final_oracles'])} passed"
+              + (f" (failed: {bad})" if bad else ""))
+        print(f"  evidence under {verdict['outdir']}")
+    return 0 if verdict["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

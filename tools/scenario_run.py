@@ -14,9 +14,12 @@ samples.json under the outdir for post-mortems.
 
 Exit 0 = every requested scenario passed, 1 = any verdict failed,
 2 = usage error. ``fast`` expands to the tier-1 pair, ``all`` to the
-whole library. ``--sweep-seeds N`` is the flake hunt: each scenario
-runs N times across consecutive seeds and the digest separates
-deterministic failures from flaky ones.
+whole library, ``composed`` to the compose()d entries. ``--sweep-seeds
+N`` is the flake hunt: each scenario runs N times across consecutive
+seeds and the digest separates deterministic failures from flaky ones —
+for composed scenarios it further attributes each failure to the
+contributing layer (which layer's oracles broke, which layer's faults
+errored).
 """
 
 from __future__ import annotations
@@ -42,11 +45,33 @@ def _expand(names):
             out.extend(library.names())
         elif name == "fast":
             out.extend(library.FAST)
+        elif name == "composed":
+            out.extend(library.COMPOSED)
         else:
             out.append(name)
     # de-dup, keep order
     seen = set()
     return [n for n in out if not (n in seen or seen.add(n))]
+
+
+def _layer_blame(failing):
+    """Aggregate per-layer attribution across failing composed
+    verdicts: layer -> {"oracles": {name: count}, "fault_errors": n,
+    "seeds": [..]}. Empty for plain scenarios (no "layers" block)."""
+    blame = {}
+    for v in failing:
+        for layer, att in (v.get("layers") or {}).items():
+            broke = att.get("oracles_failed") or []
+            errs = att.get("fault_errors") or []
+            if not broke and not errs:
+                continue
+            b = blame.setdefault(layer, {"oracles": {}, "fault_errors": 0,
+                                         "seeds": []})
+            for name in broke:
+                b["oracles"][name] = b["oracles"].get(name, 0) + 1
+            b["fault_errors"] += len(errs)
+            b["seeds"].append(v.get("seed"))
+    return blame
 
 
 def main() -> int:
@@ -76,8 +101,10 @@ def main() -> int:
     if args.list or not args.scenarios:
         for name in library.names():
             spec = library.get(name)
-            fast = " [fast]" if name in library.FAST else ""
-            print(f"{name:22s} {spec.description}{fast}")
+            tags = ("[fast]" if name in library.FAST else "") + \
+                ("[composed]" if name in library.COMPOSED else "")
+            print(f"{name:22s} {spec.description}"
+                  + (f" {tags}" if tags else ""))
         return 0 if args.list else 2
 
     names = _expand(args.scenarios)
@@ -150,7 +177,8 @@ def main() -> int:
             print()
             for name in names:
                 vs = [v for v in verdicts if v["scenario"] == name]
-                failed = [v.get("seed") for v in vs if not v["pass"]]
+                failing = [v for v in vs if not v["pass"]]
+                failed = [v.get("seed") for v in failing]
                 rate = f"{len(vs) - len(failed)}/{len(vs)}"
                 if not failed:
                     print(f"SWEEP {name:22s} {rate} seeds passed")
@@ -160,6 +188,17 @@ def main() -> int:
                 else:
                     print(f"SWEEP {name:22s} {rate} — FLAKY, failing "
                           f"seeds: {sorted(failed)}")
+                # composed scenarios: name the layer(s) the failures
+                # attribute to, so a flaky composition points at the
+                # contributing concern, not just the scenario
+                for layer, b in sorted(_layer_blame(failing).items()):
+                    what = ", ".join(f"{o}x{c}" for o, c in
+                                     sorted(b["oracles"].items()))
+                    if b["fault_errors"]:
+                        what += (", " if what else "") + \
+                            f"{b['fault_errors']} fault error(s)"
+                    print(f"      layer {layer}: {what} "
+                          f"(seeds {sorted(b['seeds'])})")
         print(f"\nevidence under {outroot}")
     return 0 if all(v["pass"] for v in verdicts) else 1
 
