@@ -1,0 +1,425 @@
+"""Light-client serving-tier tests (tmtpu/lightserve): verified-fact
+cache semantics incl. the exact trusting-period boundary, the
+two-cold-clients-one-joint-resolve guarantee with exact per-request hop
+slices, trust-period expiry refusing cached facts and re-verifying via
+backwards hash links, fork rejection on a conflicting trusted hash, the
+lightserve watchdog check, and the [lightserve] config section."""
+
+import threading
+import time
+
+import pytest
+
+from tests.test_light import CHAIN_ID, HOUR_NS, WEEK_NS, ChainProvider, \
+    FabChain
+from tmtpu.light.client import TrustOptions
+from tmtpu.lightserve import protocol as proto
+from tmtpu.lightserve.cache import Fact, VerifiedFactCache
+from tmtpu.lightserve.client import LightserveClient, LightserveRefused
+from tmtpu.lightserve.server import LightserveServer
+
+T0 = 1_700_000_000_000_000_000  # pinned chain genesis for clock tests
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _cpu_backend():
+    from tmtpu.crypto import batch as crypto_batch
+
+    old = crypto_batch._default_backend
+    crypto_batch.set_default_backend("cpu")
+    yield
+    crypto_batch.set_default_backend(old)
+
+
+def _serve(tmp_path, chain, *, period_ns=WEEK_NS, anchor_now_ns=None,
+           **kw):
+    provider = ChainProvider(chain)
+    srv = LightserveServer(
+        f"unix://{tmp_path}/ls.sock", provider,
+        TrustOptions(period_ns, 1, chain.blocks[1].header.hash()),
+        CHAIN_ID, **kw)
+    if anchor_now_ns is not None:
+        srv.init_anchor(now_ns=anchor_now_ns)
+    srv.start()
+    return srv, provider
+
+
+# --- fact cache unit tests ---------------------------------------------------
+
+
+def _fact(h, parent, t=None):
+    return Fact(h, bytes([h % 256]) * 32, T0 + h * 1_000_000_000
+                if t is None else t, parent)
+
+
+def test_cache_put_get_and_lru_eviction():
+    c = VerifiedFactCache(CHAIN_ID, WEEK_NS, max_facts=3)
+    now = T0 + 100 * 1_000_000_000
+    for h in (1, 2, 3):
+        assert c.put(_fact(h, h - 1), now)
+    assert c.get(1, now).height == 1   # touch 1 → 2 is now LRU
+    assert c.put(_fact(4, 3), now)
+    assert c.size() == 3
+    assert c.get(2, now) is None       # evicted
+    assert c.get(1, now) is not None
+    assert c.snapshot()["misses"] == 1
+
+
+def test_cache_refuses_fact_already_expired_at_put():
+    c = VerifiedFactCache(CHAIN_ID, HOUR_NS, max_facts=10)
+    f = _fact(5, 1)
+    exactly = f.header_time + HOUR_NS
+    assert not c.put(f, exactly)           # boundary: <= is expired
+    assert c.put(f, exactly - 1)           # one ns earlier is storable
+    assert c.size() == 1
+
+
+def test_cache_expiry_boundary_is_exact_on_read():
+    """The cache must flip at EXACTLY header_time + trusting_period_ns
+    (verifier.header_expired's <= boundary): fresh one nanosecond
+    before, refused and evicted at the boundary itself."""
+    c = VerifiedFactCache(CHAIN_ID, HOUR_NS, max_facts=10)
+    f = _fact(5, 1)
+    c.put(f, f.header_time)
+    boundary = f.header_time + HOUR_NS
+    assert c.get(5, boundary - 1) is f
+    assert c.get(5, boundary) is None
+    assert c.snapshot()["expired"] == 1
+    assert c.size() == 0                   # evicted, not just refused
+
+
+def test_cache_hop_chain_parent_walk():
+    c = VerifiedFactCache(CHAIN_ID, WEEK_NS, max_facts=10)
+    now = T0 + 200 * 1_000_000_000
+    for h, parent in ((1, 0), (50, 1), (75, 50), (100, 75)):
+        c.put(_fact(h, parent), now)
+    chain = c.hop_chain(1, 100)
+    assert [f.height for f in chain] == [50, 75, 100]
+    assert [f.height for f in c.hop_chain(50, 100)] == [75, 100]
+    assert [f.height for f in c.hop_chain(60, 100)] == [75, 100]
+    assert c.hop_chain(1, 99) is None      # no fact at 99
+    c._evict_locked(75)
+    assert c.hop_chain(1, 100) is None     # broken mid-walk
+
+
+def test_cache_nearest_queries():
+    c = VerifiedFactCache(CHAIN_ID, HOUR_NS, max_facts=10)
+    old = _fact(10, 1)
+    fresh = _fact(90, 10, t=T0 + 90 * 1_000_000_000)
+    now = old.header_time + HOUR_NS        # 10 expired, 90 fresh
+    c.put(old, old.header_time)
+    c.put(fresh, now)
+    assert c.nearest_at_or_below(50, now) is None   # 10 lapsed: evicted
+    assert c.size() == 1
+    assert c.nearest_above(50, now).height == 90
+
+
+# --- serving behavior --------------------------------------------------------
+
+
+def test_cold_resolve_then_cache_hit(tmp_path):
+    chain = FabChain(60)
+    srv, provider = _serve(tmp_path, chain)
+    try:
+        cli = LightserveClient(srv.addr, chain_id=CHAIN_ID)
+        anchor_hash = chain.blocks[1].header.hash()
+        r = cli.sync(1, anchor_hash, 60)
+        assert not r.cache_hit and r.dispatches > 0
+        assert r.dispatch_id != 0          # rode a joint resolve
+        assert r.hops[-1] == (60, chain.blocks[60].header.hash(),
+                              chain.blocks[60].header.time)
+        calls_after_cold = provider.calls
+        r2 = cli.sync(1, anchor_hash, 60)
+        assert r2.cache_hit and r2.dispatches == 0
+        assert r2.dispatch_id == 0         # answered inline, no resolve
+        assert r2.hops == r.hops
+        assert provider.calls == calls_after_cold  # zero provider traffic
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_two_cold_clients_one_joint_resolve_exact_slices(tmp_path):
+    """THE coalescing guarantee: two clients concurrently requesting the
+    same cold target ride EXACTLY ONE joint resolve (same dispatch_id,
+    coalesced=2, one resolve total) and each gets its own exact hop
+    slice — the full bisection path for the anchor-trusting client, the
+    strict suffix above height 40 for the mid-chain one."""
+    chain = FabChain(100, rotate_every=3)  # rotation forces bisection
+    srv, _provider = _serve(tmp_path, chain)
+    try:
+        # hold the gather window open so both arrivals coalesce
+        srv.coalescer.scheduler.gather_wait_s = lambda pending: 0.5
+        barrier = threading.Barrier(2)
+        results = {}
+
+        def session(name, trusted_height):
+            cli = LightserveClient(srv.addr, chain_id=CHAIN_ID,
+                                   client_id=name)
+            trusted_hash = chain.blocks[trusted_height].header.hash()
+            barrier.wait()
+            results[name] = cli.sync(trusted_height, trusted_hash, 100)
+            cli.close()
+
+        t1 = threading.Thread(target=session, args=("a", 1))
+        t2 = threading.Thread(target=session, args=("b", 40))
+        t1.start(); t2.start(); t1.join(30); t2.join(30)
+        a, b = results["a"], results["b"]
+
+        # one joint resolve, shared by exactly these two sessions
+        assert a.dispatch_id == b.dispatch_id != 0
+        assert a.coalesced == b.coalesced == 2
+        assert srv.coalescer.snapshot()["resolves"] == 1
+        assert a.dispatches == b.dispatches > 0
+
+        # exact slices: every hop is a real chain header, ascending,
+        # ending at the target; b's chain is exactly a's above 40
+        for r, floor in ((a, 1), (b, 40)):
+            assert r.hops[-1][0] == 100
+            assert [h for h, _, _ in r.hops] == \
+                sorted({h for h, _, _ in r.hops})
+            for h, hh, ht in r.hops:
+                assert h > floor
+                assert hh == chain.blocks[h].header.hash()
+                assert ht == chain.blocks[h].header.time
+        assert b.hops == [hop for hop in a.hops if hop[0] > 40]
+        assert len(a.hops) > len(b.hops) > 0   # rotation → real pivots
+    finally:
+        srv.stop()
+
+
+def test_trust_period_expiry_refuses_and_reverifies(tmp_path):
+    """Satellite guarantee: once header_time + trusting_period passes, a
+    CACHED fact is refused — and each request for the lapsed height
+    pays a fresh backwards re-verification (provider traffic every
+    time, nothing re-cached), exactly at the <= boundary."""
+    chain = FabChain(100, start_time=T0)
+    t_warm = T0 + 101 * 1_000_000_000      # all heights fresh
+    srv, provider = _serve(tmp_path, chain, period_ns=HOUR_NS,
+                           anchor_now_ns=t_warm)
+    try:
+        cli = LightserveClient(srv.addr, chain_id=CHAIN_ID)
+        anchor_hash = chain.blocks[1].header.hash()
+        r50 = cli.sync(1, anchor_hash, 50, now_ns=t_warm)
+        assert r50.dispatches > 0
+        cli.sync(1, anchor_hash, 100, now_ns=t_warm)  # fresh tip fact
+
+        boundary = chain.blocks[50].header.time + HOUR_NS
+        # one nanosecond BEFORE the boundary: still a pure cache hit
+        r = cli.sync(1, anchor_hash, 50, now_ns=boundary - 1)
+        assert r.cache_hit and r.dispatches == 0
+        calls0 = provider.calls
+        expired0 = srv.cache.snapshot()["expired"]
+
+        # AT the boundary: refused, evicted, re-verified via hash links
+        # from the still-fresh tip (height 100 is 50s younger)
+        r = cli.sync(1, anchor_hash, 50, now_ns=boundary)
+        assert not r.cache_hit
+        assert r.hops[-1] == (50, chain.blocks[50].header.hash(),
+                              chain.blocks[50].header.time)
+        assert r.dispatches == 0           # hash links, not signatures
+        assert provider.calls > calls0     # re-verification is real work
+        assert srv.cache.snapshot()["expired"] > expired0
+
+        # NOT re-cached: the next request pays re-verification again
+        calls1 = provider.calls
+        r = cli.sync(1, anchor_hash, 50, now_ns=boundary)
+        assert not r.cache_hit
+        assert provider.calls > calls1
+
+        # once even the tip lapses there is no fresh trust left: refuse
+        far = chain.blocks[100].header.time + HOUR_NS
+        with pytest.raises(LightserveRefused) as ei:
+            cli.sync(1, anchor_hash, 50, now_ns=far)
+        assert ei.value.status == proto.STATUS_EXPIRED
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_backwards_reverification_respects_limit(tmp_path):
+    chain = FabChain(100, start_time=T0)
+    t_warm = T0 + 101 * 1_000_000_000
+    srv, _provider = _serve(tmp_path, chain, period_ns=HOUR_NS,
+                            anchor_now_ns=t_warm, backwards_limit=10)
+    try:
+        cli = LightserveClient(srv.addr, chain_id=CHAIN_ID)
+        anchor_hash = chain.blocks[1].header.hash()
+        cli.sync(1, anchor_hash, 100, now_ns=t_warm)
+        lapsed = chain.blocks[50].header.time + HOUR_NS
+        with pytest.raises(LightserveRefused) as ei:
+            cli.sync(1, anchor_hash, 50, now_ns=lapsed)  # 50 below tip
+        assert ei.value.status == proto.STATUS_EXPIRED
+        assert "backwards limit" in str(ei.value)
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_conflicting_trusted_hash_refused(tmp_path):
+    """A client whose trusted hash disagrees with the verified spine is
+    on a fork (or being fed one): the daemon must refuse, not serve a
+    chain that silently grafts the client onto the canonical history."""
+    chain = FabChain(60)
+    srv, _provider = _serve(tmp_path, chain)
+    try:
+        cli = LightserveClient(srv.addr, chain_id=CHAIN_ID)
+        anchor_hash = chain.blocks[1].header.hash()
+        cli.sync(1, anchor_hash, 60)       # spine now knows height 60
+        with pytest.raises(LightserveRefused) as ei:
+            cli.sync(60, b"\x66" * 32, 60)
+        assert ei.value.status == proto.STATUS_UNTRUSTED
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_target_zero_means_latest_and_ping_stats(tmp_path):
+    chain = FabChain(40)
+    srv, _provider = _serve(tmp_path, chain)
+    try:
+        cli = LightserveClient(srv.addr, chain_id=CHAIN_ID)
+        srv.update_to_latest()
+        r = cli.sync(1, chain.blocks[1].header.hash(), 0)
+        assert r.target_height == 40
+        pong = cli.ping()
+        assert pong.latest_height == 40
+        st = cli.stats()
+        assert st["chain_id"] == CHAIN_ID
+        assert st["latest_height"] == 40
+        assert st["coalescer"]["queued_sessions"] == 0
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_draining_server_answers_overloaded(tmp_path):
+    from tmtpu.lightserve.client import LightserveOverloaded
+
+    chain = FabChain(10)
+    srv, _provider = _serve(tmp_path, chain)
+    try:
+        cli = LightserveClient(srv.addr, chain_id=CHAIN_ID)
+        cli.sync(1, chain.blocks[1].header.hash(), 10)
+        assert srv.drain(timeout=5.0)
+        with pytest.raises(LightserveOverloaded):
+            cli.sync(1, chain.blocks[1].header.hash(), 10)
+        cli.close()
+    finally:
+        srv.stop()
+
+
+# --- watchdog + config -------------------------------------------------------
+
+
+def test_lightserve_watchdog_check():
+    from tmtpu.libs.watchdog import lightserve_check
+
+    state = {"cache_hits": 0, "cache_misses": 0, "cache_expired": 0,
+             "backlog": 0}
+    chk = lightserve_check(lambda: dict(state), hit_rate_floor=0.5,
+                           min_lookups=10, backlog_ceiling=100)
+    healthy, _, _ = chk()
+    assert healthy                          # cold daemon is not flagged
+    state.update(cache_hits=4, cache_misses=2)
+    assert chk()[0]                         # under min_lookups: no verdict
+    state.update(cache_hits=5, cache_misses=20)
+    healthy, reason, details = chk()
+    assert not healthy and "hit rate" in reason
+    assert details["lookups_in_window"] >= 10
+    # recovery: hits flood in, rate climbs back over the floor
+    state.update(cache_hits=5000)
+    assert chk()[0]
+    # backlog ceiling trips independently of the hit rate
+    state.update(backlog=101)
+    healthy, reason, _ = chk()
+    assert not healthy and "backlog" in reason
+
+
+def test_expired_storm_trips_watchdog():
+    """Expired refusals count as non-hits: a cache where every lookup
+    lands on lapsed trust must flip /healthz even with zero misses."""
+    from tmtpu.libs.watchdog import lightserve_check
+
+    state = {"cache_hits": 0, "cache_misses": 0, "cache_expired": 0,
+             "backlog": 0}
+    chk = lightserve_check(lambda: dict(state), hit_rate_floor=0.5,
+                           min_lookups=10, backlog_ceiling=0)
+    assert chk()[0]
+    state.update(cache_expired=64)
+    healthy, reason, _ = chk()
+    assert not healthy and "hit rate" in reason
+
+
+def test_lightserve_config_round_trip_and_validation(tmp_path):
+    from tmtpu.config.config import Config
+    from tmtpu.config.toml import load_config, validate, write_config
+
+    cfg = Config()
+    cfg.lightserve.addr = "tcp://127.0.0.1:26680"
+    cfg.lightserve.chain_id = "light-chain"
+    cfg.lightserve.trust_height = 7
+    cfg.lightserve.trust_hash = "ab" * 32
+    path = str(tmp_path / "config.toml")
+    write_config(cfg, path)
+    back = load_config(path, env=False)
+    assert back.lightserve.addr == "tcp://127.0.0.1:26680"
+    assert back.lightserve.trust_height == 7
+    assert back.lightserve.backend == "auto"
+
+    cfg.lightserve.trust_hash = "zz"
+    with pytest.raises(ValueError, match="trust_hash"):
+        validate(cfg)
+    cfg.lightserve.trust_hash = "ab" * 31
+    with pytest.raises(ValueError, match="32 bytes"):
+        validate(cfg)
+    cfg.lightserve.trust_hash = "ab" * 32
+    cfg.lightserve.backend = "laser"
+    with pytest.raises(ValueError, match="lightserve.backend"):
+        validate(cfg)
+    cfg.lightserve.backend = "sidecar"    # allowed, unlike [sidecar]
+    validate(cfg)
+    cfg.lightserve.hit_rate_floor = 1.5
+    with pytest.raises(ValueError, match="hit_rate_floor"):
+        validate(cfg)
+    cfg.lightserve.hit_rate_floor = 0.5
+    cfg.lightserve.addr = "http://x:1"
+    with pytest.raises(ValueError, match="lightserve.addr"):
+        validate(cfg)
+
+
+def test_metrics_flow_end_to_end(tmp_path):
+    """The tendermint_lightserve_* family must move when the daemon
+    serves: hits, misses, resolves, dispatches-avoided, proof latency,
+    and the rendered exposition carries the prefix."""
+    from tmtpu.libs import metrics as _m
+
+    def snap():
+        return {
+            "hits": sum(_m.lightserve_server_cache_hits
+                        .summary_series().values()),
+            "avoided": sum(_m.lightserve_server_dispatches_avoided
+                           .summary_series().values()),
+            "resolves": sum(_m.lightserve_server_resolves_total
+                            .summary_series().values()),
+            "lat_n": _m.lightserve_server_proof_latency.totals()[0],
+        }
+
+    before = snap()
+    chain = FabChain(30)
+    srv, _provider = _serve(tmp_path, chain)
+    try:
+        cli = LightserveClient(srv.addr, chain_id=CHAIN_ID)
+        cli.sync(1, chain.blocks[1].header.hash(), 30)
+        cli.sync(1, chain.blocks[1].header.hash(), 30)
+        after = snap()
+        assert after["resolves"] > before["resolves"]
+        assert after["hits"] > before["hits"]
+        assert after["avoided"] > before["avoided"]
+        assert after["lat_n"] >= before["lat_n"] + 2
+        text = _m.render_prometheus()
+        assert "tendermint_lightserve_server_cache_hits_total" in text
+        assert "tendermint_lightserve_client_requests" in text
+        cli.close()
+    finally:
+        srv.stop()

@@ -11,8 +11,8 @@ determinism.
 
 Rule ids covered here (the meta rule asserts this list stays complete):
 blocking-lock, determinism, exception-safety, failpoints, jax-hygiene,
-lock-order, meta, metrics, obs-docs, recv-sync, scenarios, sidecar,
-sigcache, timeline, wire-taint.
+lightserve, lock-order, meta, metrics, obs-docs, recv-sync, scenarios,
+sidecar, sigcache, timeline, wire-taint.
 """
 
 from __future__ import annotations
@@ -27,9 +27,9 @@ from tmtpu.analysis.index import RepoIndex, default_index
 
 ALL_RULES = [
     "blocking-lock", "determinism", "exception-safety", "failpoints",
-    "jax-hygiene", "lock-order", "meta", "metrics", "obs-docs",
-    "recv-sync", "scenarios", "sidecar", "sigcache", "timeline",
-    "wire-taint",
+    "jax-hygiene", "lightserve", "lock-order", "meta", "metrics",
+    "obs-docs", "recv-sync", "scenarios", "sidecar", "sigcache",
+    "timeline", "wire-taint",
 ]
 
 
@@ -449,11 +449,12 @@ def test_timeline_flags_span_and_declaration_drift(tmp_path):
 
 
 def test_import_rules_skip_synthetic_trees(tmp_path):
-    """scenarios, sidecar, and meta import runtime registries (or read
-    repo-level docs), so they must skip cleanly on fixture trees instead
-    of crashing or reporting nonsense."""
+    """scenarios, sidecar, lightserve, and meta import runtime
+    registries (or read repo-level docs), so they must skip cleanly on
+    fixture trees instead of crashing or reporting nonsense."""
     idx = _tree(tmp_path, {"tmtpu/empty.py": "x = 1\n"})
-    results = registry.run(idx, ["scenarios", "sidecar", "meta"])
+    results = registry.run(
+        idx, ["scenarios", "sidecar", "lightserve", "meta"])
     assert results == {}
 
 
@@ -758,6 +759,42 @@ class S:
                       stats=stats3)
     assert stats3["exception-safety"]["cached"] is False
     assert r3["exception-safety"] == []
+
+
+def test_result_cache_doc_edit_invalidates_doc_reading_rule(tmp_path):
+    """The index only knows .py files, but obs-docs reads
+    docs/OBSERVABILITY.md — the fingerprint must cover non-Python files
+    under the rule's triggers too, or a doc edit keeps serving the
+    findings from before the edit (exactly the staleness that once
+    broke the warm pre-commit gate)."""
+    from tmtpu.analysis.cache import ResultCache
+
+    _tree(tmp_path, {
+        "tmtpu/libs/metrics.py":
+            'tx_latency_x = DEFAULT.counter("tx", "latency_x_total")\n',
+        "tmtpu/libs/txlat.py": 'TX_STAGES = ("submit",)\n',
+    })
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs/OBSERVABILITY.md").write_text("nothing yet\n")
+    cache = ResultCache(str(tmp_path))
+    stats: dict = {}
+    r1 = registry.run(RepoIndex(str(tmp_path)), ["obs-docs"],
+                      cache=cache, stats=stats)
+    assert "obs-docs::metric::tendermint_tx_latency_x_total" \
+        in _keys(r1["obs-docs"])
+    cache.save()
+
+    # document everything: the doc edit ALONE must invalidate
+    (tmp_path / "docs/OBSERVABILITY.md").write_text(
+        "| `tendermint_tx_latency_x_total` | ... |\n"
+        "| `submit` | ... |\n"
+        "| `tx_latency` | ... |\n")
+    cache2 = ResultCache(str(tmp_path))
+    stats2: dict = {}
+    r2 = registry.run(RepoIndex(str(tmp_path)), ["obs-docs"],
+                      cache=cache2, stats=stats2)
+    assert stats2["obs-docs"]["cached"] is False
+    assert r2["obs-docs"] == []
 
 
 def test_cli_sarif_output(capsys):

@@ -1,8 +1,9 @@
 """Tier-1 wiring for the scenario engine (tmtpu/scenario): spec
-validation is pure-unit, and the FAST library pair runs end-to-end —
+validation is pure-unit, and the FAST library set runs end-to-end —
 real subprocess localnets, fault timeline, oracle verdicts from public
-RPC evidence only. The heavier scenarios (split_brain,
-sidecar_crash_storm, wan_200ms, ...) run on demand via
+RPC evidence only (light_flood adds the lightserve daemon + session
+flood). The heavier scenarios (split_brain, sidecar_crash_storm,
+wan_200ms, ...) run on demand via
 ``python tools/scenario_run.py all``."""
 
 import pytest
@@ -41,6 +42,37 @@ def test_validate_rejects_sidecar_ops_without_sidecar():
                                             node="sidecar")],
                         oracles=[OracleSpec("height_min", {"min": 1})])
     assert any("sidecar" in p for p in spec.validate())
+
+
+def test_validate_rejects_avoided_rate_without_lightserve():
+    spec = ScenarioSpec(name="x", description="d",
+                        oracles=[OracleSpec("dispatch_avoided_rate")])
+    assert any("lightserve" in p for p in spec.validate())
+
+
+def test_dispatch_avoided_rate_oracle_judges_flood_counters():
+    from tmtpu.scenario.oracles import Evidence, dispatch_avoided_rate
+
+    def ev(stats):
+        return Evidence(None, [], [], {}, lightserve=stats)
+
+    ok, detail = dispatch_avoided_rate(
+        ev({"sessions": 1000, "avoided": 995, "errors": 0,
+            "warmed": 6, "p99_ms": 50.0}))
+    assert ok, detail
+    # rate below the floor
+    ok, detail = dispatch_avoided_rate(
+        ev({"sessions": 1000, "avoided": 980, "errors": 0}))
+    assert not ok and "0.98" in detail
+    # errors past the ceiling fail even at a perfect rate
+    ok, _ = dispatch_avoided_rate(
+        ev({"sessions": 1000, "avoided": 1000, "errors": 3}))
+    assert not ok
+    # a flood that never landed fails loudly, not vacuously
+    ok, detail = dispatch_avoided_rate(ev({"sessions": 5, "avoided": 5}))
+    assert not ok and "need >=" in detail
+    ok, _ = dispatch_avoided_rate(ev(None))
+    assert not ok
 
 
 def test_validate_rejects_action_past_duration():

@@ -9,10 +9,13 @@ the per-file fingerprints of everything the rule can read:
 - each source file contributes a ``"mtime_ns:size"`` key, recorded
   per (repo-relative) path;
 - a rule's file set = the indexed files under its trigger prefixes
-  (all files for catch-all triggers), plus the *infra set* — the
-  analysis framework itself (``tmtpu/analysis/``), the lint driver,
-  the baseline, and ``docs/ANALYSIS.md`` — so engine or baseline edits
-  invalidate everything, conservatively.
+  (all files for catch-all triggers), plus the non-Python files on
+  disk under those prefixes (the index only parses ``.py``, but rules
+  like obs-docs read ``docs/*.md`` — a doc edit must invalidate just
+  like a source edit), plus the *infra set* — the analysis framework
+  itself (``tmtpu/analysis/``), the lint driver, the baseline, and
+  ``docs/ANALYSIS.md`` — so engine or baseline edits invalidate
+  everything, conservatively.
 
 A rule's cached findings are reused only when every file key in its
 recorded set matches the tree *exactly* (adds, deletes, and edits all
@@ -37,7 +40,7 @@ from tmtpu.analysis.index import RepoIndex
 CACHE_DIRNAME = ".lint_cache"
 CACHE_BASENAME = "results.json"
 # bump when Finding serialization or fingerprint semantics change
-CACHE_VERSION = 1
+CACHE_VERSION = 2
 
 # files every rule implicitly depends on (prefixes and exact paths,
 # repo-relative): the framework, the driver, the baseline, the docs
@@ -81,10 +84,31 @@ class ResultCache:
         else:
             for trig in triggers:
                 rels.update(fi.rel for fi in index.files(trig))
+                rels.update(self._non_py_files(trig))
         for fi in index.files(*INFRA_PREFIXES):
             rels.add(fi.rel)
         rels.update(INFRA_FILES)
         return sorted(rels)
+
+    def _non_py_files(self, trig: str) -> List[str]:
+        """Non-``.py`` files on disk under a trigger prefix. The index
+        only knows Python sources, but a rule whose trigger names
+        ``docs`` reads the markdown there — those inputs must be part
+        of the fingerprint or a doc edit serves stale findings."""
+        top = os.path.join(self.root, trig)
+        if os.path.isfile(top):
+            return [] if trig.endswith(".py") else [trig]
+        out = []
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = [d for d in dirnames
+                           if not d.startswith(".") and d != "__pycache__"]
+            for name in filenames:
+                if name.endswith(".py") or name.startswith("."):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, name),
+                                      self.root)
+                out.append(rel)
+        return out
 
     def _current_keys(self, index: RepoIndex, triggers) -> Dict[str, str]:
         out = {}

@@ -13,6 +13,7 @@ from tmtpu.analysis.rules import (  # noqa: F401
     exception_safety,
     failpoints,
     jax_hygiene,
+    lightserve,
     lock_order,
     meta,
     metrics,

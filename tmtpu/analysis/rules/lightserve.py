@@ -1,0 +1,130 @@
+"""lightserve rule: the serving-tier protocol and telemetry stay covered.
+
+The lightserve daemon borrows the sidecar's frame codec but owns its own
+wire namespace and metric family, so it gets the same hygiene the
+``sidecar`` rule enforces there:
+
+1. Every class in ``tmtpu.lightserve.protocol.MESSAGE_TYPES`` has a
+   round-trip sample in tests/test_lightserve_protocol.py's SAMPLES
+   dict (and no stale samples linger).
+2. Every ``lightserve_*`` metric carries the ``tendermint_lightserve_``
+   prefix and renders through the DEFAULT registry.
+3. Every lightserve metric has a write site somewhere in the tree, and
+   every lightserve metric write names a registered metric.
+
+Imports the protocol module and metrics registry (the render check needs
+the real renderer), hence ``requires_import``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from tmtpu.analysis.findings import Finding
+from tmtpu.analysis.index import METRIC_WRITE_RE, RepoIndex
+from tmtpu.analysis.registry import rule
+
+PROTOCOL_TEST = "tests/test_lightserve_protocol.py"
+_PROTO_MOD = "tmtpu/lightserve/protocol.py"
+_METRICS_MOD = "tmtpu/libs/metrics.py"
+
+_SAMPLE_RE = re.compile(r"proto\.([A-Za-z_][A-Za-z0-9_]*)\s*:")
+_LIGHTSERVE_WRITE = re.compile(
+    r"\b(?:metrics\.|_m\.)?(lightserve_[a-z0-9_]*)" + METRIC_WRITE_RE)
+
+
+def _protocol_findings(index: RepoIndex) -> List[Finding]:
+    from tmtpu.lightserve import protocol as proto
+
+    fi = index.get(PROTOCOL_TEST)
+    if fi is None:
+        return [Finding("lightserve", PROTOCOL_TEST,
+                        f"missing protocol test file: {PROTOCOL_TEST}",
+                        key="lightserve::no-test-file")]
+    findings = []
+    if "SAMPLES" not in fi.source:
+        return [Finding("lightserve", PROTOCOL_TEST,
+                        f"{PROTOCOL_TEST} has no SAMPLES dict — the "
+                        f"round-trip coverage this rule asserts is gone",
+                        key="lightserve::no-samples")]
+    if "def test_frame_round_trip" not in fi.source:
+        findings.append(Finding(
+            "lightserve", PROTOCOL_TEST,
+            f"{PROTOCOL_TEST} lost test_frame_round_trip — samples "
+            f"exist but nothing round-trips them",
+            key="lightserve::no-round-trip-test"))
+    sampled = set(_SAMPLE_RE.findall(fi.source))
+    registered = {cls.__name__ for cls in proto.MESSAGE_TYPES.values()}
+    for name in sorted(registered - sampled):
+        findings.append(Finding(
+            "lightserve", _PROTO_MOD,
+            f"untested wire message: protocol.{name} is registered in "
+            f"MESSAGE_TYPES but has no encode/decode round-trip sample "
+            f"in {PROTOCOL_TEST}",
+            key=f"lightserve::unsampled::{name}"))
+    for name in sorted(sampled - registered):
+        findings.append(Finding(
+            "lightserve", PROTOCOL_TEST,
+            f"stale sample: {PROTOCOL_TEST} samples proto.{name}, "
+            f"which is not in MESSAGE_TYPES",
+            key=f"lightserve::stale-sample::{name}"))
+    return findings
+
+
+def _metric_findings(index: RepoIndex) -> List[Finding]:
+    from tmtpu.libs import metrics
+
+    ls_attrs = {
+        attr: obj for attr, obj in vars(metrics).items()
+        if isinstance(obj, metrics._Metric) and
+        attr.startswith("lightserve_")}
+    if not ls_attrs:
+        return [Finding(
+            "lightserve", _METRICS_MOD,
+            "no lightserve_* metrics found in tmtpu/libs/metrics.py — "
+            "the serving-tier metric set was removed or renamed",
+            key="lightserve::no-metrics")]
+    findings = []
+    rendered = metrics.render_prometheus()
+    for attr, obj in sorted(ls_attrs.items()):
+        if not obj.name.startswith("tendermint_lightserve_"):
+            findings.append(Finding(
+                "lightserve", _METRICS_MOD,
+                f"misfiled metric: {attr} renders as {obj.name!r}, "
+                f"outside the tendermint_lightserve_ subsystem",
+                key=f"lightserve::misfiled::{attr}"))
+        if f"# TYPE {obj.name} " not in rendered:
+            findings.append(Finding(
+                "lightserve", _METRICS_MOD,
+                f"unrendered metric: {attr} ({obj.name}) does not "
+                f"appear in render_prometheus() — it bypassed the "
+                f"DEFAULT registry and neither the daemon /metrics nor "
+                f"the node exposition will serve it",
+                key=f"lightserve::unrendered::{attr}"))
+    written = set()
+    for fi in index.files():
+        written.update(_LIGHTSERVE_WRITE.findall(fi.source))
+    for attr in sorted(set(ls_attrs) - written):
+        findings.append(Finding(
+            "lightserve", _METRICS_MOD,
+            f"dead metric: {attr} ({ls_attrs[attr].name}) is "
+            f"registered but never written anywhere in the tree",
+            key=f"lightserve::dead::{attr}"))
+    for name in sorted(written - set(ls_attrs)):
+        findings.append(Finding(
+            "lightserve", _METRICS_MOD,
+            f"unknown metric: lightserve metric {name} is written "
+            f"somewhere in the tree but not registered in "
+            f"tmtpu/libs/metrics.py",
+            key=f"lightserve::unknown::{name}"))
+    return findings
+
+
+@rule("lightserve",
+      doc="every lightserve wire message round-trips in a test; every "
+          "lightserve metric is prefixed, rendered, and written",
+      triggers=("tmtpu/lightserve", "tmtpu/libs", "tests"),
+      requires_import=True)
+def check(index: RepoIndex) -> List[Finding]:
+    return _protocol_findings(index) + _metric_findings(index)

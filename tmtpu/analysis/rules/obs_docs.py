@@ -9,7 +9,8 @@ appear verbatim in ``txlat`` RPC snapshots and fleet reports), the
 causal milestone/hop marks in ``TRACE_MARKS`` (served by the
 ``traces`` RPC and joined by tools/critical_path.py), the
 ``tendermint_tx_latency_*`` / ``tendermint_health_latency_*`` /
-``tendermint_trace_*`` / ``tendermint_validator_*`` metric families,
+``tendermint_trace_*`` / ``tendermint_validator_*`` /
+``tendermint_lightserve_*`` metric families,
 the ``tx_latency`` timeline event kind, and the forensics timeline
 events in ``VALSTATS_EVENTS``. Each one must have a row in
 docs/OBSERVABILITY.md — a stage, mark, event or metric added without
@@ -38,7 +39,8 @@ _TRACE_MOD = "tmtpu/libs/trace.py"
 _METRICS_MOD = "tmtpu/libs/metrics.py"
 _VALSTATS_MOD = "tmtpu/libs/valstats.py"
 _PREFIXES = ("tendermint_tx_latency", "tendermint_health_latency",
-             "tendermint_trace", "tendermint_validator")
+             "tendermint_trace", "tendermint_validator",
+             "tendermint_lightserve")
 
 
 def _str_tuple(index: RepoIndex, mod: str, var: str) -> List[str]:
@@ -61,7 +63,8 @@ def _str_tuple(index: RepoIndex, mod: str, var: str) -> List[str]:
       doc="every tx-lifecycle/tracing/validator-forensics observability "
           "name — TX_STAGES checkpoint stages, TRACE_MARKS causal marks, "
           "tendermint_tx_latency_*/tendermint_health_latency_*/"
-          "tendermint_trace_*/tendermint_validator_* metrics, the "
+          "tendermint_trace_*/tendermint_validator_*/"
+          "tendermint_lightserve_* metrics, the "
           "tx_latency timeline event, VALSTATS_EVENTS forensics events "
           "— has a docs/OBSERVABILITY.md row",
       triggers=("tmtpu/libs", "docs"))

@@ -284,6 +284,90 @@ def cmd_sidecar(args) -> int:
     return 0
 
 
+def cmd_lightserve(args) -> int:
+    """lightserve — run the light-client commit-proof serving daemon:
+    one process terminates many concurrent light-client sessions
+    against a full node's RPC, answering from a trust-period-aware
+    verified-fact cache and coalescing same-height cold misses into
+    single joint resolves. Address resolution: --addr flag,
+    [lightserve] addr, TMTPU_LIGHTSERVE_ADDR, then
+    <home>/data/lightserve.sock."""
+    from tmtpu.light.client import TrustOptions
+    from tmtpu.light.provider import HTTPProvider
+    from tmtpu.lightserve.client import default_addr
+    from tmtpu.lightserve.server import LightserveServer
+
+    cfg = _load_config(args.home)
+    ls = cfg.lightserve
+    addr = (args.addr or ls.addr or
+            default_addr(os.path.expanduser(args.home)))
+    upstream = (args.upstream or ls.upstream).rstrip("/")
+    chain_id = args.chain_id or ls.chain_id
+    trust_height = args.trust_height or ls.trust_height
+    trust_hash = args.trust_hash or ls.trust_hash
+    if not chain_id:
+        print("lightserve needs a chain id (--chain-id or "
+              "[lightserve] chain_id)")
+        return 1
+    if trust_height <= 0 or not trust_hash:
+        print("lightserve needs a social-consensus trust anchor "
+              "(--trust-height/--trust-hash or the [lightserve] pair)")
+        return 1
+    backend = args.backend or ls.backend
+    os.makedirs(os.path.join(os.path.expanduser(args.home), "data"),
+                exist_ok=True)
+    # commit checks share crypto/batch.py, so [crypto] resilience knobs
+    # apply; backend "sidecar" additionally coalesces them with every
+    # other host process's lanes in the verification daemon
+    from tmtpu.crypto import batch as crypto_batch
+
+    crypto_batch.configure(cfg.crypto)
+    if backend == "sidecar":
+        crypto_batch.configure_sidecar(
+            cfg.sidecar, home=os.path.expanduser(args.home))
+    server = LightserveServer(
+        addr, HTTPProvider(chain_id, upstream),
+        TrustOptions(period_ns=ls.trusting_period_ns,
+                     height=trust_height,
+                     hash=bytes.fromhex(trust_hash)),
+        chain_id,
+        backend=None if backend == "auto" else backend,
+        max_clock_drift_ns=ls.max_clock_drift_ns,
+        cache_max_facts=ls.cache_max_facts,
+        store_max_blocks=ls.store_max_blocks,
+        max_queue_sessions=ls.max_queue_sessions,
+        max_frame_bytes=ls.max_frame_bytes,
+        request_deadline_s=ls.request_deadline_ns / 1e9,
+        backwards_limit=ls.backwards_limit,
+        health_laddr=args.health_laddr or ls.health_laddr,
+        hit_rate_floor=ls.hit_rate_floor,
+        hit_rate_min_lookups=ls.hit_rate_min_lookups,
+        backlog_ceiling=ls.backlog_ceiling)
+    server.start()  # fetches + verifies the trust anchor
+    print(f"Lightserve listening on {server.addr} chain={chain_id} "
+          f"anchor={trust_height} upstream={upstream} "
+          f"id={server.server_id}")
+    # SIGINT stops immediately; SIGTERM drains (new sessions answered
+    # OVERLOADED, queued joint resolves finish) then exits 0
+    stop, term = [], []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: term.append(1))
+    try:
+        while not stop and not term:
+            time.sleep(0.2)
+        if term and not stop:
+            print("SIGTERM: draining lightserve "
+                  "(new sessions get OVERLOADED)...", flush=True)
+            clean = server.drain(
+                timeout=ls.request_deadline_ns / 1e9 + 5.0)
+            print("Drain complete" if clean
+                  else "Drain timed out; stopping anyway")
+    finally:
+        print("Stopping lightserve...")
+        server.stop()
+    return 0
+
+
 def cmd_version(args) -> int:
     print(ver.TMCoreSemVer)
     return 0
@@ -798,6 +882,29 @@ def main(argv=None) -> int:
     sp.add_argument("--no-warm", action="store_true",
                     help="skip the startup kernel warm-up compile")
     sp.set_defaults(fn=cmd_sidecar)
+
+    sp = sub.add_parser("lightserve",
+                        help="run the light-client commit-proof "
+                             "serving daemon")
+    sp.add_argument("--addr", default="",
+                    help="listen address (unix:///path.sock or "
+                         "tcp://host:port); default [lightserve] addr / "
+                         "TMTPU_LIGHTSERVE_ADDR / "
+                         "<home>/data/lightserve.sock")
+    sp.add_argument("--upstream", default="",
+                    help="full node RPC URL feeding the verified spine")
+    sp.add_argument("--chain-id", dest="chain_id", default="")
+    sp.add_argument("--trust-height", dest="trust_height", type=int,
+                    default=0)
+    sp.add_argument("--trust-hash", dest="trust_hash", default="",
+                    help="hex header hash at --trust-height")
+    sp.add_argument("--backend", default="",
+                    choices=["", "auto", "cpu", "tpu", "sidecar"],
+                    help="commit-verify engine; 'sidecar' rides the "
+                         "host's verification daemon")
+    sp.add_argument("--health-laddr", dest="health_laddr", default="",
+                    help="HTTP host:port for /healthz + /metrics")
+    sp.set_defaults(fn=cmd_lightserve)
 
     sp = sub.add_parser("version")
     sp.set_defaults(fn=cmd_version)

@@ -344,6 +344,53 @@ class SidecarConfig:
 
 
 @dataclass
+class LightserveConfig:
+    """Light-client serving-tier knobs (tmtpu/lightserve/): one daemon
+    terminates many concurrent light-client sessions against a full
+    node's RPC, answering from a trust-period-aware verified-fact cache
+    and coalescing same-height cold misses into single joint resolves.
+    Server side is ``python -m tmtpu lightserve``."""
+
+    # where the daemon listens / clients connect: unix:///path/to.sock
+    # or tcp://host:port. Empty resolves TMTPU_LIGHTSERVE_ADDR, then
+    # the conventional <home>/data/lightserve.sock.
+    addr: str = ""
+    # the full node whose RPC feeds the verified spine
+    upstream: str = "http://127.0.0.1:26657"
+    chain_id: str = ""
+    # social-consensus trust anchor (subjective initialization): height
+    # + header hash (hex) obtained out of band, per the light-client
+    # model. Required to start the daemon.
+    trust_height: int = 0
+    trust_hash: str = ""
+    # how long a verified header stays trustworthy; the cache refuses —
+    # and re-verifies via hash links — anything at or past this age
+    trusting_period_ns: int = 14 * 24 * 3600 * 1000 * MS
+    max_clock_drift_ns: int = 10_000 * MS
+    # verify engine for commit checks ("auto" | "cpu" | "tpu" |
+    # "sidecar" — the serving tier can ride the verification sidecar)
+    backend: str = "auto"
+    # per-session resolve deadline + admission control
+    request_deadline_ns: int = 10_000 * MS
+    max_queue_sessions: int = 65536
+    max_frame_bytes: int = 1 * 1024 * 1024
+    # verified-fact cache (tiny facts) vs full-LightBlock spine bounds
+    cache_max_facts: int = 200_000
+    store_max_blocks: int = 10_000
+    # re-verification of expired heights hash-links backwards from the
+    # nearest fresh header; give up past this many heights
+    backwards_limit: int = 1024
+    # optional HTTP host:port for /healthz + /metrics ("" disables)
+    health_laddr: str = ""
+    # watchdog lightserve_check: /healthz flips 503 when the windowed
+    # cache hit rate (after min_lookups) drops below the floor or the
+    # session backlog exceeds the ceiling
+    hit_rate_floor: float = 0.5
+    hit_rate_min_lookups: int = 64
+    backlog_ceiling: int = 4096
+
+
+@dataclass
 class BaseConfig:
     """config/config.go:158."""
 
@@ -389,6 +436,8 @@ class Config:
     health: HealthConfig = field(default_factory=HealthConfig)
     crypto: CryptoConfig = field(default_factory=CryptoConfig)
     sidecar: SidecarConfig = field(default_factory=SidecarConfig)
+    lightserve: LightserveConfig = field(
+        default_factory=LightserveConfig)
 
     def rooted(self, path: str) -> str:
         return os.path.join(os.path.expanduser(self.base.home), path)

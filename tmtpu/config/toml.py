@@ -24,7 +24,7 @@ from tmtpu.config.config import Config
 # section order mirrors the reference's template (base fields are top-level)
 _SECTIONS = ("base", "rpc", "p2p", "mempool", "consensus", "block_sync",
              "state_sync", "storage", "tx_index", "instrumentation",
-             "health", "crypto", "sidecar")
+             "health", "crypto", "sidecar", "lightserve")
 
 
 def _toml_value(v: Any) -> str:
@@ -239,3 +239,49 @@ def validate(cfg: Config) -> None:
         # cannot even fit one consensus commit's worth of lanes
         raise ValueError("sidecar.max_frame_bytes too small for "
                          "crypto_backend=sidecar (needs >= 65536)")
+    ls = cfg.lightserve
+    if ls.addr and not (ls.addr.startswith("unix://") or
+                        ls.addr.startswith("tcp://")):
+        raise ValueError(
+            f"lightserve.addr must be unix:// or tcp://, got {ls.addr!r}")
+    if ls.backend not in ("auto", "cpu", "tpu", "sidecar"):
+        # unlike the sidecar daemon, the serving tier MAY use backend
+        # "sidecar": its commit checks then coalesce with every other
+        # host process's lanes in the verification daemon
+        raise ValueError(
+            f"lightserve.backend must be auto/cpu/tpu/sidecar, got "
+            f"{ls.backend!r}")
+    if ls.trust_height < 0:
+        raise ValueError("lightserve.trust_height cannot be negative")
+    if ls.trust_hash:
+        try:
+            h = bytes.fromhex(ls.trust_hash)
+        except ValueError as exc:
+            raise ValueError(
+                f"lightserve.trust_hash is not hex: {exc}") from exc
+        if len(h) != 32:
+            raise ValueError("lightserve.trust_hash must be 32 bytes")
+    if ls.trusting_period_ns <= 0:
+        raise ValueError("lightserve.trusting_period_ns must be positive")
+    if ls.max_clock_drift_ns < 0:
+        raise ValueError(
+            "lightserve.max_clock_drift_ns cannot be negative")
+    if ls.request_deadline_ns <= 0:
+        raise ValueError("lightserve.request_deadline_ns must be positive")
+    if ls.max_queue_sessions < 1:
+        raise ValueError("lightserve.max_queue_sessions must be >= 1")
+    if ls.max_frame_bytes < 4096:
+        raise ValueError("lightserve.max_frame_bytes must be >= 4096")
+    if ls.cache_max_facts < 1:
+        raise ValueError("lightserve.cache_max_facts must be >= 1")
+    if ls.store_max_blocks < 1:
+        raise ValueError("lightserve.store_max_blocks must be >= 1")
+    if ls.backwards_limit < 0:
+        raise ValueError("lightserve.backwards_limit cannot be negative")
+    if not 0.0 <= ls.hit_rate_floor <= 1.0:
+        raise ValueError("lightserve.hit_rate_floor must be in [0, 1]")
+    if ls.hit_rate_min_lookups < 1:
+        raise ValueError("lightserve.hit_rate_min_lookups must be >= 1")
+    if ls.backlog_ceiling < 0:
+        raise ValueError("lightserve.backlog_ceiling cannot be negative "
+                         "(0 disables the backlog verdict)")

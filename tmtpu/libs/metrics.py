@@ -784,6 +784,84 @@ sidecar_client_up = DEFAULT.gauge(
     "sidecar", "client_up",
     "1 when this process holds a live sidecar connection, else 0")
 
+# --- the light-client serving-tier metric set (tmtpu/lightserve/) -----------
+#
+# Server set: written by the lightserve daemon (lightserve/server.py
+# connection loop, lightserve/coalescer.py dispatcher, lightserve/cache.py
+# read path). The serving-tier acceptance reads straight off
+# dispatches_avoided_total vs sessions served: after warmup nearly every
+# session must cost zero device dispatches (cache + coalescer working).
+
+lightserve_server_connections = DEFAULT.gauge(
+    "lightserve", "server_connections",
+    "Client connections currently held by the lightserve daemon")
+lightserve_server_requests = DEFAULT.counter(
+    "lightserve", "server_requests_total",
+    "Protocol messages handled by the lightserve daemon",
+    labels=("type",))
+lightserve_server_backlog = DEFAULT.gauge(
+    "lightserve", "server_backlog",
+    "Sync sessions currently queued in the coalescer awaiting a joint "
+    "resolve")
+lightserve_server_resolves_total = DEFAULT.counter(
+    "lightserve", "server_resolves_total",
+    "Joint target-height resolves issued by the session coalescer")
+lightserve_server_dispatches_total = DEFAULT.counter(
+    "lightserve", "server_dispatches_total",
+    "Signature-verification dispatches the daemon's resolves actually "
+    "performed (bisection hops x commit verifies)")
+lightserve_server_dispatches_avoided = DEFAULT.counter(
+    "lightserve", "server_dispatches_avoided_total",
+    "Sync sessions answered with ZERO verification dispatches (served "
+    "from the verified-height fact cache or a shared joint resolve)")
+lightserve_server_cache_hits = DEFAULT.counter(
+    "lightserve", "server_cache_hits_total",
+    "Verified-height fact cache lookups answered by a fresh fact")
+lightserve_server_cache_misses = DEFAULT.counter(
+    "lightserve", "server_cache_misses_total",
+    "Verified-height fact cache lookups that found no fact")
+lightserve_server_cache_expired = DEFAULT.counter(
+    "lightserve", "server_cache_expired_total",
+    "Cached verified-height facts refused (and evicted) because the "
+    "trusting period lapsed")
+lightserve_server_coalesced_sessions = DEFAULT.histogram(
+    "lightserve", "server_coalesced_sessions",
+    "Concurrent sessions that shared one joint target-height resolve",
+    buckets=(1, 2, 3, 4, 6, 8, 12, 16, 32, 64, 128, 256))
+lightserve_server_proof_latency = DEFAULT.histogram(
+    "lightserve", "server_proof_latency_seconds",
+    "Time from sync-request receipt to proof reply on the daemon",
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+             0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30))
+lightserve_server_overloads_total = DEFAULT.counter(
+    "lightserve", "server_overloads_total",
+    "Sync sessions rejected by admission control (backlog full)")
+lightserve_server_protocol_errors = DEFAULT.counter(
+    "lightserve", "server_protocol_errors_total",
+    "Malformed frames / bad sequencing / version or chain mismatches "
+    "rejected by the lightserve daemon",
+    labels=("kind",))
+
+# Client set: written by lightserve/client.py (the flood harness, the
+# scenario session driver, and any embedded light client attach through
+# it).
+
+lightserve_client_requests = DEFAULT.counter(
+    "lightserve", "client_requests_total",
+    "Sync requests sent to the lightserve daemon",
+    labels=("status",))
+lightserve_client_request_latency = DEFAULT.histogram(
+    "lightserve", "client_request_latency_seconds",
+    "Round-trip latency of lightserve sync requests",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 0.5, 1, 2.5, 5, 10, 30))
+lightserve_client_reconnects = DEFAULT.counter(
+    "lightserve", "client_reconnects_total",
+    "Lightserve connection (re)establishment attempts")
+lightserve_client_up = DEFAULT.gauge(
+    "lightserve", "client_up",
+    "1 when this process holds a live lightserve connection, else 0")
+
 # (curve, impl, padded-lanes) shapes already dispatched in this process:
 # jax.jit keys its cache on input shapes, so a new padded bucket size is
 # exactly one fresh XLA compile — tracked here rather than by poking jax

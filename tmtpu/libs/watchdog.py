@@ -443,6 +443,63 @@ def sidecar_check(window_s: float = 30.0,
     return check
 
 
+def lightserve_check(snapshot_fn: Callable[[], Dict],
+                     hit_rate_floor: float = 0.5,
+                     min_lookups: int = 64,
+                     backlog_ceiling: int = 4096,
+                     window_s: float = 30.0) -> CheckFn:
+    """For the lightserve daemon (tmtpu/lightserve): unhealthy when the
+    verified-fact cache hit rate over the trailing window drops below
+    ``hit_rate_floor`` — the serving tier has regressed from
+    answer-from-cache to resolve-per-request and the coalescer is the
+    only thing between the provider and a dispatch storm — or when the
+    coalescer's session backlog (queued + inflight) exceeds
+    ``backlog_ceiling``. The hit-rate verdict waits for ``min_lookups``
+    lookups in the window so a cold or idle daemon is not flagged;
+    expired refusals count as non-hits (an expiring-everywhere cache IS
+    a serving regression, operators should see it).
+
+    ``snapshot_fn`` supplies cumulative counters ``{"cache_hits",
+    "cache_misses", "cache_expired", "backlog"}`` — the daemon passes
+    ``LightserveServer.health_snapshot``."""
+
+    # (t, hits, misses+expired)
+    samples: List[Tuple[float, float, float]] = []
+
+    def check() -> Tuple[bool, str, Dict]:
+        now = time.monotonic()
+        snap = snapshot_fn()
+        hits = float(snap.get("cache_hits", 0))
+        non_hits = float(snap.get("cache_misses", 0) +
+                         snap.get("cache_expired", 0))
+        backlog = int(snap.get("backlog", 0))
+        samples.append((now, hits, non_hits))
+        while samples and samples[0][0] < now - window_s:
+            samples.pop(0)
+        d_hits = hits - samples[0][1]
+        d_non = non_hits - samples[0][2]
+        lookups = d_hits + d_non
+        hit_rate = (d_hits / lookups) if lookups > 0 else 1.0
+        details: Dict = {"window_s": window_s,
+                         "lookups_in_window": lookups,
+                         "hit_rate": round(hit_rate, 4),
+                         "hit_rate_floor": hit_rate_floor,
+                         "backlog": backlog,
+                         "backlog_ceiling": backlog_ceiling}
+        if backlog_ceiling > 0 and backlog > backlog_ceiling:
+            return (False,
+                    f"lightserve session backlog {backlog} over ceiling "
+                    f"{backlog_ceiling}", details)
+        if lookups >= min_lookups and hit_rate < hit_rate_floor:
+            return (False,
+                    f"lightserve cache hit rate {hit_rate:.2f} below "
+                    f"floor {hit_rate_floor:.2f} over {window_s:.0f}s "
+                    f"({lookups:.0f} lookups)", details)
+        return True, "", details
+
+    return check
+
+
 def sync_status_check(is_block_syncing: Callable[[], bool],
                       is_state_syncing: Callable[[], bool]) -> CheckFn:
     """Always healthy — surfaces blocksync/statesync progress so

@@ -129,6 +129,10 @@ class HTTPProvider(Provider):
         self.chain_id = chain_id
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        # one-round-trip light_block method (this repo's RPC); flips
+        # False the first time the node answers Method-not-found, after
+        # which every fetch rides commit + paginated validators
+        self._has_light_block = True
 
     def id(self) -> str:
         return self.base_url
@@ -156,6 +160,15 @@ class HTTPProvider(Provider):
 
     def light_block(self, height: Optional[int]) -> LightBlock:
         params = {} if height is None else {"height": str(height)}
+        if self._has_light_block:
+            try:
+                return self._light_block_single(params)
+            except ErrLightBlockNotFound as e:
+                # _call folds the server's -32601 "Method not found"
+                # into not-found; only THAT downgrades the transport
+                if "method not found" not in str(e).lower():
+                    raise
+                self._has_light_block = False
         c = self._call("commit", params)
         sh = SignedHeader(header_from_json(c["signed_header"]["header"]),
                           commit_from_json(c["signed_header"]["commit"]))
@@ -173,6 +186,23 @@ class HTTPProvider(Provider):
             vals.extend(got)
             page += 1
         vs = ValidatorSet.restore(vals)
+        lb = LightBlock(sh, vs)
+        try:
+            lb.validate_basic(self.chain_id)
+        except ValueError as e:
+            raise ErrBadLightBlock(str(e)) from e
+        return lb
+
+    def _light_block_single(self, params: dict) -> LightBlock:
+        """The one-round-trip path: rpc ``light_block`` serves the
+        signed header and the full (unpaginated) validator set
+        together."""
+        r = self._call("light_block", params)
+        sh = SignedHeader(header_from_json(r["signed_header"]["header"]),
+                          commit_from_json(r["signed_header"]["commit"]))
+        vs = ValidatorSet.restore(
+            [validator_from_json(x)
+             for x in r["validator_set"]["validators"]])
         lb = LightBlock(sh, vs)
         try:
             lb.validate_basic(self.chain_id)

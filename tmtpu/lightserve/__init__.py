@@ -1,0 +1,25 @@
+"""Light-client commit-proof serving tier.
+
+One daemon process terminates many concurrent light-client sessions
+against a single chain: it keeps its own verified spine (a LightStore
+anchored at social-consensus TrustOptions), folds concurrent sync
+requests for the same target height into ONE joint verification via a
+height-keyed coalescer, and answers repeat queries from a bounded
+trust-period-aware verified-height fact cache — so the Nth client
+asking about a height costs zero device dispatches.
+
+Deployment shape mirrors :mod:`tmtpu.sidecar`: a socket daemon
+(``python -m tmtpu.cmd lightserve``) speaking a length-prefixed frame
+protocol, plus an optional HTTP listener for ``/healthz`` and
+``/metrics``.
+"""
+
+from tmtpu.lightserve.cache import Fact, VerifiedFactCache  # noqa: F401
+from tmtpu.lightserve.client import (  # noqa: F401
+    LightserveClient,
+    LightserveError,
+    LightserveOverloaded,
+    LightserveRefused,
+    LightserveUnavailable,
+)
+from tmtpu.lightserve.server import LightserveServer  # noqa: F401

@@ -1,0 +1,205 @@
+"""Bounded verified-at-height fact cache, trust-period aware.
+
+A :class:`Fact` is the distilled outcome of one light-client
+verification: "header ``header_hash`` at ``height`` (time
+``header_time``) is verified, reached from ``parent_height``". Facts
+are tiny (no validator sets, no commits), so the cache holds orders of
+magnitude more heights than the LightStore spine can afford to keep as
+full blocks.
+
+Two queries matter at serving time:
+
+- :meth:`get` — is this exact height verified and still inside the
+  trusting period? Expiry is checked at READ time with the verifier's
+  own :func:`~tmtpu.light.verifier.header_expired` boundary
+  (``header_time + trusting_period_ns <= now_ns``): a fact that was
+  fresh when cached is refused — and evicted — the instant the trust
+  period lapses, never served stale.
+- :meth:`hop_chain` — the precomputed bisection path. Every fact
+  remembers the height it was verified FROM, so the path from any
+  lower trusted height to a cached target is a parent-pointer walk:
+  O(log N) hops handed out with zero dispatches, zero provider calls.
+
+Keys: one cache serves one chain (``chain_id`` is pinned at
+construction and part of every fact's identity triple ``(chain_id,
+height, header_hash)``); capacity is bounded LRU over lookups and
+inserts.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_right, insort
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+
+class Fact:
+    """One verified-height fact (identity: (chain_id, height, hash))."""
+
+    __slots__ = ("height", "header_hash", "header_time", "parent_height")
+
+    def __init__(self, height: int, header_hash: bytes, header_time: int,
+                 parent_height: int):
+        self.height = int(height)
+        self.header_hash = bytes(header_hash)
+        self.header_time = int(header_time)
+        # the verified height this fact's verification hopped from;
+        # 0 for the trust anchor itself
+        self.parent_height = int(parent_height)
+
+    def expired(self, trusting_period_ns: int, now_ns: int) -> bool:
+        """Same boundary as verifier.header_expired: expired AT exactly
+        ``header_time + trusting_period_ns``."""
+        return self.header_time + trusting_period_ns <= now_ns
+
+    def __repr__(self) -> str:  # debugging / test failure readability
+        return (f"Fact(h={self.height}, hash={self.header_hash.hex()[:8]}, "
+                f"parent={self.parent_height})")
+
+
+class VerifiedFactCache:
+    def __init__(self, chain_id: str, trusting_period_ns: int,
+                 max_facts: int = 200_000):
+        if max_facts < 1:
+            raise ValueError("max_facts must be >= 1")
+        self.chain_id = chain_id
+        self.trusting_period_ns = int(trusting_period_ns)
+        self.max_facts = max_facts
+        self._lock = threading.Lock()
+        self._facts: "OrderedDict[int, Fact]" = OrderedDict()
+        self._heights: List[int] = []   # sorted, mirrors _facts keys
+        self.hits = 0
+        self.misses = 0
+        self.expired = 0
+
+    def key(self, fact: Fact) -> Tuple[str, int, bytes]:
+        return (self.chain_id, fact.height, fact.header_hash)
+
+    # -- writes --------------------------------------------------------------
+
+    def put(self, fact: Fact, now_ns: int) -> bool:
+        """Cache a fact unless its trust already lapsed (a re-verified
+        expired height is served but NOT re-cached — it would only be
+        refused again on the next read). Returns True when stored."""
+        if fact.expired(self.trusting_period_ns, now_ns):
+            return False
+        with self._lock:
+            if fact.height not in self._facts:
+                insort(self._heights, fact.height)
+            self._facts[fact.height] = fact
+            self._facts.move_to_end(fact.height)
+            while len(self._facts) > self.max_facts:
+                evicted, _ = self._facts.popitem(last=False)
+                self._heights.remove(evicted)
+            return True
+
+    def _evict_locked(self, height: int) -> None:
+        if height in self._facts:
+            del self._facts[height]
+            self._heights.remove(height)
+
+    # -- reads ---------------------------------------------------------------
+
+    def get(self, height: int, now_ns: int) -> Optional[Fact]:
+        """The fresh fact at exactly ``height``, or None. An expired fact
+        is refused AND evicted (counted in ``expired``, not ``misses``)."""
+        from tmtpu.libs import metrics as _m
+
+        with self._lock:
+            fact = self._facts.get(height)
+            if fact is None:
+                self.misses += 1
+                _m.lightserve_server_cache_misses.inc()
+                return None
+            if fact.expired(self.trusting_period_ns, now_ns):
+                self._evict_locked(height)
+                self.expired += 1
+                _m.lightserve_server_cache_expired.inc()
+                return None
+            self._facts.move_to_end(height)
+            self.hits += 1
+            _m.lightserve_server_cache_hits.inc()
+            return fact
+
+    def peek(self, height: int) -> Optional[Fact]:
+        """Lookup without expiry check, LRU touch, or counters (used for
+        trusted-hash validation, where an expired fact still proves a
+        client is on a fork)."""
+        with self._lock:
+            return self._facts.get(height)
+
+    def nearest_at_or_below(self, height: int, now_ns: int
+                            ) -> Optional[Fact]:
+        """Highest fresh fact at or below ``height`` — the bisection
+        anchor candidate. Expired candidates encountered on the way down
+        are evicted (older headers only ever get MORE expired)."""
+        from tmtpu.libs import metrics as _m
+
+        with self._lock:
+            i = bisect_right(self._heights, height)
+            while i > 0:
+                h = self._heights[i - 1]
+                fact = self._facts[h]
+                if not fact.expired(self.trusting_period_ns, now_ns):
+                    return fact
+                self._evict_locked(h)
+                self.expired += 1
+                _m.lightserve_server_cache_expired.inc()
+                i -= 1
+            return None
+
+    def nearest_above(self, height: int, now_ns: int) -> Optional[Fact]:
+        """Lowest fresh fact strictly above ``height`` — the hash-link
+        re-verification anchor once everything at-or-below expired."""
+        with self._lock:
+            i = bisect_right(self._heights, height)
+            while i < len(self._heights):
+                fact = self._facts[self._heights[i]]
+                if not fact.expired(self.trusting_period_ns, now_ns):
+                    return fact
+                i += 1   # don't evict: higher fresh facts may follow
+            return None
+
+    def hop_chain(self, from_height: int, to_height: int
+                  ) -> Optional[List[Fact]]:
+        """The cached bisection path: facts from just above
+        ``from_height`` up to ``to_height`` inclusive, ascending, linked
+        by parent pointers. None when the walk hits a missing fact
+        (evicted mid-chain) — the caller re-resolves."""
+        with self._lock:
+            chain: List[Fact] = []
+            h = to_height
+            while h > from_height:
+                fact = self._facts.get(h)
+                if fact is None:
+                    return None
+                chain.append(fact)
+                if fact.parent_height >= h:   # corrupt pointer guard
+                    return None
+                h = fact.parent_height
+            chain.reverse()
+            return chain
+
+    # -- introspection -------------------------------------------------------
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._facts)
+
+    def lookups(self) -> int:
+        with self._lock:
+            return self.hits + self.misses + self.expired
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "chain_id": self.chain_id,
+                "facts": len(self._facts),
+                "max_facts": self.max_facts,
+                "lowest": self._heights[0] if self._heights else 0,
+                "highest": self._heights[-1] if self._heights else 0,
+                "hits": self.hits,
+                "misses": self.misses,
+                "expired": self.expired,
+            }

@@ -1,0 +1,367 @@
+"""Lightserve client: one multiplexed connection, many in-flight sessions.
+
+Mirrors :class:`tmtpu.sidecar.client.SidecarClient`: a background
+reader thread demultiplexes replies to waiters by request id, so one
+connection can carry thousands of pipelined sessions — the shape the
+flood harness uses to hold 10k+ concurrent sessions with a handful of
+sockets. Reconnects are lazy with a flat backoff window.
+
+Failure kinds, for caller policy:
+
+- :class:`LightserveUnavailable` — can't connect, connection died,
+  deadline hit, daemon answered upstream_down/shutting_down. Retryable
+  against another daemon.
+- :class:`LightserveOverloaded` — explicit admission-control
+  backpressure; the daemon is healthy. Back off and resubmit.
+- :class:`LightserveRefused` — the daemon understood and said no:
+  the trusting period lapsed (``expired``), the client's trusted hash
+  conflicts with the verified spine (``untrusted`` — treat as possible
+  fork evidence!), or the request was malformed. NOT retryable.
+
+The blocking :meth:`sync` wraps the async pair
+:meth:`sync_submit`/:meth:`SyncHandle.result`.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from tmtpu.lightserve import protocol as proto
+
+ENV_ADDR = "TMTPU_LIGHTSERVE_ADDR"
+
+
+def default_addr(home: str = "") -> str:
+    """Explicit config addr wins (caller passes it through), then
+    ``TMTPU_LIGHTSERVE_ADDR``, then the per-home unix socket."""
+    env = os.environ.get(ENV_ADDR, "")
+    if env:
+        return env
+    if home:
+        return f"unix://{os.path.join(home, 'data', 'lightserve.sock')}"
+    return ""
+
+
+class LightserveError(Exception):
+    pass
+
+
+class LightserveUnavailable(LightserveError):
+    """Daemon unreachable / dead connection / deadline / hard error."""
+
+
+class LightserveOverloaded(LightserveError):
+    """Explicit backpressure: daemon healthy but the session queue is
+    full."""
+
+
+class LightserveRefused(LightserveError):
+    """A definitive no: expired trust, conflicting trusted hash, or a
+    bad request. Resubmitting the same session cannot succeed."""
+
+    def __init__(self, status: int, message: str):
+        super().__init__(message or proto.STATUS_NAMES.get(status,
+                                                           str(status)))
+        self.status = status
+
+
+class SyncResult:
+    """One answered session."""
+
+    __slots__ = ("target_height", "hops", "dispatches", "cache_hit",
+                 "dispatch_id", "coalesced")
+
+    def __init__(self, target_height: int,
+                 hops: List[Tuple[int, bytes, int]], dispatches: int,
+                 cache_hit: bool, dispatch_id: int, coalesced: int):
+        self.target_height = target_height
+        # ascending (height, header_hash, header_time), ending at target
+        self.hops = hops
+        self.dispatches = dispatches
+        self.cache_hit = cache_hit
+        self.dispatch_id = dispatch_id   # 0 = answered inline from cache
+        self.coalesced = coalesced
+
+
+class _Waiter:
+    __slots__ = ("event", "reply", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.reply = None
+        self.error: Optional[Exception] = None
+
+
+class SyncHandle:
+    """An in-flight session: ``wait`` then ``result`` (or just
+    ``result``, which waits)."""
+
+    __slots__ = ("_client", "_rid", "_waiter", "submitted_at")
+
+    def __init__(self, client: "LightserveClient", rid: int,
+                 waiter: _Waiter):
+        self._client = client
+        self._rid = rid
+        self._waiter = waiter
+        self.submitted_at = time.perf_counter()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._waiter.event.wait(timeout)
+
+    def result(self, deadline_s: Optional[float] = None) -> SyncResult:
+        return self._client._collect(self._rid, self._waiter,
+                                     deadline_s, self.submitted_at)
+
+
+class LightserveClient:
+    def __init__(self, addr: str, *,
+                 client_id: str = "",
+                 chain_id: str = "",
+                 connect_timeout_s: float = 2.0,
+                 request_deadline_s: float = 15.0,
+                 retry_backoff_s: float = 1.0,
+                 max_frame_bytes: int = proto.DEFAULT_MAX_FRAME_BYTES):
+        self.addr = addr
+        self.client_id = client_id or f"pid-{os.getpid()}"
+        self.chain_id = chain_id       # "" = adopt the server's chain
+        self._connect_timeout_s = connect_timeout_s
+        self._request_deadline_s = request_deadline_s
+        self._retry_backoff_s = retry_backoff_s
+        self._max_frame_bytes = max_frame_bytes
+        self._sock: Optional[socket.socket] = None
+        self._rfile = None
+        self._wlock = threading.Lock()
+        self._conn_lock = threading.Lock()
+        self._waiters: Dict[int, _Waiter] = {}
+        self._waiters_lock = threading.Lock()
+        self._seq = itertools.count(1)
+        self._last_connect_fail = 0.0
+        self.hello_ack: Optional[proto.HelloAck] = None
+
+    # --- connection management ---
+
+    def connected(self) -> bool:
+        return self._sock is not None
+
+    def _ensure_connected(self) -> None:
+        if self._sock is not None:
+            return
+        with self._conn_lock:
+            if self._sock is not None:
+                return
+            now = time.monotonic()
+            if now - self._last_connect_fail < self._retry_backoff_s:
+                raise LightserveUnavailable(
+                    f"lightserve {self.addr}: in connect backoff")
+            try:
+                self._connect_locked()
+            except (OSError, proto.ProtocolError, EOFError,
+                    ValueError) as exc:
+                self._last_connect_fail = time.monotonic()
+                raise LightserveUnavailable(
+                    f"lightserve {self.addr}: {exc}") from exc
+
+    def _connect_locked(self) -> None:
+        from tmtpu.libs import metrics as _m
+
+        _m.lightserve_client_reconnects.inc()
+        kind, target = proto.parse_addr(self.addr)
+        if kind == "unix":
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        else:
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.settimeout(self._connect_timeout_s)
+        sock.connect(target)
+        rfile = sock.makefile("rb")
+        reader = proto.FrameReader(rfile, self._max_frame_bytes)
+        sock.sendall(proto.encode_frame(proto.Hello(
+            version=proto.PROTOCOL_VERSION, client_id=self.client_id,
+            chain_id=self.chain_id)))
+        ack = reader.read_msg()
+        if isinstance(ack, proto.ErrorReply):
+            raise LightserveUnavailable(
+                f"lightserve rejected handshake (code {ack.code}): "
+                f"{ack.message}")
+        if not isinstance(ack, proto.HelloAck):
+            raise proto.ProtocolError(
+                f"expected HelloAck, got {type(ack).__name__}")
+        sock.settimeout(None)  # reader thread blocks; waiters time out
+        self.hello_ack = ack
+        self._sock = sock
+        self._rfile = rfile
+        _m.lightserve_client_up.set(1.0)
+        threading.Thread(target=self._read_loop, args=(reader, sock),
+                         name="lightserve-client-read",
+                         daemon=True).start()
+
+    def close(self) -> None:
+        with self._conn_lock:
+            self._teardown(LightserveUnavailable("client closed"))
+
+    def _teardown(self, err: Exception) -> None:
+        from tmtpu.libs import metrics as _m
+
+        sock, self._sock = self._sock, None
+        self._rfile = None
+        self.hello_ack = None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            _m.lightserve_client_up.set(0.0)
+        with self._waiters_lock:
+            waiters, self._waiters = self._waiters, {}
+        for w in waiters.values():
+            w.error = err
+            w.event.set()
+
+    def _read_loop(self, reader: proto.FrameReader,
+                   sock: socket.socket) -> None:
+        try:
+            while True:
+                msg = reader.read_msg()
+                rid = getattr(msg, "request_id",
+                              getattr(msg, "nonce", 0))
+                if isinstance(msg, proto.ErrorReply) and rid == 0:
+                    raise LightserveUnavailable(
+                        f"lightserve connection error {msg.code}: "
+                        f"{msg.message}")
+                with self._waiters_lock:
+                    w = self._waiters.pop(rid, None)
+                if w is not None:
+                    w.reply = msg
+                    w.event.set()
+                # unmatched reply: waiter already timed out — drop it
+        except (EOFError, OSError, proto.ProtocolError,
+                LightserveUnavailable) as exc:
+            with self._conn_lock:
+                if self._sock is sock:
+                    self._teardown(LightserveUnavailable(
+                        f"lightserve connection lost: {exc}"))
+
+    # --- request primitives ---
+
+    def _send(self, rid: int, msg) -> _Waiter:
+        w = _Waiter()
+        with self._waiters_lock:
+            self._waiters[rid] = w
+        try:
+            data = proto.encode_frame(msg)
+            sock = self._sock
+            if sock is None:
+                raise LightserveUnavailable("lightserve not connected")
+            with self._wlock:
+                sock.sendall(data)
+        except OSError as exc:
+            with self._waiters_lock:
+                self._waiters.pop(rid, None)
+            with self._conn_lock:
+                if self._sock is sock:
+                    self._teardown(LightserveUnavailable(str(exc)))
+            raise LightserveUnavailable(
+                f"lightserve send failed: {exc}") from exc
+        return w
+
+    def _await(self, rid: int, w: _Waiter, deadline_s: float):
+        if not w.event.wait(deadline_s):
+            with self._waiters_lock:
+                self._waiters.pop(rid, None)
+            raise LightserveUnavailable(
+                f"lightserve request deadline ({deadline_s:.3f}s) "
+                f"exceeded")
+        if w.error is not None:
+            raise LightserveUnavailable(str(w.error)) from w.error
+        return w.reply
+
+    def _roundtrip(self, rid: int, msg, deadline_s: float):
+        return self._await(rid, self._send(rid, msg), deadline_s)
+
+    # --- public API ---
+
+    def sync_submit(self, trusted_height: int, trusted_hash: bytes,
+                    target_height: int = 0,
+                    now_ns: int = 0) -> SyncHandle:
+        """Fire one session without blocking; collect it later via the
+        handle. Many handles can ride one connection concurrently —
+        same-target sessions coalesce server-side."""
+        self._ensure_connected()
+        rid = next(self._seq)
+        w = self._send(rid, proto.SyncRequest(
+            request_id=rid, trusted_height=trusted_height,
+            trusted_hash=trusted_hash, target_height=target_height,
+            now_ns=now_ns))
+        return SyncHandle(self, rid, w)
+
+    def _collect(self, rid: int, w: _Waiter,
+                 deadline_s: Optional[float],
+                 submitted_at: float) -> SyncResult:
+        from tmtpu.libs import metrics as _m
+
+        try:
+            reply = self._await(rid, w,
+                                deadline_s or self._request_deadline_s)
+        except LightserveUnavailable:
+            _m.lightserve_client_requests.inc(status="error")
+            raise
+        _m.lightserve_client_request_latency.observe(
+            time.perf_counter() - submitted_at)
+        if not isinstance(reply, proto.SyncResponse):
+            _m.lightserve_client_requests.inc(status="error")
+            raise LightserveUnavailable(
+                f"unexpected reply {type(reply).__name__}")
+        status = proto.STATUS_NAMES.get(reply.status,
+                                        str(reply.status))
+        _m.lightserve_client_requests.inc(status=status)
+        if reply.status == proto.STATUS_OVERLOADED:
+            raise LightserveOverloaded(reply.error or "overloaded")
+        if reply.status in (proto.STATUS_EXPIRED,
+                            proto.STATUS_UNTRUSTED,
+                            proto.STATUS_BAD_REQUEST):
+            raise LightserveRefused(reply.status, reply.error)
+        if reply.status != proto.STATUS_OK:
+            raise LightserveUnavailable(
+                f"lightserve status {status}: {reply.error}")
+        hops = [(h.height, bytes(h.header_hash), h.header_time)
+                for h in reply.hops]
+        if not hops:
+            raise LightserveUnavailable("ok response carried no hops")
+        return SyncResult(hops[-1][0], hops, reply.dispatches,
+                          reply.cache_hit, reply.dispatch_id,
+                          reply.coalesced)
+
+    def sync(self, trusted_height: int, trusted_hash: bytes,
+             target_height: int = 0, now_ns: int = 0,
+             deadline_s: Optional[float] = None) -> SyncResult:
+        """One blocking session: prove ``target_height`` (0 = server's
+        latest) from ``(trusted_height, trusted_hash)``."""
+        handle = self.sync_submit(trusted_height, trusted_hash,
+                                  target_height, now_ns)
+        return handle.result(deadline_s)
+
+    def ping(self, deadline_s: Optional[float] = None) -> proto.Pong:
+        self._ensure_connected()
+        nonce = next(self._seq)
+        reply = self._roundtrip(nonce, proto.Ping(nonce=nonce),
+                                deadline_s or self._request_deadline_s)
+        if not isinstance(reply, proto.Pong):
+            raise LightserveUnavailable(
+                f"unexpected reply {type(reply).__name__}")
+        return reply
+
+    def stats(self, deadline_s: Optional[float] = None) -> Dict:
+        """Daemon snapshot; serializes on request id 0 like the sidecar
+        stats call — fine for a debug endpoint."""
+        self._ensure_connected()
+        reply = self._roundtrip(0, proto.StatsRequest(),
+                                deadline_s or self._request_deadline_s)
+        if not isinstance(reply, proto.StatsResponse):
+            raise LightserveUnavailable(
+                f"unexpected reply {type(reply).__name__}")
+        return json.loads(reply.stats_json.decode())

@@ -1,0 +1,193 @@
+"""Lightserve wire protocol: commit-proof sessions over framed messages.
+
+Same framing as the sidecar (``uvarint(len(body)) || type_byte ||
+payload``) — the codec itself is imported from
+:mod:`tmtpu.sidecar.protocol` with this module's own message registry,
+so the two daemons share one tested frame reader without sharing a wire
+namespace.
+
+A session is one :class:`SyncRequest`: "I trust ``(trusted_height,
+trusted_hash)``; prove ``target_height`` to me." The daemon answers
+with the chain of verified-header hops (bisection pivots per
+arXiv:2010.07031) from at-or-below the client's trusted height up to
+the target, plus accounting: how many device dispatches the answer
+actually cost (0 = pure cache hit) and how many concurrent sessions
+shared the joint resolve. Frames are small — hops are (height, hash,
+time) facts, never validator sets — so the default frame cap is 1 MiB,
+not the sidecar's 8.
+
+Handshake: client sends :class:`Hello` first (with the chain id it
+expects); server answers :class:`HelloAck` carrying its chain id,
+trust anchor, and latest verified height — a cold client with no
+social-consensus anchor of its own can adopt the server's. Version
+negotiation mirrors the sidecar: min(client, server), ``ERR_VERSION``
+on unsupported.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Type
+
+from tmtpu.libs.protoio import ProtoMessage
+from tmtpu.sidecar.protocol import (  # noqa: F401 — re-exported codec
+    ProtocolError,
+    encode_uvarint,
+    parse_addr,
+)
+from tmtpu.sidecar import protocol as _sidecar_proto
+
+PROTOCOL_VERSION = 1
+SUPPORTED_VERSIONS = (1,)
+
+# Proof frames carry (height, hash, time) hops, not lanes: 1 MiB covers
+# a ~17k-hop chain with room, far past any O(log N) bisection path.
+DEFAULT_MAX_FRAME_BYTES = 1 * 1024 * 1024
+
+# --- SyncResponse.status ---
+STATUS_OK = 0
+STATUS_OVERLOADED = 1      # admission control rejected; retry later
+STATUS_UPSTREAM_DOWN = 2   # provider unreachable / verification engine failed
+STATUS_BAD_REQUEST = 3     # zero target, malformed hash
+STATUS_SHUTTING_DOWN = 4   # daemon draining; do not resubmit
+STATUS_EXPIRED = 5         # no trusted state fresh enough to prove the target
+STATUS_UNTRUSTED = 6       # client's trusted hash conflicts with the spine
+
+STATUS_NAMES = {
+    STATUS_OK: "ok",
+    STATUS_OVERLOADED: "overloaded",
+    STATUS_UPSTREAM_DOWN: "upstream_down",
+    STATUS_BAD_REQUEST: "bad_request",
+    STATUS_SHUTTING_DOWN: "shutting_down",
+    STATUS_EXPIRED: "expired",
+    STATUS_UNTRUSTED: "untrusted",
+}
+
+# --- ErrorReply.code --- (numbering shared with the sidecar protocol)
+ERR_VERSION = 1
+ERR_PROTOCOL = 2
+ERR_INTERNAL = 3
+
+
+class Hello(ProtoMessage):
+    FIELDS = [
+        (1, "version", "uint32"),
+        (2, "client_id", "string"),
+        (3, "chain_id", "string"),           # "" = accept server's chain
+    ]
+
+
+class HelloAck(ProtoMessage):
+    FIELDS = [
+        (1, "version", "uint32"),
+        (2, "server_id", "string"),
+        (3, "chain_id", "string"),
+        (4, "anchor_height", "uint64"),      # the daemon's trust anchor…
+        (5, "anchor_hash", "bytes"),         # …a cold client can adopt it
+        (6, "latest_height", "uint64"),      # top of the verified spine
+        (7, "max_frame_bytes", "uint64"),
+    ]
+
+
+class SyncRequest(ProtoMessage):
+    FIELDS = [
+        (1, "request_id", "uint64"),
+        (2, "trusted_height", "uint64"),
+        (3, "trusted_hash", "bytes"),
+        (4, "target_height", "uint64"),      # 0 = server's latest
+        # 0 = server clock; tests pin it to exercise trust-period expiry
+        (5, "now_ns", "uint64"),
+    ]
+
+
+class Hop(ProtoMessage):
+    """One verified-header fact on the server's bisection path."""
+
+    FIELDS = [
+        (1, "height", "uint64"),
+        (2, "header_hash", "bytes"),
+        (3, "header_time", "int64"),
+    ]
+
+
+class SyncResponse(ProtoMessage):
+    FIELDS = [
+        (1, "request_id", "uint64"),
+        (2, "status", "uint32"),
+        (3, "hops", ("rep", ("msg", Hop))),  # ascending, ends at target
+        (4, "dispatches", "uint32"),         # device dispatches this answer cost
+        (5, "cache_hit", "bool"),            # target served straight from cache
+        (6, "dispatch_id", "uint64"),        # joint-resolve identity (0 = inline)
+        (7, "coalesced", "uint32"),          # sessions sharing the resolve
+        (8, "error", "string"),
+    ]
+
+
+class Ping(ProtoMessage):
+    FIELDS = [(1, "nonce", "uint64")]
+
+
+class Pong(ProtoMessage):
+    FIELDS = [
+        (1, "nonce", "uint64"),
+        (2, "latest_height", "uint64"),
+        (3, "uptime_ms", "uint64"),
+    ]
+
+
+class StatsRequest(ProtoMessage):
+    FIELDS = []
+
+
+class StatsResponse(ProtoMessage):
+    """Introspection snapshot; JSON so the payload can grow without
+    protocol bumps (advisory, not consensus-critical)."""
+
+    FIELDS = [(1, "stats_json", "bytes")]
+
+
+class ErrorReply(ProtoMessage):
+    FIELDS = [
+        (1, "request_id", "uint64"),         # 0 when not tied to a request
+        (2, "code", "uint32"),
+        (3, "message", "string"),
+    ]
+
+
+# type_byte → message class. Wire-visible; never reuse a number.
+MESSAGE_TYPES: Dict[int, Type[ProtoMessage]] = {
+    1: Hello,
+    2: HelloAck,
+    3: SyncRequest,
+    4: SyncResponse,
+    5: Ping,
+    6: Pong,
+    7: StatsRequest,
+    8: StatsResponse,
+    9: ErrorReply,
+    10: Hop,
+}
+
+TYPE_BYTES: Dict[Type[ProtoMessage], int] = {
+    cls: tb for tb, cls in MESSAGE_TYPES.items()
+}
+
+
+def encode_frame(msg: ProtoMessage) -> bytes:
+    return _sidecar_proto.encode_frame(msg, TYPE_BYTES)
+
+
+def decode_frame(body: bytes) -> ProtoMessage:
+    return _sidecar_proto.decode_frame(body, MESSAGE_TYPES)
+
+
+def write_frame(stream, msg: ProtoMessage) -> None:
+    _sidecar_proto.write_frame(stream, msg, TYPE_BYTES)
+
+
+class FrameReader(_sidecar_proto.FrameReader):
+    """Sidecar frame reader bound to the lightserve message registry."""
+
+    def __init__(self, stream,
+                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES):
+        super().__init__(stream, max_frame_bytes,
+                         message_types=MESSAGE_TYPES)

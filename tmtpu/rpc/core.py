@@ -387,6 +387,32 @@ def build_routes(env: Environment) -> dict:
             "total": str(vals.size()),
         }
 
+    def light_block(height=None):
+        """Commit + the FULL validator set in one round trip — the
+        fetch shape of light clients and the lightserve serving tier
+        (tmtpu/lightserve), which otherwise pays 1 commit + N paginated
+        validators calls per spine height."""
+        h = int(height) if height is not None else env.block_store.height()
+        meta = env.block_store.load_block_meta(h)
+        if meta is None:
+            raise RPCError(-32603, f"no commit for height {h}")
+        c = env.block_store.load_block_commit(h) \
+            or env.block_store.load_seen_commit(h)
+        vals = env.state_store.load_validators(h)
+        if vals is None:
+            raise RPCError(-32603, f"no validators for height {h}")
+        return {
+            "signed_header": {"header": _header_json(meta.header),
+                              "commit": _commit_json(c)},
+            "validator_set": {"validators": [{
+                "address": _hex(v.address),
+                "pub_key": amino_json.marshal_pub_key(v.pub_key),
+                "voting_power": str(v.voting_power),
+                "proposer_priority": str(v.proposer_priority),
+            } for v in vals.validators]},
+            "canonical": env.block_store.load_block_commit(h) is not None,
+        }
+
     def consensus_state():
         rs = env.consensus.get_round_state()
         return {"round_state": {
@@ -878,6 +904,7 @@ def build_routes(env: Environment) -> dict:
         "net_info": net_info, "blockchain": blockchain, "block": block,
         "block_by_hash": block_by_hash, "block_results": block_results,
         "commit": commit, "validators": validators,
+        "light_block": light_block,
         "consensus_state": consensus_state,
         "dump_consensus_state": dump_consensus_state,
         "consensus_params": consensus_params,

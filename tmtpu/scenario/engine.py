@@ -3,8 +3,10 @@ judge PASS/FAIL.
 
 One run is four phases on a single clock (t=0 at net start):
 
-1. **Run** — nodes come up (plus the sidecar daemon when the spec wants
-   one), tx load starts, and a sampler thread polls every node's height
+1. **Run** — nodes come up (plus the sidecar and/or lightserve daemon
+   when the spec wants them, and the light-session flood feeding the
+   dispatch_avoided_rate oracle), tx load starts, and a sampler thread
+   polls every node's height
    and watchdog verdict (the health time-series that stall/convergence
    oracles read).
 2. **Perturb** — fault actions execute at their ``at_s`` offsets:
@@ -306,7 +308,9 @@ class ScenarioEngine:
                         snap["error"] = str(e)
             nodes[node.spec.name] = snap
         return Evidence(self.spec, self.events, self.samples, nodes,
-                        sidecar_kills=self.net.sidecar_kills)
+                        sidecar_kills=self.net.sidecar_kills,
+                        lightserve=(self.net.light_stats()
+                                    if self.spec.lightserve else None))
 
     @staticmethod
     def _fetch_blocks(node, top: int,
@@ -337,6 +341,7 @@ class ScenarioEngine:
                   + (f" + {spec.full_nodes} full nodes"
                      if spec.full_nodes else "")
                   + (" + sidecar" if spec.sidecar else "")
+                  + (" + lightserve" if spec.lightserve else "")
                   + (f", layers {spec.layers}" if spec.layers else "")
                   + f", seed {spec.seed}")
         self.net.setup()
@@ -348,6 +353,13 @@ class ScenarioEngine:
         self.start_sampler()
         if spec.load_rate > 0:
             self.net.start_load()
+        if spec.lightserve:
+            # after start_load: the daemon anchors on the live chain's
+            # height-1 commit, so the net must be committing first
+            self.net.start_lightserve()
+            self.net.start_light_load()
+            self._log(f"[{self._now():7.2f}s] lightserve up on "
+                      f"{self.net.lightserve_addr}, light flood started")
 
     def shutdown(self) -> None:
         """Tear everything down in join-clean order: sampler thread
@@ -417,6 +429,8 @@ class ScenarioEngine:
             self.boot()
             self._run_timeline()
             self.net.stop_load()
+            if spec.lightserve:
+                self.net.stop_light_load()
             if spec.settle_s > 0:
                 self._log(f"[{self._now():7.2f}s] settling "
                           f"{spec.settle_s}s before judging")
@@ -440,6 +454,8 @@ class ScenarioEngine:
             "wall_s": round(time.time() - started_unix, 3),
             "outdir": self.outdir,
         }
+        if spec.lightserve:
+            verdict["lightserve"] = evidence.lightserve
         if spec.layers:
             verdict["layers"] = self._layer_attribution(verdicts)
         self._persist(verdict)

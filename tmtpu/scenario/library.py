@@ -2,7 +2,7 @@
 
 Each builder returns a fresh ``ScenarioSpec`` (callers may mutate their
 copy — shrink durations for CI, crank load for soak runs). ``FAST``
-lists the pair cheap enough to ride tier-1 under the ``scenarios``
+lists the set cheap enough to ride tier-1 under the ``scenarios``
 pytest marker; the rest run on demand via tools/scenario_run.py.
 
 Timing notes: scenario nets run the e2e fast consensus profile
@@ -341,6 +341,33 @@ def amnesia() -> ScenarioSpec:
         ])
 
 
+def light_flood() -> ScenarioSpec:
+    """A commit-proof serving daemon (``tmtpu lightserve``) anchored on
+    the live chain serves a pipelined light-session flood while the
+    validators keep committing under tx load. After the loader warms
+    its target heights, >99% of sessions must be answered with ZERO
+    verify dispatches (the serving tier's whole point: verify once,
+    serve millions) with no session errors — while the usual liveness
+    and latency invariants hold on the chain underneath. The session
+    floor keeps the rate honest: on this single-core host the flood
+    completes thousands of sessions in the window, so 200 is a
+    landed-at-all bar, not a throughput benchmark."""
+    return ScenarioSpec(
+        name="light_flood",
+        description="light-session flood against the serving tier: "
+                    ">99% of sessions dodge the verify engine",
+        validators=4, lightserve=True, load_rate=10.0,
+        duration_s=22.0, settle_s=5.0,
+        oracles=[
+            OracleSpec("dispatch_avoided_rate",
+                       {"min_rate": 0.99, "min_sessions": 200}),
+            OracleSpec("latency_p99_under_slo",
+                       {"slo_ms": 15_000.0, "min_count": 10}),
+            OracleSpec("chain_agreement"),
+            OracleSpec("height_min", {"min": 6}),
+        ])
+
+
 # -- composition layers & composed scenarios ----------------------------------
 #
 # Layers below exist to be composed (spec.compose): each is a valid
@@ -542,6 +569,7 @@ SCENARIOS = {
     "churn_rotation": churn_rotation,
     "statesync_join": statesync_join,
     "latency_under_load": latency_under_load,
+    "light_flood": light_flood,
     "crash_restart_wal": crash_restart_wal,
     "laggard": laggard,
     "amnesia": amnesia,
@@ -553,7 +581,7 @@ SCENARIOS = {
 }
 
 # cheap enough for tier-1 (the ``scenarios`` pytest marker)
-FAST = ("equivocation", "wal_under_lan")
+FAST = ("equivocation", "wal_under_lan", "light_flood")
 
 
 def names() -> list:

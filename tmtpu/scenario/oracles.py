@@ -33,15 +33,20 @@ class Evidence:
     ``samples`` is the health time-series ({"t", "node", "height",
     "healthy", "reasons"}, t = seconds since net start) and ``events``
     the executed fault timeline ({"t", "op", "node", "ok", "detail"}).
+    ``lightserve`` carries the light-session flood counters when the
+    spec ran a serving tier ({"sessions", "avoided", "errors",
+    "warmed", "p50_ms", "p99_ms"}), else None.
     """
 
     def __init__(self, spec, events: List[dict], samples: List[dict],
-                 nodes: Dict[str, dict], sidecar_kills: int = 0):
+                 nodes: Dict[str, dict], sidecar_kills: int = 0,
+                 lightserve: Optional[dict] = None):
         self.spec = spec
         self.events = events
         self.samples = samples
         self.nodes = nodes
         self.sidecar_kills = sidecar_kills
+        self.lightserve = lightserve
 
     # -- accessors -----------------------------------------------------------
 
@@ -435,6 +440,32 @@ def sidecar_fallbacks_cover_kills(ev: Evidence, min_per_kill: float = 1.0) \
     return (got >= need,
             f"{got} fallback lanes vs {ev.sidecar_kills} kills "
             f"(need >= {need})")
+
+
+@oracle
+def dispatch_avoided_rate(ev: Evidence, min_rate: float = 0.99,
+                          min_sessions: int = 200,
+                          max_errors: int = 0) -> Tuple[bool, str]:
+    """The light-client serving tier answered nearly every flood
+    session without touching the verification engine — the "verify
+    once, serve millions" invariant. Judges the steady-state counters
+    the light flood recorded (warm-phase resolves are excluded by the
+    loader, the way a long-lived daemon serves after warmup), and
+    demands enough completed sessions that the rate means something —
+    a flood that never landed must fail loudly, not vacuously pass."""
+    st = ev.lightserve or {}
+    sessions = int(st.get("sessions", 0))
+    if sessions < min_sessions:
+        return False, (f"only {sessions} light sessions completed "
+                       f"(need >= {min_sessions}); stats {st}")
+    avoided = int(st.get("avoided", 0))
+    errors = int(st.get("errors", 0))
+    rate = avoided / sessions
+    detail = (f"{avoided}/{sessions} sessions avoided a dispatch "
+              f"(rate {rate:.4f}, floor {min_rate}), {errors} errors "
+              f"(ceiling {max_errors}), p99 {st.get('p99_ms')}ms, "
+              f"{st.get('warmed', 0)} warm resolves excluded")
+    return rate >= min_rate and errors <= max_errors, detail
 
 
 @oracle

@@ -87,6 +87,11 @@ class ScenarioSpec:
     validators: int = 4
     full_nodes: int = 0
     sidecar: bool = False                # shared batch-verify daemon
+    # light-client commit-proof serving daemon + session flood: the
+    # engine starts `tmtpu lightserve` against node0's RPC once the
+    # chain serves commit(1), then floods pipelined light sessions at
+    # it for the rest of the run (judged via dispatch_avoided_rate)
+    lightserve: bool = False
     load_rate: float = 10.0              # tx/s offered while running
     load_size: int = 32
     duration_s: float = 20.0             # fault-timeline window
@@ -196,6 +201,11 @@ class ScenarioSpec:
                 and not self.sidecar:
             problems.append(
                 f"{self.name}: sidecar fault ops but sidecar=False")
+        if any(o.name == "dispatch_avoided_rate" for o in self.oracles) \
+                and not self.lightserve:
+            problems.append(
+                f"{self.name}: dispatch_avoided_rate oracle but "
+                f"lightserve=False — no serving tier to judge")
         problems.extend(self.composition_problems())
         return problems
 
@@ -254,7 +264,8 @@ class ScenarioSpec:
         d = {
             "name": self.name, "description": self.description,
             "validators": self.validators, "full_nodes": self.full_nodes,
-            "sidecar": self.sidecar, "load_rate": self.load_rate,
+            "sidecar": self.sidecar, "lightserve": self.lightserve,
+            "load_rate": self.load_rate,
             "duration_s": self.duration_s, "settle_s": self.settle_s,
             "seed": self.seed, "links": self.links,
             "misbehaviors": {n: dict(m) for n, m in
@@ -335,6 +346,7 @@ def compose(name: str, *layer_specs: ScenarioSpec,
         validators=max(sp.validators for sp in layer_specs),
         full_nodes=max(sp.full_nodes for sp in layer_specs),
         sidecar=any(sp.sidecar for sp in layer_specs),
+        lightserve=any(sp.lightserve for sp in layer_specs),
         duration_s=max(sp.duration_s for sp in layer_specs),
         settle_s=max(sp.settle_s for sp in layer_specs),
         timeout_s=max(sp.timeout_s for sp in layer_specs),

@@ -39,7 +39,7 @@ lane i's verdict is bit ``i & 7`` of byte ``i >> 3``, LSB-first —
 from __future__ import annotations
 
 import io
-from typing import Dict, List, Tuple, Type
+from typing import Dict, List, Optional, Tuple, Type
 
 from tmtpu.libs.protoio import (
     DelimitedReader,
@@ -194,20 +194,29 @@ class ProtocolError(Exception):
     """Raised on malformed frames, unknown types, or bad sequencing."""
 
 
-def encode_frame(msg: ProtoMessage) -> bytes:
-    tb = TYPE_BYTES.get(type(msg))
+def encode_frame(msg: ProtoMessage,
+                 type_bytes: Optional[Dict[Type[ProtoMessage], int]] = None
+                 ) -> bytes:
+    """Encode one frame. ``type_bytes`` defaults to the sidecar registry;
+    sibling frame protocols (tmtpu/lightserve) pass their own class→tag
+    map to reuse the codec without sharing a wire namespace."""
+    tb = (TYPE_BYTES if type_bytes is None else type_bytes).get(type(msg))
     if tb is None:
         raise ProtocolError(f"unregistered message type {type(msg).__name__}")
     body = bytes([tb]) + msg.encode()
     return encode_uvarint(len(body)) + body
 
 
-def decode_frame(body: bytes) -> ProtoMessage:
+def decode_frame(body: bytes,
+                 message_types: Optional[Dict[int, Type[ProtoMessage]]] = None
+                 ) -> ProtoMessage:
     """Decode one frame *body* (type byte + payload, length prefix already
-    stripped)."""
+    stripped). ``message_types`` defaults to the sidecar registry; sibling
+    protocols pass their own tag→class map."""
     if not body:
         raise ProtocolError("empty frame")
-    cls = MESSAGE_TYPES.get(body[0])
+    cls = (MESSAGE_TYPES if message_types is None else message_types
+           ).get(body[0])
     if cls is None:
         raise ProtocolError(f"unknown message type {body[0]}")
     try:
@@ -223,18 +232,22 @@ class FrameReader:
     Thin veneer over :class:`protoio.DelimitedReader`; EOF mid-frame
     surfaces as ``EOFError`` (peer went away), anything else malformed as
     :class:`ProtocolError` so the connection loop can answer
-    ``ERR_PROTOCOL`` before closing.
+    ``ERR_PROTOCOL`` before closing. ``message_types`` selects the tag
+    registry (defaults to the sidecar's).
     """
 
-    def __init__(self, stream, max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES):
+    def __init__(self, stream, max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+                 message_types: Optional[Dict[int,
+                                              Type[ProtoMessage]]] = None):
         self._rd = DelimitedReader(stream, max_size=max_frame_bytes)
+        self._message_types = message_types
 
     def read_msg(self) -> ProtoMessage:
         try:
             body = self._rd.read_msg()
         except ValueError as exc:  # oversized frame / runaway varint
             raise ProtocolError(str(exc)) from exc
-        return decode_frame(body)
+        return decode_frame(body, self._message_types)
 
 
 def pack_mask(mask: List[bool]) -> bytes:
@@ -252,8 +265,10 @@ def unpack_mask(packed: bytes, lane_count: int) -> List[bool]:
     return [bool(packed[i >> 3] & (1 << (i & 7))) for i in range(lane_count)]
 
 
-def write_frame(stream: io.RawIOBase, msg: ProtoMessage) -> None:
-    stream.write(encode_frame(msg))
+def write_frame(stream: io.RawIOBase, msg: ProtoMessage,
+                type_bytes: Optional[Dict[Type[ProtoMessage], int]] = None
+                ) -> None:
+    stream.write(encode_frame(msg, type_bytes))
     flush = getattr(stream, "flush", None)
     if flush is not None:
         flush()

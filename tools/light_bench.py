@@ -13,10 +13,18 @@ then measures:
    #4); the reference loops per-hop (light/client.go:613).
 
 Usage: python tools/light_bench.py [--heights 100000] [--backend cpu|tpu]
-       [--run 1024]
+       [--run 1024] [--sidecar unix:///path/sidecar.sock]
 
-Prints one JSON line per scenario. Chain fabrication signs
-heights × validators votes on host (~4 MockPV ed25519 signs per height).
+``--sidecar ADDR`` attaches the bench to a running verification sidecar
+daemon: commit checks ride the daemon's cross-client coalescer instead
+of an in-process backend, so a host-shared device serves the bench and
+live nodes together.
+
+Prints one JSON line per scenario, each carrying ``dispatches`` — the
+verify dispatches that line cost (in-process batch dispatches plus
+sidecar round trips), the denominator for any dispatches/block claim.
+Chain fabrication signs heights × validators votes on host (~4 MockPV
+ed25519 signs per height).
 """
 
 import argparse
@@ -34,15 +42,38 @@ def main():
     ap.add_argument("--backend", default="cpu", choices=("cpu", "tpu"))
     ap.add_argument("--run", type=int, default=1024,
                     help="adjacent-run fused batch size (blocks/dispatch)")
+    ap.add_argument("--sidecar", default="", metavar="ADDR",
+                    help="attach to a running verification sidecar "
+                         "(unix:///path.sock or tcp://host:port) instead "
+                         "of an in-process backend")
     args = ap.parse_args()
 
-    if args.backend == "cpu":
+    if args.backend == "cpu" and not args.sidecar:
         from tmtpu.tpu.compat import force_cpu_backend
 
         force_cpu_backend(1)
     from tmtpu.crypto import batch as crypto_batch
 
-    crypto_batch.set_default_backend(args.backend)
+    if args.sidecar:
+        from tmtpu.config.config import SidecarConfig
+
+        crypto_batch.configure_sidecar(SidecarConfig(addr=args.sidecar))
+        crypto_batch.set_default_backend("sidecar")
+        backend_name = "sidecar"
+    else:
+        crypto_batch.set_default_backend(args.backend)
+        backend_name = args.backend
+
+    from tmtpu.libs import metrics as _metrics
+
+    def dispatch_count():
+        """In-process device/CPU batch dispatches + sidecar round trips
+        — every way a commit check can cost a dispatch."""
+        n = sum(v["count"] for v in
+                _metrics.crypto_batch_size.summary_series().values())
+        n += sum(_metrics.sidecar_client_requests
+                 .summary_series().values())
+        return int(n)
 
     from tests.test_light import (
         CHAIN_ID, WEEK_NS, ChainProvider, FabChain,
@@ -69,6 +100,7 @@ def main():
         provider, [ChainProvider(chain, "w1")],
         LightStore(MemDB()),
     )
+    d0 = dispatch_count()
     t0 = time.perf_counter()
     lb = c.verify_light_block_at_height(args.heights, now_ns=now_ns)
     dt = time.perf_counter() - t0
@@ -78,11 +110,13 @@ def main():
         "heights": args.heights,
         "value": round(dt * 1e3, 1), "unit": "ms",
         "provider_calls": provider.calls,
-        "backend": args.backend,
+        "dispatches": dispatch_count() - d0,
+        "backend": backend_name,
     }))
 
     # 2. sequential: every header verified, commits fused per run
     trusted = chain.blocks[1]
+    d0 = dispatch_count()
     t0 = time.perf_counter()
     h = 2
     verified = 0
@@ -103,7 +137,8 @@ def main():
         "run": args.run,
         "wall_s": round(dt, 2),
         "sig_s": round(4 * verified / dt, 1),
-        "backend": args.backend,
+        "dispatches": dispatch_count() - d0,
+        "backend": backend_name,
     }))
 
 
