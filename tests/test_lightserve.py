@@ -21,6 +21,17 @@ from tmtpu.lightserve.server import LightserveServer
 T0 = 1_700_000_000_000_000_000  # pinned chain genesis for clock tests
 
 
+class FakeClock:
+    """Settable server clock: trust expiry is judged on the SERVER
+    clock only, so clock tests pin the server's, not the client's."""
+
+    def __init__(self, now_ns: int):
+        self.now_ns = now_ns
+
+    def __call__(self) -> int:
+        return self.now_ns
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _cpu_backend():
     from tmtpu.crypto import batch as crypto_batch
@@ -114,6 +125,31 @@ def test_cache_nearest_queries():
     assert c.nearest_above(50, now).height == 90
 
 
+def test_cache_lazy_height_index_under_churn():
+    """Eviction is lazy in the height index (no O(N) list scan under
+    the serving lock): churning far past capacity must keep every
+    range query and snapshot bound correct, and compaction must keep
+    the index from growing unboundedly stale."""
+    c = VerifiedFactCache(CHAIN_ID, WEEK_NS, max_facts=100)
+    now = T0 + 10_000 * 1_000_000_000
+    for h in range(1, 1001):               # 10x capacity of churn
+        assert c.put(_fact(h, h - 1), now)
+    assert c.size() == 100
+    # index never holds more than live + pre-compaction stale entries
+    assert len(c._heights) <= 2 * (100 + 65)
+    snap = c.snapshot()
+    assert snap["lowest"] == 901 and snap["highest"] == 1000
+    # range queries skip lazily-deleted entries correctly
+    assert c.nearest_at_or_below(950, now).height == 950
+    assert c.nearest_at_or_below(900, now) is None  # all evicted below
+    assert c.nearest_above(900, now).height == 901
+    assert c.get(900, now) is None and c.get(901, now).height == 901
+    # resurrecting an evicted height keeps the index duplicate-free
+    assert c.put(_fact(500, 1), now)
+    assert c.nearest_at_or_below(600, now).height == 500
+    assert c._heights.count(500) == 1
+
+
 # --- serving behavior --------------------------------------------------------
 
 
@@ -195,25 +231,29 @@ def test_trust_period_expiry_refuses_and_reverifies(tmp_path):
     time, nothing re-cached), exactly at the <= boundary."""
     chain = FabChain(100, start_time=T0)
     t_warm = T0 + 101 * 1_000_000_000      # all heights fresh
+    clock = FakeClock(t_warm)
     srv, provider = _serve(tmp_path, chain, period_ns=HOUR_NS,
-                           anchor_now_ns=t_warm)
+                           anchor_now_ns=t_warm, clock=clock)
     try:
         cli = LightserveClient(srv.addr, chain_id=CHAIN_ID)
         anchor_hash = chain.blocks[1].header.hash()
+        # a matching client now_ns rides the skew check and is accepted
         r50 = cli.sync(1, anchor_hash, 50, now_ns=t_warm)
         assert r50.dispatches > 0
-        cli.sync(1, anchor_hash, 100, now_ns=t_warm)  # fresh tip fact
+        cli.sync(1, anchor_hash, 100)      # fresh tip fact
 
         boundary = chain.blocks[50].header.time + HOUR_NS
         # one nanosecond BEFORE the boundary: still a pure cache hit
-        r = cli.sync(1, anchor_hash, 50, now_ns=boundary - 1)
+        clock.now_ns = boundary - 1
+        r = cli.sync(1, anchor_hash, 50)
         assert r.cache_hit and r.dispatches == 0
         calls0 = provider.calls
         expired0 = srv.cache.snapshot()["expired"]
 
         # AT the boundary: refused, evicted, re-verified via hash links
         # from the still-fresh tip (height 100 is 50s younger)
-        r = cli.sync(1, anchor_hash, 50, now_ns=boundary)
+        clock.now_ns = boundary
+        r = cli.sync(1, anchor_hash, 50)
         assert not r.cache_hit
         assert r.hops[-1] == (50, chain.blocks[50].header.hash(),
                               chain.blocks[50].header.time)
@@ -223,14 +263,14 @@ def test_trust_period_expiry_refuses_and_reverifies(tmp_path):
 
         # NOT re-cached: the next request pays re-verification again
         calls1 = provider.calls
-        r = cli.sync(1, anchor_hash, 50, now_ns=boundary)
+        r = cli.sync(1, anchor_hash, 50)
         assert not r.cache_hit
         assert provider.calls > calls1
 
         # once even the tip lapses there is no fresh trust left: refuse
-        far = chain.blocks[100].header.time + HOUR_NS
+        clock.now_ns = chain.blocks[100].header.time + HOUR_NS
         with pytest.raises(LightserveRefused) as ei:
-            cli.sync(1, anchor_hash, 50, now_ns=far)
+            cli.sync(1, anchor_hash, 50)
         assert ei.value.status == proto.STATUS_EXPIRED
         cli.close()
     finally:
@@ -240,17 +280,81 @@ def test_trust_period_expiry_refuses_and_reverifies(tmp_path):
 def test_backwards_reverification_respects_limit(tmp_path):
     chain = FabChain(100, start_time=T0)
     t_warm = T0 + 101 * 1_000_000_000
+    clock = FakeClock(t_warm)
     srv, _provider = _serve(tmp_path, chain, period_ns=HOUR_NS,
-                            anchor_now_ns=t_warm, backwards_limit=10)
+                            anchor_now_ns=t_warm, backwards_limit=10,
+                            clock=clock)
     try:
         cli = LightserveClient(srv.addr, chain_id=CHAIN_ID)
         anchor_hash = chain.blocks[1].header.hash()
-        cli.sync(1, anchor_hash, 100, now_ns=t_warm)
-        lapsed = chain.blocks[50].header.time + HOUR_NS
+        cli.sync(1, anchor_hash, 100)
+        clock.now_ns = chain.blocks[50].header.time + HOUR_NS
         with pytest.raises(LightserveRefused) as ei:
-            cli.sync(1, anchor_hash, 50, now_ns=lapsed)  # 50 below tip
+            cli.sync(1, anchor_hash, 50)   # 50 below the fresh tip
         assert ei.value.status == proto.STATUS_EXPIRED
         assert "backwards limit" in str(ei.value)
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_client_clock_skew_rejected_and_cannot_evict(tmp_path):
+    """The high-severity regression: a client's now_ns must never act
+    as the expiry clock. A far-future clock is refused bad_request and
+    the shared cache keeps serving fresh facts to everyone else; a
+    far-past clock cannot resurrect server-side expiry safety either."""
+    chain = FabChain(60, start_time=T0)
+    t_warm = T0 + 61 * 1_000_000_000
+    clock = FakeClock(t_warm)
+    srv, provider = _serve(tmp_path, chain, period_ns=HOUR_NS,
+                           anchor_now_ns=t_warm, clock=clock)
+    try:
+        cli = LightserveClient(srv.addr, chain_id=CHAIN_ID)
+        anchor_hash = chain.blocks[1].header.hash()
+        cli.sync(1, anchor_hash, 60)               # warm the cache
+        hits0 = srv.cache.snapshot()["hits"]
+
+        # far-future client clock: would expire-evict every cached fact
+        # if honored — must be refused outright instead
+        far_future = t_warm + 365 * 24 * 3600 * 1_000_000_000
+        with pytest.raises(LightserveRefused) as ei:
+            cli.sync(1, anchor_hash, 60, now_ns=far_future)
+        assert ei.value.status == proto.STATUS_BAD_REQUEST
+        assert "skew" in str(ei.value)
+
+        # far-past clock: cannot bypass server-side trust bookkeeping
+        with pytest.raises(LightserveRefused) as ei:
+            cli.sync(1, anchor_hash, 60, now_ns=T0 - WEEK_NS)
+        assert ei.value.status == proto.STATUS_BAD_REQUEST
+
+        # the shared fact survived both: still a zero-dispatch hit
+        calls0 = provider.calls
+        r = cli.sync(1, anchor_hash, 60)
+        assert r.cache_hit and r.dispatches == 0
+        assert provider.calls == calls0
+        assert srv.cache.snapshot()["hits"] > hits0
+        assert srv.cache.snapshot()["expired"] == 0
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_cold_sessions_use_reply_pool_not_per_session_threads(tmp_path):
+    """Cold coalesced sessions are answered by the fixed reply pool;
+    no lightserve-reply thread is created per session (per-session
+    threads died in Thread.start under cold-session floods)."""
+    chain = FabChain(40)
+    srv, _provider = _serve(tmp_path, chain, reply_workers=2)
+    try:
+        cli = LightserveClient(srv.addr, chain_id=CHAIN_ID)
+        anchor_hash = chain.blocks[1].header.hash()
+        for target in (10, 20, 30, 40):    # four cold resolves
+            r = cli.sync(1, anchor_hash, target)
+            assert r.dispatch_id != 0      # really rode the coalescer
+        reply_threads = [t.name for t in threading.enumerate()
+                         if t.name.startswith("lightserve-reply")]
+        assert sorted(reply_threads) == ["lightserve-reply-0",
+                                         "lightserve-reply-1"]
         cli.close()
     finally:
         srv.stop()

@@ -87,11 +87,26 @@ def test_live_server_answers_every_get_safe_spec_path(tmp_path):
                    for p in ops["get"].get("parameters", [])):
                 continue  # needs inputs (tx, hash, evidence)
             url = f"http://127.0.0.1:{n.rpc_server.port}{path}"
+            if path == "/metrics":
+                # the one non-JSON-RPC route: Prometheus exposition text
+                with urllib.request.urlopen(url, timeout=30) as r:
+                    assert r.headers["Content-Type"].startswith(
+                        "text/plain")
+                    assert b"# HELP" in r.read()
+                checked += 1
+                continue
             with urllib.request.urlopen(url, timeout=30) as r:
                 body = json.loads(r.read())
             assert body["jsonrpc"] == "2.0"
             assert "result" in body or "error" in body, path
-            assert "error" not in body, (path, body.get("error"))
+            if path.startswith("/unsafe_"):
+                # config-gated routes answer a WELL-FORMED error when
+                # [rpc] unsafe is off (the spec-conformance point is
+                # the envelope, not the verdict)
+                assert "error" in body, path
+                assert "unsafe" in body["error"]["message"], path
+            else:
+                assert "error" not in body, (path, body.get("error"))
             checked += 1
         assert checked >= 17  # every no-required-param route answered
     finally:

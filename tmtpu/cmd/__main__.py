@@ -333,6 +333,8 @@ def cmd_lightserve(args) -> int:
         chain_id,
         backend=None if backend == "auto" else backend,
         max_clock_drift_ns=ls.max_clock_drift_ns,
+        max_client_skew_ns=ls.max_client_skew_ns,
+        reply_workers=ls.reply_workers,
         cache_max_facts=ls.cache_max_facts,
         store_max_blocks=ls.store_max_blocks,
         max_queue_sessions=ls.max_queue_sessions,

@@ -367,6 +367,14 @@ class LightserveConfig:
     # and re-verifies via hash links — anything at or past this age
     trusting_period_ns: int = 14 * 24 * 3600 * 1000 * MS
     max_clock_drift_ns: int = 10_000 * MS
+    # trust expiry is judged on the SERVER clock; a session whose
+    # self-reported clock strays further than this from ours is refused
+    # bad_request (its trusting-period window would disagree with the
+    # proofs we serve) — the client value itself is never trusted
+    max_client_skew_ns: int = 10_000 * MS
+    # fixed reply-sender pool for cold (coalesced) sessions; cache hits
+    # answer inline on the connection thread and never touch it
+    reply_workers: int = 8
     # verify engine for commit checks ("auto" | "cpu" | "tpu" |
     # "sidecar" — the serving tier can ride the verification sidecar)
     backend: str = "auto"

@@ -266,6 +266,11 @@ def validate(cfg: Config) -> None:
     if ls.max_clock_drift_ns < 0:
         raise ValueError(
             "lightserve.max_clock_drift_ns cannot be negative")
+    if ls.max_client_skew_ns < 0:
+        raise ValueError(
+            "lightserve.max_client_skew_ns cannot be negative")
+    if ls.reply_workers < 1:
+        raise ValueError("lightserve.reply_workers must be >= 1")
     if ls.request_deadline_ns <= 0:
         raise ValueError("lightserve.request_deadline_ns must be positive")
     if ls.max_queue_sessions < 1:

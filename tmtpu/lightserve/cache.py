@@ -24,12 +24,19 @@ Keys: one cache serves one chain (``chain_id`` is pinned at
 construction and part of every fact's identity triple ``(chain_id,
 height, header_hash)``); capacity is bounded LRU over lookups and
 inserts.
+
+The sorted height index (``_heights``, backing the ``nearest_*``
+range queries) uses lazy deletion: evictions only drop the fact and
+bump a stale counter, and the index is rebuilt in one O(N log N) pass
+once stale entries outnumber live ones. A miss-heavy workload at
+``max_facts`` therefore costs O(log N) amortized per insert/evict
+under the serving lock, never an O(N) list scan per eviction.
 """
 
 from __future__ import annotations
 
 import threading
-from bisect import bisect_right, insort
+from bisect import bisect_left, bisect_right
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
@@ -68,7 +75,10 @@ class VerifiedFactCache:
         self.max_facts = max_facts
         self._lock = threading.Lock()
         self._facts: "OrderedDict[int, Fact]" = OrderedDict()
-        self._heights: List[int] = []   # sorted, mirrors _facts keys
+        # sorted height index; may lag _facts by lazily-deleted entries
+        # (heights whose fact was evicted), compacted once _stale wins
+        self._heights: List[int] = []
+        self._stale = 0
         self.hits = 0
         self.misses = 0
         self.expired = 0
@@ -86,18 +96,32 @@ class VerifiedFactCache:
             return False
         with self._lock:
             if fact.height not in self._facts:
-                insort(self._heights, fact.height)
+                i = bisect_left(self._heights, fact.height)
+                if i < len(self._heights) and \
+                        self._heights[i] == fact.height:
+                    self._stale -= 1   # resurrected a lazy-deleted slot
+                else:
+                    self._heights.insert(i, fact.height)
             self._facts[fact.height] = fact
             self._facts.move_to_end(fact.height)
             while len(self._facts) > self.max_facts:
-                evicted, _ = self._facts.popitem(last=False)
-                self._heights.remove(evicted)
+                self._facts.popitem(last=False)
+                self._stale += 1
+            self._maybe_compact_locked()
             return True
 
     def _evict_locked(self, height: int) -> None:
         if height in self._facts:
             del self._facts[height]
-            self._heights.remove(height)
+            self._stale += 1
+            self._maybe_compact_locked()
+
+    def _maybe_compact_locked(self) -> None:
+        """Rebuild the height index once lazily-deleted entries
+        outnumber live ones (amortized O(log N) per eviction)."""
+        if self._stale > 64 and self._stale * 2 > len(self._heights):
+            self._heights = sorted(self._facts)
+            self._stale = 0
 
     # -- reads ---------------------------------------------------------------
 
@@ -138,16 +162,21 @@ class VerifiedFactCache:
 
         with self._lock:
             i = bisect_right(self._heights, height)
+            found = None
             while i > 0:
-                h = self._heights[i - 1]
-                fact = self._facts[h]
+                i -= 1
+                fact = self._facts.get(self._heights[i])
+                if fact is None:
+                    continue   # lazily-deleted index entry
                 if not fact.expired(self.trusting_period_ns, now_ns):
-                    return fact
-                self._evict_locked(h)
+                    found = fact
+                    break
+                del self._facts[fact.height]
+                self._stale += 1
                 self.expired += 1
                 _m.lightserve_server_cache_expired.inc()
-                i -= 1
-            return None
+            self._maybe_compact_locked()
+            return found
 
     def nearest_above(self, height: int, now_ns: int) -> Optional[Fact]:
         """Lowest fresh fact strictly above ``height`` — the hash-link
@@ -155,8 +184,9 @@ class VerifiedFactCache:
         with self._lock:
             i = bisect_right(self._heights, height)
             while i < len(self._heights):
-                fact = self._facts[self._heights[i]]
-                if not fact.expired(self.trusting_period_ns, now_ns):
+                fact = self._facts.get(self._heights[i])
+                if fact is not None and \
+                        not fact.expired(self.trusting_period_ns, now_ns):
                     return fact
                 i += 1   # don't evict: higher fresh facts may follow
             return None
@@ -191,14 +221,21 @@ class VerifiedFactCache:
         with self._lock:
             return self.hits + self.misses + self.expired
 
+    def _bound_locked(self, highest: bool) -> int:
+        it = reversed(self._heights) if highest else iter(self._heights)
+        for h in it:
+            if h in self._facts:   # skip lazily-deleted index entries
+                return h
+        return 0
+
     def snapshot(self) -> Dict:
         with self._lock:
             return {
                 "chain_id": self.chain_id,
                 "facts": len(self._facts),
                 "max_facts": self.max_facts,
-                "lowest": self._heights[0] if self._heights else 0,
-                "highest": self._heights[-1] if self._heights else 0,
+                "lowest": self._bound_locked(False),
+                "highest": self._bound_locked(True),
                 "hits": self.hits,
                 "misses": self.misses,
                 "expired": self.expired,

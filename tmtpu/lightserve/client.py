@@ -252,6 +252,7 @@ class LightserveClient:
         w = _Waiter()
         with self._waiters_lock:
             self._waiters[rid] = w
+        sock = None
         try:
             data = proto.encode_frame(msg)
             sock = self._sock
@@ -259,15 +260,20 @@ class LightserveClient:
                 raise LightserveUnavailable("lightserve not connected")
             with self._wlock:
                 sock.sendall(data)
-        except OSError as exc:
+            return w
+        except BaseException as exc:
+            # EVERY failure path must unregister the waiter, including
+            # the sock-is-None raise above (a connect race would
+            # otherwise leak the entry until teardown)
             with self._waiters_lock:
                 self._waiters.pop(rid, None)
-            with self._conn_lock:
-                if self._sock is sock:
-                    self._teardown(LightserveUnavailable(str(exc)))
-            raise LightserveUnavailable(
-                f"lightserve send failed: {exc}") from exc
-        return w
+            if isinstance(exc, OSError):
+                with self._conn_lock:
+                    if self._sock is sock:
+                        self._teardown(LightserveUnavailable(str(exc)))
+                raise LightserveUnavailable(
+                    f"lightserve send failed: {exc}") from exc
+            raise
 
     def _await(self, rid: int, w: _Waiter, deadline_s: float):
         if not w.event.wait(deadline_s):

@@ -45,11 +45,14 @@ class PendingSync:
     __slots__ = ("client_id", "target_height", "trusted_height",
                  "trusted_hash", "now_ns", "deadline", "enqueued_at",
                  "done", "status", "hops", "dispatches", "cache_hit",
-                 "error", "failure", "dispatch_id", "coalesced")
+                 "error", "failure", "dispatch_id", "coalesced",
+                 "on_done")
 
     def __init__(self, client_id: str, target_height: int,
                  trusted_height: int, trusted_hash: bytes, now_ns: int,
-                 deadline: Optional[float]):
+                 deadline: Optional[float],
+                 on_done: Optional[Callable[["PendingSync"], None]]
+                 = None):
         self.client_id = client_id
         self.target_height = target_height
         self.trusted_height = trusted_height
@@ -66,9 +69,23 @@ class PendingSync:
         self.failure = ""          # "" | "expired" | "engine" | "stopped"
         self.dispatch_id = 0
         self.coalesced = 0
+        # completion hook: invoked exactly once, AFTER done is set, on
+        # whichever coalescer thread finished the session. Keep it
+        # cheap/non-blocking (the server hands off to a reply pool).
+        self.on_done = on_done
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self.done.wait(timeout)
+
+    def finish(self) -> None:
+        """Mark the session complete and fire its completion hook."""
+        self.done.set()
+        cb, self.on_done = self.on_done, None   # once, ever
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception:  # noqa: BLE001 — a reply-path bug must
+                pass           # not wedge the coalescer thread
 
 
 class SyncCoalescer:
@@ -115,22 +132,27 @@ class SyncCoalescer:
         for req in leftovers:
             req.error = "coalescer stopped"
             req.failure = "stopped"
-            req.done.set()
+            req.finish()
 
     # --- client side ---
 
     def submit(self, client_id: str, target_height: int,
                trusted_height: int, trusted_hash: bytes, now_ns: int,
-               deadline_s: Optional[float] = None) -> PendingSync:
+               deadline_s: Optional[float] = None,
+               on_done: Optional[Callable[[PendingSync], None]] = None
+               ) -> PendingSync:
         """Enqueue; returns a waitable :class:`PendingSync`. Raises
-        :class:`Overloaded` when the session backlog is full."""
+        :class:`Overloaded` when the session backlog is full. Every
+        admitted session's ``on_done`` hook fires exactly once — on
+        resolve, slice failure, deadline lapse, or coalescer stop."""
         from tmtpu.libs import metrics as _m
 
         req = PendingSync(
             client_id, target_height, trusted_height, trusted_hash,
             now_ns,
             None if deadline_s is None
-            else time.monotonic() + deadline_s)
+            else time.monotonic() + deadline_s,
+            on_done)
         with self._cond:
             if not self._running:
                 raise Overloaded("coalescer not running")
@@ -236,7 +258,7 @@ class SyncCoalescer:
             if req.deadline is not None and now > req.deadline:
                 req.error = "deadline expired before resolve"
                 req.failure = "expired"
-                req.done.set()
+                req.finish()
             else:
                 live.append(req)
         if not live:
@@ -244,9 +266,10 @@ class SyncCoalescer:
         with self._lock:
             self._resolve_seq += 1
             resolve_id = self._resolve_seq
-        # the joint resolve judges expiry at the most advanced clock any
-        # waiting session presented — conservative: never serves a fact
-        # some coalesced session would have to refuse
+        # the joint resolve judges expiry at the newest admission
+        # stamp. Every now_ns is SERVER-stamped at admission (the
+        # server never forwards a client clock here), so the max is
+        # simply the most recent server-clock reading in the batch.
         now_ns = max(req.now_ns for req in live)
         t0 = time.perf_counter()
         try:
@@ -256,7 +279,7 @@ class SyncCoalescer:
             for req in live:
                 req.error = f"resolve engine failed: {exc}"
                 req.failure = "engine"
-                req.done.set()
+                req.finish()
             return
         dt = time.perf_counter() - t0
         self.scheduler.note_dispatch(len(live), dt)
@@ -270,4 +293,4 @@ class SyncCoalescer:
             except Exception as exc:  # noqa: BLE001
                 req.error = f"slice failed: {exc}"
                 req.failure = "engine"
-            req.done.set()
+            req.finish()

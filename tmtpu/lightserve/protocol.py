@@ -94,7 +94,10 @@ class SyncRequest(ProtoMessage):
         (2, "trusted_height", "uint64"),
         (3, "trusted_hash", "bytes"),
         (4, "target_height", "uint64"),      # 0 = server's latest
-        # 0 = server clock; tests pin it to exercise trust-period expiry
+        # the client's wall clock, ONLY a skew check: the server
+        # refuses bad_request when it strays past max_client_skew_ns,
+        # but trust expiry is always judged on the SERVER clock (a
+        # client value must never evict shared cache facts). 0 = skip.
         (5, "now_ns", "uint64"),
     ]
 

@@ -11,7 +11,7 @@ Request path, cheapest first:
 
 1. **Cache hit** — the target height's fact is cached and inside the
    trusting period: answered INLINE on the connection thread (no
-   coalescer, no reply thread), hop chain cut from parent pointers.
+   coalescer, no reply pool), hop chain cut from parent pointers.
    This is the path that must hold at 10k+ concurrent sessions.
 2. **Joint resolve** — cold target: the session queues in the
    height-keyed :class:`~tmtpu.lightserve.coalescer.SyncCoalescer`;
@@ -25,6 +25,15 @@ Request path, cheapest first:
    fact is NOT re-cached — it is expired by definition and would only
    be refused again — so each request for a lapsed height pays its own
    re-verification.
+
+Trust expiry is judged on the SERVER clock, always. A client's
+``SyncRequest.now_ns`` is only checked against the server clock
+(rejected ``bad_request`` past ``max_client_skew_ns``) — it is never
+used for cache reads/evictions or joint resolves, because the shared
+cache and every coalesced peer would otherwise be at the mercy of one
+unauthenticated client's clock. Cold sessions are answered by a small
+fixed reply pool fed by coalescer completion hooks, not by
+per-session threads.
 
 Introspection mirrors the sidecar daemon: ``Ping``/``StatsRequest`` on
 the protocol socket, optional HTTP ``/healthz`` (verdict from
@@ -40,10 +49,11 @@ from __future__ import annotations
 
 import json
 import os
+import queue
 import socket
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from tmtpu.light import provider as prov
 from tmtpu.light import verifier
@@ -90,6 +100,56 @@ class Resolution:
         self.hops_override = hops_override
 
 
+class _ReplyPool:
+    """Bounded pool of reply senders for cold sessions.
+
+    Cold sessions complete on the coalescer thread, which must never
+    block on a slow client socket; per-session reply threads (the old
+    shape) explode at high cold-session volume and die in
+    ``Thread.start``. Instead the coalescer's ``on_done`` hook enqueues
+    the finished session here and a FIXED set of workers drains the
+    queue. The queue itself needs no cap: each admitted session
+    enqueues at most one job, and admission is already bounded by the
+    coalescer's ``max_queue_sessions``."""
+
+    def __init__(self, workers: int):
+        self.workers = workers
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._threads: List[threading.Thread] = []
+
+    def start(self) -> None:
+        if self._threads:
+            return
+        for i in range(self.workers):
+            t = threading.Thread(target=self._run,
+                                 name=f"lightserve-reply-{i}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        # sentinels queue BEHIND any leftover failure replies the
+        # coalescer enqueued during its own stop, so those still drain
+        for _ in self._threads:
+            self._q.put(None)
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._threads = []
+
+    def put(self, job) -> None:
+        self._q.put(job)
+
+    def _run(self) -> None:
+        while True:
+            job = self._q.get()
+            if job is None:
+                return
+            try:
+                job()
+            except Exception:  # noqa: BLE001 — one bad reply must not
+                pass           # kill the worker
+
+
 class LightserveServer:
     def __init__(self, addr: str, provider: prov.Provider,
                  trust_options: TrustOptions, chain_id: str, *,
@@ -106,7 +166,10 @@ class LightserveServer:
                  server_id: str = "",
                  hit_rate_floor: float = 0.5,
                  hit_rate_min_lookups: int = 64,
-                 backlog_ceiling: int = 4096):
+                 backlog_ceiling: int = 4096,
+                 max_client_skew_ns: int = 10_000_000_000,
+                 reply_workers: int = 8,
+                 clock: Callable[[], int] = time.time_ns):
         from tmtpu.libs.db import MemDB
 
         trust_options.validate_basic()
@@ -132,6 +195,15 @@ class LightserveServer:
         self._hit_rate_floor = hit_rate_floor
         self._hit_rate_min_lookups = hit_rate_min_lookups
         self._backlog_ceiling = backlog_ceiling
+        # the SERVER clock is the only expiry clock: it drives every
+        # cache read/eviction and joint-resolve decision. A client's
+        # now_ns is only a skew CHECK (see _handle_sync), never an
+        # input — an unauthenticated far-future clock must not be able
+        # to evict shared facts or poison coalesced peers. Injectable
+        # for tests (the only supported way to pin time).
+        self._clock = clock
+        self._max_client_skew_ns = max_client_skew_ns
+        self._reply_pool = _ReplyPool(max(1, reply_workers))
         self.coalescer = SyncCoalescer(
             self._resolve, self._slice,
             max_queue_sessions=max_queue_sessions)
@@ -173,7 +245,7 @@ class LightserveServer:
         from tmtpu.libs import metrics as _m
         from tmtpu.types import commit_verify
 
-        now_ns = now_ns if now_ns is not None else time.time_ns()
+        now_ns = now_ns if now_ns is not None else self._clock()
         lb = self._fetch(self.trust_options.height)
         if lb.header.hash() != self.trust_options.hash:
             raise verifier.LightError(
@@ -198,7 +270,7 @@ class LightserveServer:
     def update_to_latest(self, now_ns: Optional[int] = None) -> int:
         """Advance the spine to the provider's tip (one joint-style
         resolve, same dispatch accounting). Returns the new tip height."""
-        now_ns = now_ns if now_ns is not None else time.time_ns()
+        now_ns = now_ns if now_ns is not None else self._clock()
         tip = self._fetch(None)
         if tip.height() > self.latest_height():
             res = self._resolve(tip.height(), now_ns)
@@ -416,6 +488,7 @@ class LightserveServer:
         self._listener = sock
         self._running = True
         self._started_at = time.monotonic()
+        self._reply_pool.start()
         self.coalescer.start()
         from tmtpu.libs import watchdog as _wd
 
@@ -471,7 +544,11 @@ class LightserveServer:
                 c.close()
             except OSError:
                 pass
+        # coalescer first: its stop() finishes leftover sessions, whose
+        # on_done hooks enqueue failure replies the pool then drains
+        # ahead of its shutdown sentinels
         self.coalescer.stop()
+        self._reply_pool.stop()
         if self._health_httpd is not None:
             try:
                 self._health_httpd.shutdown()
@@ -690,11 +767,26 @@ class LightserveServer:
             reject(proto.STATUS_BAD_REQUEST,
                    "no target height (spine empty and none requested)")
             return
-        now_ns = req.now_ns or time.time_ns()
+        # THE expiry clock is the server's. The client's now_ns is a
+        # skew CHECK only: a clock too far from ours would judge our
+        # proofs under a different trusting-period window, so refuse
+        # loudly — but never let an unauthenticated value drive cache
+        # eviction or the joint resolve (a far-future clock would evict
+        # fresh shared facts and expire every coalesced peer; a
+        # far-past one would bypass trusting-period safety).
+        now_ns = self._clock()
+        if req.now_ns:
+            skew = req.now_ns - now_ns
+            if abs(skew) > self._max_client_skew_ns:
+                reject(proto.STATUS_BAD_REQUEST,
+                       f"client clock skew {skew}ns exceeds "
+                       f"±{self._max_client_skew_ns}ns; fix the client "
+                       f"clock (the server clock judges trust expiry)")
+                return
         ps = PendingSync(client_id, target, req.trusted_height,
                          bytes(req.trusted_hash), now_ns, None)
         # fast path: fresh cached fact — answered inline on the
-        # connection thread, no coalescer, no reply thread. This is the
+        # connection thread, no coalescer, no reply pool. This is the
         # only path that can hold 10k+ concurrent sessions.
         fact = self.cache.get(target, now_ns)
         if fact is not None:
@@ -703,30 +795,29 @@ class LightserveServer:
                                        cache_hit=True))
             self._reply_sync(send, req.request_id, ps, t0)
             return
-        # cold path: ride the height-keyed coalescer
+
+        # cold path: ride the height-keyed coalescer. The reply is sent
+        # by the bounded reply pool when the coalescer finishes the
+        # session (its on_done hook fires exactly once for every
+        # admitted session — resolve, failure, deadline, or stop — so
+        # no per-session thread and no unanswered session). A wedged
+        # upstream is bounded by the provider's own timeouts plus the
+        # client-side request deadline.
+        request_id = req.request_id
+
+        def on_done(pending: PendingSync) -> None:
+            self._reply_pool.put(
+                lambda: self._reply_sync(send, request_id, pending, t0))
+
         try:
-            pending = self.coalescer.submit(
+            self.coalescer.submit(
                 client_id, target, req.trusted_height,
                 bytes(req.trusted_hash), now_ns,
-                deadline_s=self._default_deadline_s)
+                deadline_s=self._default_deadline_s,
+                on_done=on_done)
         except Overloaded as exc:
             reject(proto.STATUS_OVERLOADED, str(exc))
             return
-
-        def finish() -> None:
-            if not pending.wait(self._default_deadline_s + 5.0):
-                try:
-                    reject(proto.STATUS_UPSTREAM_DOWN,
-                           "resolve wedged past deadline")
-                except OSError:
-                    pass
-                return
-            self._reply_sync(send, req.request_id, pending, t0)
-
-        # answer off-thread so the connection keeps reading — one client
-        # can pipeline many request_ids and they coalesce with each other
-        threading.Thread(target=finish, name="lightserve-reply",
-                         daemon=True).start()
 
     # --- health HTTP --------------------------------------------------------
 

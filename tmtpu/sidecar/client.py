@@ -224,6 +224,7 @@ class SidecarClient:
         w = _Waiter()
         with self._waiters_lock:
             self._waiters[rid] = w
+        sock = None
         try:
             data = proto.encode_frame(msg)
             sock = self._sock
@@ -231,14 +232,18 @@ class SidecarClient:
                 raise SidecarUnavailable("sidecar not connected")
             with self._wlock:
                 sock.sendall(data)
-        except OSError as exc:
+        except BaseException as exc:
+            # every failure path must unregister the waiter, including
+            # the sock-is-None raise (else a connect race leaks it)
             with self._waiters_lock:
                 self._waiters.pop(rid, None)
-            with self._conn_lock:
-                if self._sock is sock:
-                    self._teardown(SidecarUnavailable(str(exc)))
-            raise SidecarUnavailable(
-                f"sidecar send failed: {exc}") from exc
+            if isinstance(exc, OSError):
+                with self._conn_lock:
+                    if self._sock is sock:
+                        self._teardown(SidecarUnavailable(str(exc)))
+                raise SidecarUnavailable(
+                    f"sidecar send failed: {exc}") from exc
+            raise
         if not w.event.wait(deadline_s):
             with self._waiters_lock:
                 self._waiters.pop(rid, None)
